@@ -1,0 +1,5 @@
+from hetu_tpu.core.dtypes import Policy, autocast, current_policy
+from hetu_tpu.core.mesh import make_mesh, local_devices
+from hetu_tpu.core import tree
+
+__all__ = ["Policy", "autocast", "current_policy", "make_mesh", "local_devices", "tree"]

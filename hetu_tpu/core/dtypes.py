@@ -1,0 +1,81 @@
+"""Mixed-precision policy — the TPU-native answer to Hetu's autocast.
+
+The reference implements AMP as a graph pass that inserts cast ops
+(``hetu/graph/autocast/autocast.h:17``) plus a ``GradScaler`` driven by
+``CheckFinite``/``UpdateScale`` CUDA kernels. On TPU the idiomatic design is a
+*dtype policy* threaded through module application: params live in fp32,
+compute runs in bf16 (MXU-native), outputs/losses in fp32. No loss scaling is
+needed for bf16; an optional fp16 ``GradScaler`` lives in
+``hetu_tpu.optim.scaler`` for parity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype policy: how params are stored, compute is done, outputs returned."""
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    def cast_to_compute(self, x):
+        return _tree_cast(x, self.compute_dtype)
+
+    def cast_to_param(self, x):
+        return _tree_cast(x, self.param_dtype)
+
+    def cast_to_output(self, x):
+        return _tree_cast(x, self.output_dtype)
+
+
+def _tree_cast(x, dtype):
+    import jax
+
+    def cast(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree.map(cast, x)
+
+
+#: default full-precision policy
+FP32 = Policy()
+#: bf16 compute policy — the standard TPU training configuration
+BF16_COMPUTE = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+                      output_dtype=jnp.float32)
+#: fully bf16 (params too) — for inference / memory-bound cases
+BF16_FULL = Policy(param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16,
+                   output_dtype=jnp.bfloat16)
+
+_state = threading.local()
+
+
+def current_policy() -> Policy:
+    return getattr(_state, "policy", FP32)
+
+
+@contextlib.contextmanager
+def autocast(policy: Policy | str = BF16_COMPUTE):
+    """Context manager mirroring ``hetu.autocast`` (reference context.py:153).
+
+    Inside the context, modules pick up ``current_policy()`` as their default
+    compute dtype.
+    """
+    if isinstance(policy, str):
+        policy = {"fp32": FP32, "bf16": BF16_COMPUTE, "bf16_full": BF16_FULL}[policy]
+    prev = getattr(_state, "policy", FP32)
+    _state.policy = policy
+    try:
+        yield policy
+    finally:
+        _state.policy = prev

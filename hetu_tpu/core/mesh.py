@@ -1,0 +1,53 @@
+"""Device mesh helpers.
+
+Replaces the reference's ``Device``/``DeviceGroup`` identity layer
+(``hetu/core/device.h:56,228``) and the gRPC rank-bootstrap
+(``hetu/impl/communication/comm_group.h:217-229``): on TPU, device identity
+and topology come from the XLA runtime, and all parallelism is expressed over
+a ``jax.sharding.Mesh`` whose named axes carry the strategy's dp/cp/tp/pp/ep
+degrees.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+# Canonical mesh-axis names used across the framework. Order matters: the
+# leading axes change slowest across the physical device order, so axes whose
+# collectives need the most bandwidth (tp) are placed innermost, riding ICI
+# neighbours.
+AXIS_DP = "dp"      # data parallel (also ZeRO shard axis)
+AXIS_PP = "pp"      # pipeline stages
+AXIS_CP = "cp"      # context parallel (ring attention / sequence)
+AXIS_EP = "ep"      # expert parallel (MoE all-to-all)
+AXIS_TP = "tp"      # tensor parallel (Megatron-style)
+
+DEFAULT_AXIS_ORDER = (AXIS_DP, AXIS_PP, AXIS_CP, AXIS_TP)
+
+
+def local_devices(platform: str | None = None):
+    return jax.devices(platform) if platform else jax.devices()
+
+
+def make_mesh(shape: dict[str, int] | Sequence[int],
+              axis_names: Sequence[str] | None = None,
+              devices=None) -> Mesh:
+    """Build a Mesh from ``{axis: degree}`` (axes with degree 1 are kept so
+    specs can always name them)."""
+    if isinstance(shape, dict):
+        axis_names = tuple(shape.keys())
+        dims = tuple(shape.values())
+    else:
+        dims = tuple(shape)
+        axis_names = tuple(axis_names or DEFAULT_AXIS_ORDER[: len(dims)])
+    devices = devices if devices is not None else jax.devices()
+    n = int(np.prod(dims))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh shape {dims} needs {n} devices, have {len(devices)}")
+    dev_array = np.asarray(devices[:n]).reshape(dims)
+    return Mesh(dev_array, axis_names)

@@ -1,0 +1,65 @@
+"""Pytree path utilities — flatten nested param dicts to dotted names.
+
+Replaces the reference's subgraph/module-path bookkeeping
+(``hetu/graph/subgraph.h:36``) and the safetensors key mapping in
+``python/hetu/utils/checkpoint/ht_safetensors.py``: params are plain nested
+dicts, and checkpoints / sharding rules address them by dotted path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+import jax
+
+
+def flatten_with_paths(tree: Any, sep: str = ".") -> dict[str, Any]:
+    """Flatten a nested dict/list pytree into ``{"a.b.0.w": leaf}``."""
+    out: dict[str, Any] = {}
+
+    def rec(prefix, node):
+        if isinstance(node, Mapping):
+            for k in node:
+                rec(f"{prefix}{sep}{k}" if prefix else str(k), node[k])
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                rec(f"{prefix}{sep}{i}" if prefix else str(i), v)
+        else:
+            out[prefix] = node
+
+    rec("", tree)
+    return out
+
+
+def unflatten_from_paths(flat: Mapping[str, Any], sep: str = ".") -> Any:
+    """Inverse of :func:`flatten_with_paths` (lists come back as dicts keyed
+    by stringified index; fine for params which are dict-only)."""
+    root: dict = {}
+    for path, leaf in flat.items():
+        parts = path.split(sep)
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return root
+
+
+def map_with_path(fn: Callable[[str, Any], Any], tree: Any) -> Any:
+    """Map ``fn(path, leaf)`` over a pytree, preserving structure."""
+    paths_leaves = jax.tree_util.tree_flatten_with_path(tree)
+    leaves, treedef = paths_leaves
+    new_leaves = []
+    for key_path, leaf in leaves:
+        path = ".".join(_key_str(k) for k in key_path)
+        new_leaves.append(fn(path, leaf))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    if hasattr(k, "name"):
+        return str(k.name)
+    return str(k)

@@ -1,0 +1,15 @@
+from hetu_tpu.nn.module import (
+    Module, ParamSpec, Sequential,
+    zeros_init, ones_init, constant_init, normal_init, uniform_init,
+    xavier_uniform_init, kaiming_uniform_init,
+)
+from hetu_tpu.nn.layers import (
+    Linear, Embedding, LayerNorm, RMSNorm, Dropout, MLP,
+)
+
+__all__ = [
+    "Module", "ParamSpec", "Sequential",
+    "zeros_init", "ones_init", "constant_init", "normal_init",
+    "uniform_init", "xavier_uniform_init", "kaiming_uniform_init",
+    "Linear", "Embedding", "LayerNorm", "RMSNorm", "Dropout", "MLP",
+]

@@ -1,0 +1,121 @@
+"""Basic layers: Linear, Embedding, norms, Dropout, MLP.
+
+Covers the dense end of the reference's op library (``hetu/graph/ops/``:
+Linear/MatMul, LayerNorm/RMSNorm via fused kernels ``impl/kernel/RMSNorm.cu``,
+``FusedLayerNorm.cu``, embedding lookup) as idiomatic JAX modules. Norms call
+into ``hetu_tpu.ops.normalization`` so a fused Pallas path can slot in
+underneath without touching model code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.nn.module import (
+    Module, normal_init, zeros_init, ones_init, kaiming_uniform_init,
+)
+from hetu_tpu.ops import normalization as norm_ops
+
+
+class Linear(Module):
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 init=None, axes: Sequence[Optional[str]] = (None, None)):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.param("weight", (in_features, out_features),
+                   init or kaiming_uniform_init(), axes=axes)
+        if bias:
+            self.param("bias", (out_features,), zeros_init(), axes=(axes[1],))
+
+    def __call__(self, params, x):
+        dt = self.compute_dtype()
+        y = jnp.matmul(x.astype(dt), params["weight"].astype(dt))
+        if self.use_bias:
+            y = y + params["bias"].astype(dt)
+        return y
+
+
+class Embedding(Module):
+    def __init__(self, num_embeddings: int, features: int, init=None,
+                 axes: Sequence[Optional[str]] = (None, None)):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.features = features
+        self.param("weight", (num_embeddings, features),
+                   init or normal_init(0.02), axes=axes)
+
+    def __call__(self, params, ids):
+        return jnp.take(params["weight"], ids, axis=0).astype(
+            self.compute_dtype())
+
+
+class LayerNorm(Module):
+    def __init__(self, features: int, eps: float = 1e-5,
+                 use_bias: bool = True, use_scale: bool = True,
+                 axes: Sequence[Optional[str]] = (None,)):
+        super().__init__()
+        self.features = features
+        self.eps = eps
+        self.use_bias = use_bias
+        self.use_scale = use_scale
+        if use_scale:
+            self.param("scale", (features,), ones_init(), axes=axes)
+        if use_bias:
+            self.param("bias", (features,), zeros_init(), axes=axes)
+
+    def __call__(self, params, x):
+        scale = params["scale"] if self.use_scale else None
+        bias = params["bias"] if self.use_bias else None
+        return norm_ops.layer_norm(x, scale, bias, eps=self.eps).astype(
+            self.compute_dtype())
+
+
+class RMSNorm(Module):
+    def __init__(self, features: int, eps: float = 1e-6,
+                 axes: Sequence[Optional[str]] = (None,)):
+        super().__init__()
+        self.features = features
+        self.eps = eps
+        self.param("scale", (features,), ones_init(), axes=axes)
+
+    def __call__(self, params, x):
+        return norm_ops.rms_norm(x, params["scale"], eps=self.eps).astype(
+            self.compute_dtype())
+
+
+class Dropout(Module):
+    def __init__(self, rate: float):
+        super().__init__()
+        self.rate = rate
+
+    def __call__(self, params, x, *, rng: Optional[jax.Array] = None,
+                 deterministic: bool = True):
+        if deterministic or self.rate == 0.0:
+            return x
+        if rng is None:
+            raise ValueError("Dropout needs an rng when not deterministic")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class MLP(Module):
+    """Plain 2-layer MLP (GELU) — GPT-2 style."""
+
+    def __init__(self, features: int, hidden: int, bias: bool = True,
+                 activation=jax.nn.gelu):
+        super().__init__()
+        self.fc_in = Linear(features, hidden, bias=bias,
+                            init=normal_init(0.02), axes=("embed", "mlp"))
+        self.fc_out = Linear(hidden, features, bias=bias,
+                             init=normal_init(0.02), axes=("mlp", "embed"))
+        self.activation = activation
+
+    def __call__(self, params, x):
+        h = self.activation(self.fc_in(params["fc_in"], x))
+        return self.fc_out(params["fc_out"], h)

@@ -1,0 +1,18 @@
+from hetu_tpu.ops.normalization import rms_norm, layer_norm
+from hetu_tpu.ops.activations import swiglu, gelu, silu, relu, quick_gelu
+from hetu_tpu.ops.rotary import rope_frequencies, apply_rotary
+from hetu_tpu.ops.losses import (
+    softmax_cross_entropy,
+    cross_entropy_mean,
+    vocab_parallel_cross_entropy,
+)
+from hetu_tpu.ops.attention import attention_reference, flash_attention
+
+__all__ = [
+    "rms_norm", "layer_norm",
+    "swiglu", "gelu", "silu", "relu", "quick_gelu",
+    "rope_frequencies", "apply_rotary",
+    "softmax_cross_entropy", "cross_entropy_mean",
+    "vocab_parallel_cross_entropy",
+    "attention_reference", "flash_attention",
+]

@@ -1,0 +1,27 @@
+"""Activation ops — the fused-unary family of the reference
+(``hetu/impl/kernel/FusedUnary.cu``, ``SwiGLU.cu``). XLA fuses these into the
+adjacent matmuls on TPU; swiglu is kept as one function so a Pallas fusion can
+replace it transparently.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def swiglu(gate, up):
+    """SwiGLU combine: silu(gate) * up (reference SwiGLU.cu semantics)."""
+    return jax.nn.silu(gate) * up
+
+
+def gelu(x, approximate: bool = True):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+silu = jax.nn.silu
+relu = jax.nn.relu
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)

@@ -1,0 +1,104 @@
+"""Attention ops: reference implementation + dispatch to the Pallas flash
+kernel on TPU.
+
+Replaces the reference's FlashAttention wrapper
+(``hetu/impl/kernel/FlashAttention.cu`` over vendored ``third_party/
+flash_attn``) and the cp=1 path of ``ParallelAttentionOp``
+(``hetu/graph/ops/ParallelAttention.h:711``). Packing/varlen is expressed via
+``segment_ids`` (the TPU-native formulation) instead of cu_seqlens.
+
+Layout convention everywhere: (batch, seq, num_heads, head_dim), GQA allowed
+(kv heads divide q heads).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _expand_kv(k, num_q_heads):
+    """Repeat kv heads to match q heads for GQA in the reference path."""
+    kv_heads = k.shape[-2]
+    if kv_heads == num_q_heads:
+        return k
+    rep = num_q_heads // kv_heads
+    return jnp.repeat(k, rep, axis=-2)
+
+
+def attention_reference(q, k, v, *, causal: bool = False,
+                        segment_ids: Optional[jnp.ndarray] = None,
+                        kv_segment_ids: Optional[jnp.ndarray] = None,
+                        scale: Optional[float] = None,
+                        return_lse: bool = False,
+                        q_offset: int | jnp.ndarray = 0,
+                        kv_offset: int | jnp.ndarray = 0):
+    """Pure-jnp attention oracle, fp32 softmax.
+
+    ``q_offset``/``kv_offset`` shift the absolute positions used by the causal
+    mask — needed when q/kv are chunks of a longer sequence (ring attention).
+    """
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    k = _expand_kv(k, hq)
+    v = _expand_kv(v, hq)
+    qf = q.astype(jnp.float32) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+
+    mask = jnp.ones((b, 1, sq, sk), dtype=bool)
+    if causal:
+        qpos = jnp.arange(sq)[:, None] + q_offset
+        kpos = jnp.arange(sk)[None, :] + kv_offset
+        mask = mask & (qpos >= kpos)[None, None]
+    if segment_ids is not None:
+        kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        mask = mask & (segment_ids[:, None, :, None] == kv_seg[:, None, None, :])
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    lse = jax.nn.logsumexp(logits, axis=-1)  # (b, h, q)
+    # rows that are fully masked (can happen in ring hops) produce 0 output
+    probs = jnp.exp(logits - lse[..., None])
+    probs = jnp.where(mask, probs, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    out = out.astype(q.dtype)
+    if return_lse:
+        return out, lse
+    return out
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    segment_ids: Optional[jnp.ndarray] = None,
+                    scale: Optional[float] = None,
+                    impl: str = "auto"):
+    """Dispatch: Pallas flash kernel on TPU, reference elsewhere.
+
+    ``impl``: "auto" | "pallas" | "reference".
+    """
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() and _pallas_supported(q, k) else "reference"
+    if impl == "pallas":
+        from hetu_tpu.ops.flash_pallas import flash_attention_pallas
+        return flash_attention_pallas(q, k, v, causal=causal,
+                                      segment_ids=segment_ids, scale=scale)
+    return attention_reference(q, k, v, causal=causal,
+                               segment_ids=segment_ids, scale=scale)
+
+
+@functools.cache
+def _on_tpu() -> bool:
+    try:
+        plat = jax.default_backend()
+        return plat in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _pallas_supported(q, k) -> bool:
+    d = q.shape[-1]
+    return d in (64, 128, 256) and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0

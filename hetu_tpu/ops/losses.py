@@ -1,0 +1,71 @@
+"""Loss ops: softmax cross-entropy and tensor-parallel (vocab-sharded) CE.
+
+Equivalent of the reference's ``SoftmaxCrossEntropy`` ops and
+``hetu/impl/kernel/VocabParallelCrossEntropyLoss.cu`` (+ the graph op
+``hetu/graph/ops/VocabParallelCrossEntropyLoss.*``). The vocab-parallel
+variant runs inside ``shard_map`` with the vocabulary dimension sharded over
+the ``tp`` mesh axis: local max / sum-exp / target-logit gather are combined
+with ``psum`` so no rank ever materializes the full-vocab logits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits, labels, ignore_index: int = -100):
+    """Token-level CE. logits (..., V) fp any; labels (...,) int.
+
+    Returns per-token loss with ignored positions zeroed, plus the valid mask.
+    """
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(
+        logits, safe_labels[..., None], axis=-1).squeeze(-1)
+    loss = (lse - tgt) * valid
+    return loss, valid
+
+
+def cross_entropy_mean(logits, labels, ignore_index: int = -100):
+    loss, valid = softmax_cross_entropy(logits, labels, ignore_index)
+    denom = jnp.maximum(valid.sum(), 1)
+    return loss.sum() / denom
+
+
+def vocab_parallel_cross_entropy(local_logits, labels, *, axis_name: str,
+                                 vocab_start: jnp.ndarray | int,
+                                 ignore_index: int = -100):
+    """Per-token CE over vocabulary sharded along ``axis_name``.
+
+    Must be called inside ``shard_map``. ``local_logits``: (..., V_local);
+    ``labels``: (...,) global vocab ids; ``vocab_start``: this shard's global
+    offset (``axis_index * V_local``).
+
+    Numerics mirror the reference kernel: global max via psum-of-masked-max is
+    replaced by ``pmax``; sum-exp and target-logit are ``psum``-ed.
+    """
+    logits = local_logits.astype(jnp.float32)
+    v_local = logits.shape[-1]
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+
+    local_max = jnp.max(logits, axis=-1)
+    global_max = jax.lax.pmax(local_max, axis_name)
+    shifted = logits - global_max[..., None]
+    sum_exp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
+
+    # target logit: only the owning shard contributes
+    local_ids = safe_labels - vocab_start
+    in_shard = (local_ids >= 0) & (local_ids < v_local)
+    clipped = jnp.clip(local_ids, 0, v_local - 1)
+    tgt_local = jnp.take_along_axis(
+        shifted, clipped[..., None], axis=-1).squeeze(-1)
+    tgt = jax.lax.psum(jnp.where(in_shard, tgt_local, 0.0), axis_name)
+
+    loss = (jnp.log(sum_exp) - tgt) * valid
+    return loss, valid

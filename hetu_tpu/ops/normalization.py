@@ -1,0 +1,42 @@
+"""Normalization ops.
+
+Equivalent of the reference's fused norm kernels
+(``hetu/impl/kernel/RMSNorm.cu``, ``FusedLayerNorm.cu``). On TPU, XLA fuses
+the reduction+scale chain into surrounding ops well, so the default path is
+plain jnp with fp32 statistics; a Pallas fused variant can be slotted in here
+if profiling shows a win.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def rms_norm(x, scale, eps: float = 1e-6):
+    """RMSNorm with fp32 statistics regardless of input dtype."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * _rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def layer_norm(x, scale: Optional[jnp.ndarray], bias: Optional[jnp.ndarray],
+               eps: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * _rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _rsqrt(v):
+    import jax.lax as lax
+    return lax.rsqrt(v)

@@ -1,0 +1,22 @@
+from hetu_tpu.optim.base import (
+    Transform, chain, apply_updates, identity, scale, scale_by_schedule,
+    add_decayed_weights,
+)
+from hetu_tpu.optim.optimizers import sgd, adam, adamw, scale_by_adam, trace
+from hetu_tpu.optim.schedules import (
+    constant, linear_warmup, cosine_decay, linear_decay,
+)
+from hetu_tpu.optim.clipping import clip_by_global_norm, global_norm
+from hetu_tpu.optim.scaler import (
+    ScalerState, init_scaler, scale_loss, unscale_and_check, update_scaler,
+)
+
+__all__ = [
+    "Transform", "chain", "apply_updates", "identity", "scale",
+    "scale_by_schedule", "add_decayed_weights",
+    "sgd", "adam", "adamw", "scale_by_adam", "trace",
+    "constant", "linear_warmup", "cosine_decay", "linear_decay",
+    "clip_by_global_norm", "global_norm",
+    "ScalerState", "init_scaler", "scale_loss", "unscale_and_check",
+    "update_scaler",
+]

@@ -1,0 +1,92 @@
+"""Optimizer core — gradient-transformation style with shardable state.
+
+The reference implements optimizers as fused CUDA update ops inserted into
+the graph (``hetu/graph/ops/optimizer_update.h:9-130``, kernels
+``impl/kernel/Optimizers.cu``) behind ``Optimizer::Minimize``. Here an
+optimizer is a pure ``(init, update)`` pair over pytrees (optax-compatible
+shape); fused-update performance comes from jit + buffer donation rather
+than hand-written kernels. Optimizer state mirrors the param pytree so ZeRO
+sharding is just "apply a spec tree to the state" (``hetu_tpu.parallel.zero``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class Transform(NamedTuple):
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Optional[Any]], tuple[Any, Any]]
+    # update(grads, state, params) -> (updates, new_state)
+
+
+def chain(*transforms: Transform) -> Transform:
+    def init(params):
+        return tuple(t.init(params) for t in transforms)
+
+    def update(grads, state, params=None):
+        new_state = []
+        for t, s in zip(transforms, state):
+            grads, s = t.update(grads, s, params)
+            new_state.append(s)
+        return grads, tuple(new_state)
+
+    return Transform(init, update)
+
+
+def apply_updates(params, updates):
+    return jax.tree.map(
+        lambda p, u: (p + u.astype(p.dtype)) if u is not None else p,
+        params, updates)
+
+
+def identity() -> Transform:
+    return Transform(lambda p: (), lambda g, s, p=None: (g, s))
+
+
+def scale(factor: float) -> Transform:
+    return Transform(
+        lambda p: (),
+        lambda g, s, p=None: (jax.tree.map(lambda x: x * factor, g), s))
+
+
+def scale_by_schedule(schedule: Callable[[jnp.ndarray], jnp.ndarray]) -> Transform:
+    def init(params):
+        return jnp.zeros([], jnp.int32)
+
+    def update(grads, count, params=None):
+        lr = schedule(count)
+        return (jax.tree.map(lambda g: -lr * g, grads), count + 1)
+
+    return Transform(init, update)
+
+
+def add_decayed_weights(weight_decay: float,
+                        mask: Optional[Callable[[str], bool]] = None) -> Transform:
+    """Decoupled weight decay (AdamW). ``mask(path)`` selects decayed params
+    (default: every param with ndim >= 2, i.e. skip norms/bias)."""
+    from hetu_tpu.core.tree import map_with_path
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("weight decay needs params")
+
+        def leaf(path, g):
+            p = _get_path(params, path)
+            use = mask(path) if mask is not None else (p.ndim >= 2)
+            return g + weight_decay * p.astype(g.dtype) if use else g
+
+        return map_with_path(leaf, grads), state
+
+    return Transform(lambda p: (), update)
+
+
+def _get_path(tree, path: str):
+    node = tree
+    for part in path.split("."):
+        node = node[part]
+    return node

@@ -1,0 +1,99 @@
+"""Concrete optimizers: SGD / Momentum / Adam / AdamW.
+
+Parity targets: the reference's fused update ops
+(``hetu/graph/ops/optimizer_update.h``: SGDUpdate, MomentumUpdate,
+AdamUpdate with step-count state) and Python wrappers (``python/hetu/optim``).
+State lives in fp32 regardless of param dtype (master weights pattern).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.optim.base import (
+    Transform, chain, scale_by_schedule, add_decayed_weights,
+)
+
+ScalarOrSchedule = Union[float, Callable]
+
+
+def _lr_transform(lr: ScalarOrSchedule) -> Transform:
+    if callable(lr):
+        return scale_by_schedule(lr)
+    return scale_by_schedule(lambda _: jnp.asarray(lr, jnp.float32))
+
+
+class MomentumState(NamedTuple):
+    velocity: jnp.ndarray  # pytree
+
+
+def trace(momentum: float, nesterov: bool = False) -> Transform:
+    def init(params):
+        return MomentumState(jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params))
+
+    def update(grads, state, params=None):
+        v = jax.tree.map(
+            lambda g, v: momentum * v + g.astype(jnp.float32),
+            grads, state.velocity)
+        out = jax.tree.map(
+            lambda g, vv: g.astype(jnp.float32) + momentum * vv, grads, v
+        ) if nesterov else v
+        return out, MomentumState(v)
+
+    return Transform(init, update)
+
+
+class AdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: jnp.ndarray      # pytree
+    nu: jnp.ndarray      # pytree
+
+
+def scale_by_adam(b1: float = 0.9, b2: float = 0.999,
+                  eps: float = 1e-8) -> Transform:
+    def init(params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return AdamState(jnp.zeros([], jnp.int32),
+                         jax.tree.map(z, params), jax.tree.map(z, params))
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        mu = jax.tree.map(
+            lambda g, m: b1 * m + (1 - b1) * g.astype(jnp.float32),
+            grads, state.mu)
+        nu = jax.tree.map(
+            lambda g, n: b2 * n + (1 - b2) * jnp.square(g.astype(jnp.float32)),
+            grads, state.nu)
+        mu_hat_scale = 1.0 / (1 - b1 ** cf)
+        nu_hat_scale = 1.0 / (1 - b2 ** cf)
+        updates = jax.tree.map(
+            lambda m, n: (m * mu_hat_scale) / (jnp.sqrt(n * nu_hat_scale) + eps),
+            mu, nu)
+        return updates, AdamState(count, mu, nu)
+
+    return Transform(init, update)
+
+
+def sgd(lr: ScalarOrSchedule, momentum: float = 0.0,
+        nesterov: bool = False) -> Transform:
+    if momentum:
+        return chain(trace(momentum, nesterov), _lr_transform(lr))
+    return chain(_lr_transform(lr))
+
+
+def adam(lr: ScalarOrSchedule, b1: float = 0.9, b2: float = 0.999,
+         eps: float = 1e-8) -> Transform:
+    return chain(scale_by_adam(b1, b2, eps), _lr_transform(lr))
+
+
+def adamw(lr: ScalarOrSchedule, b1: float = 0.9, b2: float = 0.999,
+          eps: float = 1e-8, weight_decay: float = 0.01,
+          mask: Optional[Callable[[str], bool]] = None) -> Transform:
+    return chain(scale_by_adam(b1, b2, eps),
+                 add_decayed_weights(weight_decay, mask),
+                 _lr_transform(lr))

@@ -1,0 +1,49 @@
+"""fp16 gradient scaler — parity with the reference's ``GradScaler``
+(``hetu/graph/autocast/gradscaler.h:33`` + ``CheckFinite``/``UpdateScale``
+kernels). Rarely needed on TPU (bf16 has fp32's exponent range) but kept for
+API-complete fp16 support.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ScalerState(NamedTuple):
+    scale: jnp.ndarray
+    growth_tracker: jnp.ndarray
+
+
+def init_scaler(init_scale: float = 2.0 ** 16) -> ScalerState:
+    return ScalerState(jnp.asarray(init_scale, jnp.float32),
+                       jnp.zeros([], jnp.int32))
+
+
+def scale_loss(state: ScalerState, loss):
+    return loss * state.scale
+
+
+def unscale_and_check(state: ScalerState, grads):
+    """Unscale grads; return (grads, finite) where finite is a scalar bool."""
+    inv = 1.0 / state.scale
+    grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+    finite = jnp.array(True)
+    for g in jax.tree.leaves(grads):
+        finite = finite & jnp.all(jnp.isfinite(g))
+    return grads, finite
+
+
+def update_scaler(state: ScalerState, finite,
+                  growth_factor: float = 2.0, backoff_factor: float = 0.5,
+                  growth_interval: int = 2000) -> ScalerState:
+    tracker = jnp.where(finite, state.growth_tracker + 1, 0)
+    grow = tracker >= growth_interval
+    scale = jnp.where(
+        finite,
+        jnp.where(grow, state.scale * growth_factor, state.scale),
+        state.scale * backoff_factor)
+    tracker = jnp.where(grow, 0, tracker)
+    return ScalerState(scale, tracker)

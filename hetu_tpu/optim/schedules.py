@@ -1,0 +1,43 @@
+"""LR schedules — parity with the reference's
+``optim/optimizerParamScheduler.h`` (warmup + decay styles)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def constant(lr: float):
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def linear_warmup(lr: float, warmup_steps: int):
+    def f(step):
+        s = step.astype(jnp.float32)
+        w = jnp.minimum(1.0, (s + 1) / max(warmup_steps, 1))
+        return lr * w
+    return f
+
+
+def cosine_decay(lr: float, decay_steps: int, warmup_steps: int = 0,
+                 min_lr: float = 0.0):
+    def f(step):
+        s = step.astype(jnp.float32)
+        warm = jnp.minimum(1.0, (s + 1) / max(warmup_steps, 1)) \
+            if warmup_steps > 0 else 1.0
+        prog = jnp.clip((s - warmup_steps) / max(decay_steps - warmup_steps, 1),
+                        0.0, 1.0)
+        cos = 0.5 * (1 + jnp.cos(jnp.pi * prog))
+        return (min_lr + (lr - min_lr) * cos) * warm
+    return f
+
+
+def linear_decay(lr: float, decay_steps: int, warmup_steps: int = 0,
+                 min_lr: float = 0.0):
+    def f(step):
+        s = step.astype(jnp.float32)
+        warm = jnp.minimum(1.0, (s + 1) / max(warmup_steps, 1)) \
+            if warmup_steps > 0 else 1.0
+        prog = jnp.clip((s - warmup_steps) / max(decay_steps - warmup_steps, 1),
+                        0.0, 1.0)
+        return (min_lr + (lr - min_lr) * (1 - prog)) * warm
+    return f

@@ -1,0 +1,15 @@
+from hetu_tpu.parallel.strategy import Strategy, MESH_AXES
+from hetu_tpu.parallel.sharding import (
+    AxisRules,
+    param_partition_specs,
+    named_shardings,
+    shard_params,
+    constrain,
+    sharded_init,
+)
+
+__all__ = [
+    "Strategy", "MESH_AXES",
+    "AxisRules", "param_partition_specs", "named_shardings",
+    "shard_params", "constrain", "sharded_init",
+]

@@ -1,0 +1,105 @@
+"""Logical-axis → PartitionSpec compiler and sharding helpers.
+
+This is the TPU-native replacement for the reference's per-tensor
+``DistributedStates`` algebra (``hetu/graph/distributed_states.h:13``:
+``{dim→splits}``, ``-1`` duplicate, ``-2`` partial) and the ds-deduction pass
+(``DoDeduceStates``). Parameters declare *logical* axis names once (in their
+``ParamSpec``); an :class:`AxisRules` table maps those names to mesh axes per
+strategy. Partial-reduction states (ds ``-2``) have no explicit spec — they
+exist transiently inside ``shard_map`` blocks as pre-psum values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hetu_tpu.nn.module import Module, ParamSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisRules:
+    """Mapping from logical axis names to mesh axis names (or None)."""
+
+    rules: Mapping[str, Optional[str | tuple[str, ...]]]
+
+    def spec_for(self, axes: Sequence[Optional[str]],
+                 mesh: Optional[Mesh] = None,
+                 shape: Optional[Sequence[int]] = None) -> P:
+        """Build a PartitionSpec from per-dim logical names.
+
+        If ``mesh``+``shape`` are given, axes whose mesh degree does not
+        divide the dim size fall back to replication (mirrors the reference's
+        ds validity check ``states_can_be_split``).
+        """
+        parts = []
+        for i, name in enumerate(axes):
+            mesh_axis = self.rules.get(name) if name else None
+            if mesh_axis is not None and mesh is not None and shape is not None:
+                size = _axis_size(mesh, mesh_axis)
+                if size <= 1 or shape[i] % size != 0:
+                    mesh_axis = None
+            parts.append(mesh_axis)
+        while parts and parts[-1] is None:
+            parts.pop()
+        return P(*parts)
+
+    def extended(self, extra: Mapping[str, Optional[str]]) -> "AxisRules":
+        merged = dict(self.rules)
+        merged.update(extra)
+        return AxisRules(merged)
+
+
+def _axis_size(mesh: Mesh, axis) -> int:
+    if isinstance(axis, tuple):
+        n = 1
+        for a in axis:
+            n *= mesh.shape[a]
+        return n
+    return mesh.shape[axis]
+
+
+def param_partition_specs(module: Module, rules: AxisRules,
+                          mesh: Optional[Mesh] = None) -> Any:
+    """Pytree of PartitionSpec matching ``module.init(...)`` structure."""
+    specs = module.abstract_specs()
+
+    def to_spec(ps: ParamSpec) -> P:
+        axes = ps.axes if ps.axes is not None else (None,) * len(ps.shape)
+        return rules.spec_for(axes, mesh=mesh, shape=ps.shape)
+
+    return jax.tree.map(to_spec, specs,
+                        is_leaf=lambda x: isinstance(x, ParamSpec))
+
+
+def named_shardings(mesh: Mesh, spec_tree: Any) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params: Any, mesh: Mesh, spec_tree: Any) -> Any:
+    """Place a param pytree onto the mesh per spec (initial distribution or
+    hot-switch resharding — XLA computes the minimal collective plan, doing
+    the job of the reference's ``SwitchExecGraph`` P2P slicing)."""
+    return jax.device_put(params, named_shardings(mesh, spec_tree))
+
+
+def constrain(x, spec: P):
+    """``with_sharding_constraint`` under the ambient mesh — the equivalent
+    of inserting an explicit comm op in the reference graph."""
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def sharded_init(module: Module, key, mesh: Mesh, rules: AxisRules,
+                 dtype=None) -> Any:
+    """Initialize params directly in their sharded layout (jit + out
+    shardings) so giant models never materialize replicated."""
+    specs = param_partition_specs(module, rules, mesh=mesh)
+    shardings = named_shardings(mesh, specs)
+    fn = jax.jit(lambda k: module.init(k, dtype=dtype),
+                 out_shardings=shardings)
+    return fn(key)

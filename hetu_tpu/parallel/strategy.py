@@ -1,0 +1,99 @@
+"""Strategy IR — the TPU-native equivalent of Hetu's ds-parallel JSON.
+
+The reference drives all parallelism from a JSON strategy file (per-module
+``{split, dup, device_group_union, zero, recompute}`` — SURVEY §2.5,
+``generate_llama_4d_config.py``) which a C++ pass propagates through the graph
+as ``DistributedStates``. Here a :class:`Strategy` compiles directly to
+``(jax.sharding.Mesh, AxisRules)``: the mesh axes carry the dp/pp/cp/tp/ep
+degrees and the rules map each parameter's *logical* axes onto mesh axes.
+GSPMD then does what ``SubstituteCommOp`` did — inserting the collectives
+implied by producer/consumer shardings.
+
+Strategies serialize to/from JSON so external planners (Galvatron-style
+search, Malleus replanning) can emit them, and so hot switching is a matter
+of re-sharding the train state under a new Strategy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+# Mesh axis order: slower-varying first. tp is innermost so its collectives
+# ride nearest-neighbour ICI links; ep sits between dp and cp so expert
+# all-to-all stays within a dp replica.
+MESH_AXES = ("pp", "dp", "ep", "cp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class Strategy:
+    """One hybrid-parallel configuration (reference: one entry of
+    ``DistributedStatesHierarchy``)."""
+
+    dp: int = 1          # data parallel
+    tp: int = 1          # tensor parallel (Megatron-style)
+    pp: int = 1          # pipeline stages
+    cp: int = 1          # context parallel (ring attention)
+    ep: int = 1          # expert parallel (MoE)
+    zero: bool = False   # ZeRO-1: shard optimizer state over dp
+    fsdp: bool = False   # ZeRO-3-style param sharding over dp
+    num_microbatches: int = 1   # pipeline / grad-accumulation microbatches
+    remat: str = "none"          # "none" | "full" | "selective"
+    offload: bool = False        # host offload of remat'd activations
+
+    # -- derived -----------------------------------------------------------
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp * self.pp * self.cp * self.ep
+
+    def mesh_shape(self) -> dict[str, int]:
+        return {"pp": self.pp, "dp": self.dp, "ep": self.ep,
+                "cp": self.cp, "tp": self.tp}
+
+    def build_mesh(self, devices=None) -> Mesh:
+        from hetu_tpu.core.mesh import make_mesh
+        return make_mesh(self.mesh_shape(), devices=devices)
+
+    def axis_rules(self) -> "AxisRules":
+        from hetu_tpu.parallel.sharding import AxisRules
+        rules = {
+            "vocab": "tp",
+            "mlp": "tp",
+            "heads": "tp",
+            "kv_heads": "tp",
+            "expert": "ep",
+            "layers": "pp",
+            "embed": "dp" if self.fsdp else None,
+        }
+        return AxisRules(rules)
+
+    def data_spec(self, ndim: int = 2) -> P:
+        """PartitionSpec for a (batch, seq, ...) input batch: batch over
+        dp×ep, seq over cp."""
+        batch_axes = ("dp", "ep") if self.ep > 1 else "dp"
+        parts = [batch_axes, "cp"] + [None] * (ndim - 2)
+        return P(*parts[:ndim])
+
+    # -- serialization (planner interface) ---------------------------------
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Strategy":
+        return cls(**json.loads(s))
+
+    def validate(self, n_devices: Optional[int] = None):
+        if self.num_microbatches < 1:
+            raise ValueError("num_microbatches must be >= 1")
+        if self.pp > 1 and self.num_microbatches % self.pp != 0:
+            raise ValueError(
+                f"num_microbatches ({self.num_microbatches}) must be a "
+                f"multiple of pp ({self.pp}) for the pipeline schedule")
+        if n_devices is not None and self.num_devices > n_devices:
+            raise ValueError(
+                f"strategy needs {self.num_devices} devices, have {n_devices}")
+        return self

@@ -1,0 +1,91 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu import nn
+from hetu_tpu.core import tree as treelib
+
+
+def test_linear_init_and_apply(rng):
+    lin = nn.Linear(8, 16)
+    params = lin.init(rng)
+    assert params["weight"].shape == (8, 16)
+    assert params["bias"].shape == (16,)
+    x = jnp.ones((4, 8))
+    y = lin(params, x)
+    assert y.shape == (4, 16)
+    np.testing.assert_allclose(
+        y, x @ params["weight"] + params["bias"], rtol=1e-5)
+
+
+def test_nested_modules_param_tree(rng):
+    mlp = nn.MLP(8, 32)
+    params = mlp.init(rng)
+    assert set(params.keys()) == {"fc_in", "fc_out"}
+    assert params["fc_in"]["weight"].shape == (8, 32)
+    y = mlp(params, jnp.ones((2, 8)))
+    assert y.shape == (2, 8)
+
+
+def test_sequential(rng):
+    model = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    params = model.init(rng)
+    y = model(params, jnp.ones((3, 4)))
+    assert y.shape == (3, 2)
+
+
+def test_param_axes():
+    mlp = nn.MLP(8, 32)
+    axes = mlp.param_axes()
+    assert axes["fc_in"]["weight"] == ("embed", "mlp")
+    assert axes["fc_out"]["weight"] == ("mlp", "embed")
+    assert axes["fc_in"]["bias"] == ("mlp",)
+
+
+def test_abstract_params_match_init(rng):
+    mlp = nn.MLP(8, 16)
+    abstract = mlp.abstract_params()
+    real = mlp.init(rng)
+    flat_a = treelib.flatten_with_paths(abstract)
+    flat_r = treelib.flatten_with_paths(real)
+    assert set(flat_a) == set(flat_r)
+    for k in flat_a:
+        assert flat_a[k].shape == flat_r[k].shape
+
+
+def test_named_modules():
+    model = nn.Sequential(nn.Linear(4, 8), nn.MLP(8, 16))
+    names = [n for n, _ in model.named_modules()]
+    assert "layers.0" in names
+    assert "layers.1.fc_in" in names
+
+
+def test_init_deterministic(rng):
+    lin = nn.Linear(8, 8)
+    p1 = lin.init(rng)
+    p2 = lin.init(rng)
+    np.testing.assert_array_equal(p1["weight"], p2["weight"])
+
+
+def test_dropout(rng):
+    drop = nn.Dropout(0.5)
+    x = jnp.ones((100, 100))
+    y = drop({}, x, deterministic=True)
+    np.testing.assert_array_equal(x, y)
+    y2 = drop({}, x, rng=rng, deterministic=False)
+    frac = float((y2 == 0).mean())
+    assert 0.4 < frac < 0.6
+
+
+def test_axes_rank_mismatch_raises():
+    with pytest.raises(ValueError):
+        nn.Linear(4, 4, axes=("a", "b", "c"))
+
+
+def test_tree_flatten_roundtrip():
+    t = {"a": {"b": jnp.ones(2), "c": jnp.zeros(3)}, "d": jnp.ones(1)}
+    flat = treelib.flatten_with_paths(t)
+    assert set(flat) == {"a.b", "a.c", "d"}
+    back = treelib.unflatten_from_paths(flat)
+    assert jax.tree.structure(t) == jax.tree.structure(back)

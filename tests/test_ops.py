@@ -1,0 +1,127 @@
+"""Op numerics vs pure-numpy/torch-free oracles (reference test pattern:
+``tests/test_ops.py`` compares against torch; here oracles are explicit)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu import ops
+
+
+def test_rms_norm():
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    scale = np.random.RandomState(1).rand(16).astype(np.float32)
+    got = ops.rms_norm(jnp.asarray(x), jnp.asarray(scale))
+    want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * scale
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_layer_norm():
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    got = ops.layer_norm(jnp.asarray(x), None, None)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    want = (x - mu) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm_bf16_stats_in_fp32():
+    x = (np.random.RandomState(0).randn(4, 256) * 30).astype(np.float32)
+    got = ops.rms_norm(jnp.asarray(x, jnp.bfloat16), jnp.ones(256, jnp.bfloat16))
+    assert got.dtype == jnp.bfloat16
+    want = ops.rms_norm(jnp.asarray(x), jnp.ones(256))
+    np.testing.assert_allclose(got.astype(np.float32), want, rtol=0.05, atol=0.05)
+
+
+def test_swiglu():
+    g = jnp.asarray([-1.0, 0.0, 2.0])
+    u = jnp.asarray([3.0, 3.0, 3.0])
+    got = ops.swiglu(g, u)
+    want = (g * jax.nn.sigmoid(g)) * u
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_rotary_norm_preserved():
+    cos, sin = ops.rope_frequencies(8, 32)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 4, 8),
+                    dtype=jnp.float32)
+    y = ops.apply_rotary(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(y), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+    # position 0 is unrotated
+    np.testing.assert_allclose(y[:, 0], x[:, 0], rtol=1e-6)
+
+
+def test_rotary_packed_positions():
+    cos, sin = ops.rope_frequencies(8, 32)
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 8, 2, 8),
+                    dtype=jnp.float32)
+    # packed: two sequences of length 4 → positions reset
+    pos = jnp.asarray([[0, 1, 2, 3, 0, 1, 2, 3]])
+    y = ops.apply_rotary(x, cos, sin, positions=pos)
+    y_first = ops.apply_rotary(x[:, :4], cos, sin)
+    np.testing.assert_allclose(y[:, 4:],
+                               ops.apply_rotary(x[:, 4:], cos, sin),
+                               rtol=1e-5)
+    np.testing.assert_allclose(y[:, :4], y_first, rtol=1e-5)
+
+
+def test_softmax_cross_entropy():
+    logits = jnp.asarray(np.random.RandomState(0).randn(4, 10),
+                         dtype=jnp.float32)
+    labels = jnp.asarray([1, 2, 3, -100])
+    loss, valid = ops.softmax_cross_entropy(logits, labels)
+    assert valid.tolist() == [True, True, True, False]
+    assert loss[3] == 0.0
+    p = jax.nn.log_softmax(logits)
+    for i, l in enumerate([1, 2, 3]):
+        np.testing.assert_allclose(loss[i], -p[i, l], rtol=1e-5)
+
+
+def test_attention_reference_causal():
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(2, 8, 4, 16), dtype=jnp.float32)
+    k = jnp.asarray(rs.randn(2, 8, 4, 16), dtype=jnp.float32)
+    v = jnp.asarray(rs.randn(2, 8, 4, 16), dtype=jnp.float32)
+    out = ops.attention_reference(q, k, v, causal=True)
+    assert out.shape == q.shape
+    # first token only attends to itself
+    np.testing.assert_allclose(out[:, 0], v[:, 0], rtol=1e-5)
+
+
+def test_attention_gqa_matches_expanded():
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 8, 8, 16), dtype=jnp.float32)
+    k = jnp.asarray(rs.randn(1, 8, 2, 16), dtype=jnp.float32)
+    v = jnp.asarray(rs.randn(1, 8, 2, 16), dtype=jnp.float32)
+    got = ops.attention_reference(q, k, v, causal=True)
+    k_full = jnp.repeat(k, 4, axis=2)
+    v_full = jnp.repeat(v, 4, axis=2)
+    want = ops.attention_reference(q, k_full, v_full, causal=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_attention_segment_ids_block_diagonal():
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 8, 2, 16), dtype=jnp.float32)
+    k = jnp.asarray(rs.randn(1, 8, 2, 16), dtype=jnp.float32)
+    v = jnp.asarray(rs.randn(1, 8, 2, 16), dtype=jnp.float32)
+    seg = jnp.asarray([[0, 0, 0, 0, 1, 1, 1, 1]])
+    got = ops.attention_reference(q, k, v, causal=True, segment_ids=seg)
+    # each segment must equal standalone attention over that segment
+    for sl in (slice(0, 4), slice(4, 8)):
+        want = ops.attention_reference(q[:, sl], k[:, sl], v[:, sl],
+                                       causal=True)
+        np.testing.assert_allclose(got[:, sl], want, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_lse():
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 4, 2, 8), dtype=jnp.float32)
+    k = jnp.asarray(rs.randn(1, 4, 2, 8), dtype=jnp.float32)
+    v = jnp.asarray(rs.randn(1, 4, 2, 8), dtype=jnp.float32)
+    out, lse = ops.attention_reference(q, k, v, return_lse=True)
+    assert lse.shape == (1, 2, 4)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q / jnp.sqrt(8.0), k)
+    np.testing.assert_allclose(lse, jax.nn.logsumexp(logits, -1), rtol=1e-5)

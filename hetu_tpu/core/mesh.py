@@ -19,14 +19,17 @@ from jax.sharding import Mesh
 # Canonical mesh-axis names used across the framework. Order matters: the
 # leading axes change slowest across the physical device order, so axes whose
 # collectives need the most bandwidth (tp) are placed innermost, riding ICI
-# neighbours.
+# neighbours; ep sits between dp and cp so expert all-to-all stays within a
+# dp replica. This is the single source of truth — Strategy.build_mesh and
+# positional make_mesh both use it.
 AXIS_DP = "dp"      # data parallel (also ZeRO shard axis)
 AXIS_PP = "pp"      # pipeline stages
 AXIS_CP = "cp"      # context parallel (ring attention / sequence)
 AXIS_EP = "ep"      # expert parallel (MoE all-to-all)
 AXIS_TP = "tp"      # tensor parallel (Megatron-style)
 
-DEFAULT_AXIS_ORDER = (AXIS_DP, AXIS_PP, AXIS_CP, AXIS_TP)
+MESH_AXES = (AXIS_PP, AXIS_DP, AXIS_EP, AXIS_CP, AXIS_TP)
+DEFAULT_AXIS_ORDER = MESH_AXES
 
 
 def local_devices(platform: str | None = None):

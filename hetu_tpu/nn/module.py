@@ -100,35 +100,46 @@ class Module:
 
     # -- init --------------------------------------------------------------
     def init(self, key: jax.Array, dtype=None) -> dict:
-        """Materialize the param pytree (nested dicts)."""
+        """Materialize the param pytree (nested dicts).
+
+        The pytree structurally mirrors the module tree, including empty
+        subtrees for param-less modules (Dropout, activations), so containers
+        can always index ``params[child_name]``.
+        """
         dtype = dtype or current_policy().param_dtype
         specs = self.abstract_specs()
         flat = _flatten_specs(specs)
         keys = jax.random.split(key, max(len(flat), 1))
-        flat_params = {
-            path: spec.instantiate(k, dtype)
-            for (path, spec), k in zip(flat.items(), keys)
-        }
-        return _unflatten(flat_params)
+        keymap = dict(zip(flat.keys(), keys))
+
+        def build(tree: Mapping, prefix: str = "") -> dict:
+            out = {}
+            for k, v in tree.items():
+                path = f"{prefix}.{k}" if prefix else str(k)
+                if isinstance(v, ParamSpec):
+                    out[k] = v.instantiate(keymap[path], dtype)
+                else:
+                    out[k] = build(v, path)
+            return out
+
+        return build(specs)
 
     def abstract_specs(self) -> dict:
-        """Nested dict of ParamSpec mirroring the param pytree structure."""
+        """Nested dict of ParamSpec mirroring the module tree structure.
+
+        Param-less children contribute empty dicts (NOT pruned) so the param
+        pytree always has the same structure as the module tree.
+        """
         out: dict[str, Any] = dict(getattr(self, "_param_specs", {}))
         for name, child in self.children().items():
             if isinstance(child, Module):
-                sub = child.abstract_specs()
-                if sub:
-                    out[name] = sub
+                out[name] = child.abstract_specs()
             elif isinstance(child, list):
-                sub = {str(i): m.abstract_specs() for i, m in enumerate(child)}
-                sub = {k: v for k, v in sub.items() if v}
-                if sub:
-                    out[name] = sub
+                out[name] = {str(i): m.abstract_specs()
+                             for i, m in enumerate(child)}
             else:
-                sub = {k: m.abstract_specs() for k, m in child.items()}
-                sub = {k: v for k, v in sub.items() if v}
-                if sub:
-                    out[name] = sub
+                out[name] = {k: m.abstract_specs()
+                             for k, m in child.items()}
         return out
 
     def abstract_params(self, dtype=None) -> dict:

@@ -81,6 +81,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ``impl``: "auto" | "pallas" | "reference".
     """
     if impl == "auto":
+        # Pallas kernel on real TPU; on CPU the XLA-fused oracle is faster
+        # than interpret-mode Pallas.
         impl = "pallas" if _on_tpu() and _pallas_supported(q, k) else "reference"
     if impl == "pallas":
         from hetu_tpu.ops.flash_pallas import flash_attention_pallas

@@ -23,10 +23,8 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
-# Mesh axis order: slower-varying first. tp is innermost so its collectives
-# ride nearest-neighbour ICI links; ep sits between dp and cp so expert
-# all-to-all stays within a dp replica.
-MESH_AXES = ("pp", "dp", "ep", "cp", "tp")
+# Canonical mesh axis order lives in core.mesh (single source of truth).
+from hetu_tpu.core.mesh import MESH_AXES
 
 
 @dataclasses.dataclass(frozen=True)

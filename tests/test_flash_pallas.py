@@ -1,0 +1,93 @@
+"""Pallas flash attention vs the pure-jnp oracle (interpret mode on CPU).
+
+Mirrors the reference's op-parity test discipline (``tests/test_ops.py``
+there compares every op fwd+grad against torch; here the oracle is
+``attention_reference``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.ops.attention import attention_reference, flash_attention
+from hetu_tpu.ops.flash_pallas import flash_attention_pallas
+
+
+def _rand_qkv(key, b, sq, sk, hq, hkv, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, sq, hq, d), dtype)
+    k = jax.random.normal(kk, (b, sk, hkv, d), dtype)
+    v = jax.random.normal(kv, (b, sk, hkv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+def test_flash_fwd_matches_reference(rng, causal, hq, hkv):
+    q, k, v = _rand_qkv(rng, 2, 256, 256, hq, hkv, 128)
+    out = flash_attention_pallas(q, k, v, causal=causal, interpret=True)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_fwd_segment_ids(rng):
+    b, s, h, d = 2, 256, 2, 128
+    q, k, v = _rand_qkv(rng, b, s, s, h, h, d)
+    seg = jnp.concatenate([
+        jnp.zeros((b, s // 2), jnp.int32),
+        jnp.ones((b, s // 2), jnp.int32)], axis=1)
+    out = flash_attention_pallas(q, k, v, causal=True, segment_ids=seg,
+                                 interpret=True)
+    ref = attention_reference(q, k, v, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hq,hkv", [(2, 2), (4, 2)])
+def test_flash_grads_match_reference(rng, causal, hq, hkv):
+    q, k, v = _rand_qkv(rng, 1, 256, 256, hq, hkv, 128)
+
+    def loss_pallas(q, k, v):
+        o = flash_attention_pallas(q, k, v, causal=causal, interpret=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = attention_reference(q, k, v, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_grads_segment_ids(rng):
+    b, s, h, d = 1, 256, 2, 128
+    q, k, v = _rand_qkv(rng, b, s, s, h, h, d)
+    seg = jnp.concatenate([
+        jnp.zeros((b, 96), jnp.int32),
+        jnp.ones((b, 96), jnp.int32),
+        jnp.full((b, 64), 2, jnp.int32)], axis=1)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    fp = lambda q, k, v: flash_attention_pallas(
+        q, k, v, causal=True, segment_ids=seg, interpret=True)
+    fr = lambda q, k, v: attention_reference(
+        q, k, v, causal=True, segment_ids=seg)
+    gp = jax.grad(lambda *a: loss(fp, *a), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: loss(fr, *a), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_dispatch_pallas_importable(rng):
+    """impl='pallas' must not crash (ADVICE r1 high-severity finding)."""
+    q, k, v = _rand_qkv(rng, 1, 128, 128, 2, 2, 64)
+    out = flash_attention(q, k, v, causal=True, impl="pallas")
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

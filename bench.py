@@ -1,0 +1,107 @@
+"""Benchmark: GPT-2 small pretrain step on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: BASELINE.md north star — ≥50% MFU on the pretrain step
+(vs_baseline = MFU / 0.50).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import optim
+from hetu_tpu.core.dtypes import Policy, autocast
+from hetu_tpu.engine import make_plan, init_state, build_train_step
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.parallel.strategy import Strategy
+
+# bf16 peak FLOPs per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5": 459e12,      # v5p
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v6 lite": 918e12,  # v6e
+    "TPU v6e": 918e12,
+    "TPU v7": 4614e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    # longest match first so "TPU v5 lite" doesn't hit the "TPU v5" entry
+    for k in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if kind.startswith(k) or k in kind:
+            return PEAK_FLOPS[k]
+    return 0.0  # unknown / CPU → MFU reported as 0
+
+
+def model_flops_per_token(cfg: GPTConfig, n_params: int, seq: int) -> float:
+    # 6N matmul flops/token + causal attention 12*L*H*s/2 … standard MFU
+    # accounting (PaLM appendix B)
+    return 6.0 * n_params + 6.0 * cfg.num_layers * cfg.hidden_size * seq
+
+
+def main():
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = GPTConfig.small()      # 124M params
+        batch, seq, steps, warmup = 8, 1024, 20, 3
+        dtype_policy = Policy(param_dtype=jnp.float32,
+                              compute_dtype=jnp.bfloat16)
+    else:  # CPU smoke fallback so the bench always emits a number
+        cfg = GPTConfig.tiny()
+        batch, seq, steps, warmup = 4, 64, 3, 1
+        dtype_policy = Policy(param_dtype=jnp.float32,
+                              compute_dtype=jnp.float32)
+
+    seq = min(seq, cfg.max_positions)
+    model = GPTLMHeadModel(cfg)
+    opt = optim.adamw(1e-4, weight_decay=0.01)
+    strategy = Strategy()  # single chip; driver runs multi-chip via dryrun
+    with autocast(dtype_policy):
+        plan = make_plan(model, opt, strategy)
+        state = init_state(model, opt, plan, jax.random.key(0))
+        step = build_train_step(model, opt, plan)
+
+        ids = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
+                                 cfg.vocab_size)
+        batch_data = plan.shard_batch(
+            {"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+
+        for _ in range(warmup):
+            state, metrics = step(state, batch_data)
+        # host fetch forces the full dependency chain to finish (donated
+        # state chains step N → N+1), robust even where block_until_ready
+        # is lazy (remote PJRT relays)
+        float(jax.device_get(metrics["loss"]))
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch_data)
+        final_loss = float(jax.device_get(metrics["loss"]))
+        dt = (time.perf_counter() - t0) / steps
+        assert final_loss == final_loss, "NaN loss in bench"
+
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    tokens_per_sec = batch * seq / dt
+    flops = model_flops_per_token(cfg, n_params, seq) * tokens_per_sec
+    peak = peak_flops(dev)
+    mfu = flops / peak if peak else 0.0
+
+    print(json.dumps({
+        "metric": "gpt2_small_pretrain_mfu" if on_tpu else "gpt2_tiny_cpu_smoke",
+        "value": round(mfu, 4) if on_tpu else round(tokens_per_sec, 1),
+        "unit": "mfu" if on_tpu else "tokens/sec",
+        "vs_baseline": round(mfu / 0.50, 4) if peak else 0.0,
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "step_time_ms": round(dt * 1e3, 2),
+        "n_params": n_params,
+        "device": getattr(dev, "device_kind", dev.platform),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,8 @@ from hetu_tpu.core.mesh import make_mesh, local_devices
 from hetu_tpu import nn
 from hetu_tpu import ops
 from hetu_tpu import optim
+from hetu_tpu import models
+from hetu_tpu import engine
 from hetu_tpu.parallel.strategy import Strategy
 from hetu_tpu.parallel.sharding import (
     AxisRules,
@@ -46,6 +48,8 @@ __all__ = [
     "nn",
     "ops",
     "optim",
+    "models",
+    "engine",
     "Strategy",
     "AxisRules",
     "param_partition_specs",

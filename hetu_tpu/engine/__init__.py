@@ -1,0 +1,15 @@
+"""Engine: train state, train-step compiler, trainer.
+
+Parity target: ``python/hetu/engine`` (``Trainer`` `trainer.py:66`,
+planners, straggler monitor).
+"""
+
+from hetu_tpu.engine.state import TrainState
+from hetu_tpu.engine.train_step import (
+    TrainPlan, make_plan, init_state, build_train_step, build_eval_step,
+)
+
+__all__ = [
+    "TrainState", "TrainPlan", "make_plan", "init_state",
+    "build_train_step", "build_eval_step",
+]

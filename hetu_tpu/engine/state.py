@@ -1,0 +1,25 @@
+"""Train state: one pytree holding step / params / optimizer state.
+
+The reference keeps params, grads and opt states in separate device buffers
+tracked by the executable graph (``ParamBuffer``, ``executable_graph.h``);
+hot switching re-shards each with dedicated P2P plans
+(``switch_exec_graph.h:42-48`` modes). Designing the state as a *single
+pytree* makes all of that one ``jax.device_put`` with new shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class TrainState(NamedTuple):
+    step: jax.Array        # scalar int32
+    params: Any            # nested-dict param pytree
+    opt_state: Any         # optimizer transform state
+
+
+def new_train_state(params, opt) -> TrainState:
+    return TrainState(jnp.zeros([], jnp.int32), params, opt.init(params))

@@ -1,0 +1,11 @@
+"""Model zoo: GPT-2 and Llama families.
+
+Parity targets: ``python/hetu/models/gpt`` and
+``python/hetu/models/llama/llama_model.py`` (LlamaModel :385,
+LlamaLMHeadModel :446).
+"""
+
+from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+
+__all__ = ["GPTConfig", "GPTLMHeadModel", "LlamaConfig", "LlamaLMHeadModel"]

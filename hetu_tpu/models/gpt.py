@@ -1,0 +1,108 @@
+"""GPT-2 family.
+
+Parity target: the reference's GPT model (``python/hetu/models/gpt/``,
+driven by ``tests/ci_test/train_hetu_gpt_ds_parallel.py``): learned position
+embeddings, pre-LayerNorm blocks, GELU MLP, tied wte/lm_head. TP-ready out of
+the box — every layer declares logical axes and the LM loss runs
+vocab-parallel under ``shard_map`` when a tp>1 ActivationSharding context is
+active.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from hetu_tpu.nn.layers import Embedding, LayerNorm
+from hetu_tpu.nn.module import Module, normal_init
+from hetu_tpu.nn.parallel import (
+    ParallelAttention, ParallelMLP, StackedBlocks, VocabParallelEmbedding,
+)
+from hetu_tpu.ops.losses import vocab_parallel_lm_loss
+from hetu_tpu.parallel.sharding import act_constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    max_positions: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    layer_norm_eps: float = 1e-5
+    init_std: float = 0.02
+
+    @classmethod
+    def small(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        """Test-size config."""
+        return cls(vocab_size=256, max_positions=128, hidden_size=64,
+                   num_layers=2, num_heads=4)
+
+
+class GPTBlock(Module):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
+        self.attn = ParallelAttention(
+            cfg.hidden_size, cfg.num_heads, bias=True, causal=True,
+            use_rope=False, init=normal_init(cfg.init_std))
+        self.ln_2 = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
+        self.mlp = ParallelMLP(cfg.hidden_size,
+                               cfg.mlp_ratio * cfg.hidden_size,
+                               bias=True, gated=False)
+
+    def __call__(self, params, x, *, segment_ids=None, attn_impl="auto"):
+        x = x + self.attn(params["attn"], self.ln_1(params["ln_1"], x),
+                          segment_ids=segment_ids, attn_impl=attn_impl)
+        x = x + self.mlp(params["mlp"], self.ln_2(params["ln_2"], x))
+        return act_constrain(x, "tokens")
+
+
+class GPTLMHeadModel(Module):
+    """GPT-2 with tied-embedding LM head."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
+                                          init=normal_init(cfg.init_std))
+        self.wpe = Embedding(cfg.max_positions, cfg.hidden_size,
+                             init=normal_init(cfg.init_std))
+        self.blocks = StackedBlocks(lambda: GPTBlock(cfg), cfg.num_layers)
+        self.ln_f = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
+
+    def hidden_states(self, params, input_ids, *, positions=None,
+                      segment_ids=None, attn_impl="auto", remat="none"):
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.arange(s)[None, :]
+        h = self.wte(params["wte"], input_ids) \
+            + self.wpe(params["wpe"], positions)
+        h = act_constrain(h, "tokens")
+        h = self.blocks(params["blocks"], h, remat=remat,
+                        segment_ids=segment_ids, attn_impl=attn_impl)
+        return self.ln_f(params["ln_f"], h)
+
+    def __call__(self, params, input_ids, **kwargs):
+        """Full logits (inference / entry path)."""
+        h = self.hidden_states(params, input_ids, **kwargs)
+        w = params["wte"]["weight"]
+        logits = jnp.einsum("bse,ve->bsv", h.astype(jnp.float32),
+                            w.astype(jnp.float32))
+        return act_constrain(logits, "logits")
+
+    def loss(self, params, input_ids, labels, *, positions=None,
+             segment_ids=None, attn_impl="auto", remat="none",
+             ignore_index: int = -100):
+        """Mean LM loss; the head runs vocab-parallel when tp is active."""
+        h = self.hidden_states(params, input_ids, positions=positions,
+                               segment_ids=segment_ids, attn_impl=attn_impl,
+                               remat=remat)
+        return vocab_parallel_lm_loss(h, params["wte"]["weight"], labels,
+                                      ignore_index=ignore_index)

@@ -1,0 +1,318 @@
+"""Tensor-parallel layers and the stacked-block transformer core.
+
+TPU-native equivalent of the reference's multi-ds parallel layers
+(``python/hetu/nn/modules/parallel_multi_ds.py``: ``HtMultiColumnParallelLinear``
+:328, ``HtMultiRowParallelLinear`` :411, ``HtMultiQKVColumnParallelLinear``
+:504 (GQA-aware), ``HtMultiVocabParallelEmbedding`` :268). The reference
+threads per-strategy ``DistributedStates`` unions through every layer and a
+C++ pass inserts comm ops; here layers declare *logical* axes on their params
+("mlp", "heads", "kv_heads", "vocab", "embed", "layers") and call
+``act_constrain`` at the canonical activation cut points — GSPMD then inserts
+the same collectives ``SubstituteCommOp`` would (allreduce after row-parallel,
+allgather on resharding, …).
+
+``StackedBlocks`` is the scan-over-layers representation: every block param
+gains a leading ``layers`` dim so (a) compile time is O(1) in depth, (b) the
+pipeline executor can shard the ``layers`` axis over ``pp``
+(``hetu_tpu.parallel.pipeline``), and (c) remat policy is applied per block
+exactly like the reference's per-block recompute config
+(``hetu/graph/recompute/recompute.h:12``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from hetu_tpu.nn.module import Module, ParamSpec, normal_init, zeros_init
+from hetu_tpu.ops import activations as act_ops
+from hetu_tpu.ops.attention import flash_attention
+from hetu_tpu.ops.rotary import rope_frequencies, apply_rotary
+from hetu_tpu.parallel.sharding import act_constrain, current_act_sharding
+
+
+class ColumnParallelLinear(Module):
+    """Linear whose *output* features shard over tp (Y = XW, W: (in, out/tp)).
+
+    Reference: ``HtMultiColumnParallelLinear`` (`parallel_multi_ds.py:328`).
+    No gather is emitted here — the consumer is expected to be tp-local
+    (attention heads, MLP hidden) until a RowParallelLinear reduces back.
+    """
+
+    def __init__(self, in_features: int, out_features: int, *,
+                 bias: bool = True, init=None, axis: str = "mlp",
+                 out_kind: str = "hidden"):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.out_kind = out_kind
+        self.param("weight", (in_features, out_features),
+                   init or normal_init(0.02), axes=("embed", axis))
+        if bias:
+            self.param("bias", (out_features,), zeros_init(), axes=(axis,))
+
+    def __call__(self, params, x):
+        dt = self.compute_dtype()
+        y = jnp.matmul(x.astype(dt), params["weight"].astype(dt))
+        if self.use_bias:
+            y = y + params["bias"].astype(dt)
+        return act_constrain(y, self.out_kind)
+
+
+class RowParallelLinear(Module):
+    """Linear whose *input* features shard over tp (W: (in/tp, out)).
+
+    The contraction over the sharded dim leaves a partial sum; constraining
+    the output to a tp-replicated spec makes GSPMD emit the allreduce — the
+    same comm the reference deduces for ds ``-2`` partial states
+    (`parallel_multi_ds.py:411`, ``distributed_states.h:133``).
+    """
+
+    def __init__(self, in_features: int, out_features: int, *,
+                 bias: bool = True, init=None, axis: str = "mlp"):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.param("weight", (in_features, out_features),
+                   init or normal_init(0.02), axes=(axis, "embed"))
+        if bias:
+            self.param("bias", (out_features,), zeros_init(), axes=(None,))
+
+    def __call__(self, params, x):
+        dt = self.compute_dtype()
+        y = jnp.matmul(x.astype(dt), params["weight"].astype(dt))
+        y = act_constrain(y, "tokens")
+        if self.use_bias:
+            y = y + params["bias"].astype(dt)
+        return y
+
+
+class VocabParallelEmbedding(Module):
+    """Embedding with the vocabulary dim sharded over tp.
+
+    Reference: ``HtMultiVocabParallelEmbedding`` (`parallel_multi_ds.py:268`)
+    — masked local lookup + allreduce. When an ActivationSharding context with
+    tp>1 is active the lookup runs under ``shard_map`` (local masked take +
+    ``psum``), so no device materializes the full table; otherwise a plain
+    take.
+    """
+
+    def __init__(self, num_embeddings: int, features: int, init=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.features = features
+        self.param("weight", (num_embeddings, features),
+                   init or normal_init(0.02), axes=("vocab", "embed"))
+
+    def __call__(self, params, ids):
+        w = params["weight"]
+        ctx = current_act_sharding()
+        if ctx is not None and isinstance(ctx.tp, str) \
+                and ctx.mesh.shape[ctx.tp] > 1 \
+                and self.num_embeddings % ctx.mesh.shape[ctx.tp] == 0:
+            out = _vocab_parallel_lookup(w, ids, ctx)
+        else:
+            out = jnp.take(w, ids, axis=0)
+        return act_constrain(out.astype(self.compute_dtype()), "tokens")
+
+
+def _vocab_parallel_lookup(weight, ids, ctx):
+    tp = ctx.tp
+    v_local = weight.shape[0] // ctx.mesh.shape[tp]
+
+    @functools.partial(
+        shard_map, mesh=ctx.mesh,
+        in_specs=(P(tp, None), P(ctx.batch, ctx.seq)),
+        out_specs=P(ctx.batch, ctx.seq, None), check_vma=False)
+    def lookup(w, ids):
+        start = jax.lax.axis_index(tp) * v_local
+        local = ids - start
+        ok = (local >= 0) & (local < v_local)
+        emb = jnp.take(w, jnp.clip(local, 0, v_local - 1), axis=0)
+        emb = jnp.where(ok[..., None], emb, jnp.zeros([], emb.dtype))
+        return jax.lax.psum(emb, tp)
+
+    return lookup(weight, ids)
+
+
+class ParallelMLP(Module):
+    """Transformer MLP: column-parallel up, row-parallel down.
+
+    ``gated=True`` gives the Llama SwiGLU form (reference MLP
+    `llama_model.py:292`, fused kernel ``impl/kernel/SwiGLU.cu``); otherwise
+    GPT-2 GELU.
+    """
+
+    def __init__(self, features: int, hidden: int, *, bias: bool = True,
+                 gated: bool = False, activation=None):
+        super().__init__()
+        self.gated = gated
+        self.activation = activation or (act_ops.swiglu if gated
+                                         else jax.nn.gelu)
+        if gated:
+            # separate gate/up projections: both column-sharded over tp, so
+            # the elementwise gate never crosses a shard boundary (a fused
+            # (E, 2H) kernel + split would force a per-layer reshard)
+            self.gate_proj = ColumnParallelLinear(
+                features, hidden, bias=bias, axis="mlp", out_kind="hidden")
+            self.up_proj = ColumnParallelLinear(
+                features, hidden, bias=bias, axis="mlp", out_kind="hidden")
+        else:
+            self.fc_in = ColumnParallelLinear(
+                features, hidden, bias=bias, axis="mlp", out_kind="hidden")
+        self.fc_out = RowParallelLinear(hidden, features, bias=bias,
+                                        axis="mlp")
+
+    def __call__(self, params, x):
+        if self.gated:
+            h = self.activation(self.gate_proj(params["gate_proj"], x),
+                                self.up_proj(params["up_proj"], x))
+        else:
+            h = self.activation(self.fc_in(params["fc_in"], x))
+        h = act_constrain(h, "hidden")
+        return self.fc_out(params["fc_out"], h)
+
+
+class ParallelAttention(Module):
+    """Multi-head attention with GQA, RoPE and flash-kernel dispatch, heads
+    sharded over tp.
+
+    Reference: ``HtMultiQKVColumnParallelLinear`` (`parallel_multi_ds.py:504`)
+    + ``ParallelAttentionOp`` cp=1 path (`hetu/graph/ops/ParallelAttention.h:711`).
+    Ring-attention CP wraps this at the op level
+    (``hetu_tpu.parallel.ring_attention``) — this module stays cp-agnostic
+    and only sees its local sequence chunk (positions/segment_ids make the
+    causal mask correct for chunks).
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int, *,
+                 num_kv_heads: Optional[int] = None,
+                 head_dim: Optional[int] = None,
+                 bias: bool = True, causal: bool = True,
+                 use_rope: bool = False, rope_theta: float = 10000.0,
+                 max_positions: int = 4096, init=None):
+        super().__init__()
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        if num_heads % self.num_kv_heads != 0:
+            raise ValueError("num_heads must be divisible by num_kv_heads")
+        self.head_dim = head_dim or embed_dim // num_heads
+        self.causal = causal
+        self.use_rope = use_rope
+        init = init or normal_init(0.02)
+        self.q_proj = ColumnParallelLinear(
+            embed_dim, num_heads * self.head_dim, bias=bias, init=init,
+            axis="heads", out_kind="hidden")
+        self.k_proj = ColumnParallelLinear(
+            embed_dim, self.num_kv_heads * self.head_dim, bias=bias,
+            init=init, axis="kv_heads", out_kind="hidden")
+        self.v_proj = ColumnParallelLinear(
+            embed_dim, self.num_kv_heads * self.head_dim, bias=bias,
+            init=init, axis="kv_heads", out_kind="hidden")
+        self.out_proj = RowParallelLinear(
+            num_heads * self.head_dim, embed_dim, bias=bias, init=init,
+            axis="heads")
+        if use_rope:
+            self._rope = rope_frequencies(self.head_dim, max_positions,
+                                          theta=rope_theta)
+        else:
+            self._rope = None
+
+    def __call__(self, params, x, *, positions=None, segment_ids=None,
+                 attn_impl: str = "auto"):
+        b, s, _ = x.shape
+        q = self.q_proj(params["q_proj"], x).reshape(
+            b, s, self.num_heads, self.head_dim)
+        k = self.k_proj(params["k_proj"], x).reshape(
+            b, s, self.num_kv_heads, self.head_dim)
+        v = self.v_proj(params["v_proj"], x).reshape(
+            b, s, self.num_kv_heads, self.head_dim)
+        if self._rope is not None:
+            cos, sin = self._rope
+            q = apply_rotary(q, cos, sin, positions=positions)
+            k = apply_rotary(k, cos, sin, positions=positions)
+        q = act_constrain(q, "heads")
+        k = act_constrain(k, "heads")
+        v = act_constrain(v, "heads")
+        out = flash_attention(q, k, v, causal=self.causal,
+                              segment_ids=segment_ids, impl=attn_impl)
+        out = act_constrain(out, "heads")
+        out = out.reshape(b, s, self.num_heads * self.head_dim)
+        return self.out_proj(params["out_proj"], out)
+
+
+def remat_policy(name: str):
+    """Map a Strategy remat/offload name to a ``jax.checkpoint`` policy.
+
+    Reference equivalents: recompute pass (``recompute/recompute.h:12``) and
+    activation CPU offload pass (``offload/activation_cpu_offload.h:11``).
+    """
+    if name == "none":
+        return None
+    if name == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    if name == "selective":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "offload":
+        make = getattr(jax.checkpoint_policies,
+                       "offload_dot_with_no_batch_dims", None)
+        if make is None:  # older jax: degrade to plain remat
+            return jax.checkpoint_policies.nothing_saveable
+        return make("device", "pinned_host")
+    raise ValueError(
+        f"remat must be none|full|selective|offload, got {name!r}")
+
+
+class StackedBlocks(Module):
+    """N identical blocks as one scan, params stacked on a leading ``layers``
+    dim.
+
+    The reference represents depth as N distinct subgraphs with per-block
+    recompute/offload flags (`llama_model.py:342`); on TPU the idiomatic form
+    is a single block traced once and scanned, with the stacked ``layers``
+    axis available to the pipeline executor (axis rule ``"layers" → "pp"``)
+    and ``jax.checkpoint`` applied per block for recompute parity.
+    """
+
+    def __init__(self, make_block: Callable[[], Module], num_layers: int):
+        super().__init__()
+        self.num_layers = num_layers
+        self._block = make_block()  # underscore: excluded from children()
+
+    @property
+    def block(self) -> Module:
+        return self._block
+
+    def abstract_specs(self) -> dict:
+        inner = self._block.abstract_specs()
+        L = self.num_layers
+
+        def wrap(spec: ParamSpec) -> ParamSpec:
+            def init(key, shape, dtype, _orig=spec):
+                keys = jax.random.split(key, shape[0])
+                return jax.vmap(
+                    lambda k: _orig.init(k, _orig.shape, dtype))(keys)
+            axes = spec.axes if spec.axes is not None \
+                else (None,) * len(spec.shape)
+            return ParamSpec((L,) + spec.shape, init, spec.dtype,
+                             ("layers",) + axes)
+
+        return jax.tree.map(wrap, inner,
+                            is_leaf=lambda x: isinstance(x, ParamSpec))
+
+    def __call__(self, params, x, *, remat: str = "none", **kwargs):
+        def body(carry, layer_params):
+            return self._block(layer_params, carry, **kwargs), None
+
+        if remat != "none":
+            body = jax.checkpoint(body, policy=remat_policy(remat),
+                                  prevent_cse=False)
+        x, _ = jax.lax.scan(body, x, params)
+        return x

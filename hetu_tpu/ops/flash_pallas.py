@@ -63,6 +63,19 @@ def _expand_kv_ids(seg: jnp.ndarray) -> jnp.ndarray:
         seg, (seg.shape[0], NUM_SUBLANES, seg.shape[1]), (0, 2))
 
 
+def _block_live(iq, ik, *, causal, block_q, block_k, q_offset, kv_offset):
+    """Scalar predicate: does this (q_block, kv_block) cell have any live
+    causal entry? Cells entirely above the diagonal are skipped with
+    ``pl.when`` so the MXU never sees them (~2x FLOPs saved at long seq —
+    the flash-attn tiling trick the reference gets from the CUDA kernels).
+    Returns None when nothing can be skipped statically (non-causal)."""
+    if not causal:
+        return None
+    last_q = iq * block_q + (block_q - 1) + q_offset
+    first_k = ik * block_k + kv_offset
+    return last_q >= first_k
+
+
 def _mask_for_block(iq, ik, *, block_q, block_k, causal,
                     q_offset, kv_offset, q_ids, kv_ids):
     """Returns bool mask (block_q, block_k) or None if nothing masks."""
@@ -95,34 +108,45 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0, 0]  # (block_q, d), scale already folded in
-    k = k_ref[0, 0]  # (block_k, d)
-    v = v_ref[0, 0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+    def compute():
+        q = q_ref[0, 0]  # (block_q, d), scale already folded in
+        k = k_ref[0, 0]  # (block_k, d)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
 
-    q_ids = qseg_ref[0][:, :1] if qseg_ref is not None else None
-    kv_ids = kseg_ref[0][:1, :] if kseg_ref is not None else None
-    mask = _mask_for_block(iq, ik, block_q=block_q, block_k=block_k,
-                           causal=causal, q_offset=q_offset,
-                           kv_offset=kv_offset, q_ids=q_ids, kv_ids=kv_ids)
-    if mask is not None:
-        s = jnp.where(mask, s, NEG_INF)
+        q_ids = qseg_ref[0][:, :1] if qseg_ref is not None else None
+        kv_ids = kseg_ref[0][:1, :] if kseg_ref is not None else None
+        mask = _mask_for_block(iq, ik, block_q=block_q, block_k=block_k,
+                               causal=causal, q_offset=q_offset,
+                               kv_offset=kv_offset, q_ids=q_ids,
+                               kv_ids=kv_ids)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
 
-    m_prev = m_scr[:, :1]
-    l_prev = l_scr[:, :1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_next = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_next)
-    if mask is not None:
-        p = jnp.where(mask, p, 0.0)  # exact zero for fully-masked rows
-    l_cur = jnp.sum(p, axis=1, keepdims=True)
-    alpha = jnp.exp(m_prev - m_next)
-    m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
-    l_scr[...] = jnp.broadcast_to(alpha * l_prev + l_cur, l_scr.shape)
-    pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    acc_scr[...] = acc_scr[...] * alpha + pv
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_next)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)  # exact zero for fully-masked rows
+        l_cur = jnp.sum(p, axis=1, keepdims=True)
+        alpha = jnp.exp(m_prev - m_next)
+        m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(alpha * l_prev + l_cur, l_scr.shape)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+
+    live = _block_live(iq, ik, causal=causal, block_q=block_q,
+                       block_k=block_k, q_offset=q_offset,
+                       kv_offset=kv_offset)
+    if live is None:
+        compute()
+    else:
+        pl.when(live)(compute)
 
     @pl.when(ik == kv_blocks - 1)
     def _finalize():
@@ -220,32 +244,42 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0, 0]          # (bq, d) pre-scaled
-    k = k_ref[0, 0]          # (bk, d)
-    v = v_ref[0, 0]
-    do = do_ref[0, 0]        # (bq, d)
-    lse = lse_ref[0, 0][:, :1]     # (bq, 1)
-    delta = delta_ref[0, 0][:, :1]  # (bq, 1)
+    def compute():
+        q = q_ref[0, 0]          # (bq, d) pre-scaled
+        k = k_ref[0, 0]          # (bk, d)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]        # (bq, d)
+        lse = lse_ref[0, 0][:, :1]     # (bq, 1)
+        delta = delta_ref[0, 0][:, :1]  # (bq, 1)
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    q_ids = qseg_ref[0][:, :1] if qseg_ref is not None else None
-    kv_ids = kseg_ref[0][:1, :] if kseg_ref is not None else None
-    mask = _mask_for_block(iq, ik, block_q=block_q, block_k=block_k,
-                           causal=causal, q_offset=q_offset,
-                           kv_offset=kv_offset, q_ids=q_ids, kv_ids=kv_ids)
-    if mask is not None:
-        s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse)
-    if mask is not None:
-        p = jnp.where(mask, p, 0.0)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        q_ids = qseg_ref[0][:, :1] if qseg_ref is not None else None
+        kv_ids = kseg_ref[0][:1, :] if kseg_ref is not None else None
+        mask = _mask_for_block(iq, ik, block_q=block_q, block_k=block_k,
+                               causal=causal, q_offset=q_offset,
+                               kv_offset=kv_offset, q_ids=q_ids,
+                               kv_ids=kv_ids)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
 
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta)    # (bq, bk), fp32
-    dq_scr[...] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)    # (bq, bk), fp32
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = _block_live(iq, ik, causal=causal, block_q=block_q,
+                       block_k=block_k, q_offset=q_offset,
+                       kv_offset=kv_offset)
+    if live is None:
+        compute()
+    else:
+        pl.when(live)(compute)
 
     @pl.when(ik == kv_blocks - 1)
     def _finalize():
@@ -263,37 +297,47 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    q = q_ref[0, 0]
-    k = k_ref[0, 0]
-    v = v_ref[0, 0]
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0][:, :1]
-    delta = delta_ref[0, 0][:, :1]
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    q_ids = qseg_ref[0][:, :1] if qseg_ref is not None else None
-    kv_ids = kseg_ref[0][:1, :] if kseg_ref is not None else None
-    mask = _mask_for_block(iq, ik, block_q=block_q, block_k=block_k,
-                           causal=causal, q_offset=q_offset,
-                           kv_offset=kv_offset, q_ids=q_ids, kv_ids=kv_ids)
-    if mask is not None:
-        s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse)
-    if mask is not None:
-        p = jnp.where(mask, p, 0.0)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        q_ids = qseg_ref[0][:, :1] if qseg_ref is not None else None
+        kv_ids = kseg_ref[0][:1, :] if kseg_ref is not None else None
+        mask = _mask_for_block(iq, ik, block_q=block_q, block_k=block_k,
+                               causal=causal, q_offset=q_offset,
+                               kv_offset=kv_offset, q_ids=q_ids,
+                               kv_ids=kv_ids)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
 
-    # dV += P^T @ dO
-    dv_scr[...] += jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    # dS = P * (dO @ V^T - delta);  dK += dS^T @ Q
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta)
-    dk_scr[...] += jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        # dV += P^T @ dO
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dS = P * (dO @ V^T - delta);  dK += dS^T @ Q
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = _block_live(iq, ik, causal=causal, block_q=block_q,
+                       block_k=block_k, q_offset=q_offset,
+                       kv_offset=kv_offset)
+    if live is None:
+        compute()
+    else:
+        pl.when(live)(compute)
 
     @pl.when(iq == q_blocks - 1)
     def _finalize():

@@ -55,7 +55,9 @@ def vocab_parallel_cross_entropy(local_logits, labels, *, axis_name: str,
     safe_labels = jnp.where(valid, labels, 0)
 
     local_max = jnp.max(logits, axis=-1)
-    global_max = jax.lax.pmax(local_max, axis_name)
+    # max-shift cancels exactly in the CE value/gradient; stop_gradient keeps
+    # AD from needing a pmax transpose rule
+    global_max = jax.lax.pmax(jax.lax.stop_gradient(local_max), axis_name)
     shifted = logits - global_max[..., None]
     sum_exp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
 
@@ -69,3 +71,52 @@ def vocab_parallel_cross_entropy(local_logits, labels, *, axis_name: str,
 
     loss = (jnp.log(sum_exp) - tgt) * valid
     return loss, valid
+
+
+def vocab_parallel_lm_loss(hidden, vocab_weight, labels, *,
+                           ignore_index: int = -100):
+    """Mean LM loss with the (V, E) head weight sharded on vocab over tp.
+
+    The whole head — projection onto the vocab shard + vocab-parallel CE —
+    runs inside one ``shard_map`` over the active
+    :class:`~hetu_tpu.parallel.sharding.ActivationSharding` mesh, so the
+    full-vocab logits are never materialized on any device (reference:
+    ``ops/VocabParallelCrossEntropyLoss.cu`` fused with the column-parallel
+    lm_head, `parallel_multi_ds.py:268-327`). Falls back to the dense path
+    when no context / tp=1.
+    """
+    from jax import shard_map
+    import functools
+    from hetu_tpu.parallel.sharding import current_act_sharding
+
+    ctx = current_act_sharding()
+    # shard_map path needs a plain axis name (axis_index/psum take strings)
+    tp_deg = ctx.mesh.shape[ctx.tp] \
+        if (ctx and isinstance(ctx.tp, str)) else 1
+    if ctx is None or tp_deg <= 1 or vocab_weight.shape[0] % tp_deg != 0:
+        logits = jnp.einsum(
+            "bse,ve->bsv", hidden.astype(jnp.float32),
+            vocab_weight.astype(jnp.float32))
+        return cross_entropy_mean(logits, labels, ignore_index)
+
+    tp = ctx.tp
+    v_local = vocab_weight.shape[0] // tp_deg
+
+    @functools.partial(
+        shard_map, mesh=ctx.mesh,
+        in_specs=(jax.sharding.PartitionSpec(ctx.batch, ctx.seq, None),
+                  jax.sharding.PartitionSpec(tp, None),
+                  jax.sharding.PartitionSpec(ctx.batch, ctx.seq)),
+        out_specs=(jax.sharding.PartitionSpec(ctx.batch, ctx.seq),
+                   jax.sharding.PartitionSpec(ctx.batch, ctx.seq)),
+        check_vma=False)
+    def head(h, w, y):
+        local_logits = jnp.einsum(
+            "bse,ve->bsv", h.astype(jnp.float32), w.astype(jnp.float32))
+        vocab_start = jax.lax.axis_index(tp) * v_local
+        return vocab_parallel_cross_entropy(
+            local_logits, y, axis_name=tp, vocab_start=vocab_start,
+            ignore_index=ignore_index)
+
+    loss, valid = head(hidden, vocab_weight, labels)
+    return loss.sum() / jnp.maximum(valid.sum(), 1)

@@ -94,6 +94,69 @@ def constrain(x, spec: P):
     return jax.lax.with_sharding_constraint(x, spec)
 
 
+# -- activation sharding context -------------------------------------------
+#
+# The reference inserts comm ops between layers via ``SubstituteCommOp``
+# (``hetu/graph/executable_graph.cc:366``) by comparing producer/consumer
+# DistributedStates. On TPU the analogue is ``with_sharding_constraint`` on
+# activations; models call :func:`act_constrain` at the canonical cut points
+# and the trainer activates an :class:`ActivationSharding` context (built
+# from the Strategy) around tracing. Outside the context the calls are
+# no-ops, so models stay mesh-agnostic.
+
+_ACT_CTX: list["ActivationSharding"] = []
+
+
+@dataclasses.dataclass(frozen=True)
+class ActivationSharding:
+    """Per-kind PartitionSpecs for activations + the mesh they live on.
+
+    ``batch``/``seq``/``tp`` are mesh axis names (or axis tuples / None).
+    """
+
+    mesh: Mesh
+    batch: Any = None       # mesh axes for the batch dim (e.g. "dp" or ("dp","ep"))
+    seq: Any = None         # mesh axes for the sequence dim (cp; "tp" if Megatron-SP)
+    tp: Any = None          # plain axis NAME for tp-sharded feature/head dims
+                            # (the shard_map vocab-parallel paths need a string)
+
+    def spec(self, kind: str) -> Optional[P]:
+        if kind == "tokens":        # (batch, seq, embed)
+            return P(self.batch, self.seq, None)
+        if kind == "hidden":        # (batch, seq, features/tp)
+            return P(self.batch, self.seq, self.tp)
+        if kind == "heads":         # (batch, seq, heads/tp, head_dim)
+            return P(self.batch, self.seq, self.tp, None)
+        if kind == "logits":        # (batch, seq, vocab/tp)
+            return P(self.batch, self.seq, self.tp)
+        raise ValueError(f"unknown activation kind {kind!r}")
+
+    def __enter__(self):
+        _ACT_CTX.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _ACT_CTX.pop()
+        return False
+
+
+def current_act_sharding() -> Optional[ActivationSharding]:
+    return _ACT_CTX[-1] if _ACT_CTX else None
+
+
+def act_constrain(x, kind: str):
+    """Constrain an activation to the active context's spec for ``kind``.
+
+    No-op when no :class:`ActivationSharding` context is active (single
+    device, oracle tests) — models may therefore call this unconditionally.
+    """
+    ctx = current_act_sharding()
+    if ctx is None:
+        return x
+    spec = ctx.spec(kind)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(ctx.mesh, spec))
+
+
 def sharded_init(module: Module, key, mesh: Mesh, rules: AxisRules,
                  dtype=None) -> Any:
     """Initialize params directly in their sharded layout (jit + out

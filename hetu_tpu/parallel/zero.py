@@ -1,0 +1,77 @@
+"""ZeRO optimizer-state sharding.
+
+The reference marks ZeRO on a tensor's DistributedStates (``zero`` flag,
+``hetu/graph/distributed_states.h:69-75``) and bookkeeps the pre-ZeRO
+hierarchy (``define_and_run_graph.h:177``); grads are reduce-scattered and
+params re-allgathered around the update. On TPU the whole mechanism is a
+*sharding spec for the optimizer state*: moments inherit the param's spec
+plus a ``dp`` shard on a free dim, and GSPMD emits exactly the
+reduce-scatter / all-gather pair when the jitted update runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def add_axis_to_spec(spec: P, shape, mesh: Mesh, axis: str) -> P:
+    """Shard ``axis`` onto the first unsharded dim it divides; no-op if none
+    fits or the axis has degree 1 (mirrors the reference's
+    ``states_can_be_split`` validity rule)."""
+    if mesh.shape.get(axis, 1) <= 1:
+        return spec
+    size = mesh.shape[axis]
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    # already sharded over this axis (e.g. FSDP params) — nothing to add
+    for part in parts:
+        if part == axis or (isinstance(part, tuple) and axis in part):
+            return spec
+    for i, (part, dim) in enumerate(zip(parts, shape)):
+        if part is None and dim % size == 0:
+            parts[i] = axis
+            while parts and parts[-1] is None:
+                parts.pop()
+            return P(*parts)
+    return spec
+
+
+def opt_state_partition_specs(state_struct: Any, params_struct: Any,
+                              param_specs: Any, *, mesh: Mesh,
+                              zero_axis: Optional[str] = None) -> Any:
+    """PartitionSpec tree for an optimizer state.
+
+    Subtrees structurally matching the param pytree (Adam mu/nu, momentum
+    velocity, fp32 master copies) inherit the param specs — plus a
+    ``zero_axis`` ("dp") shard when ZeRO-1 is on. Scalar leaves (step counts)
+    replicate.
+    """
+    params_treedef = jax.tree.structure(params_struct)
+
+    def leaf_spec(leaf_struct, spec: P) -> P:
+        if zero_axis is None:
+            return spec
+        return add_axis_to_spec(spec, leaf_struct.shape, mesh, zero_axis)
+
+    def walk(node):
+        if node is None:
+            return None
+        try:
+            if jax.tree.structure(node) == params_treedef:
+                return jax.tree.map(leaf_spec, node, param_specs)
+        except Exception:
+            pass
+        if isinstance(node, tuple):
+            children = [walk(c) for c in node]
+            if hasattr(node, "_fields"):  # NamedTuple state
+                return type(node)(*children)
+            return tuple(children)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return P()  # scalar leaf (count) — replicated
+
+    return walk(state_struct)

@@ -1,0 +1,121 @@
+"""Model-level tests: shapes, loss-goes-down (the reference's
+``test_cifar10.py``/``test_simple_model.py`` pattern, SURVEY §4), and
+tp-sharded loss parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu import optim
+from hetu_tpu.engine import make_plan, init_state, build_train_step
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel, LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.optim.base import apply_updates
+from hetu_tpu.parallel.strategy import Strategy
+
+
+def _batch(key, vocab, b=4, s=16):
+    ids = jax.random.randint(key, (b, s + 1), 0, vocab)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+@pytest.mark.parametrize("model_cls,cfg", [
+    (GPTLMHeadModel, GPTConfig.tiny()),
+    (LlamaLMHeadModel, LlamaConfig.tiny()),
+])
+def test_forward_shapes(rng, model_cls, cfg):
+    model = model_cls(cfg)
+    params = model.init(rng, dtype=jnp.float32)
+    batch = _batch(jax.random.key(1), cfg.vocab_size)
+    logits = model(params, batch["input_ids"])
+    assert logits.shape == (4, 16, cfg.vocab_size)
+    loss = model.loss(params, batch["input_ids"], batch["labels"])
+    assert jnp.isfinite(loss)
+    # loss of random init ≈ log(vocab)
+    assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.0
+
+
+@pytest.mark.parametrize("model_cls,cfg", [
+    (GPTLMHeadModel, GPTConfig.tiny()),
+    (LlamaLMHeadModel, LlamaConfig.tiny()),
+])
+def test_loss_decreases(rng, model_cls, cfg):
+    model = model_cls(cfg)
+    params = model.init(rng, dtype=jnp.float32)
+    opt = optim.adam(1e-3)
+    opt_state = opt.init(params)
+    batch = _batch(jax.random.key(2), cfg.vocab_size)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: model.loss(p, batch["input_ids"], batch["labels"])
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_llama_untied_head(rng):
+    cfg = LlamaConfig.tiny()  # tie_embeddings=False → separate lm_head
+    model = LlamaLMHeadModel(cfg)
+    params = model.init(rng, dtype=jnp.float32)
+    assert "lm_head" in params
+    loss = model.loss(params, *(_batch(jax.random.key(3), cfg.vocab_size)
+                                .values()))
+    assert jnp.isfinite(loss)
+
+
+def test_gpt_tp_loss_parity(rng):
+    """tp=4 GPT loss (vocab-parallel head + shard_map embedding) matches the
+    single-device value — VERDICT item 7's done-criterion."""
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    params = model.init(rng, dtype=jnp.float32)
+    batch = _batch(jax.random.key(4), cfg.vocab_size)
+    ref = float(model.loss(params, batch["input_ids"], batch["labels"]))
+
+    strat = Strategy(dp=2, tp=4)
+    plan = make_plan(model, optim.adam(1e-3), strat)
+    from hetu_tpu.parallel.sharding import shard_params
+    sp = shard_params(params, plan.mesh, plan.param_specs)
+    sbatch = plan.shard_batch(batch)
+
+    @jax.jit
+    def loss_fn(p, b):
+        with plan.act:
+            return model.loss(p, b["input_ids"], b["labels"])
+
+    got = float(loss_fn(sp, sbatch))
+    np.testing.assert_allclose(ref, got, rtol=1e-5)
+
+
+def test_packed_segment_ids_isolate_sequences(rng):
+    """Packing two sequences with segment_ids must equal per-sequence losses
+    (reference: packing via cu_seqlens, ``data/bucket.py``)."""
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    params = model.init(rng, dtype=jnp.float32)
+    k1, k2 = jax.random.split(jax.random.key(5))
+    a = jax.random.randint(k1, (1, 8), 0, cfg.vocab_size)
+    b = jax.random.randint(k2, (1, 8), 0, cfg.vocab_size)
+
+    # packed: both sequences in one row, positions reset, segments marked
+    packed_ids = jnp.concatenate([a, b], axis=1)
+    positions = jnp.concatenate([jnp.arange(8), jnp.arange(8)])[None, :]
+    segs = jnp.concatenate([jnp.zeros(8, jnp.int32),
+                            jnp.ones(8, jnp.int32)])[None, :]
+
+    logits_packed = model(params, packed_ids, positions=positions,
+                          segment_ids=segs)
+    logits_a = model(params, a)
+    logits_b = model(params, b)
+    np.testing.assert_allclose(np.asarray(logits_packed[:, :8]),
+                               np.asarray(logits_a), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(logits_packed[:, 8:]),
+                               np.asarray(logits_b), rtol=2e-4, atol=2e-4)

@@ -1,0 +1,190 @@
+"""TP parallel-layer semantics on the 8-device virtual mesh.
+
+Mirrors the reference's ds-deduction tests (``tests/test_parallel.py``) but
+actually *executes* the sharded compute and checks numerics against the
+unsharded oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hetu_tpu.nn.parallel import (
+    ColumnParallelLinear, ParallelAttention, ParallelMLP, StackedBlocks,
+    VocabParallelEmbedding,
+)
+from hetu_tpu.models.gpt import GPTBlock, GPTConfig
+from hetu_tpu.ops.losses import vocab_parallel_lm_loss, cross_entropy_mean
+from hetu_tpu.parallel.sharding import (
+    ActivationSharding, param_partition_specs, shard_params,
+)
+from hetu_tpu.parallel.strategy import Strategy
+
+
+def _tp_env(strategy=None):
+    strategy = strategy or Strategy(dp=2, tp=4)
+    mesh = strategy.build_mesh()
+    rules = strategy.axis_rules()
+    act = ActivationSharding(mesh, batch="dp", seq="cp", tp="tp")
+    return strategy, mesh, rules, act
+
+
+def _run_sharded(module, params, x, mesh, rules, act, x_spec):
+    specs = param_partition_specs(module, rules, mesh=mesh)
+    sp = shard_params(params, mesh, specs)
+    xs = jax.device_put(x, NamedSharding(mesh, x_spec))
+
+    @jax.jit
+    def f(p, x):
+        with act:
+            return module(p, x)
+
+    return f(sp, xs)
+
+
+def test_mlp_tp_parity(rng):
+    mlp = ParallelMLP(16, 32, bias=True)
+    params = mlp.init(rng, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (4, 8, 16), jnp.float32)
+    ref = mlp(params, x)
+    _, mesh, rules, act = _tp_env()
+    out = _run_sharded(mlp, params, x, mesh, rules, act, P("dp", None, None))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gated_mlp_tp_parity(rng):
+    mlp = ParallelMLP(16, 32, bias=False, gated=True)
+    params = mlp.init(rng, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(2), (2, 8, 16), jnp.float32)
+    ref = mlp(params, x)
+    _, mesh, rules, act = _tp_env()
+    out = _run_sharded(mlp, params, x, mesh, rules, act, P("dp", None, None))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_attention_tp_parity(rng):
+    attn = ParallelAttention(32, 4, num_kv_heads=2, bias=False, causal=True,
+                             use_rope=True, max_positions=64)
+    params = attn.init(rng, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(3), (2, 16, 32), jnp.float32)
+    ref = attn(params, x)
+    _, mesh, rules, act = _tp_env(Strategy(dp=2, tp=2))
+    out = _run_sharded(attn, params, x, mesh, rules, act, P("dp", None, None))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_vocab_parallel_embedding_matches_take(rng):
+    emb = VocabParallelEmbedding(32, 16)
+    params = emb.init(rng, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.key(4), (4, 8), 0, 32)
+    ref = emb(params, ids)  # no context → plain take
+    _, mesh, rules, act = _tp_env()
+    specs = param_partition_specs(emb, rules, mesh=mesh)
+    sp = shard_params(params, mesh, specs)
+    # vocab dim must actually be sharded for the shard_map path
+    assert specs["weight"] == P("tp")
+
+    @jax.jit
+    def f(p, i):
+        with act:
+            return emb(p, i)
+
+    out = f(sp, jax.device_put(ids, NamedSharding(mesh, P("dp", None))))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_vocab_parallel_lm_loss_matches_dense(rng):
+    V, E = 32, 16
+    w = jax.random.normal(rng, (V, E), jnp.float32) * 0.1
+    h = jax.random.normal(jax.random.key(5), (4, 8, E), jnp.float32)
+    labels = jax.random.randint(jax.random.key(6), (4, 8), 0, V)
+    labels = labels.at[0, :2].set(-100)  # exercise ignore_index
+    logits = jnp.einsum("bse,ve->bsv", h, w)
+    ref = cross_entropy_mean(logits, labels)
+
+    _, mesh, rules, act = _tp_env()
+
+    @jax.jit
+    def f(h, w, y):
+        with act:
+            return vocab_parallel_lm_loss(h, w, y)
+
+    out = f(jax.device_put(h, NamedSharding(mesh, P("dp", None, None))),
+            jax.device_put(w, NamedSharding(mesh, P("tp", None))),
+            jax.device_put(labels, NamedSharding(mesh, P("dp", None))))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_vocab_parallel_lm_loss_grads_match_dense(rng):
+    V, E = 32, 16
+    w = jax.random.normal(rng, (V, E), jnp.float32) * 0.1
+    h = jax.random.normal(jax.random.key(7), (2, 8, E), jnp.float32)
+    labels = jax.random.randint(jax.random.key(8), (2, 8), 0, V)
+
+    def dense(h, w):
+        return cross_entropy_mean(jnp.einsum("bse,ve->bsv", h, w), labels)
+
+    gh_ref, gw_ref = jax.grad(dense, argnums=(0, 1))(h, w)
+
+    _, mesh, rules, act = _tp_env()
+
+    @jax.jit
+    def g(h, w):
+        with act:
+            return jax.grad(
+                lambda h, w: vocab_parallel_lm_loss(h, w, labels),
+                argnums=(0, 1))(h, w)
+
+    gh, gw = g(jax.device_put(h, NamedSharding(mesh, P("dp", None, None))),
+               jax.device_put(w, NamedSharding(mesh, P("tp", None))))
+    np.testing.assert_allclose(np.asarray(gh_ref), np.asarray(gh),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw_ref), np.asarray(gw),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_stacked_blocks_match_sequential(rng):
+    cfg = GPTConfig.tiny()
+    stacked = StackedBlocks(lambda: GPTBlock(cfg), cfg.num_layers)
+    params = stacked.init(rng, dtype=jnp.float32)
+    # every leaf gains a leading layers dim
+    for leaf in jax.tree.leaves(params):
+        assert leaf.shape[0] == cfg.num_layers
+
+    x = jax.random.normal(jax.random.key(9), (2, 8, cfg.hidden_size),
+                          jnp.float32)
+    out = stacked(params, x)
+
+    ref = x
+    block = stacked.block
+    for i in range(cfg.num_layers):
+        layer_i = jax.tree.map(lambda p: p[i], params)
+        ref = block(layer_i, ref)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("remat", ["full", "selective"])
+def test_stacked_blocks_remat_parity(rng, remat):
+    cfg = GPTConfig.tiny()
+    stacked = StackedBlocks(lambda: GPTBlock(cfg), cfg.num_layers)
+    params = stacked.init(rng, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(10), (2, 8, cfg.hidden_size),
+                          jnp.float32)
+
+    def loss(p, r):
+        return jnp.sum(stacked(p, x, remat=r) ** 2)
+
+    ref = jax.grad(loss)(params, "none")
+    got = jax.grad(loss)(params, remat)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        ref, got)

@@ -241,8 +241,17 @@ class ParallelAttention(Module):
         q = act_constrain(q, "heads")
         k = act_constrain(k, "heads")
         v = act_constrain(v, "heads")
-        out = flash_attention(q, k, v, causal=self.causal,
-                              segment_ids=segment_ids, impl=attn_impl)
+        ctx = current_act_sharding()
+        if ctx is not None and isinstance(ctx.seq, str) \
+                and ctx.mesh.shape[ctx.seq] > 1:
+            # context parallelism: seq dim is sharded — run the KV ring
+            # (reference: ParallelAttentionOp → AttnCommRing when cp>1)
+            from hetu_tpu.parallel.ring_attention import ring_attention
+            out = ring_attention(q, k, v, ctx=ctx, causal=self.causal,
+                                 segment_ids=segment_ids, impl=attn_impl)
+        else:
+            out = flash_attention(q, k, v, causal=self.causal,
+                                  segment_ids=segment_ids, impl=attn_impl)
         out = act_constrain(out, "heads")
         out = out.reshape(b, s, self.num_heads * self.head_dim)
         return self.out_proj(params["out_proj"], out)

@@ -346,8 +346,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
-               q_offset=0, kv_offset=0, interpret=None):
-    """Returns (dq, dk, dv) in input dtypes/shapes ((b,h,s,d) layout)."""
+               q_offset=0, kv_offset=0, interpret=None, delta=None):
+    """Returns (dq, dk, dv) in input dtypes/shapes ((b,h,s,d) layout).
+
+    ``delta`` (b,hq,sq) fp32 may be precomputed by the caller (ring
+    attention passes the globally-combined value); defaults to
+    sum(out*do, -1)."""
     b, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     rep = hq // hkv
@@ -356,8 +360,9 @@ def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
     interpret = _interpret_default() if interpret is None else interpret
 
     qf = (q.astype(jnp.float32) * scale).astype(q.dtype)
-    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
-                    axis=-1)                                    # (b,hq,sq)
+    if delta is None:
+        delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                        axis=-1)                                # (b,hq,sq)
     lse_l = jax.lax.broadcast_in_dim(lse, (*lse.shape, NUM_LANES), (0, 1, 2))
     delta_l = jax.lax.broadcast_in_dim(delta, (*delta.shape, NUM_LANES),
                                        (0, 1, 2))

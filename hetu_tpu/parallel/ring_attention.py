@@ -1,0 +1,272 @@
+"""Ring-attention context parallelism.
+
+TPU-native re-design of the reference's ``AttnCommRing``
+(``hetu/graph/ops/ParallelAttention.h:391-470``): the sequence dim is
+sharded over the ``cp`` mesh axis; KV blocks rotate around the ring via
+``jax.lax.ppermute`` (the reference uses batched NCCL P2P with bounded
+``kv_storage`` slots); each hop runs flash attention with the per-pair mask
+(CAUSAL on the diagonal hop, FULL for earlier chunks, EMPTY/skipped for
+later chunks — the reference's ``AttnMask`` enum :27-33); partial outputs
+are combined with online-softmax LSE correction (``ExecCorr``); the
+backward ring piggybacks dK/dV accumulators on the rotating KV blocks
+(``PrepareKVBlocks(piggyback_grad)`` :401).
+
+Differences from the reference, by design:
+- The ring is expressed *inside* ``shard_map`` with a ``custom_vjp``; XLA
+  schedules the ppermute/compute overlap instead of hand-managed streams.
+- Chunking is contiguous (the reference's NORMAL split). Its SYM/STRIPE
+  load-balancing splits are a data-side concern
+  (``data/bucket.py:193`` CP-symmetric packing) layered on top.
+- Packing/varlen uses segment ids (global across the sequence), which ride
+  the ring alongside KV.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from hetu_tpu.ops.attention import NEG_INF, _expand_kv
+
+# --------------------------------------------------------------------------
+# Per-hop attention: forward returns (out fp32, lse fp32); backward consumes
+# the *combined* lse (ring-attention math: p_hop = exp(s_hop - lse_total)).
+# Layouts: q/k/v/o (b, s, h, d); lse/delta (b, h, s).
+# --------------------------------------------------------------------------
+
+
+def _hop_fwd_ref(q, k, v, q_seg, kv_seg, *, causal, scale):
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    kf = _expand_kv(k, hq).astype(jnp.float32)
+    vf = _expand_kv(v, hq).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, kf)
+    mask = _hop_mask(sq, sk, causal, q_seg, kv_seg)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # guard fully-masked rows (all NEG_INF)
+    m = jnp.maximum(m, NEG_INF)
+    p = jnp.exp(s - m)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    lse = jnp.where(l[..., 0] == 0.0, NEG_INF, m[..., 0] + jnp.log(
+        jnp.where(l[..., 0] == 0.0, 1.0, l[..., 0])))          # (b,h,q)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+    o = o / jnp.where(l[..., 0] == 0.0, 1.0, l[..., 0]).transpose(
+        0, 2, 1)[..., None]
+    return o, lse
+
+
+def _hop_bwd_ref(q, k, v, q_seg, kv_seg, lse, delta, do, *, causal, scale):
+    """dq/dk/dv for one hop given combined lse and delta (fp32, (b,h,s))."""
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    rep = hq // hkv
+    kf = _expand_kv(k, hq).astype(jnp.float32)
+    vf = _expand_kv(v, hq).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf * scale, kf)
+    mask = _hop_mask(sq, sk, causal, q_seg, kv_seg)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])          # (b,h,q,k)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    ds = p * (dp - delta[..., None])         # (b,h,q,k)
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+    if rep > 1:
+        dk = dk.reshape(b, sk, hkv, rep, d).sum(axis=3)
+        dv = dv.reshape(b, sk, hkv, rep, d).sum(axis=3)
+    return dq, dk, dv
+
+
+def _hop_mask(sq, sk, causal, q_seg, kv_seg):
+    mask = None
+    if causal:
+        mask = (jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+                )[None, None]
+    if q_seg is not None:
+        smask = (q_seg[:, None, :, None] == kv_seg[:, None, None, :])
+        mask = smask if mask is None else mask & smask
+    return mask
+
+
+def _hop_fwd_pallas(q, k, v, q_seg, kv_seg, *, causal, scale):
+    from hetu_tpu.ops.flash_pallas import _flash_fwd
+    out, lse = _flash_fwd(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+        q_seg, kv_seg, causal=causal, scale=scale)
+    return jnp.swapaxes(out, 1, 2).astype(jnp.float32), lse
+
+
+def _hop_bwd_pallas(q, k, v, q_seg, kv_seg, lse, delta, do, *,
+                    causal, scale):
+    from hetu_tpu.ops.flash_pallas import _flash_bwd
+    qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    doh = jnp.swapaxes(do, 1, 2)
+    # out is only used by _flash_bwd to derive delta; we pass the combined
+    # delta explicitly, so a placeholder is fine.
+    dq, dk, dv = _flash_bwd(qh, kh, vh, q_seg, kv_seg, qh, lse, doh,
+                            causal=causal, scale=scale, delta=delta)
+    return (jnp.swapaxes(dq, 1, 2).astype(jnp.float32),
+            jnp.swapaxes(dk, 1, 2).astype(jnp.float32),
+            jnp.swapaxes(dv, 1, 2).astype(jnp.float32))
+
+
+def _combine(out_acc, lse_acc, out_h, lse_h):
+    """Online-softmax merge of two normalized partials (the reference's
+    ``ExecCorr``). out (b,s,h,d) fp32; lse (b,h,s) fp32."""
+    lse_new = jnp.logaddexp(lse_acc, lse_h)
+    w_acc = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
+    w_h = jnp.exp(lse_h - lse_new).transpose(0, 2, 1)[..., None]
+    return out_acc * w_acc + out_h * w_h, lse_new
+
+
+# --------------------------------------------------------------------------
+# The ring (runs per-device inside shard_map)
+# --------------------------------------------------------------------------
+
+
+def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
+                    use_pallas: bool):
+    hop_fwd = _hop_fwd_pallas if use_pallas else _hop_fwd_ref
+    hop_bwd = _hop_bwd_pallas if use_pallas else _hop_bwd_ref
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def rotate(tree):
+        return jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), tree)
+
+    @jax.custom_vjp
+    def ring(q, k, v, q_seg, kv_seg):
+        out, _ = _ring_fwd(q, k, v, q_seg, kv_seg)
+        return out
+
+    def _ring_fwd(q, k, v, q_seg, kv_seg):
+        idx = jax.lax.axis_index(axis_name)
+        b, sq, hq, d = q.shape
+        out_acc = jnp.zeros(q.shape, jnp.float32)
+        lse_acc = jnp.full((b, hq, sq), NEG_INF, jnp.float32)
+        k_cur, v_cur, kvseg_cur = k, v, kv_seg
+        for hop in range(cp):
+            if hop == 0:
+                out_h, lse_h = hop_fwd(q, k_cur, v_cur, q_seg, kvseg_cur,
+                                       causal=causal, scale=scale)
+            else:
+                src = (idx - hop) % cp
+
+                def live(kv):
+                    kk, vv, ss = kv
+                    return hop_fwd(q, kk, vv, q_seg, ss,
+                                   causal=False, scale=scale)
+
+                def dead(kv):
+                    return (jnp.zeros(q.shape, jnp.float32),
+                            jnp.full((b, hq, sq), NEG_INF, jnp.float32))
+
+                # contiguous chunks: src<idx ⇒ all kv earlier ⇒ FULL;
+                # src>idx ⇒ all kv later ⇒ EMPTY (skip). Non-causal
+                # attention needs every hop.
+                pred = (src < idx) if causal else jnp.bool_(True)
+                out_h, lse_h = jax.lax.cond(
+                    pred, live, dead, (k_cur, v_cur, kvseg_cur))
+            out_acc, lse_acc = _combine(out_acc, lse_acc, out_h, lse_h)
+            if hop < cp - 1:
+                k_cur, v_cur, kvseg_cur = rotate((k_cur, v_cur, kvseg_cur))
+        return out_acc.astype(q.dtype), lse_acc
+
+    def ring_fwd(q, k, v, q_seg, kv_seg):
+        out, lse = _ring_fwd(q, k, v, q_seg, kv_seg)
+        return out, (q, k, v, q_seg, kv_seg, out, lse)
+
+    def ring_bwd(res, g):
+        q, k, v, q_seg, kv_seg, out, lse = res
+        idx = jax.lax.axis_index(axis_name)
+        do = g
+        delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                        axis=-1).transpose(0, 2, 1)        # (b,h,sq)
+        dq_acc = jnp.zeros(q.shape, jnp.float32)
+        k_cur, v_cur, kvseg_cur = k, v, kv_seg
+        dkv = (jnp.zeros(k.shape, jnp.float32),
+               jnp.zeros(v.shape, jnp.float32))
+        for hop in range(cp):
+            if hop == 0:
+                dq_h, dk_h, dv_h = hop_bwd(q, k_cur, v_cur, q_seg,
+                                           kvseg_cur, lse, delta, do,
+                                           causal=causal, scale=scale)
+            else:
+                src = (idx - hop) % cp
+
+                def live(kv):
+                    kk, vv, ss = kv
+                    return hop_bwd(q, kk, vv, q_seg, ss, lse, delta, do,
+                                   causal=False, scale=scale)
+
+                def dead(kv):
+                    return (jnp.zeros(q.shape, jnp.float32),
+                            jnp.zeros(k.shape, jnp.float32),
+                            jnp.zeros(v.shape, jnp.float32))
+
+                pred = (src < idx) if causal else jnp.bool_(True)
+                dq_h, dk_h, dv_h = jax.lax.cond(
+                    pred, live, dead, (k_cur, v_cur, kvseg_cur))
+            dq_acc = dq_acc + dq_h
+            dkv = (dkv[0] + dk_h, dkv[1] + dv_h)
+            # dK/dV accumulators ride the ring with their KV blocks; after
+            # cp rotations each lands back on its owner (the reference's
+            # piggyback_grad).
+            k_cur, v_cur, kvseg_cur, dkv = (
+                *rotate((k_cur, v_cur, kvseg_cur)), rotate(dkv))
+        return (dq_acc.astype(q.dtype), dkv[0].astype(k.dtype),
+                dkv[1].astype(v.dtype), None, None)
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
+
+
+def ring_attention(q, k, v, *, ctx, causal: bool = True,
+                   segment_ids: Optional[jnp.ndarray] = None,
+                   scale: Optional[float] = None, impl: str = "auto"):
+    """Context-parallel attention over ``ctx.seq`` (global arrays in,
+    global arrays out; seq dim sharded over the cp axis).
+
+    ``ctx`` is the active ActivationSharding; heads shard over ``ctx.tp``
+    when that is a plain axis name.
+    """
+    assert isinstance(ctx.seq, str), "ring attention needs a named cp axis"
+    cp = ctx.mesh.shape[ctx.seq]
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    s_local = q.shape[1] // cp
+    if impl == "auto":
+        use_pallas = (jax.default_backend() == "tpu"
+                      and d in (64, 128, 256) and s_local % 128 == 0)
+    else:
+        use_pallas = impl == "pallas"
+
+    ring = _make_ring_core(ctx.seq, cp, causal, scale, use_pallas)
+    tp_ax = ctx.tp if isinstance(ctx.tp, str) else None
+    qkv_spec = P(ctx.batch, ctx.seq, tp_ax, None)
+    seg_spec = P(ctx.batch, ctx.seq)
+
+    if segment_ids is None:
+        # materialize trivial ids so the ring carries a consistent pytree
+        segment_ids = jnp.zeros(q.shape[:2], jnp.int32)
+
+    fn = shard_map(
+        ring, mesh=ctx.mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec, seg_spec),
+        out_specs=qkv_spec, check_vma=False)
+    return fn(q, k, v, segment_ids, segment_ids)

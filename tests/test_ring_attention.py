@@ -1,0 +1,158 @@
+"""Ring-attention CP vs the full-sequence oracle (fwd + grads).
+
+Reference semantics under test: ``AttnCommRing``
+(``hetu/graph/ops/ParallelAttention.h:391-470``) — per-hop masks, LSE
+correction, backward ring with dKV piggyback.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hetu_tpu.ops.attention import attention_reference
+from hetu_tpu.parallel.ring_attention import ring_attention
+from hetu_tpu.parallel.sharding import ActivationSharding
+from hetu_tpu.parallel.strategy import Strategy
+
+
+def _env(cp, dp=1):
+    mesh = Strategy(dp=dp, cp=cp).build_mesh()
+    return ActivationSharding(mesh, batch="dp", seq="cp", tp="tp"), mesh
+
+
+def _qkv(key, b=2, s=32, hq=4, hkv=2, d=8):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, hkv, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_oracle_fwd(rng, cp, causal):
+    ctx, mesh = _env(cp)
+    q, k, v = _qkv(rng)
+    ref = attention_reference(q, k, v, causal=causal)
+
+    @jax.jit
+    def f(q, k, v):
+        return ring_attention(q, k, v, ctx=ctx, causal=causal)
+
+    sh = NamedSharding(mesh, P("dp", "cp", None, None))
+    out = f(*(jax.device_put(x, sh) for x in (q, k, v)))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+def test_ring_matches_oracle_grads(rng, cp):
+    ctx, mesh = _env(cp)
+    q, k, v = _qkv(rng)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gq_ref, gk_ref, gv_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+
+    @jax.jit
+    def g(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(
+                ring_attention(q, k, v, ctx=ctx, causal=True) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    sh = NamedSharding(mesh, P("dp", "cp", None, None))
+    gq, gk, gv = g(*(jax.device_put(x, sh) for x in (q, k, v)))
+    np.testing.assert_allclose(np.asarray(gq_ref), np.asarray(gq),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gk_ref), np.asarray(gk),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gv_ref), np.asarray(gv),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ring_packed_segments(rng):
+    """Packed sequences must not attend across segment boundaries, even
+    when a segment spans a cp chunk boundary."""
+    cp = 2
+    ctx, mesh = _env(cp)
+    q, k, v = _qkv(rng, s=32)
+    # segment 0: tokens 0..19 (spans the cp boundary at 16); segment 1: rest
+    segs = (jnp.arange(32) >= 20).astype(jnp.int32)[None, :].repeat(2, 0)
+    ref = attention_reference(q, k, v, causal=True, segment_ids=segs)
+
+    @jax.jit
+    def f(q, k, v, s):
+        return ring_attention(q, k, v, ctx=ctx, causal=True,
+                              segment_ids=s)
+
+    sh = NamedSharding(mesh, P("dp", "cp", None, None))
+    ssh = NamedSharding(mesh, P("dp", "cp"))
+    out = f(jax.device_put(q, sh), jax.device_put(k, sh),
+            jax.device_put(v, sh), jax.device_put(segs, ssh))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_with_dp_and_tp(rng):
+    """cp composed with dp on the same mesh."""
+    ctx, mesh = _env(cp=2, dp=2)
+    q, k, v = _qkv(rng, b=4)
+    ref = attention_reference(q, k, v, causal=True)
+
+    @jax.jit
+    def f(q, k, v):
+        return ring_attention(q, k, v, ctx=ctx, causal=True)
+
+    sh = NamedSharding(mesh, P("dp", "cp", None, None))
+    out = f(*(jax.device_put(x, sh) for x in (q, k, v)))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_pallas_interpret(rng):
+    """The Pallas per-hop kernel path (interpret mode on CPU)."""
+    cp = 2
+    ctx, mesh = _env(cp)
+    q, k, v = _qkv(rng, b=1, s=256, hq=2, hkv=1, d=64)
+    ref = attention_reference(q, k, v, causal=True)
+
+    @jax.jit
+    def f(q, k, v):
+        return ring_attention(q, k, v, ctx=ctx, causal=True, impl="pallas")
+
+    sh = NamedSharding(mesh, P("dp", "cp", None, None))
+    out = f(*(jax.device_put(x, sh) for x in (q, k, v)))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_model_uses_ring_under_cp(rng):
+    """End-to-end: GPT loss under cp=4 matches single-device (the model
+    routes attention through the ring when ctx.seq is sharded)."""
+    from hetu_tpu import optim
+    from hetu_tpu.engine import make_plan
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+    from hetu_tpu.parallel.sharding import shard_params
+
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    params = model.init(rng, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (2, 33), 0, cfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    ref = float(model.loss(params, batch["input_ids"], batch["labels"]))
+
+    plan = make_plan(model, optim.adam(1e-3), Strategy(dp=2, cp=4))
+    sp = shard_params(params, plan.mesh, plan.param_specs)
+    sbatch = plan.shard_batch(batch)
+
+    @jax.jit
+    def loss_fn(p, b):
+        with plan.act:
+            return model.loss(p, b["input_ids"], b["labels"])
+
+    got = float(loss_fn(sp, sbatch))
+    np.testing.assert_allclose(ref, got, rtol=1e-5)

@@ -120,6 +120,11 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
     """
     strategy = plan.strategy
     if strategy.pp > 1:
+        if loss_fn is not None:
+            raise ValueError(
+                "custom loss_fn is not supported with pp > 1 — the pipeline "
+                "executor schedules model.embed/blocks/head_loss itself; "
+                "override model.head_loss instead")
         from hetu_tpu.parallel.pipeline import build_pipeline_train_step
         return build_pipeline_train_step(model, opt, plan,
                                          attn_impl=attn_impl, donate=donate)

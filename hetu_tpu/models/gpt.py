@@ -57,7 +57,11 @@ class GPTBlock(Module):
                                cfg.mlp_ratio * cfg.hidden_size,
                                bias=True, gated=False)
 
-    def __call__(self, params, x, *, segment_ids=None, attn_impl="auto"):
+    def __call__(self, params, x, *, positions=None, segment_ids=None,
+                 attn_impl="auto"):
+        # positions accepted for pipeline-payload uniformity (GPT's learned
+        # position embedding is applied in embed(), not per block)
+        del positions
         x = x + self.attn(params["attn"], self.ln_1(params["ln_1"], x),
                           segment_ids=segment_ids, attn_impl=attn_impl)
         x = x + self.mlp(params["mlp"], self.ln_2(params["ln_2"], x))
@@ -77,16 +81,30 @@ class GPTLMHeadModel(Module):
         self.blocks = StackedBlocks(lambda: GPTBlock(cfg), cfg.num_layers)
         self.ln_f = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
 
-    def hidden_states(self, params, input_ids, *, positions=None,
-                      segment_ids=None, attn_impl="auto", remat="none"):
-        b, s = input_ids.shape
+    def embed(self, params, input_ids, *, positions=None):
+        s = input_ids.shape[-1]
         if positions is None:
             positions = jnp.arange(s)[None, :]
         h = self.wte(params["wte"], input_ids) \
             + self.wpe(params["wpe"], positions)
-        h = act_constrain(h, "tokens")
-        h = self.blocks(params["blocks"], h, remat=remat,
-                        segment_ids=segment_ids, attn_impl=attn_impl)
+        return act_constrain(h, "tokens")
+
+    def head_loss(self, params, h, labels, *, ignore_index: int = -100):
+        """Final norm + (vocab-parallel) LM loss on *pre-norm* backbone
+        output."""
+        h = self.ln_f(params["ln_f"], h)
+        return vocab_parallel_lm_loss(h, params["wte"]["weight"], labels,
+                                      ignore_index=ignore_index)
+
+    def backbone(self, params, input_ids, *, positions=None,
+                 segment_ids=None, attn_impl="auto", remat="none"):
+        """embed + blocks, WITHOUT the final norm (head_loss applies it)."""
+        h = self.embed(params, input_ids, positions=positions)
+        return self.blocks(params["blocks"], h, remat=remat,
+                           segment_ids=segment_ids, attn_impl=attn_impl)
+
+    def hidden_states(self, params, input_ids, **kwargs):
+        h = self.backbone(params, input_ids, **kwargs)
         return self.ln_f(params["ln_f"], h)
 
     def __call__(self, params, input_ids, **kwargs):
@@ -97,12 +115,8 @@ class GPTLMHeadModel(Module):
                             w.astype(jnp.float32))
         return act_constrain(logits, "logits")
 
-    def loss(self, params, input_ids, labels, *, positions=None,
-             segment_ids=None, attn_impl="auto", remat="none",
-             ignore_index: int = -100):
+    def loss(self, params, input_ids, labels, *, ignore_index: int = -100,
+             **kwargs):
         """Mean LM loss; the head runs vocab-parallel when tp is active."""
-        h = self.hidden_states(params, input_ids, positions=positions,
-                               segment_ids=segment_ids, attn_impl=attn_impl,
-                               remat=remat)
-        return vocab_parallel_lm_loss(h, params["wte"]["weight"], labels,
-                                      ignore_index=ignore_index)
+        h = self.backbone(params, input_ids, **kwargs)
+        return self.head_loss(params, h, labels, ignore_index=ignore_index)

@@ -101,13 +101,28 @@ class LlamaLMHeadModel(Module):
             return params["wte"]["weight"]
         return params["lm_head"]["weight"].T
 
-    def hidden_states(self, params, input_ids, *, positions=None,
-                      segment_ids=None, attn_impl="auto", remat="none"):
+    def embed(self, params, input_ids, *, positions=None):
+        del positions  # rotary positions are applied inside the blocks
         h = self.wte(params["wte"], input_ids)
-        h = act_constrain(h, "tokens")
-        h = self.blocks(params["blocks"], h, remat=remat,
-                        positions=positions, segment_ids=segment_ids,
-                        attn_impl=attn_impl)
+        return act_constrain(h, "tokens")
+
+    def head_loss(self, params, h, labels, *, ignore_index: int = -100):
+        """Final norm + (vocab-parallel) LM loss on *pre-norm* backbone
+        output."""
+        h = self.final_norm(params["final_norm"], h)
+        return vocab_parallel_lm_loss(h, self._head_weight(params), labels,
+                                      ignore_index=ignore_index)
+
+    def backbone(self, params, input_ids, *, positions=None,
+                 segment_ids=None, attn_impl="auto", remat="none"):
+        """embed + blocks, WITHOUT the final norm (head_loss applies it)."""
+        h = self.embed(params, input_ids)
+        return self.blocks(params["blocks"], h, remat=remat,
+                           positions=positions, segment_ids=segment_ids,
+                           attn_impl=attn_impl)
+
+    def hidden_states(self, params, input_ids, **kwargs):
+        h = self.backbone(params, input_ids, **kwargs)
         return self.final_norm(params["final_norm"], h)
 
     def __call__(self, params, input_ids, **kwargs):
@@ -117,11 +132,7 @@ class LlamaLMHeadModel(Module):
                             w.astype(jnp.float32))
         return act_constrain(logits, "logits")
 
-    def loss(self, params, input_ids, labels, *, positions=None,
-             segment_ids=None, attn_impl="auto", remat="none",
-             ignore_index: int = -100):
-        h = self.hidden_states(params, input_ids, positions=positions,
-                               segment_ids=segment_ids, attn_impl=attn_impl,
-                               remat=remat)
-        return vocab_parallel_lm_loss(h, self._head_weight(params), labels,
-                                      ignore_index=ignore_index)
+    def loss(self, params, input_ids, labels, *, ignore_index: int = -100,
+             **kwargs):
+        h = self.backbone(params, input_ids, **kwargs)
+        return self.head_loss(params, h, labels, ignore_index=ignore_index)

@@ -1,0 +1,170 @@
+"""Pipeline parallelism: single-jit microbatch-streaming executor.
+
+The reference's pipeline is a host-driven per-op scheduler: pipedream-flush /
+gpipe task lists (``hetu/graph/executable_graph.cc:836,803``), NCCL-grouped
+P2P between stages (.cc:987-1008), shared-embedding send/recv classification
+(.cc:1868-1960). The TPU-native design is one SPMD program: the stacked
+``layers`` axis of the block params is sharded over the ``pp`` mesh axis
+(axis rule ``"layers" → "pp"``), and inside a *partial-manual* ``shard_map``
+(manual over pp only — dp/tp/cp stay GSPMD-auto) microbatches stream through
+stages with ``ppermute``; a ``lax.scan`` over ``num_microbatches + pp - 1``
+ticks realizes the fill/steady/drain schedule. Reverse-mode AD through the
+scan+ppermute yields the flush-style backward automatically, and per-stage
+``jax.checkpoint`` bounds activation memory like the reference's
+pipedream-flush + recompute combination.
+
+Shared embeddings (wte used by the first stage's input and the LM head) need
+no P2P machinery here: both uses live outside the manual region, so GSPMD
+sums their gradient contributions — subsuming ``executable_graph.cc:1868``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hetu_tpu.engine.state import TrainState
+from hetu_tpu.nn.parallel import remat_policy
+from hetu_tpu.optim.base import apply_updates
+from hetu_tpu.optim.clipping import global_norm
+from hetu_tpu.parallel.sharding import no_act_sharding
+
+
+def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
+                    *, mesh: Mesh, num_microbatches: int,
+                    pp_axis: str = "pp", remat: str = "none") -> jnp.ndarray:
+    """Run ``payload`` microbatches through pp pipeline stages.
+
+    ``block_fn(layer_params, x, **extras)`` applies one transformer block.
+    ``stacked_params``: leaves with leading ``layers`` dim, sharded over
+    ``pp_axis``. ``payload``: dict with key ``"x"`` of shape
+    (nm, mb, s, E) plus extra per-microbatch arrays (positions,
+    segment_ids) that travel with the activations through the ring.
+    Returns the final hidden states, (nm, mb, s, E).
+    """
+    nm = num_microbatches
+    pp = mesh.shape[pp_axis]
+    ticks = nm + pp - 1
+    payload = {k: v for k, v in payload.items() if v is not None}
+
+    def device_fn(params_local, payload_all):
+        stage = jax.lax.axis_index(pp_axis)
+
+        def one_block(h, layer_params, extras):
+            return block_fn(layer_params, h, **extras)
+
+        if remat != "none":
+            one_block = jax.checkpoint(
+                one_block, policy=remat_policy(remat), prevent_cse=False)
+
+        def stage_fn(cur):
+            extras = {k: v for k, v in cur.items() if k != "x"}
+            x, _ = jax.lax.scan(
+                lambda h, lp: (one_block(h, lp, extras), None),
+                cur["x"], params_local)
+            return {**cur, "x": x}
+
+        zero = jax.tree.map(lambda v: jnp.zeros_like(v[0]), payload_all)
+        out_buf = jnp.zeros_like(payload_all["x"])
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            cur, out_buf = carry
+            # stage 0 ingests microbatch t (clamped during drain)
+            feed = jax.tree.map(
+                lambda v: jax.lax.dynamic_index_in_dim(
+                    v, jnp.clip(t, 0, nm - 1), axis=0, keepdims=False),
+                payload_all)
+            cur = jax.tree.map(
+                lambda f, c: jnp.where(stage == 0, f, c), feed, cur)
+            y = stage_fn(cur)
+            # last stage emits microbatch t-(pp-1) (during fill: masked off)
+            slot = jnp.clip(t - (pp - 1), 0, nm - 1)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                out_buf, y["x"].astype(out_buf.dtype), slot, 0)
+            out_buf = jnp.where(t >= pp - 1, updated, out_buf)
+            nxt = jax.tree.map(
+                lambda a: jax.lax.ppermute(a, pp_axis, perm), y)
+            return (nxt, out_buf), None
+
+        (_, out_buf), _ = jax.lax.scan(
+            tick, (zero, out_buf), jnp.arange(ticks))
+        # only the last stage holds real outputs; broadcast over the ring
+        return jax.lax.psum(
+            jnp.where(stage == pp - 1, out_buf,
+                      jnp.zeros([], out_buf.dtype)), pp_axis)
+
+    param_specs = jax.tree.map(lambda _: P(pp_axis), stacked_params)
+    payload_specs = jax.tree.map(lambda _: P(), payload)
+
+    fn = shard_map(
+        device_fn, mesh=mesh,
+        in_specs=(param_specs, payload_specs), out_specs=P(),
+        axis_names={pp_axis}, check_vma=False)
+    # activation-sharding constraints don't apply inside the manual region
+    # (and ring attention must not nest another shard_map) — trace with the
+    # context suppressed
+    with no_act_sharding():
+        return fn(stacked_params, payload)
+
+
+def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
+                              donate: bool = True) -> Callable:
+    """jitted ``step(state, batch)`` for strategies with pp > 1.
+
+    Schedule parity target: pipedream-flush
+    (``GeneratePipedreamFlushSchedule``, ``executable_graph.cc:836``) —
+    same bubble fraction, with memory bounded via per-block remat instead
+    of 1F1B interleaving.
+    """
+    from hetu_tpu.engine.train_step import effective_remat
+
+    strategy, mesh = plan.strategy, plan.mesh
+    nm = strategy.num_microbatches
+    remat = effective_remat(strategy)
+
+    def loss_fn(params, batch):
+        with plan.act:
+            ids, labels = batch["input_ids"], batch["labels"]
+            B, s = ids.shape
+            mb = B // nm
+            positions = batch.get("positions")
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(s)[None, :], (B, s))
+            seg = batch.get("segment_ids")
+
+            h0 = model.embed(params, ids, positions=positions)
+            payload = {
+                "x": h0.reshape(nm, mb, *h0.shape[1:]),
+                "positions": positions.reshape(nm, mb, s),
+            }
+            if seg is not None:
+                payload["segment_ids"] = seg.reshape(nm, mb, s)
+
+            block = model.blocks.block
+            block_fn = functools.partial(block, attn_impl=attn_impl)
+            h = pipeline_blocks(
+                block_fn, params["blocks"], payload, mesh=mesh,
+                num_microbatches=nm, remat=remat)
+            h = h.reshape(B, s, -1)
+            return model.head_loss(params, h, labels)
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def step(state: TrainState, batch: dict):
+        loss, grads = grad_fn(state.params, batch)
+        gnorm = global_norm(grads)
+        updates, new_opt = opt.update(grads, state.opt_state, state.params)
+        new_params = apply_updates(state.params, updates)
+        metrics = {"loss": loss, "grad_norm": gnorm}
+        return TrainState(state.step + 1, new_params, new_opt), metrics
+
+    return jax.jit(
+        step,
+        out_shardings=(plan.state_shardings, None),
+        donate_argnums=(0,) if donate else ())

@@ -158,17 +158,20 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
         b, sq, hq, d = q.shape
         out_acc = jnp.zeros(q.shape, jnp.float32)
         lse_acc = jnp.full((b, hq, sq), NEG_INF, jnp.float32)
-        k_cur, v_cur, kvseg_cur = k, v, kv_seg
+        kv_cur = (k, v, kv_seg) if kv_seg is not None else (k, v)
         for hop in range(cp):
+            kvseg_cur = kv_cur[2] if kv_seg is not None else None
             if hop == 0:
-                out_h, lse_h = hop_fwd(q, k_cur, v_cur, q_seg, kvseg_cur,
-                                       causal=causal, scale=scale)
+                out_h, lse_h = hop_fwd(q, kv_cur[0], kv_cur[1], q_seg,
+                                       kvseg_cur, causal=causal,
+                                       scale=scale)
             else:
                 src = (idx - hop) % cp
 
                 def live(kv):
-                    kk, vv, ss = kv
-                    return hop_fwd(q, kk, vv, q_seg, ss,
+                    return hop_fwd(q, kv[0], kv[1],
+                                   q_seg, kv[2] if kv_seg is not None
+                                   else None,
                                    causal=False, scale=scale)
 
                 def dead(kv):
@@ -179,11 +182,10 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
                 # src>idx ⇒ all kv later ⇒ EMPTY (skip). Non-causal
                 # attention needs every hop.
                 pred = (src < idx) if causal else jnp.bool_(True)
-                out_h, lse_h = jax.lax.cond(
-                    pred, live, dead, (k_cur, v_cur, kvseg_cur))
+                out_h, lse_h = jax.lax.cond(pred, live, dead, kv_cur)
             out_acc, lse_acc = _combine(out_acc, lse_acc, out_h, lse_h)
             if hop < cp - 1:
-                k_cur, v_cur, kvseg_cur = rotate((k_cur, v_cur, kvseg_cur))
+                kv_cur = rotate(kv_cur)
         return out_acc.astype(q.dtype), lse_acc
 
     def ring_fwd(q, k, v, q_seg, kv_seg):
@@ -197,20 +199,22 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
         delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                         axis=-1).transpose(0, 2, 1)        # (b,h,sq)
         dq_acc = jnp.zeros(q.shape, jnp.float32)
-        k_cur, v_cur, kvseg_cur = k, v, kv_seg
+        kv_cur = (k, v, kv_seg) if kv_seg is not None else (k, v)
         dkv = (jnp.zeros(k.shape, jnp.float32),
                jnp.zeros(v.shape, jnp.float32))
         for hop in range(cp):
+            kvseg_cur = kv_cur[2] if kv_seg is not None else None
             if hop == 0:
-                dq_h, dk_h, dv_h = hop_bwd(q, k_cur, v_cur, q_seg,
+                dq_h, dk_h, dv_h = hop_bwd(q, kv_cur[0], kv_cur[1], q_seg,
                                            kvseg_cur, lse, delta, do,
                                            causal=causal, scale=scale)
             else:
                 src = (idx - hop) % cp
 
                 def live(kv):
-                    kk, vv, ss = kv
-                    return hop_bwd(q, kk, vv, q_seg, ss, lse, delta, do,
+                    return hop_bwd(q, kv[0], kv[1], q_seg,
+                                   kv[2] if kv_seg is not None else None,
+                                   lse, delta, do,
                                    causal=False, scale=scale)
 
                 def dead(kv):
@@ -219,15 +223,17 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
                             jnp.zeros(v.shape, jnp.float32))
 
                 pred = (src < idx) if causal else jnp.bool_(True)
-                dq_h, dk_h, dv_h = jax.lax.cond(
-                    pred, live, dead, (k_cur, v_cur, kvseg_cur))
+                dq_h, dk_h, dv_h = jax.lax.cond(pred, live, dead, kv_cur)
             dq_acc = dq_acc + dq_h
             dkv = (dkv[0] + dk_h, dkv[1] + dv_h)
             # dK/dV accumulators ride the ring with their KV blocks; after
             # cp rotations each lands back on its owner (the reference's
-            # piggyback_grad).
-            k_cur, v_cur, kvseg_cur, dkv = (
-                *rotate((k_cur, v_cur, kvseg_cur)), rotate(dkv))
+            # piggyback_grad). On the final hop only the accumulators still
+            # need to travel.
+            if hop < cp - 1:
+                kv_cur, dkv = rotate((kv_cur, dkv))
+            else:
+                dkv = rotate(dkv)
         return (dq_acc.astype(q.dtype), dkv[0].astype(k.dtype),
                 dkv[1].astype(v.dtype), None, None)
 
@@ -259,12 +265,17 @@ def ring_attention(q, k, v, *, ctx, causal: bool = True,
     ring = _make_ring_core(ctx.seq, cp, causal, scale, use_pallas)
     tp_ax = ctx.tp if isinstance(ctx.tp, str) else None
     qkv_spec = P(ctx.batch, ctx.seq, tp_ax, None)
-    seg_spec = P(ctx.batch, ctx.seq)
 
     if segment_ids is None:
-        # materialize trivial ids so the ring carries a consistent pytree
-        segment_ids = jnp.zeros(q.shape[:2], jnp.int32)
+        # no packing: hops run the cheaper no-segment kernel variant and
+        # the ring carries only (k, v)
+        fn = shard_map(
+            lambda q, k, v: ring(q, k, v, None, None), mesh=ctx.mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec, check_vma=False)
+        return fn(q, k, v)
 
+    seg_spec = P(ctx.batch, ctx.seq)
     fn = shard_map(
         ring, mesh=ctx.mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec, seg_spec),

@@ -144,6 +144,23 @@ def current_act_sharding() -> Optional[ActivationSharding]:
     return _ACT_CTX[-1] if _ACT_CTX else None
 
 
+class no_act_sharding:
+    """Suppress the active ActivationSharding (pushes None).
+
+    Used while tracing code inside a manual ``shard_map`` region (the
+    pipeline executor), where GSPMD constraints don't apply and ring
+    attention must not nest another shard_map.
+    """
+
+    def __enter__(self):
+        _ACT_CTX.append(None)
+        return None
+
+    def __exit__(self, *exc):
+        _ACT_CTX.pop()
+        return False
+
+
 def act_constrain(x, kind: str):
     """Constrain an activation to the active context's spec for ``kind``.
 

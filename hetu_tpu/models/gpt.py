@@ -33,16 +33,31 @@ class GPTConfig:
     mlp_ratio: int = 4
     layer_norm_eps: float = 1e-5
     init_std: float = 0.02
+    # MoE (0 experts = dense; parity: HetuMoE GPT, BASELINE config 4)
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @classmethod
     def small(cls):
         return cls()
 
     @classmethod
+    def moe_8e(cls):
+        """GPT-MoE 8-expert (BASELINE config 4)."""
+        return cls(num_experts=8)
+
+    @classmethod
     def tiny(cls):
         """Test-size config."""
         return cls(vocab_size=256, max_positions=128, hidden_size=64,
                    num_layers=2, num_heads=4)
+
+    @classmethod
+    def tiny_moe(cls, num_experts=4, **kw):
+        return cls(vocab_size=256, max_positions=128, hidden_size=64,
+                   num_layers=2, num_heads=4, num_experts=num_experts, **kw)
 
 
 class GPTBlock(Module):
@@ -53,9 +68,17 @@ class GPTBlock(Module):
             cfg.hidden_size, cfg.num_heads, bias=True, causal=True,
             use_rope=False, init=normal_init(cfg.init_std))
         self.ln_2 = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
-        self.mlp = ParallelMLP(cfg.hidden_size,
-                               cfg.mlp_ratio * cfg.hidden_size,
-                               bias=True, gated=False)
+        if cfg.num_experts > 0:
+            from hetu_tpu.nn.moe import MoEMLP
+            self.mlp = MoEMLP(cfg.hidden_size,
+                              cfg.mlp_ratio * cfg.hidden_size,
+                              cfg.num_experts, k=cfg.moe_top_k,
+                              capacity_factor=cfg.moe_capacity_factor)
+            self.returns_aux = True
+        else:
+            self.mlp = ParallelMLP(cfg.hidden_size,
+                                   cfg.mlp_ratio * cfg.hidden_size,
+                                   bias=True, gated=False)
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto"):
@@ -64,8 +87,11 @@ class GPTBlock(Module):
         del positions
         x = x + self.attn(params["attn"], self.ln_1(params["ln_1"], x),
                           segment_ids=segment_ids, attn_impl=attn_impl)
-        x = x + self.mlp(params["mlp"], self.ln_2(params["ln_2"], x))
-        return act_constrain(x, "tokens")
+        h = self.mlp(params["mlp"], self.ln_2(params["ln_2"], x))
+        if self.returns_aux:
+            h, aux = h
+            return act_constrain(x + h, "tokens"), aux
+        return act_constrain(x + h, "tokens")
 
 
 class GPTLMHeadModel(Module):
@@ -98,13 +124,18 @@ class GPTLMHeadModel(Module):
 
     def backbone(self, params, input_ids, *, positions=None,
                  segment_ids=None, attn_impl="auto", remat="none"):
-        """embed + blocks, WITHOUT the final norm (head_loss applies it)."""
+        """embed + blocks, WITHOUT the final norm (head_loss applies it).
+        Returns ``(h, aux)`` — aux is 0 for dense models, the accumulated
+        MoE load-balance loss otherwise."""
         h = self.embed(params, input_ids, positions=positions)
-        return self.blocks(params["blocks"], h, remat=remat,
-                           segment_ids=segment_ids, attn_impl=attn_impl)
+        out = self.blocks(params["blocks"], h, remat=remat,
+                          segment_ids=segment_ids, attn_impl=attn_impl)
+        if self.blocks.returns_aux:
+            return out
+        return out, jnp.zeros([], jnp.float32)
 
     def hidden_states(self, params, input_ids, **kwargs):
-        h = self.backbone(params, input_ids, **kwargs)
+        h, _ = self.backbone(params, input_ids, **kwargs)
         return self.ln_f(params["ln_f"], h)
 
     def __call__(self, params, input_ids, **kwargs):
@@ -117,6 +148,8 @@ class GPTLMHeadModel(Module):
 
     def loss(self, params, input_ids, labels, *, ignore_index: int = -100,
              **kwargs):
-        """Mean LM loss; the head runs vocab-parallel when tp is active."""
-        h = self.backbone(params, input_ids, **kwargs)
-        return self.head_loss(params, h, labels, ignore_index=ignore_index)
+        """Mean LM loss (+ MoE aux); the head runs vocab-parallel when tp
+        is active."""
+        h, aux = self.backbone(params, input_ids, **kwargs)
+        lm = self.head_loss(params, h, labels, ignore_index=ignore_index)
+        return lm + self.cfg.moe_aux_coef * aux

@@ -38,6 +38,11 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     init_std: float = 0.02
     tie_embeddings: bool = False
+    # MoE (0 experts = dense; experts are SwiGLU like the dense MLP)
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @classmethod
     def llama_7b(cls):
@@ -67,8 +72,16 @@ class LlamaBlock(Module):
             rope_theta=cfg.rope_theta, max_positions=cfg.max_positions,
             init=normal_init(cfg.init_std))
         self.post_attn_norm = RMSNorm(cfg.hidden_size, eps=cfg.rms_norm_eps)
-        self.mlp = ParallelMLP(cfg.hidden_size, cfg.intermediate_size,
-                               bias=False, gated=True)
+        if cfg.num_experts > 0:
+            from hetu_tpu.nn.moe import MoEMLP
+            self.mlp = MoEMLP(cfg.hidden_size, cfg.intermediate_size,
+                              cfg.num_experts, k=cfg.moe_top_k,
+                              capacity_factor=cfg.moe_capacity_factor,
+                              gated=True)
+            self.returns_aux = True
+        else:
+            self.mlp = ParallelMLP(cfg.hidden_size, cfg.intermediate_size,
+                                   bias=False, gated=True)
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto"):
@@ -76,9 +89,12 @@ class LlamaBlock(Module):
                           self.input_norm(params["input_norm"], x),
                           positions=positions, segment_ids=segment_ids,
                           attn_impl=attn_impl)
-        x = x + self.mlp(params["mlp"],
-                         self.post_attn_norm(params["post_attn_norm"], x))
-        return act_constrain(x, "tokens")
+        h = self.mlp(params["mlp"],
+                     self.post_attn_norm(params["post_attn_norm"], x))
+        if self.returns_aux:
+            h, aux = h
+            return act_constrain(x + h, "tokens"), aux
+        return act_constrain(x + h, "tokens")
 
 
 class LlamaLMHeadModel(Module):
@@ -115,14 +131,18 @@ class LlamaLMHeadModel(Module):
 
     def backbone(self, params, input_ids, *, positions=None,
                  segment_ids=None, attn_impl="auto", remat="none"):
-        """embed + blocks, WITHOUT the final norm (head_loss applies it)."""
+        """embed + blocks, WITHOUT the final norm (head_loss applies it).
+        Returns ``(h, aux)`` — aux is 0 for dense models."""
         h = self.embed(params, input_ids)
-        return self.blocks(params["blocks"], h, remat=remat,
-                           positions=positions, segment_ids=segment_ids,
-                           attn_impl=attn_impl)
+        out = self.blocks(params["blocks"], h, remat=remat,
+                          positions=positions, segment_ids=segment_ids,
+                          attn_impl=attn_impl)
+        if self.blocks.returns_aux:
+            return out
+        return out, jnp.zeros([], jnp.float32)
 
     def hidden_states(self, params, input_ids, **kwargs):
-        h = self.backbone(params, input_ids, **kwargs)
+        h, _ = self.backbone(params, input_ids, **kwargs)
         return self.final_norm(params["final_norm"], h)
 
     def __call__(self, params, input_ids, **kwargs):
@@ -134,5 +154,6 @@ class LlamaLMHeadModel(Module):
 
     def loss(self, params, input_ids, labels, *, ignore_index: int = -100,
              **kwargs):
-        h = self.backbone(params, input_ids, **kwargs)
-        return self.head_loss(params, h, labels, ignore_index=ignore_index)
+        h, aux = self.backbone(params, input_ids, **kwargs)
+        lm = self.head_loss(params, h, labels, ignore_index=ignore_index)
+        return lm + self.cfg.moe_aux_coef * aux

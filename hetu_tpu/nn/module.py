@@ -54,6 +54,11 @@ class Module:
     (``hetu/graph/subgraph.h:36``).
     """
 
+    #: modules whose __call__ returns (output, aux_loss) — e.g. MoE layers
+    #: with a load-balance term — set this True so containers (Sequential,
+    #: StackedBlocks, the pipeline executor) thread the aux accumulation.
+    returns_aux: bool = False
+
     def __init__(self):
         self._param_specs: dict[str, ParamSpec] = {}
 

@@ -316,12 +316,26 @@ class StackedBlocks(Module):
         return jax.tree.map(wrap, inner,
                             is_leaf=lambda x: isinstance(x, ParamSpec))
 
+    @property
+    def returns_aux(self):
+        return self._block.returns_aux
+
     def __call__(self, params, x, *, remat: str = "none", **kwargs):
-        def body(carry, layer_params):
-            return self._block(layer_params, carry, **kwargs), None
+        if self._block.returns_aux:
+            def body(carry, layer_params):
+                h, aux = carry
+                h, a = self._block(layer_params, h, **kwargs)
+                return (h, aux + a), None
+        else:
+            def body(carry, layer_params):
+                return self._block(layer_params, carry, **kwargs), None
 
         if remat != "none":
             body = jax.checkpoint(body, policy=remat_policy(remat),
                                   prevent_cse=False)
+        if self._block.returns_aux:
+            (x, aux), _ = jax.lax.scan(
+                body, (x, jnp.zeros([], jnp.float32)), params)
+            return x, aux
         x, _ = jax.lax.scan(body, x, params)
         return x

@@ -37,20 +37,26 @@ from hetu_tpu.parallel.sharding import no_act_sharding
 
 def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
                     *, mesh: Mesh, num_microbatches: int,
-                    pp_axis: str = "pp", remat: str = "none") -> jnp.ndarray:
+                    pp_axis: str = "pp", remat: str = "none",
+                    block_returns_aux: bool = False):
     """Run ``payload`` microbatches through pp pipeline stages.
 
-    ``block_fn(layer_params, x, **extras)`` applies one transformer block.
+    ``block_fn(layer_params, x, **extras)`` applies one transformer block
+    (returning ``(x, aux)`` when ``block_returns_aux``).
     ``stacked_params``: leaves with leading ``layers`` dim, sharded over
     ``pp_axis``. ``payload``: dict with key ``"x"`` of shape
     (nm, mb, s, E) plus extra per-microbatch arrays (positions,
     segment_ids) that travel with the activations through the ring.
-    Returns the final hidden states, (nm, mb, s, E).
+    Returns final hidden states (nm, mb, s, E), or ``(h, aux)`` with aux
+    of shape (nm,) when blocks carry an aux loss.
     """
     nm = num_microbatches
     pp = mesh.shape[pp_axis]
     ticks = nm + pp - 1
     payload = {k: v for k, v in payload.items() if v is not None}
+    if block_returns_aux:
+        payload["aux"] = jnp.zeros((nm,), jnp.float32)
+    collect = ("x", "aux") if block_returns_aux else ("x",)
 
     def device_fn(params_local, payload_all):
         stage = jax.lax.axis_index(pp_axis)
@@ -63,18 +69,27 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
                 one_block, policy=remat_policy(remat), prevent_cse=False)
 
         def stage_fn(cur):
-            extras = {k: v for k, v in cur.items() if k != "x"}
+            extras = {k: v for k, v in cur.items()
+                      if k not in ("x", "aux")}
+            if block_returns_aux:
+                def body(carry, lp):
+                    h, aux = carry
+                    h, a = one_block(h, lp, extras)
+                    return (h, aux + a), None
+                (x, aux), _ = jax.lax.scan(
+                    body, (cur["x"], cur["aux"]), params_local)
+                return {**cur, "x": x, "aux": aux}
             x, _ = jax.lax.scan(
                 lambda h, lp: (one_block(h, lp, extras), None),
                 cur["x"], params_local)
             return {**cur, "x": x}
 
         zero = jax.tree.map(lambda v: jnp.zeros_like(v[0]), payload_all)
-        out_buf = jnp.zeros_like(payload_all["x"])
+        out_bufs = {k: jnp.zeros_like(payload_all[k]) for k in collect}
         perm = [(i, (i + 1) % pp) for i in range(pp)]
 
         def tick(carry, t):
-            cur, out_buf = carry
+            cur, out_bufs = carry
             # stage 0 ingests microbatch t (clamped during drain)
             feed = jax.tree.map(
                 lambda v: jax.lax.dynamic_index_in_dim(
@@ -85,32 +100,40 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
             y = stage_fn(cur)
             # last stage emits microbatch t-(pp-1) (during fill: masked off)
             slot = jnp.clip(t - (pp - 1), 0, nm - 1)
-            updated = jax.lax.dynamic_update_index_in_dim(
-                out_buf, y["x"].astype(out_buf.dtype), slot, 0)
-            out_buf = jnp.where(t >= pp - 1, updated, out_buf)
+            new_bufs = {}
+            for key in collect:
+                updated = jax.lax.dynamic_update_index_in_dim(
+                    out_bufs[key], y[key].astype(out_bufs[key].dtype),
+                    slot, 0)
+                new_bufs[key] = jnp.where(t >= pp - 1, updated,
+                                          out_bufs[key])
             nxt = jax.tree.map(
                 lambda a: jax.lax.ppermute(a, pp_axis, perm), y)
-            return (nxt, out_buf), None
+            return (nxt, new_bufs), None
 
-        (_, out_buf), _ = jax.lax.scan(
-            tick, (zero, out_buf), jnp.arange(ticks))
+        (_, out_bufs), _ = jax.lax.scan(
+            tick, (zero, out_bufs), jnp.arange(ticks))
         # only the last stage holds real outputs; broadcast over the ring
-        return jax.lax.psum(
-            jnp.where(stage == pp - 1, out_buf,
-                      jnp.zeros([], out_buf.dtype)), pp_axis)
+        return {k: jax.lax.psum(
+            jnp.where(stage == pp - 1, v, jnp.zeros([], v.dtype)), pp_axis)
+            for k, v in out_bufs.items()}
 
     param_specs = jax.tree.map(lambda _: P(pp_axis), stacked_params)
     payload_specs = jax.tree.map(lambda _: P(), payload)
 
     fn = shard_map(
         device_fn, mesh=mesh,
-        in_specs=(param_specs, payload_specs), out_specs=P(),
+        in_specs=(param_specs, payload_specs),
+        out_specs={k: P() for k in collect},
         axis_names={pp_axis}, check_vma=False)
     # activation-sharding constraints don't apply inside the manual region
     # (and ring attention must not nest another shard_map) — trace with the
     # context suppressed
     with no_act_sharding():
-        return fn(stacked_params, payload)
+        out = fn(stacked_params, payload)
+    if block_returns_aux:
+        return out["x"], out["aux"]
+    return out["x"]
 
 
 def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
@@ -148,11 +171,20 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
 
             block = model.blocks.block
             block_fn = functools.partial(block, attn_impl=attn_impl)
-            h = pipeline_blocks(
+            out = pipeline_blocks(
                 block_fn, params["blocks"], payload, mesh=mesh,
-                num_microbatches=nm, remat=remat)
+                num_microbatches=nm, remat=remat,
+                block_returns_aux=block.returns_aux)
+            aux = jnp.zeros([], jnp.float32)
+            if block.returns_aux:
+                h, aux_mb = out
+                aux = jnp.mean(aux_mb)
+            else:
+                h = out
             h = h.reshape(B, s, -1)
-            return model.head_loss(params, h, labels)
+            lm = model.head_loss(params, h, labels)
+            coef = getattr(model.cfg, "moe_aux_coef", 0.0)
+            return lm + coef * aux
 
     grad_fn = jax.value_and_grad(loss_fn)
 

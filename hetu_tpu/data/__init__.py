@@ -1,0 +1,19 @@
+"""Data pipeline: datasets, packing, buckets, loaders.
+
+Parity target: ``python/hetu/data`` — ``JsonDataset``, packing buckets
+(``bucket.py:8,86,193``), sample- and token-level batch samplers
+(``dataloader.py:46,162,244``).
+"""
+
+from hetu_tpu.data.packing import PackedBatch, pack_sequences
+from hetu_tpu.data.bucket import SeqLenBuckets
+from hetu_tpu.data.dataset import JsonDataset, SyntheticLMDataset
+from hetu_tpu.data.loader import (
+    build_data_loader, sample_batches, token_batches,
+)
+
+__all__ = [
+    "PackedBatch", "pack_sequences", "SeqLenBuckets",
+    "JsonDataset", "SyntheticLMDataset",
+    "build_data_loader", "sample_batches", "token_batches",
+]

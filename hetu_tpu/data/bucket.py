@@ -1,0 +1,46 @@
+"""Sequence-length buckets — the static-shape answer to dynamic seq lens.
+
+The reference handles varying sequence lengths with symbolic shapes
+(``hetu/core/symbol.h:19,95,160``) propagated through shape plans
+(``DeduceShapePlan``, ``define_and_run_graph.cc:303``). Under XLA every
+shape is a compilation, so the TPU-native equivalent is a small set of
+bucket lengths: each batch is padded/packed to its bucket and jit caches
+one executable per bucket (SURVEY §7.3 item 4).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+
+class SeqLenBuckets:
+    """Map raw lengths to a fixed set of bucket lengths."""
+
+    def __init__(self, sizes: Sequence[int] | None = None, *,
+                 min_len: int = 128, max_len: int = 8192,
+                 multiple_of: int = 1):
+        if sizes is None:
+            sizes, s = [], min_len
+            while s <= max_len:
+                sizes.append(s)
+                s *= 2
+        sizes = sorted(set(int(s) for s in sizes))
+        for s in sizes:
+            if s % multiple_of != 0:
+                raise ValueError(
+                    f"bucket size {s} not a multiple of {multiple_of} "
+                    f"(cp/block alignment)")
+        self.sizes = sizes
+
+    def bucket_for(self, length: int) -> int:
+        for s in self.sizes:
+            if length <= s:
+                return s
+        return self.sizes[-1]
+
+    def group(self, lengths: Iterable[int]) -> dict[int, list[int]]:
+        """indices grouped by bucket size."""
+        out: dict[int, list[int]] = {}
+        for i, L in enumerate(lengths):
+            out.setdefault(self.bucket_for(L), []).append(i)
+        return out

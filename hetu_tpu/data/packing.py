@@ -1,0 +1,105 @@
+"""Sequence packing with segment ids and reset positions.
+
+Parity target: ``python/hetu/data/bucket.py`` — ``Bucket.pack_data`` (:86)
+packs variable-length sequences into fixed rows with ``cu_seqlens``;
+``generate_cp_pack_data`` (:193) makes rows CP-splittable. The TPU-native
+formulation replaces cu_seqlens with per-token ``segment_ids`` (what the
+flash kernels consume) and per-token ``positions`` (reset at each segment
+start, what rotary/learned embeddings consume).
+
+Loss alignment: ``labels[i] = tokens[i+1]`` *within* a segment; the last
+token of each segment and all padding get ``ignore_index`` so packed loss
+equals the sum of per-sequence losses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PackedBatch:
+    """Arrays shaped (rows, seq_len); feed directly as a model batch."""
+
+    input_ids: np.ndarray
+    labels: np.ndarray
+    positions: np.ndarray
+    segment_ids: np.ndarray
+
+    def as_batch(self) -> dict:
+        return {"input_ids": self.input_ids, "labels": self.labels,
+                "positions": self.positions,
+                "segment_ids": self.segment_ids}
+
+
+def pack_sequences(seqs: Sequence[np.ndarray], seq_len: int, *,
+                   pad_id: int = 0, ignore_index: int = -100,
+                   cp: int = 1) -> PackedBatch:
+    """Greedy first-fit packing of token sequences into rows of
+    ``seq_len``.
+
+    ``cp``: context-parallel degree — asserts ``seq_len % cp == 0`` so rows
+    split evenly into contiguous ring chunks (the reference additionally
+    supports SYM splits for load balance; contiguous is what
+    ``parallel.ring_attention`` consumes).
+
+    Sequences longer than ``seq_len`` are truncated. Each packed segment
+    gets a distinct id; padding uses a trailing id with all-ignored labels.
+    """
+    if seq_len % cp != 0:
+        raise ValueError(f"seq_len {seq_len} not divisible by cp {cp}")
+    rows: list[list[np.ndarray]] = []
+    space: list[int] = []
+    for seq in seqs:
+        seq = np.asarray(seq)[:seq_len]
+        placed = False
+        for i, free in enumerate(space):
+            if len(seq) <= free:
+                rows[i].append(seq)
+                space[i] -= len(seq)
+                placed = True
+                break
+        if not placed:
+            rows.append([seq])
+            space.append(seq_len - len(seq))
+
+    n = len(rows)
+    input_ids = np.full((n, seq_len), pad_id, np.int32)
+    labels = np.full((n, seq_len), ignore_index, np.int32)
+    positions = np.zeros((n, seq_len), np.int32)
+    segment_ids = np.zeros((n, seq_len), np.int32)
+    for r, segs in enumerate(rows):
+        off = 0
+        for s_id, seq in enumerate(segs):
+            L = len(seq)
+            input_ids[r, off:off + L] = seq
+            labels[r, off:off + L - 1] = seq[1:]
+            positions[r, off:off + L] = np.arange(L)
+            segment_ids[r, off:off + L] = s_id
+            off += L
+        # padding tail: its own segment id, positions 0, labels ignored
+        segment_ids[r, off:] = len(segs)
+    return PackedBatch(input_ids, labels, positions, segment_ids)
+
+
+def pad_batch(seqs: Sequence[np.ndarray], seq_len: int, *,
+              pad_id: int = 0, ignore_index: int = -100) -> PackedBatch:
+    """One sequence per row (the reference's pad mode, ``bucket.py:8``)."""
+    n = len(seqs)
+    input_ids = np.full((n, seq_len), pad_id, np.int32)
+    labels = np.full((n, seq_len), ignore_index, np.int32)
+    positions = np.zeros((n, seq_len), np.int32)
+    segment_ids = np.ones((n, seq_len), np.int32)  # 1 = padding
+    for r, seq in enumerate(seqs):
+        seq = np.asarray(seq)[:seq_len]
+        L = len(seq)
+        if L == 0:
+            continue
+        input_ids[r, :L] = seq
+        labels[r, :L - 1] = seq[1:]
+        positions[r, :L] = np.arange(L)
+        segment_ids[r, :L] = 0
+    return PackedBatch(input_ids, labels, positions, segment_ids)

@@ -1,0 +1,169 @@
+"""Trainer: the user-facing training engine.
+
+Parity target: ``python/hetu/engine/trainer.py:66`` — builds the graph
+under autocast (:187-244), runs steps with a strategy id (:279-323), packs
+data, checkpoints, and hot-switches strategies (``examples/hotspa``).
+TPU-native shape: a Trainer owns (model, optimizer, TrainPlan, TrainState);
+``set_strategy`` recompiles the plan and re-shards the live state
+(HotSPa switch = ``parallel.switch.switch_strategy``); data arrives as an
+iterator of host batches (``hetu_tpu.data.build_data_loader``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core.dtypes import BF16_COMPUTE, FP32, Policy, autocast
+from hetu_tpu.engine.state import TrainState
+from hetu_tpu.engine.train_step import (
+    build_eval_step, build_train_step, init_state, make_plan,
+)
+from hetu_tpu.optim.base import Transform
+from hetu_tpu.parallel.strategy import Strategy
+from hetu_tpu.parallel.switch import switch_strategy
+from hetu_tpu.utils.checkpoint import (
+    CheckpointWriter, load_checkpoint, save_checkpoint,
+)
+from hetu_tpu.utils.logging import MetricsLogger, get_logger
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    """Reference: ``engine/trainer_config.py`` TrainingConfig."""
+
+    total_steps: int = 1000
+    log_every: int = 10
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 0          # 0 = only final
+    async_ckpt: bool = True
+    seed: int = 0
+    precision: str = "bf16"      # "bf16" | "fp32"
+    attn_impl: str = "auto"
+
+    def policy(self) -> Policy:
+        return BF16_COMPUTE if self.precision == "bf16" else FP32
+
+
+class Trainer:
+    def __init__(self, model, opt: Transform, strategy: Strategy,
+                 config: Optional[TrainerConfig] = None, devices=None):
+        self.model = model
+        self.opt = opt
+        self.config = config if config is not None else TrainerConfig()
+        self.devices = devices
+        self.state: Optional[TrainState] = None
+        self.plan = None
+        self._step_fn = None
+        self._eval_fn = None
+        self._ckpt_writer: Optional[CheckpointWriter] = None
+        self.metrics = MetricsLogger()
+        self.set_strategy(strategy)
+
+    # -- strategy / hot switching ------------------------------------------
+    def set_strategy(self, strategy: Strategy):
+        """Compile the plan for ``strategy``; if training is live, hot-switch
+        the full train state onto the new shardings (HotSPa)."""
+        strategy.validate(len(self.devices or jax.devices()))
+        with autocast(self.config.policy()):
+            plan = make_plan(self.model, self.opt, strategy, self.devices)
+            step_fn = build_train_step(self.model, self.opt, plan,
+                                       attn_impl=self.config.attn_impl)
+            eval_fn = build_eval_step(self.model, plan,
+                                      attn_impl=self.config.attn_impl)
+        if self.state is not None:
+            self.state = switch_strategy(self.state, plan)
+            get_logger().info(
+                f"hot-switched to {strategy.to_json()} at step "
+                f"{int(jax.device_get(self.state.step))}")
+        self.plan = plan
+        self._step_fn = step_fn
+        self._eval_fn = eval_fn
+        return plan
+
+    @property
+    def strategy(self) -> Strategy:
+        return self.plan.strategy
+
+    # -- state lifecycle ---------------------------------------------------
+    def initialize(self, key: Optional[jax.Array] = None) -> TrainState:
+        key = key if key is not None else jax.random.key(self.config.seed)
+        with autocast(self.config.policy()):
+            self.state = init_state(self.model, self.opt, self.plan, key)
+        return self.state
+
+    def resume(self, path: str) -> TrainState:
+        self.state = load_checkpoint(path, self.model, self.opt, self.plan)
+        get_logger().info(
+            f"resumed from {path} at step "
+            f"{int(jax.device_get(self.state.step))}")
+        return self.state
+
+    def save(self, path: Optional[str] = None, *, wait: bool = False):
+        path = path or self.config.ckpt_dir
+        if path is None:
+            raise ValueError("no checkpoint path configured")
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait()  # one in-flight save at a time
+        self._ckpt_writer = save_checkpoint(
+            path, self.state, async_save=self.config.async_ckpt and not wait)
+        if wait:
+            self._ckpt_writer.wait()
+        return path
+
+    # -- training ----------------------------------------------------------
+    def train_step(self, batch: dict) -> dict:
+        if self.state is None:
+            self.initialize()
+        sbatch = self.plan.shard_batch(batch)
+        self.state, metrics = self._step_fn(self.state, sbatch)
+        return metrics
+
+    def train(self, batches: Iterable[dict],
+              steps: Optional[int] = None) -> list[dict]:
+        """Run up to ``steps`` (default config.total_steps) steps; returns
+        the logged metric records."""
+        if self.state is None:
+            self.initialize()
+        steps = steps if steps is not None else self.config.total_steps
+        history = []
+        t_last = time.perf_counter()
+        tokens_since = 0
+        it: Iterator[dict] = iter(batches)
+        for _ in range(steps):
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            metrics = self.train_step(batch)
+            tokens_since += int(batch["input_ids"].size)
+            step_no = int(jax.device_get(self.state.step))
+            if self.config.log_every and \
+                    step_no % self.config.log_every == 0:
+                now = time.perf_counter()
+                loss = float(jax.device_get(metrics["loss"]))
+                rec = self.metrics.log(
+                    step_no, loss=loss,
+                    grad_norm=float(jax.device_get(metrics["grad_norm"])),
+                    tokens_per_sec=round(tokens_since / (now - t_last), 1))
+                history.append(rec)
+                t_last, tokens_since = now, 0
+            if self.config.ckpt_every and self.config.ckpt_dir and \
+                    step_no % self.config.ckpt_every == 0:
+                self.save()
+        if self.config.ckpt_dir:
+            self.save(wait=True)
+        return history
+
+    def evaluate(self, batches: Iterable[dict]) -> float:
+        total, n = 0.0, 0
+        for batch in batches:
+            loss = self._eval_fn(self.state.params,
+                                 self.plan.shard_batch(batch))
+            total += float(jax.device_get(loss))
+            n += 1
+        return total / max(n, 1)

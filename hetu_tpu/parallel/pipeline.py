@@ -150,6 +150,13 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
     strategy, mesh = plan.strategy, plan.mesh
     nm = strategy.num_microbatches
     remat = effective_remat(strategy)
+    if strategy.ep > 1 and model.blocks.returns_aux:
+        from hetu_tpu.utils.logging import get_logger
+        get_logger().warning(
+            "pp>1 with ep>1: MoE layers inside the pipeline's manual "
+            "region use the dense fallback (every expert computes every "
+            "token) — the explicit all_to_all EP path cannot nest inside "
+            "the pp shard_map; prefer ep without pp for MoE models")
 
     def loss_fn(params, batch):
         with plan.act:

@@ -1,0 +1,34 @@
+"""Hot strategy switching (HotSPa, SOSP'24).
+
+The reference implements mid-training strategy switches with
+``SwitchExecGraph`` (``hetu/graph/switch_exec_graph.h:465,593``): every
+param/grad/opt-state tensor is sliced into intersection ``ParamSlice``s
+between (src ds, src group) and (dst ds, dst group), a P2P comm graph is
+built (``MakeCommGraph`` :623) and executed as one fused
+``BufferBatchedIsendIrecv`` on dedicated switch streams, with send-order
+algorithms selected by env var (:27-33).
+
+On TPU the entire mechanism reduces to one ``jax.device_put`` of the train
+state pytree onto the destination plan's shardings: XLA computes the
+minimal collective/reshard plan (the ParamSlice algebra is exactly what the
+SPMD partitioner does internally). Params, optimizer moments and the step
+counter are one pytree, so the reference's separate switch modes
+(ORIGIN_PARAM / ORIGIN_PARAM_AND_OPTIMIZER / ACCUMULATE_GRAD, :42-48)
+collapse into "switch the whole state".
+"""
+
+from __future__ import annotations
+
+import jax
+
+from hetu_tpu.engine.state import TrainState
+
+
+def switch_strategy(state: TrainState, new_plan) -> TrainState:
+    """Reshard a full train state onto ``new_plan``'s mesh/shardings.
+
+    Works across strategies of the same device set (the reference's hot
+    path); cross-topology elastic resharding goes through a checkpoint
+    (``utils.checkpoint`` saves global values, loads under any plan).
+    """
+    return jax.device_put(state, new_plan.state_shardings)

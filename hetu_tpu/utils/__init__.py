@@ -1,0 +1,10 @@
+"""Utilities: checkpointing, logging, profiling.
+
+Parity target: ``python/hetu/utils`` (checkpoint, parallel config tooling).
+"""
+
+from hetu_tpu.utils.checkpoint import (
+    save_checkpoint, load_checkpoint, CheckpointWriter,
+)
+
+__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointWriter"]

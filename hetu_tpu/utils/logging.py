@@ -1,0 +1,58 @@
+"""Structured training logs.
+
+Parity target: the reference's logging macros (``hetu/common/logging.h``),
+per-step loss/throughput prints and loss plotting hooks
+(``engine/trainer.py:779``). Here: a leveled logger plus a JSONL metrics
+sink the Trainer writes each log interval.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Optional
+
+_LOGGER = None
+
+
+def get_logger() -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        log = logging.getLogger("hetu_tpu")
+        if not log.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(logging.Formatter(
+                "[%(asctime)s %(levelname)s hetu_tpu] %(message)s",
+                datefmt="%H:%M:%S"))
+            log.addHandler(h)
+            log.setLevel(logging.INFO)
+        _LOGGER = log
+    return _LOGGER
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics stream (stdout and/or a file)."""
+
+    def __init__(self, path: Optional[str] = None, echo: bool = True):
+        self._f = open(path, "a") if path else None
+        self._echo = echo
+        self._t0 = time.perf_counter()
+
+    def log(self, step: int, **metrics):
+        rec = {"step": step,
+               "elapsed_s": round(time.perf_counter() - self._t0, 3),
+               **{k: (float(v) if hasattr(v, "__float__") else v)
+                  for k, v in metrics.items()}}
+        line = json.dumps(rec)
+        if self._f:
+            self._f.write(line + "\n")
+            self._f.flush()
+        if self._echo:
+            get_logger().info(line)
+        return rec
+
+    def close(self):
+        if self._f:
+            self._f.close()

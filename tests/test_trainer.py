@@ -1,0 +1,95 @@
+"""Trainer + hot-switching tests.
+
+Parity targets: ``engine/trainer.py:66`` (train loop, checkpoint
+integration) and ``switch_exec_graph`` / HotSPa (train N steps under A,
+switch to B, continue — loss curve identical to never-switched)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu import optim
+from hetu_tpu.engine.trainer import Trainer, TrainerConfig
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.parallel.strategy import Strategy
+
+CFG = GPTConfig.tiny()
+
+
+def _batches(n, seed=0, b=8, s=16):
+    for i in range(n):
+        ids = jax.random.randint(jax.random.key(seed + i), (b, s + 1), 0,
+                                 CFG.vocab_size)
+        yield {"input_ids": np.asarray(ids[:, :-1]),
+               "labels": np.asarray(ids[:, 1:])}
+
+
+def _cfg(**kw):
+    return TrainerConfig(log_every=1, precision="fp32", **kw)
+
+
+def test_trainer_trains_and_logs():
+    tr = Trainer(GPTLMHeadModel(CFG), optim.adamw(3e-3), Strategy(dp=2),
+                 config=_cfg(total_steps=8))
+    one = next(_batches(1))
+    history = tr.train(one for _ in range(8))
+    assert len(history) == 8
+    assert history[-1]["loss"] < history[0]["loss"] - 0.5
+    assert history[-1]["tokens_per_sec"] > 0
+
+
+def test_hot_switch_loss_curve_identical():
+    """HotSPa done-criterion (VERDICT item 10): switch strategies
+    mid-training; the curve matches the never-switched run."""
+    # never-switched reference
+    tr_ref = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3),
+                     Strategy(dp=2, tp=4), config=_cfg(total_steps=6))
+    ref = [r["loss"] for r in tr_ref.train(_batches(6))]
+
+    tr = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3),
+                 Strategy(dp=2, tp=4), config=_cfg(total_steps=6))
+    got = [r["loss"] for r in tr.train(_batches(3), steps=3)]
+    step_before = int(jax.device_get(tr.state.step))
+    tr.set_strategy(Strategy(dp=4, tp=2, zero=True, fsdp=True))
+    assert int(jax.device_get(tr.state.step)) == step_before
+    # moments resharded over dp by the switch
+    mu_spec = tr.state.opt_state[0].mu["wte"]["weight"].sharding.spec
+    assert "dp" in jax.tree.leaves(tuple(mu_spec))
+    got += [r["loss"] for r in tr.train(_batches(3, seed=3), steps=3)]
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
+
+
+def test_trainer_checkpoint_resume(tmp_path):
+    ck = str(tmp_path / "ck")
+    tr = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3), Strategy(dp=2),
+                 config=_cfg(total_steps=3))
+    ref = [r["loss"] for r in tr.train(_batches(3))]
+    tr.save(ck, wait=True)
+    more_ref = [r["loss"] for r in tr.train(_batches(3, seed=3), steps=3)]
+
+    tr2 = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3),
+                  Strategy(dp=4, zero=True), config=_cfg(total_steps=3))
+    tr2.resume(ck)
+    assert int(jax.device_get(tr2.state.step)) == 3
+    more = [r["loss"] for r in tr2.train(_batches(3, seed=3), steps=3)]
+    np.testing.assert_allclose(more_ref, more, rtol=2e-4, atol=2e-4)
+
+
+def test_trainer_switch_to_pipeline():
+    """Dense GPT: dp -> pp mid-training keeps training stable."""
+    tr = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3), Strategy(dp=8),
+                 config=_cfg(total_steps=4))
+    a = [r["loss"] for r in tr.train(_batches(2), steps=2)]
+    tr.set_strategy(Strategy(pp=2, dp=2, num_microbatches=2))
+    b = [r["loss"] for r in tr.train(_batches(2, seed=2), steps=2)]
+    assert all(np.isfinite(a + b))
+    spec = tr.state.params["blocks"]["mlp"]["fc_in"]["weight"].sharding.spec
+    assert spec and spec[0] == "pp"
+
+
+def test_trainer_evaluate():
+    tr = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3), Strategy(dp=2),
+                 config=_cfg(total_steps=2))
+    tr.initialize()
+    loss = tr.evaluate(_batches(2))
+    assert np.isfinite(loss) and abs(loss - np.log(CFG.vocab_size)) < 1.0

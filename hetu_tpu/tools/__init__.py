@@ -1,0 +1,1 @@
+"""Standalone tools (auto-parallel search)."""

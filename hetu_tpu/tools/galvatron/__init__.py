@@ -1,0 +1,19 @@
+"""Galvatron-style auto-parallel search (cost model + native DP core).
+
+Reference: ``tools/Galvatron`` (VLDB'23) — profiler, cost estimator,
+DP search core (``csrc/dp_core.cpp:22``).
+"""
+
+from hetu_tpu.tools.galvatron.cost_model import (
+    CostBreakdown, ModelDims, TPUTopology, estimate,
+)
+from hetu_tpu.tools.galvatron.search import (
+    Candidate, enumerate_candidates, search_layerwise, search_uniform,
+)
+from hetu_tpu.tools.galvatron.dp_core import solve_layer_dp
+
+__all__ = [
+    "CostBreakdown", "ModelDims", "TPUTopology", "estimate",
+    "Candidate", "enumerate_candidates", "search_layerwise",
+    "search_uniform", "solve_layer_dp",
+]

@@ -1,0 +1,178 @@
+"""Analytic cost model for hybrid-parallel transformer training on TPU.
+
+Galvatron-equivalent (reference ``tools/Galvatron``: hardware profiler →
+cost estimator → DP search), re-derived for TPU systems: MXU peak FLOPs,
+HBM capacity, and ICI ring bandwidth replace the NVLink/IB tables. The
+model follows the standard scaling-book accounting:
+
+- compute: fwd FLOPs/layer = 2·tokens·(attn+mlp params) + attention
+  O(s²); bwd = 2× fwd; divided across dp·tp·cp.
+- tp comm: 2 allreduces per layer fwd (+2 bwd) of the activation block,
+  ring cost 2·(n-1)/n · bytes / bw.
+- cp comm: (cp-1) ring hops of local KV per layer, fwd + bwd.
+- dp comm: one grad allreduce (or reduce-scatter+allgather under ZeRO)
+  per step, overlappable fraction configurable.
+- pp: bubble multiplier (nm + pp - 1)/nm on the per-stage time.
+- memory: params·(weights+grads+Adam moments)/shards + activation
+  checkpointing policy factor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from hetu_tpu.parallel.strategy import Strategy
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUTopology:
+    """One slice. Defaults ≈ TPU v5p."""
+
+    num_devices: int
+    peak_flops: float = 459e12        # bf16 per chip
+    ici_bw: float = 9e10              # bytes/s per direction, ring
+    dcn_bw: float = 2.5e9             # bytes/s per host pair (multi-slice)
+    hbm_bytes: float = 95e9
+    mxu_efficiency: float = 0.5       # achievable fraction of peak
+    dp_overlap: float = 0.7           # grad-allreduce overlap with bwd
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDims:
+    """Shapes that drive cost (from a GPTConfig/LlamaConfig + run shape)."""
+
+    num_layers: int
+    hidden: int
+    intermediate: int
+    num_heads: int
+    num_kv_heads: int
+    vocab: int
+    seq_len: int
+    global_batch: int
+    bytes_per_el: int = 2             # bf16 activations/weights on the wire
+    num_experts: int = 0
+    moe_top_k: int = 2
+
+    @classmethod
+    def from_config(cls, cfg, *, seq_len: int, global_batch: int):
+        inter = getattr(cfg, "intermediate_size",
+                        getattr(cfg, "mlp_ratio", 4) * cfg.hidden_size)
+        return cls(
+            num_layers=cfg.num_layers, hidden=cfg.hidden_size,
+            intermediate=inter, num_heads=cfg.num_heads,
+            num_kv_heads=getattr(cfg, "num_kv_heads", None)
+            or cfg.num_heads,
+            vocab=cfg.vocab_size, seq_len=seq_len,
+            global_batch=global_batch,
+            num_experts=getattr(cfg, "num_experts", 0),
+            moe_top_k=getattr(cfg, "moe_top_k", 2))
+
+    # params of one block (attention + dense or expert MLP)
+    def layer_params(self) -> float:
+        h, hd = self.hidden, self.hidden // self.num_heads
+        attn = h * (self.num_heads * hd + 2 * self.num_kv_heads * hd) \
+            + self.num_heads * hd * h
+        mlp_dense = 3 * h * self.intermediate if self.intermediate \
+            != 4 * h else 2 * h * self.intermediate
+        if self.num_experts > 0:
+            mlp_dense *= self.num_experts
+        return attn + mlp_dense
+
+    def total_params(self) -> float:
+        return self.num_layers * self.layer_params() \
+            + self.vocab * self.hidden
+
+
+@dataclasses.dataclass
+class CostBreakdown:
+    step_time: float
+    compute: float
+    tp_comm: float
+    cp_comm: float
+    dp_comm: float
+    pp_bubble_factor: float
+    mem_per_device: float
+
+    def fits(self, topo: TPUTopology) -> bool:
+        return self.mem_per_device <= topo.hbm_bytes
+
+
+def _ring_allreduce_time(bytes_: float, n: int, bw: float) -> float:
+    if n <= 1:
+        return 0.0
+    return 2.0 * (n - 1) / n * bytes_ / bw
+
+
+def estimate(dims: ModelDims, strategy: Strategy,
+             topo: TPUTopology) -> CostBreakdown:
+    """Estimated step time (seconds) and per-device memory for one
+    strategy."""
+    s = strategy
+    b_loc = dims.global_batch / max(s.dp * s.ep, 1)      # per dp×ep shard
+    seq_loc = dims.seq_len / s.cp
+    h = dims.hidden
+    tokens_loc = b_loc * dims.seq_len                    # per dp replica
+
+    # ---- compute ----------------------------------------------------------
+    # matmul flops per token per layer = 6 * layer_params (fwd+bwd), but
+    # MoE only computes top_k experts' worth
+    lp = dims.layer_params()
+    if dims.num_experts > 0:
+        mlp_all = lp - (h * (dims.num_heads + 2 * dims.num_kv_heads)
+                        * (h // dims.num_heads)
+                        + h * dims.num_heads * (h // dims.num_heads))
+        lp_active = lp - mlp_all + mlp_all * dims.moe_top_k \
+            / dims.num_experts
+    else:
+        lp_active = lp
+    flops_layer = 6.0 * tokens_loc * lp_active
+    # causal attention scores+pv: fwd 2·b·s²·h ≈, ×3 for bwd
+    flops_attn = 6.0 * b_loc * dims.seq_len * dims.seq_len * h / 2
+    layers_per_stage = dims.num_layers / s.pp
+    flops_dev = (flops_layer + flops_attn) * layers_per_stage \
+        / (s.tp * s.cp)
+    # embedding + lm head on the last/first stage
+    flops_head = 6.0 * tokens_loc * dims.vocab * h / (s.tp * s.cp)
+    t_compute = (flops_dev + flops_head) \
+        / (topo.mxu_efficiency * topo.peak_flops)
+
+    # ---- tp comm ----------------------------------------------------------
+    act_bytes = b_loc * seq_loc * h * dims.bytes_per_el
+    t_tp = 4.0 * _ring_allreduce_time(act_bytes, s.tp, topo.ici_bw) \
+        * layers_per_stage if s.tp > 1 else 0.0
+
+    # ---- cp ring comm -----------------------------------------------------
+    kv_bytes = 2.0 * b_loc * seq_loc * \
+        (dims.num_kv_heads * (h / dims.num_heads)) * dims.bytes_per_el
+    # fwd ring + bwd ring with dkv piggyback (~2x)
+    t_cp = 3.0 * (s.cp - 1) * kv_bytes / topo.ici_bw * layers_per_stage \
+        if s.cp > 1 else 0.0
+
+    # ---- dp grad sync -----------------------------------------------------
+    param_bytes_dev = dims.total_params() * dims.bytes_per_el \
+        / (s.tp * s.pp)
+    t_dp = _ring_allreduce_time(param_bytes_dev, s.dp, topo.ici_bw) \
+        * (1.0 - topo.dp_overlap) if s.dp > 1 else 0.0
+
+    # ---- pp bubble --------------------------------------------------------
+    nm = max(s.num_microbatches, 1)
+    bubble = (nm + s.pp - 1) / nm if s.pp > 1 else 1.0
+
+    step = (t_compute + t_tp + t_cp) * bubble + t_dp
+
+    # ---- memory -----------------------------------------------------------
+    p_shard = dims.total_params() / (s.tp * s.pp * max(s.ep, 1))
+    dp_shard = s.dp if (s.fsdp or s.zero) else 1
+    # weights bf16 + fp32 master-ish grads + two fp32 Adam moments
+    opt_div = s.dp if s.zero else 1
+    mem_params = p_shard * (2 + 4 / dp_shard if s.fsdp else 6)
+    mem_opt = p_shard * 8 / opt_div
+    act_factor = {"none": 14.0, "selective": 6.0, "full": 2.0,
+                  "offload": 1.0}.get(s.remat, 14.0)
+    mem_act = b_loc / nm * seq_loc * h * act_factor * layers_per_stage \
+        * dims.bytes_per_el / s.tp
+    mem = mem_params + mem_opt + mem_act
+
+    return CostBreakdown(step, t_compute * bubble, t_tp * bubble,
+                         t_cp * bubble, t_dp, bubble, mem)

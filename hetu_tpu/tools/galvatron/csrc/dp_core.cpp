@@ -1,0 +1,104 @@
+// Layer-wise auto-parallel dynamic program.
+//
+// Native re-implementation of the search core the reference ships as
+// tools/Galvatron/csrc/dp_core.cpp:22 (`dynamic_programming_core` over
+// layers x strategies x memory budget): choose one strategy per layer to
+// minimize total time with total memory under budget, with a transition
+// cost when adjacent layers use different strategies (resharding the
+// activations between layer-local layouts).
+//
+// DP state: best[m][s] = min time over the first l layers using exactly
+// memory m (discretized units) with layer l assigned strategy s.
+// Complexity O(L * M * S^2); M is the discretized budget.
+//
+// C ABI for ctypes (no pybind11 in this image):
+//   solve_dp(L, S, M,
+//            time_cost[L*S], mem_cost[L*S] (units), switch_cost[S*S],
+//            out_choice[L])  -> total time (or +inf if infeasible)
+
+#include <algorithm>
+#include <cstddef>
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+using std::size_t;
+
+extern "C" {
+
+double solve_dp(int32_t L, int32_t S, int64_t M,
+                const double* time_cost, const int64_t* mem_cost,
+                const double* switch_cost, int32_t* out_choice) {
+  const double INF = std::numeric_limits<double>::infinity();
+  if (L <= 0 || S <= 0 || M < 0) return INF;
+
+  // best[m][s]: min time, first l layers, total mem == m, layer l uses s
+  std::vector<double> best(static_cast<size_t>(M + 1) * S, INF);
+  std::vector<double> next(static_cast<size_t>(M + 1) * S, INF);
+  // choice[l][m][s]: argmin strategy of layer l-1 leading to (m, s)
+  std::vector<int32_t> choice(static_cast<size_t>(L) * (M + 1) * S, -1);
+
+  auto idx = [S](int64_t m, int32_t s) {
+    return static_cast<size_t>(m) * S + s;
+  };
+
+  for (int32_t s = 0; s < S; ++s) {
+    int64_t mem = mem_cost[s];
+    if (mem <= M) {
+      double t = time_cost[s];
+      if (t < best[idx(mem, s)]) best[idx(mem, s)] = t;
+    }
+  }
+
+  for (int32_t l = 1; l < L; ++l) {
+    std::fill(next.begin(), next.end(), INF);
+    for (int64_t m = 0; m <= M; ++m) {
+      for (int32_t sp = 0; sp < S; ++sp) {
+        double base = best[idx(m, sp)];
+        if (base == INF) continue;
+        for (int32_t s = 0; s < S; ++s) {
+          int64_t mem = mem_cost[static_cast<size_t>(l) * S + s];
+          int64_t m2 = m + mem;
+          if (m2 > M) continue;
+          double t = base + time_cost[static_cast<size_t>(l) * S + s] +
+                     switch_cost[static_cast<size_t>(sp) * S + s];
+          size_t j = idx(m2, s);
+          if (t < next[j]) {
+            next[j] = t;
+            choice[(static_cast<size_t>(l) * (M + 1) + m2) * S + s] = sp;
+          }
+        }
+      }
+    }
+    best.swap(next);
+  }
+
+  // find optimum endpoint
+  double opt = INF;
+  int64_t opt_m = -1;
+  int32_t opt_s = -1;
+  for (int64_t m = 0; m <= M; ++m) {
+    for (int32_t s = 0; s < S; ++s) {
+      if (best[idx(m, s)] < opt) {
+        opt = best[idx(m, s)];
+        opt_m = m;
+        opt_s = s;
+      }
+    }
+  }
+  if (opt == INF) return INF;
+
+  // backtrack
+  int64_t m = opt_m;
+  int32_t s = opt_s;
+  for (int32_t l = L - 1; l >= 0; --l) {
+    out_choice[l] = s;
+    if (l == 0) break;
+    int32_t sp = choice[(static_cast<size_t>(l) * (M + 1) + m) * S + s];
+    m -= mem_cost[static_cast<size_t>(l) * S + s];
+    s = sp;
+  }
+  return opt;
+}
+
+}  // extern "C"

@@ -1,0 +1,121 @@
+"""ctypes bridge to the native DP core (+ pure-Python fallback).
+
+The reference builds ``tools/Galvatron/csrc/dp_core.cpp`` as a Python
+extension; this image has no pybind11, so the native core is compiled with
+g++ at first use and loaded via ctypes (C ABI). The Python fallback
+implements identical semantics for environments without a toolchain, and
+the test suite asserts parity between the two.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(__file__), "csrc", "dp_core.cpp")
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_FAILED = False
+
+
+def _build_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_FAILED
+    if _LIB is not None or _LIB_FAILED:
+        return _LIB
+    try:
+        build_dir = os.path.join(tempfile.gettempdir(), "hetu_tpu_native")
+        os.makedirs(build_dir, exist_ok=True)
+        so = os.path.join(build_dir, "libdp_core.so")
+        if not os.path.exists(so) or \
+                os.path.getmtime(so) < os.path.getmtime(_CSRC):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 _CSRC, "-o", so],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(so)
+        lib.solve_dp.restype = ctypes.c_double
+        lib.solve_dp.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        _LIB = lib
+    except Exception:
+        _LIB_FAILED = True
+    return _LIB
+
+
+def solve_layer_dp(time_cost: np.ndarray, mem_cost: np.ndarray,
+                   budget: int, switch_cost: Optional[np.ndarray] = None,
+                   *, force_python: bool = False
+                   ) -> tuple[float, Optional[np.ndarray]]:
+    """Min-time layer→strategy assignment under a memory budget.
+
+    ``time_cost`` (L, S) float; ``mem_cost`` (L, S) int units;
+    ``switch_cost`` (S, S) transition cost (default zeros). Returns
+    (total_time, choices (L,)) or (inf, None) when infeasible.
+    """
+    time_cost = np.ascontiguousarray(time_cost, np.float64)
+    mem_cost = np.ascontiguousarray(mem_cost, np.int64)
+    L, S = time_cost.shape
+    if switch_cost is None:
+        switch_cost = np.zeros((S, S), np.float64)
+    switch_cost = np.ascontiguousarray(switch_cost, np.float64)
+
+    lib = None if force_python else _build_lib()
+    if lib is not None:
+        out = np.zeros(L, np.int32)
+        total = lib.solve_dp(L, S, int(budget), time_cost, mem_cost,
+                             switch_cost, out)
+        if not np.isfinite(total):
+            return float("inf"), None
+        return float(total), out
+
+    return _solve_python(time_cost, mem_cost, int(budget), switch_cost)
+
+
+def _solve_python(time_cost, mem_cost, budget, switch_cost):
+    L, S = time_cost.shape
+    INF = float("inf")
+    best = np.full((budget + 1, S), INF)
+    choice = np.full((L, budget + 1, S), -1, np.int32)
+    for s in range(S):
+        if mem_cost[0, s] <= budget:
+            best[mem_cost[0, s], s] = min(best[mem_cost[0, s], s],
+                                          time_cost[0, s])
+    for l in range(1, L):
+        nxt = np.full((budget + 1, S), INF)
+        for m in range(budget + 1):
+            for sp in range(S):
+                base = best[m, sp]
+                if base == INF:
+                    continue
+                for s in range(S):
+                    m2 = m + mem_cost[l, s]
+                    if m2 > budget:
+                        continue
+                    t = base + time_cost[l, s] + switch_cost[sp, s]
+                    if t < nxt[m2, s]:
+                        nxt[m2, s] = t
+                        choice[l, m2, s] = sp
+        best = nxt
+    flat = np.argmin(best)
+    m, s = divmod(int(flat), S)
+    if best[m, s] == INF:
+        return INF, None
+    total = float(best[m, s])
+    out = np.zeros(L, np.int32)
+    for l in range(L - 1, -1, -1):
+        out[l] = s
+        if l == 0:
+            break
+        sp = int(choice[l, m, s])
+        m -= int(mem_cost[l, s])
+        s = sp
+    return total, out

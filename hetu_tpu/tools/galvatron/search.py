@@ -1,0 +1,130 @@
+"""Auto-parallel strategy search.
+
+Reference: Galvatron's profiler → cost model → dynamic-programming search
+(``tools/Galvatron``, DP core ``csrc/dp_core.cpp:22``), emitting runtime
+configs. Here the search emits :class:`~hetu_tpu.parallel.strategy.Strategy`
+JSON directly, so the Trainer (and hot switching) consume it unchanged —
+preserving the reference's planner pluggability (SURVEY §7.1).
+
+Two modes:
+- :func:`search_uniform` — enumerate dp/tp/pp/cp/ep factorizations (+ zero/
+  fsdp/remat variants), score with the analytic cost model, return every
+  feasible candidate ranked. This is the path the runtime consumes today.
+- :func:`search_layerwise` — per-layer strategy assignment under a memory
+  budget via the native DP core (the reference's hetero-layer formulation;
+  informative for hetero-parallel planning).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from hetu_tpu.parallel.strategy import Strategy
+from hetu_tpu.tools.galvatron.cost_model import (
+    CostBreakdown, ModelDims, TPUTopology, estimate,
+)
+from hetu_tpu.tools.galvatron.dp_core import solve_layer_dp
+
+
+@dataclasses.dataclass
+class Candidate:
+    strategy: Strategy
+    cost: CostBreakdown
+
+    def __repr__(self):
+        c = self.cost
+        return (f"Candidate({self.strategy.to_json()}, "
+                f"step={c.step_time * 1e3:.2f}ms, "
+                f"mem={c.mem_per_device / 1e9:.1f}GB)")
+
+
+def _factorizations(n: int, dims: ModelDims, max_tp: int = 16,
+                    max_pp: int = 16, max_cp: int = 16):
+    for tp in _divisors(n, max_tp):
+        if dims.num_heads % tp or dims.num_kv_heads % tp:
+            continue
+        for pp in _divisors(n // tp, max_pp):
+            if dims.num_layers % pp:
+                continue
+            for cp in _divisors(n // (tp * pp), max_cp):
+                if dims.seq_len % cp:
+                    continue
+                rest = n // (tp * pp * cp)
+                eps = [1]
+                if dims.num_experts > 0:
+                    eps += [e for e in _divisors(rest, rest)
+                            if e > 1 and dims.num_experts % e == 0]
+                for ep in eps:
+                    dp = rest // ep
+                    if dp < 1 or dims.global_batch % (dp * ep):
+                        continue
+                    yield dp, tp, pp, cp, ep
+
+
+def _divisors(n: int, cap: int):
+    return [d for d in range(1, min(n, cap) + 1) if n % d == 0]
+
+
+def enumerate_candidates(dims: ModelDims, topo: TPUTopology, *,
+                         num_microbatches: Sequence[int] = (1, 4, 8),
+                         remats: Sequence[str] = ("none", "full"),
+                         ) -> list[Candidate]:
+    out = []
+    for dp, tp, pp, cp, ep in _factorizations(topo.num_devices, dims):
+        for remat in remats:
+            for zero in ({True, dp > 1} if dp > 1 else {False}):
+                nms = [nm for nm in num_microbatches
+                       if nm % pp == 0 or pp == 1] or [pp]
+                for nm in nms:
+                    if pp > 1 and nm % pp != 0:
+                        continue
+                    if dims.global_batch % (dp * ep * nm):
+                        continue
+                    s = Strategy(dp=dp, tp=tp, pp=pp, cp=cp, ep=ep,
+                                 zero=bool(zero), remat=remat,
+                                 num_microbatches=nm)
+                    out.append(Candidate(s, estimate(dims, s, topo)))
+    return out
+
+
+def search_uniform(dims: ModelDims, topo: TPUTopology, *,
+                   mem_budget: Optional[float] = None,
+                   **kw) -> list[Candidate]:
+    """All feasible candidates, fastest first. ``[0]`` is the pick."""
+    budget = mem_budget if mem_budget is not None else topo.hbm_bytes
+    cands = [c for c in enumerate_candidates(dims, topo, **kw)
+             if c.cost.mem_per_device <= budget]
+    cands.sort(key=lambda c: c.cost.step_time)
+    return cands
+
+
+def search_layerwise(dims: ModelDims, topo: TPUTopology,
+                     candidates: Sequence[Strategy], *,
+                     mem_budget: Optional[float] = None,
+                     mem_units: int = 256,
+                     switch_penalty: float = 1e-4):
+    """Per-layer strategy assignment via the native DP core.
+
+    Each candidate's per-layer (time, mem) comes from the cost model;
+    memory is discretized to ``mem_units`` knapsack units of the budget.
+    Returns (total_time, [Strategy per layer]) or (inf, None).
+    """
+    budget = mem_budget if mem_budget is not None else topo.hbm_bytes
+    L, S = dims.num_layers, len(candidates)
+    time_cost = np.zeros((L, S))
+    mem_cost = np.zeros((L, S), np.int64)
+    unit = budget / mem_units
+    for j, s in enumerate(candidates):
+        c = estimate(dims, s, topo)
+        time_cost[:, j] = c.step_time / dims.num_layers
+        mem_cost[:, j] = max(1, int(np.ceil(
+            c.mem_per_device / dims.num_layers / unit)))
+    switch = np.full((S, S), switch_penalty) - \
+        switch_penalty * np.eye(S)
+    total, choice = solve_layer_dp(time_cost, mem_cost, mem_units, switch)
+    if choice is None:
+        return float("inf"), None
+    return total, [candidates[int(j)] for j in choice]

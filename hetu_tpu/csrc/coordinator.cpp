@@ -1,0 +1,177 @@
+// Cluster coordinator: rank assignment, typed KV store, barriers,
+// heartbeats — over a single-threaded poll() TCP loop.
+//
+// Native re-implementation of the reference's gRPC DeviceController
+// (hetu/impl/communication/protos/heturpc.proto:10-70; servers
+// python/hetu/rpc/heturpc_{polling,async,elastic}_server.py): Connect/
+// GetRank, PutString/GetString KV, Barrier, HeartBeat, and the elastic
+// server's last-heartbeat tracking (heturpc_elastic_server.py:463-486).
+// On TPU the collective bootstrap itself belongs to the JAX runtime; this
+// service keeps the *extra* duties: elastic membership, KV, barriers.
+//
+// Line protocol (newline-terminated, value strings are percent-escaped by
+// the python client):
+//   RANK <name>            -> RANK <int>          (idempotent per name)
+//   SET <key> <value>      -> OK
+//   GET <key>              -> VAL <value> | NONE
+//   BARRIER <name> <n>     -> OK                  (response deferred until
+//                                                  n distinct arrivals)
+//   BEAT <name>            -> OK                  (records heartbeat time)
+//   STATUS <timeout_ms>    -> ALIVE a,b,c DEAD d,e
+//   PING                   -> PONG
+//   SHUTDOWN               -> OK (server exits)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Barrier {
+  int target = 0;
+  std::set<std::string> arrived;
+  std::vector<int> waiting_fds;
+};
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void send_line(int fd, const std::string& s) {
+  std::string out = s + "\n";
+  ::send(fd, out.data(), out.size(), 0);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? std::atoi(argv[1]) : 23456;
+
+  int srv = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  ::listen(srv, 64);
+  // announce readiness (the launcher waits for this line)
+  std::printf("COORDINATOR READY %d\n", port);
+  std::fflush(stdout);
+
+  std::map<std::string, int> ranks;
+  std::map<std::string, std::string> kv;
+  std::map<std::string, Barrier> barriers;
+  std::map<std::string, int64_t> beats;
+  std::map<int, std::string> bufs;
+  bool running = true;
+
+  std::vector<pollfd> fds{{srv, POLLIN, 0}};
+  while (running) {
+    ::poll(fds.data(), fds.size(), 1000);
+    for (size_t i = 0; i < fds.size(); ++i) {
+      if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      if (fds[i].fd == srv) {
+        int c = ::accept(srv, nullptr, nullptr);
+        if (c >= 0) fds.push_back({c, POLLIN, 0});
+        continue;
+      }
+      char tmp[4096];
+      ssize_t n = ::recv(fds[i].fd, tmp, sizeof(tmp), 0);
+      if (n <= 0) {
+        ::close(fds[i].fd);
+        bufs.erase(fds[i].fd);
+        fds[i].fd = -1;  // compacted below
+        continue;
+      }
+      std::string& buf = bufs[fds[i].fd];
+      buf.append(tmp, static_cast<size_t>(n));
+      size_t pos;
+      while ((pos = buf.find('\n')) != std::string::npos) {
+        std::string line = buf.substr(0, pos);
+        buf.erase(0, pos + 1);
+        std::istringstream ss(line);
+        std::string cmd;
+        ss >> cmd;
+        int fd = fds[i].fd;
+        if (cmd == "RANK") {
+          std::string name;
+          ss >> name;
+          auto it = ranks.find(name);
+          int r = it != ranks.end()
+                      ? it->second
+                      : (ranks[name] = static_cast<int>(ranks.size()));
+          send_line(fd, "RANK " + std::to_string(r));
+        } else if (cmd == "SET") {
+          std::string k, v;
+          ss >> k >> v;
+          kv[k] = v;
+          send_line(fd, "OK");
+        } else if (cmd == "GET") {
+          std::string k;
+          ss >> k;
+          auto it = kv.find(k);
+          send_line(fd, it == kv.end() ? "NONE" : "VAL " + it->second);
+        } else if (cmd == "BARRIER") {
+          std::string name, who;
+          int target;
+          ss >> name >> target >> who;
+          Barrier& b = barriers[name];
+          b.target = target;
+          b.arrived.insert(who);
+          b.waiting_fds.push_back(fd);
+          if (static_cast<int>(b.arrived.size()) >= b.target) {
+            for (int w : b.waiting_fds) send_line(w, "OK");
+            barriers.erase(name);
+          }
+        } else if (cmd == "BEAT") {
+          std::string name;
+          ss >> name;
+          beats[name] = now_ms();
+          send_line(fd, "OK");
+        } else if (cmd == "STATUS") {
+          int64_t timeout;
+          ss >> timeout;
+          std::string alive, dead;
+          int64_t t = now_ms();
+          for (auto& [name, last] : beats) {
+            std::string& dst = (t - last <= timeout) ? alive : dead;
+            if (!dst.empty()) dst += ",";
+            dst += name;
+          }
+          send_line(fd, "ALIVE " + alive + " DEAD " + dead);
+        } else if (cmd == "PING") {
+          send_line(fd, "PONG");
+        } else if (cmd == "SHUTDOWN") {
+          send_line(fd, "OK");
+          running = false;
+        } else {
+          send_line(fd, "ERR unknown command");
+        }
+      }
+    }
+    fds.erase(std::remove_if(fds.begin() + 1, fds.end(),
+                             [](const pollfd& p) { return p.fd < 0; }),
+              fds.end());
+  }
+  for (auto& p : fds) ::close(p.fd);
+  return 0;
+}

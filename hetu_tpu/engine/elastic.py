@@ -1,0 +1,98 @@
+"""Elastic training: heartbeat-based failure detection + re-planning.
+
+Parity target: the reference's elastic server flow
+(``rpc/heturpc_elastic_server.py:39-559``): workers heartbeat, the server
+tracks last-beat times and declares death (:463-486), then the cluster
+re-plans (Malleus/Ampelos, ``engine/strategy*.py``) and restarts from
+checkpoint (``ht_safetensors.py:881`` load_by_training). TPU-native shape:
+the Coordinator service tracks membership; on failure the controller picks
+a new Strategy for the surviving device count via the Galvatron search and
+the Trainer resumes from the latest checkpoint under the new plan (our
+checkpoints are global-valued, so cross-topology restore is just a load).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from hetu_tpu.rpc.client import CoordinatorClient
+from hetu_tpu.utils.logging import get_logger
+
+
+class HeartbeatSender:
+    """Background heartbeat thread for one worker."""
+
+    def __init__(self, port: int, name: str, interval_s: float = 1.0):
+        self.client = CoordinatorClient(port)
+        self.name = name
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self.client.heartbeat(self.name)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.client.heartbeat(self.name)
+            except Exception:
+                return
+
+    def stop(self):
+        self._stop.set()
+
+
+class ElasticController:
+    """Watches membership; on failure computes a recovery plan."""
+
+    def __init__(self, port: int, *, timeout_ms: int = 3000):
+        self.client = CoordinatorClient(port)
+        self.timeout_ms = timeout_ms
+
+    def check(self) -> tuple[list[str], list[str]]:
+        return self.client.status(self.timeout_ms)
+
+    def recovery_plan(self, dims, topo, n_alive_devices: int):
+        """New Strategy for the surviving device count (largest
+        power-of-two subset), via the auto-parallel search."""
+        from hetu_tpu.tools.galvatron import TPUTopology, search_uniform
+
+        n = n_alive_devices
+        while n > 1 and (n & (n - 1)):
+            n -= 1
+        if n < 1:
+            return None
+        new_topo = TPUTopology(
+            num_devices=n, peak_flops=topo.peak_flops, ici_bw=topo.ici_bw,
+            dcn_bw=topo.dcn_bw, hbm_bytes=topo.hbm_bytes,
+            mxu_efficiency=topo.mxu_efficiency, dp_overlap=topo.dp_overlap)
+        cands = search_uniform(dims, new_topo)
+        if not cands:
+            return None
+        get_logger().info(
+            f"elastic replan: {n_alive_devices} alive → n={n}, "
+            f"strategy={cands[0].strategy.to_json()}")
+        return cands[0].strategy
+
+    def watch(self, on_failure: Callable[[list[str], list[str]], None], *,
+              poll_s: float = 1.0, stop: Optional[threading.Event] = None):
+        """Poll membership; invoke ``on_failure(alive, dead)`` once when
+        deaths appear. Returns the watcher thread."""
+        stop = stop or threading.Event()
+
+        def run():
+            while not stop.wait(poll_s):
+                alive, dead = self.check()
+                if dead:
+                    on_failure(alive, dead)
+                    return
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.stop_event = stop  # type: ignore[attr-defined]
+        return t

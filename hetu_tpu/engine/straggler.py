@@ -1,0 +1,91 @@
+"""Straggler detection + Malleus-style replanning hook.
+
+Parity target: ``python/hetu/engine/straggler.py:20`` (each worker times a
+standard matmul workload, publishes slowdown ratios) feeding the Malleus
+ILP planner (``engine/strategy.py:53-98``) which emits a new hetero config
+for hot switching. TPU formulation: per-device microbench of an
+MXU-saturating matmul; ratios scale the cost model's ``mxu_efficiency``
+and (in the elastic path) select the device subset to re-plan over with
+the Galvatron search.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class StragglerReport:
+    times_s: dict[int, float]           # device id → measured seconds
+    ratios: dict[int, float]            # device id → time / best time
+
+    def stragglers(self, threshold: float = 1.5) -> list[int]:
+        return [d for d, r in self.ratios.items() if r > threshold]
+
+
+class StragglerMonitor:
+    """Times a standard matmul workload on each device."""
+
+    def __init__(self, size: int = 2048, iters: int = 8,
+                 dtype=jnp.bfloat16):
+        self.size = size
+        self.iters = iters
+        self.dtype = dtype
+
+    def _bench_device(self, device) -> float:
+        x = jax.device_put(
+            jnp.ones((self.size, self.size), self.dtype), device)
+
+        @jax.jit
+        def mm(a):
+            for _ in range(4):
+                a = a @ a / self.size
+            return a
+
+        mm(x).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(self.iters):
+            x = mm(x)
+        x.block_until_ready()
+        return (time.perf_counter() - t0) / self.iters
+
+    def measure(self, devices: Optional[Sequence] = None
+                ) -> StragglerReport:
+        devices = list(devices) if devices is not None else jax.devices()
+        times = {d.id: self._bench_device(d) for d in devices}
+        best = min(times.values())
+        ratios = {i: t / best for i, t in times.items()}
+        return StragglerReport(times, ratios)
+
+
+def replan_for_stragglers(report: StragglerReport, dims, topo, *,
+                          threshold: float = 1.5):
+    """Drop straggling devices and search a new strategy over the healthy
+    subset (the Malleus flow: ratios → plan → hot switch/elastic restart).
+    Returns (healthy_device_ids, best Candidate or None)."""
+    from hetu_tpu.tools.galvatron import TPUTopology, search_uniform
+
+    bad = set(report.stragglers(threshold))
+    healthy = [d for d in report.ratios if d not in bad]
+    # strategies need a power-of-two-ish device count; take the largest
+    # divisor-friendly prefix
+    n = len(healthy)
+    while n > 1 and (n & (n - 1)):
+        n -= 1
+    healthy = healthy[:n]
+    if not healthy:
+        return [], None
+    new_topo = TPUTopology(
+        num_devices=len(healthy), peak_flops=topo.peak_flops,
+        ici_bw=topo.ici_bw, dcn_bw=topo.dcn_bw,
+        hbm_bytes=topo.hbm_bytes,
+        mxu_efficiency=topo.mxu_efficiency /
+        max(report.ratios[d] for d in healthy),
+        dp_overlap=topo.dp_overlap)
+    cands = search_uniform(dims, new_topo)
+    return healthy, (cands[0] if cands else None)

@@ -1,0 +1,10 @@
+"""Cluster control plane: coordinator service + client.
+
+Parity target: ``python/hetu/rpc`` — gRPC DeviceController servers
+(polling/async/elastic), KV store, barriers, heartbeat monitoring.
+"""
+
+from hetu_tpu.rpc.coordinator import Coordinator
+from hetu_tpu.rpc.client import CoordinatorClient
+
+__all__ = ["Coordinator", "CoordinatorClient"]

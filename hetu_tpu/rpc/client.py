@@ -1,0 +1,74 @@
+"""Coordinator client (line protocol over TCP).
+
+Reference: ``hetu/impl/communication/rpc_client.cc`` (Connect/GetRank/
+KV/Barrier/HeartBeat) + the Python KV-store client
+(``rpc/kv_store/client.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.parse
+from typing import Any, Optional
+
+
+class CoordinatorClient:
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._buf = b""
+
+    def _cmd(self, line: str) -> str:
+        self._sock.sendall(line.encode() + b"\n")
+        while b"\n" not in self._buf:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("coordinator closed connection")
+            self._buf += chunk
+        resp, self._buf = self._buf.split(b"\n", 1)
+        return resp.decode()
+
+    # -- rank / membership --------------------------------------------------
+    def rank(self, name: str) -> int:
+        resp = self._cmd(f"RANK {name}")
+        return int(resp.split()[1])
+
+    def heartbeat(self, name: str):
+        assert self._cmd(f"BEAT {name}") == "OK"
+
+    def status(self, timeout_ms: int = 5000) -> tuple[list[str], list[str]]:
+        resp = self._cmd(f"STATUS {timeout_ms}")
+        # "ALIVE a,b DEAD c"
+        parts = resp.split()
+        alive = parts[1].split(",") if len(parts) > 1 and parts[1] else []
+        dead_idx = parts.index("DEAD")
+        dead = parts[dead_idx + 1].split(",") \
+            if len(parts) > dead_idx + 1 and parts[dead_idx + 1] else []
+        return [a for a in alive if a], [d for d in dead if d]
+
+    # -- KV (typed, like the reference's double/int/string/json) ------------
+    def put(self, key: str, value: Any):
+        enc = urllib.parse.quote(json.dumps(value), safe="")
+        assert self._cmd(f"SET {key} {enc}") == "OK"
+
+    def get(self, key: str, default: Any = None) -> Any:
+        resp = self._cmd(f"GET {key}")
+        if resp == "NONE":
+            return default
+        return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
+
+    # -- synchronization ----------------------------------------------------
+    def barrier(self, name: str, n: int, who: str):
+        """Blocks until ``n`` distinct participants arrive."""
+        assert self._cmd(f"BARRIER {name} {n} {who}") == "OK"
+
+    def ping(self) -> bool:
+        return self._cmd("PING") == "PONG"
+
+    def shutdown(self):
+        self._cmd("SHUTDOWN")
+
+    def close(self):
+        self._sock.close()

@@ -1,0 +1,94 @@
+"""Coordinator service launcher.
+
+Builds and spawns the native server (``hetu_tpu/csrc/coordinator.cpp`` —
+the C++ re-implementation of the reference's gRPC DeviceController), with
+a pure-Python fallback speaking the same line protocol when no toolchain
+is available. Reference servers: ``rpc/heturpc_polling_server.py:17``,
+``heturpc_elastic_server.py:39-559``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import tempfile
+import threading
+import time
+from typing import Optional
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                     "csrc", "coordinator.cpp")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Coordinator:
+    """Owns a running coordinator server (native or Python fallback)."""
+
+    def __init__(self, port: Optional[int] = None, *,
+                 prefer_native: bool = True):
+        self.port = port or _free_port()
+        self._proc: Optional[subprocess.Popen] = None
+        self._py_server = None
+        if prefer_native and self._start_native():
+            self.native = True
+        else:
+            self._start_python()
+            self.native = False
+
+    # -- native server ------------------------------------------------------
+    def _start_native(self) -> bool:
+        try:
+            build_dir = os.path.join(tempfile.gettempdir(),
+                                     "hetu_tpu_native")
+            os.makedirs(build_dir, exist_ok=True)
+            exe = os.path.join(build_dir, "coordinator")
+            if not os.path.exists(exe) or \
+                    os.path.getmtime(exe) < os.path.getmtime(_CSRC):
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", _CSRC, "-o", exe],
+                    check=True, capture_output=True)
+            self._proc = subprocess.Popen(
+                [exe, str(self.port)], stdout=subprocess.PIPE, text=True)
+            line = self._proc.stdout.readline()
+            return line.startswith("COORDINATOR READY")
+        except Exception:
+            if self._proc is not None:
+                self._proc.kill()
+                self._proc = None
+            return False
+
+    # -- python fallback ----------------------------------------------------
+    def _start_python(self):
+        from hetu_tpu.rpc.py_server import PyCoordinatorServer
+        self._py_server = PyCoordinatorServer(self.port)
+        self._py_server.start()
+        self._py_server.wait_ready()
+
+    def shutdown(self):
+        try:
+            from hetu_tpu.rpc.client import CoordinatorClient
+            CoordinatorClient(self.port).shutdown()
+        except Exception:
+            pass
+        if self._proc is not None:
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._proc = None
+        if self._py_server is not None:
+            self._py_server.stop()
+            self._py_server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

@@ -1,0 +1,115 @@
+"""Pure-Python coordinator fallback (same line protocol as the native
+server in ``hetu_tpu/csrc/coordinator.cpp``) — used where no C++
+toolchain exists. Reference analogue: ``rpc/heturpc_polling_server.py``.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+import time
+from typing import Optional
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ranks: dict[str, int] = {}
+        self.kv: dict[str, str] = {}
+        self.beats: dict[str, float] = {}
+        self.barriers: dict[str, dict] = {}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        st: _State = self.server.state  # type: ignore[attr-defined]
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            parts = line.decode().strip().split()
+            if not parts:
+                continue
+            cmd, args = parts[0], parts[1:]
+            if cmd == "RANK":
+                with st.lock:
+                    r = st.ranks.setdefault(args[0], len(st.ranks))
+                self._send(f"RANK {r}")
+            elif cmd == "SET":
+                with st.lock:
+                    st.kv[args[0]] = args[1]
+                self._send("OK")
+            elif cmd == "GET":
+                with st.lock:
+                    v = st.kv.get(args[0])
+                self._send("NONE" if v is None else f"VAL {v}")
+            elif cmd == "BEAT":
+                with st.lock:
+                    st.beats[args[0]] = time.monotonic()
+                self._send("OK")
+            elif cmd == "STATUS":
+                timeout = int(args[0]) / 1e3
+                now = time.monotonic()
+                with st.lock:
+                    alive = [n for n, t in st.beats.items()
+                             if now - t <= timeout]
+                    dead = [n for n, t in st.beats.items()
+                            if now - t > timeout]
+                self._send(f"ALIVE {','.join(alive)} DEAD "
+                           f"{','.join(dead)}")
+            elif cmd == "BARRIER":
+                name, target, who = args[0], int(args[1]), args[2]
+                with st.lock:
+                    b = st.barriers.setdefault(
+                        name, {"event": threading.Event(), "who": set()})
+                    b["who"].add(who)
+                    if len(b["who"]) >= target:
+                        b["event"].set()
+                        st.barriers.pop(name, None)
+                        ev = b["event"]
+                    else:
+                        ev = b["event"]
+                ev.wait()
+                self._send("OK")
+            elif cmd == "PING":
+                self._send("PONG")
+            elif cmd == "SHUTDOWN":
+                self._send("OK")
+                threading.Thread(
+                    target=self.server.shutdown, daemon=True).start()
+                return
+            else:
+                self._send("ERR unknown command")
+
+    def _send(self, s: str):
+        self.wfile.write((s + "\n").encode())
+        self.wfile.flush()
+
+
+class PyCoordinatorServer:
+    def __init__(self, port: int):
+        self.port = port
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+
+    def start(self):
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", self.port), _Handler)
+        self._server.state = _State()  # type: ignore[attr-defined]
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        self._ready.set()
+
+    def wait_ready(self, timeout: float = 10.0):
+        self._ready.wait(timeout)
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -1,0 +1,102 @@
+"""Profiling: per-step timing, compile-time separation, device memory,
+XLA trace capture.
+
+Parity target: the reference's op profiler (``impl/profiler/profiler.h:25``),
+graph/memory profiler (``graph/profiler.h:40`` — mempool peaks, per-micro-
+batch ``MicroBatchMemoryInfo``) and subgraph fwd/bwd/update timing
+(``subgraph.h:53-56``). On TPU the op/stream layer belongs to XLA, so the
+equivalents are: wall-step statistics with first-step (compile) isolation,
+``device.memory_stats()`` peaks, and ``jax.profiler`` xplane traces for
+op-level drill-down.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import statistics
+import time
+from typing import Any, Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class StepStats:
+    count: int
+    mean_s: float
+    p50_s: float
+    min_s: float
+    max_s: float
+    compile_s: Optional[float]
+
+    def tokens_per_sec(self, tokens_per_step: int) -> float:
+        return tokens_per_step / self.mean_s if self.mean_s else 0.0
+
+
+class StepProfiler:
+    """Wall-clock step profiler; treats the first step as compile+run.
+
+    Usage::
+
+        prof = StepProfiler()
+        for batch in data:
+            with prof.step():
+                state, m = step_fn(state, batch)
+                jax.block_until_ready(m["loss"])
+        print(prof.stats())
+    """
+
+    def __init__(self):
+        self._times: list[float] = []
+
+    @contextlib.contextmanager
+    def step(self):
+        t0 = time.perf_counter()
+        yield
+        self._times.append(time.perf_counter() - t0)
+
+    def record(self, seconds: float):
+        self._times.append(seconds)
+
+    def stats(self, *, skip_first: bool = True) -> StepStats:
+        times = self._times
+        compile_s = None
+        if skip_first and len(times) > 1:
+            compile_s = times[0]
+            times = times[1:]
+        if not times:
+            return StepStats(0, 0.0, 0.0, 0.0, 0.0, compile_s)
+        return StepStats(len(times), statistics.fmean(times),
+                         statistics.median(times), min(times), max(times),
+                         compile_s)
+
+
+def device_memory_stats(device=None) -> dict[str, Any]:
+    """Allocator peaks — the ``CUDACachingMemoryPool`` counters analogue
+    (``graph/profiler.h:15-75``). Empty dict where the backend doesn't
+    report."""
+    device = device or jax.devices()[0]
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        stats = {}
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size", "num_allocs")
+    return {k: stats[k] for k in keep if k in stats}
+
+
+@contextlib.contextmanager
+def xla_trace(logdir: str):
+    """Capture an XLA/xplane trace viewable in TensorBoard/Perfetto —
+    replaces the reference's nsys hook (``rpc/pssh_start.py:55``)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def live_array_bytes() -> int:
+    """Total bytes of live device arrays (coarse leak/occupancy check)."""
+    return sum(x.nbytes for x in jax.live_arrays())

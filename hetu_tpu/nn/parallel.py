@@ -299,6 +299,12 @@ class StackedBlocks(Module):
     def block(self) -> Module:
         return self._block
 
+    def children(self):
+        # expose the template so module-tree walks (named_modules, LoRA
+        # injection) reach the per-layer submodules; abstract_specs is
+        # overridden so this never double-counts params
+        return {"block": self._block}
+
     def abstract_specs(self) -> dict:
         inner = self._block.abstract_specs()
         L = self.num_layers

@@ -1,0 +1,68 @@
+"""Quantization ops: int8/int4 symmetric per-channel quant/dequant.
+
+Parity target: the reference's quantization kernels
+(``hetu/impl/kernel/quantization.cu`` over vendored bitsandbytes; graph op
+``hetu/graph/ops/Quantization.h:15,79``) and quantized checkpoint storage
+(``ht_safetensors.py:42-49``). TPU-native: plain jnp — XLA fuses the
+dequant-multiply into the consuming matmul, so a custom kernel buys
+nothing for the W8A16 pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def quantize_int8(x, axis: int = -1):
+    """Symmetric per-channel int8. Returns (q int8, scale fp32)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
+    scale = jnp.where(amax == 0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q, scale, dtype=jnp.float32):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quantize_int4(x, axis: int = -1):
+    """Symmetric per-channel int4, packed two values per int8 along
+    ``axis`` (which must have even length). Returns (packed int8, scale,
+    orig_len)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
+    scale = jnp.where(amax == 0, 1.0, amax / 7.0)
+    q = jnp.clip(jnp.round(xf / scale), -7, 7).astype(jnp.int8)
+    q = jnp.moveaxis(q, axis, -1)
+    n = q.shape[-1]
+    if n % 2:
+        raise ValueError("int4 packing needs an even quantized axis")
+    lo = q[..., 0::2] & 0x0F
+    hi = (q[..., 1::2] & 0x0F) << 4
+    packed = (lo | hi).astype(jnp.int8)
+    packed = jnp.moveaxis(packed, -1, axis)
+    return packed, scale, n
+
+
+def dequantize_int4(packed, scale, orig_len: int, axis: int = -1,
+                    dtype=jnp.float32):
+    p = jnp.moveaxis(packed, axis, -1).astype(jnp.uint8)
+    lo = (p & 0x0F).astype(jnp.int8)
+    hi = ((p >> 4) & 0x0F).astype(jnp.int8)
+    # sign-extend 4-bit two's complement
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    q = jnp.stack([lo, hi], axis=-1).reshape(*p.shape[:-1], orig_len)
+    q = jnp.moveaxis(q, -1, axis)
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def int8_matmul(x, q_weight, scale, dtype=None):
+    """W8A16 matmul: ``x @ dequant(q_weight)`` — XLA fuses the dequant
+    into the matmul's operand stream."""
+    dtype = dtype or x.dtype
+    w = dequantize_int8(q_weight, scale, dtype)
+    return jnp.matmul(x.astype(dtype), w)

@@ -1,6 +1,6 @@
 from hetu_tpu.optim.base import (
     Transform, chain, apply_updates, identity, scale, scale_by_schedule,
-    add_decayed_weights,
+    add_decayed_weights, masked,
 )
 from hetu_tpu.optim.optimizers import sgd, adam, adamw, scale_by_adam, trace
 from hetu_tpu.optim.schedules import (
@@ -13,7 +13,7 @@ from hetu_tpu.optim.scaler import (
 
 __all__ = [
     "Transform", "chain", "apply_updates", "identity", "scale",
-    "scale_by_schedule", "add_decayed_weights",
+    "scale_by_schedule", "add_decayed_weights", "masked",
     "sgd", "adam", "adamw", "scale_by_adam", "trace",
     "constant", "linear_warmup", "cosine_decay", "linear_decay",
     "clip_by_global_norm", "global_norm",

@@ -90,3 +90,19 @@ def _get_path(tree, path: str):
     for part in path.split("."):
         node = node[part]
     return node
+
+
+def masked(inner: Transform, mask_tree: Any) -> Transform:
+    """Freeze params where ``mask_tree`` is False (PEFT/LoRA: train only
+    adapters). Both gradients entering ``inner`` and the final updates are
+    zeroed for frozen leaves, so weight decay cannot leak into them."""
+
+    def zero_frozen(tree):
+        return jax.tree.map(
+            lambda x, m: x if m else jnp.zeros_like(x), tree, mask_tree)
+
+    def update(grads, state, params=None):
+        updates, state = inner.update(zero_frozen(grads), state, params)
+        return zero_frozen(updates), state
+
+    return Transform(inner.init, update)

@@ -1,0 +1,15 @@
+"""Parameter-efficient fine-tuning.
+
+Parity target: ``python/hetu/peft`` (LoRA config/layer/model injection,
+multi-task ``MultiLoraModel`` — ``peft/lora/model.py:6``).
+"""
+
+from hetu_tpu.peft.lora import (
+    LoraConfig, LoraLinear, inject_lora, merge_lora, lora_trainable_mask,
+    wrap_params_for_lora,
+)
+
+__all__ = [
+    "LoraConfig", "LoraLinear", "inject_lora", "merge_lora",
+    "lora_trainable_mask", "wrap_params_for_lora",
+]

@@ -1,0 +1,162 @@
+"""LoRA: low-rank adapters over linear layers, multi-task capable.
+
+Parity target: ``python/hetu/peft/lora`` — config, layer wrapper, model
+injection, and multi-task ``MultiLoraModel`` (``peft/lora/model.py:6``,
+used by the LobRA example). Functional JAX design: injection *mutates the
+module tree* (modules are config objects), and a params-migration helper
+moves the existing trained weights under ``"base"`` while initializing
+adapter A/B factors — so a pretrained checkpoint keeps loading.
+
+Multi-task: adapters carry a leading ``task`` dim; ``task_id`` selects one
+at call time (the reference trains several LoRA tasks against one frozen
+base).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.core.tree import flatten_with_paths, unflatten_from_paths
+from hetu_tpu.nn.layers import Linear
+from hetu_tpu.nn.module import Module, normal_init, zeros_init
+from hetu_tpu.nn.parallel import ColumnParallelLinear, RowParallelLinear
+
+_LINEAR_TYPES = (Linear, ColumnParallelLinear, RowParallelLinear)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    r: int = 8
+    alpha: float = 16.0
+    num_tasks: int = 1
+    # regex matched against dotted module paths (e.g. "attn.q_proj")
+    target_patterns: Sequence[str] = (r"\.(q_proj|k_proj|v_proj|"
+                                      r"out_proj|fc_in|fc_out|gate_proj|"
+                                      r"up_proj)$",)
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.r
+
+
+class LoraLinear(Module):
+    """Wraps a Linear-like module: ``y = base(x) + scaling · (x A) B``.
+
+    A: (tasks, in, r) init normal; B: (tasks, r, out) init zeros (adapter
+    starts as identity). The base params live under ``params["base"]`` and
+    are frozen by :func:`lora_trainable_mask`.
+    """
+
+    def __init__(self, base: Module, cfg: LoraConfig):
+        super().__init__()
+        self.base = base
+        self.cfg = cfg
+        in_f = base.in_features
+        out_f = base.out_features
+        self.param("lora_A", (cfg.num_tasks, in_f, cfg.r),
+                   normal_init(0.02), axes=(None, "embed", None))
+        self.param("lora_B", (cfg.num_tasks, cfg.r, out_f),
+                   zeros_init(), axes=(None, None, None))
+
+    def abstract_specs(self) -> dict:
+        out = dict(self._param_specs)
+        out["base"] = self.base.abstract_specs()
+        return out
+
+    def children(self):
+        return {}  # base handled explicitly (nested under "base")
+
+    def __call__(self, params, x, *, task_id: int | jnp.ndarray = 0):
+        y = self.base(params["base"], x)
+        a = params["lora_A"][task_id]
+        b = params["lora_B"][task_id]
+        dt = self.compute_dtype()
+        delta = jnp.matmul(jnp.matmul(x.astype(dt), a.astype(dt)),
+                           b.astype(dt))
+        return y + self.cfg.scaling * delta
+
+
+def _match(path: str, patterns: Sequence[str]) -> bool:
+    return any(re.search(p, path) for p in patterns)
+
+
+def inject_lora(model: Module, cfg: LoraConfig) -> list[str]:
+    """Replace matching Linear-like children with LoraLinear wrappers
+    (in place). Returns the dotted paths that were wrapped."""
+    wrapped = []
+    for path, mod in list(model.named_modules()):
+        for name, child in list(vars(mod).items()):
+            if name.startswith("_") or not isinstance(child,
+                                                      _LINEAR_TYPES):
+                continue
+            child_path = f"{path}.{name}" if path else name
+            if _match(child_path, cfg.target_patterns):
+                setattr(mod, name, LoraLinear(child, cfg))
+                wrapped.append(child_path)
+    return wrapped
+
+
+def wrap_params_for_lora(model: Module, params: Any, key: jax.Array,
+                         dtype=None) -> Any:
+    """Migrate an existing (pretrained) param tree into the post-injection
+    structure: wrapped leaves move under ``"base"``, adapters initialize
+    fresh. Call *after* :func:`inject_lora`."""
+    old_flat = flatten_with_paths(params)
+    fresh = model.init(key, dtype=dtype)  # correct structure + new A/B
+    fresh_flat = flatten_with_paths(fresh)
+    out = {}
+    for path, leaf in fresh_flat.items():
+        if path.endswith("lora_A") or path.endswith("lora_B"):
+            out[path] = leaf
+            continue
+        base_path = path.replace(".base.", ".")
+        out[path] = old_flat.get(base_path, leaf)
+    return unflatten_from_paths(out)
+
+
+def lora_trainable_mask(params: Any) -> Any:
+    """Pytree of bools: True for adapter params, False for frozen base."""
+    flat = flatten_with_paths(params)
+    mask = {p: (p.endswith("lora_A") or p.endswith("lora_B"))
+            for p in flat}
+    return unflatten_from_paths(mask)
+
+
+def merge_lora(model: Module, params: Any, *, task_id: int = 0) -> Any:
+    """Fold adapters into base weights (W += scaling · A B) and return a
+    param tree matching the *pre-injection* structure."""
+    flat = flatten_with_paths(params)
+    out = {}
+    for path, leaf in flat.items():
+        if path.endswith("lora_A") or path.endswith("lora_B"):
+            continue
+        if ".base." in f".{path}":
+            prefix = path[:path.index("base.")]
+            new_path = (prefix + path[path.index("base.") + 5:]) \
+                .replace("..", ".")
+            if path.endswith("weight"):
+                a = jnp.asarray(flat[prefix + "lora_A"])
+                b = jnp.asarray(flat[prefix + "lora_B"])
+                scale = _first_lora_scaling(model)
+                if a.ndim == 4:  # stacked blocks: (layers, tasks, in, r)
+                    delta = jnp.einsum("lir,lro->lio", a[:, task_id],
+                                       b[:, task_id])
+                else:            # (tasks, in, r)
+                    delta = a[task_id] @ b[task_id]
+                leaf = leaf + (scale * delta).astype(leaf.dtype)
+            out[new_path] = leaf
+        else:
+            out[path] = leaf
+    return unflatten_from_paths(out)
+
+
+def _first_lora_scaling(model: Module) -> float:
+    for _, mod in model.named_modules():
+        if isinstance(mod, LoraLinear):
+            return mod.cfg.scaling
+    return 1.0

@@ -88,19 +88,33 @@ class CheckpointWriter:
 
 def save_checkpoint(path: str, state: TrainState, *,
                     async_save: bool = False,
-                    max_shard_bytes: Optional[int] = None
-                    ) -> CheckpointWriter:
+                    max_shard_bytes: Optional[int] = None,
+                    quantize: Optional[str] = None) -> CheckpointWriter:
     """Save a TrainState (params + optimizer state + step) to ``path``.
 
     The device→host snapshot is synchronous (consistent point-in-time);
     with ``async_save`` the file write runs in a background thread
     (reference: ``save_file_async``/``model_saver.py``).
     ``max_shard_bytes`` splits the archive with an index json (reference
-    split archives).
+    split archives). ``quantize="int8"`` stores 2-D+ float params
+    quantized with per-channel scales (reference quantized storage,
+    ``ht_safetensors.py:42-49``); optimizer state stays full precision.
     """
     tensors: dict[str, np.ndarray] = {}
+    quantized: list[str] = []
     for name, leaf in _flatten(state.params).items():
-        tensors[_MODEL_PREFIX + name] = np.asarray(jax.device_get(leaf))
+        arr = np.asarray(jax.device_get(leaf))
+        key = _MODEL_PREFIX + name
+        if quantize == "int8" and arr.ndim >= 2 and \
+                np.issubdtype(np.asarray(arr).dtype, np.floating):
+            from hetu_tpu.ops.quantization import quantize_int8
+            import jax.numpy as jnp
+            q, scale = quantize_int8(jnp.asarray(np.float32(arr)))
+            tensors[key] = np.asarray(jax.device_get(q))
+            tensors[key + ".q8scale"] = np.asarray(jax.device_get(scale))
+            quantized.append(key)
+        else:
+            tensors[key] = arr
     for name, leaf in _flatten(state.opt_state).items():
         tensors[_OPT_PREFIX + name] = np.asarray(jax.device_get(leaf))
     step = int(jax.device_get(state.step))
@@ -108,7 +122,7 @@ def save_checkpoint(path: str, state: TrainState, *,
     def write():
         os.makedirs(path, exist_ok=True)
         tmp_meta = {"step": step, "format_version": 1,
-                    "framework": "hetu_tpu"}
+                    "framework": "hetu_tpu", "quantized": quantized}
         if max_shard_bytes is None:
             save_file(tensors, os.path.join(path, _WEIGHTS_FILE))
         else:
@@ -173,6 +187,13 @@ def load_checkpoint(path: str, model, opt, plan=None) -> TrainState:
     tensors = _load_tensors(path)
     with open(os.path.join(path, _META_FILE)) as f:
         meta = json.load(f)
+
+    for key in meta.get("quantized", []):
+        from hetu_tpu.ops.quantization import dequantize_int8
+        import jax.numpy as jnp
+        deq = dequantize_int8(jnp.asarray(tensors[key]),
+                              jnp.asarray(tensors.pop(key + ".q8scale")))
+        tensors[key] = np.asarray(jax.device_get(deq))
 
     params_struct = model.abstract_params()
     opt_struct = jax.eval_shape(opt.init, params_struct)

@@ -1,0 +1,153 @@
+"""LoRA + quantization tests (parity: ``python/hetu/peft/lora``,
+``hetu/impl/kernel/quantization.cu`` / quantized checkpoint storage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu import optim
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.ops.quantization import (
+    dequantize_int4, dequantize_int8, int8_matmul, quantize_int4,
+    quantize_int8,
+)
+from hetu_tpu.peft import (
+    LoraConfig, inject_lora, lora_trainable_mask, merge_lora,
+    wrap_params_for_lora,
+)
+
+CFG = GPTConfig.tiny()
+
+
+def _data(b=4, s=16):
+    ids = jax.random.randint(jax.random.key(9), (b, s + 1), 0,
+                             CFG.vocab_size)
+    return ids[:, :-1], ids[:, 1:]
+
+
+def test_lora_injection_preserves_forward(rng):
+    """Fresh adapters (B=0) must not change the model's function."""
+    model = GPTLMHeadModel(CFG)
+    params = model.init(rng, dtype=jnp.float32)
+    ids, labels = _data()
+    ref = model(params, ids)
+
+    wrapped = inject_lora(model, LoraConfig(r=4))
+    assert any("q_proj" in w for w in wrapped)
+    params2 = wrap_params_for_lora(model, params, jax.random.key(1),
+                                   dtype=jnp.float32)
+    # base weights migrated intact
+    np.testing.assert_array_equal(
+        np.asarray(params["blocks"]["attn"]["q_proj"]["weight"]),
+        np.asarray(params2["blocks"]["attn"]["q_proj"]["base"]["weight"]))
+    got = model(params2, ids)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lora_training_updates_only_adapters(rng):
+    model = GPTLMHeadModel(CFG)
+    params = model.init(rng, dtype=jnp.float32)
+    inject_lora(model, LoraConfig(r=4))
+    params = wrap_params_for_lora(model, params, jax.random.key(1),
+                                  dtype=jnp.float32)
+    mask = lora_trainable_mask(params)
+    opt = optim.masked(optim.adamw(5e-3), mask)
+    opt_state = opt.init(params)
+    ids, labels = _data()
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: model.loss(p, ids, labels))(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optim.apply_updates(params, updates), opt_state, loss
+
+    before = jax.tree.map(lambda x: np.asarray(x), params)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1, losses
+    # base frozen, adapters moved
+    base_w = params["blocks"]["attn"]["q_proj"]["base"]["weight"]
+    np.testing.assert_array_equal(
+        np.asarray(base_w),
+        before["blocks"]["attn"]["q_proj"]["base"]["weight"])
+    moved = np.abs(np.asarray(
+        params["blocks"]["attn"]["q_proj"]["lora_B"])).max()
+    assert moved > 0
+
+
+def test_lora_merge_matches_adapter_forward(rng):
+    model = GPTLMHeadModel(CFG)
+    params = model.init(rng, dtype=jnp.float32)
+    inject_lora(model, LoraConfig(r=4))
+    params = wrap_params_for_lora(model, params, jax.random.key(1),
+                                  dtype=jnp.float32)
+    # give adapters nonzero values
+    params = jax.tree.map(lambda x: x, params)
+    params["blocks"]["attn"]["q_proj"]["lora_B"] = \
+        jax.random.normal(jax.random.key(2),
+                          params["blocks"]["attn"]["q_proj"]["lora_B"]
+                          .shape) * 0.01
+    ids, _ = _data()
+    ref = model(params, ids)
+
+    merged = merge_lora(model, params)
+    plain = GPTLMHeadModel(CFG)
+    got = plain(merged, ids)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_multi_task_lora(rng):
+    model = GPTLMHeadModel(CFG)
+    params = model.init(rng, dtype=jnp.float32)
+    inject_lora(model, LoraConfig(r=4, num_tasks=3))
+    params = wrap_params_for_lora(model, params, jax.random.key(1),
+                                  dtype=jnp.float32)
+    # stacked blocks: (layers, tasks, in, r)
+    a = params["blocks"]["attn"]["q_proj"]["lora_A"]
+    assert a.shape[:2] == (CFG.num_layers, 3)
+
+
+def test_int8_roundtrip():
+    x = jax.random.normal(jax.random.key(0), (64, 32)) * 3.0
+    q, scale = quantize_int8(x)
+    assert q.dtype == jnp.int8
+    deq = dequantize_int8(q, scale)
+    err = jnp.abs(deq - x).max() / jnp.abs(x).max()
+    assert float(err) < 0.02
+    # fused matmul path
+    a = jax.random.normal(jax.random.key(1), (8, 64))
+    np.testing.assert_allclose(np.asarray(a @ deq),
+                               np.asarray(int8_matmul(a, q, scale)),
+                               rtol=1e-5)
+
+
+def test_int4_roundtrip():
+    x = jax.random.normal(jax.random.key(2), (16, 32))
+    packed, scale, n = quantize_int4(x)
+    assert packed.shape == (16, 16) and n == 32
+    deq = dequantize_int4(packed, scale, n)
+    err = jnp.abs(deq - x).max() / jnp.abs(x).max()
+    assert float(err) < 0.2  # 4-bit precision
+
+
+def test_quantized_checkpoint(tmp_path, rng):
+    from hetu_tpu.engine import make_plan, init_state
+    from hetu_tpu.parallel.strategy import Strategy
+    from hetu_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    model = GPTLMHeadModel(CFG)
+    opt = optim.adamw(1e-3)
+    plan = make_plan(model, opt, Strategy())
+    state = init_state(model, opt, plan, rng, dtype=jnp.float32)
+    save_checkpoint(str(tmp_path / "q8"), state, quantize="int8")
+    loaded = load_checkpoint(str(tmp_path / "q8"), model, opt, plan)
+    w = np.asarray(state.params["wte"]["weight"])
+    wq = np.asarray(loaded.params["wte"]["weight"])
+    assert wq.shape == w.shape
+    rel = np.abs(wq - w).max() / np.abs(w).max()
+    assert rel < 0.02, rel

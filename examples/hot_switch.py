@@ -1,0 +1,48 @@
+"""Hot strategy switching example — HotSPa
+(reference ``examples/hotspa/llama_hot_switch_trainer.py``): start under
+one hybrid-parallel strategy, switch mid-training without losing state.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python examples/hot_switch.py
+"""
+
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the axon TPU plugin overrides the env var; pin via config
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+
+from hetu_tpu import optim
+from hetu_tpu.data import SyntheticLMDataset, build_data_loader
+from hetu_tpu.engine.trainer import Trainer, TrainerConfig
+from hetu_tpu.models import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.parallel.strategy import Strategy
+
+
+def main():
+    cfg = LlamaConfig.tiny()
+    trainer = Trainer(LlamaLMHeadModel(cfg), optim.adamw(3e-3),
+                      Strategy(dp=2, tp=4),
+                      config=TrainerConfig(total_steps=10, log_every=5,
+                                           precision="fp32"))
+    ds = SyntheticLMDataset(cfg.vocab_size, num_docs=1024, min_len=16,
+                            max_len=64, seed=0)
+
+    def loader():
+        return build_data_loader(ds, seq_len=64, batch_rows=8, pack=True)
+
+    trainer.train(loader(), steps=10)
+    # e.g. a long-context phase: switch to context parallelism + ZeRO
+    trainer.set_strategy(Strategy(dp=2, cp=4, zero=True, remat="full"))
+    trainer.train(loader(), steps=10)
+    # and to a pipeline layout
+    trainer.set_strategy(Strategy(dp=2, pp=2, tp=2, num_microbatches=4))
+    trainer.train(loader(), steps=10)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""HuggingFace checkpoint converters.
+
+Parity target: ``python/hetu/models/utils/converter/convert_llama_hf_to_ht.py``
+(+ the GPT analogue): map HF state dicts onto our param trees so users can
+start from public checkpoints. Input is a ``{name: array}`` state dict
+(e.g. ``{k: v.numpy() for k, v in torch_model.state_dict().items()}`` or a
+loaded safetensors file) — no torch dependency here.
+
+Layout notes:
+- HF GPT-2 uses Conv1D weights already shaped (in, out) with a fused
+  (E, 3E) c_attn — split into q/k/v.
+- HF Llama uses torch Linear weights (out, in) — transposed on the way in.
+- Per-layer tensors stack onto the leading ``layers`` dim of our
+  StackedBlocks params.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+
+
+def _stack(arrs):
+    return np.stack([np.asarray(a) for a in arrs])
+
+
+def convert_gpt2_from_hf(sd: Mapping[str, np.ndarray],
+                         cfg: GPTConfig) -> dict:
+    """HF ``GPT2LMHeadModel`` state dict → our GPT param tree."""
+    g = {k[len("transformer."):] if k.startswith("transformer.") else k: v
+         for k, v in sd.items()}
+    L, E = cfg.num_layers, cfg.hidden_size
+
+    def layer(i, name):
+        return np.asarray(g[f"h.{i}.{name}"])
+
+    qs, ks, vs, qb, kb, vb = [], [], [], [], [], []
+    for i in range(L):
+        w = layer(i, "attn.c_attn.weight")       # (E, 3E), Conv1D layout
+        b = layer(i, "attn.c_attn.bias")
+        qs.append(w[:, :E]); ks.append(w[:, E:2 * E]); vs.append(w[:, 2 * E:])
+        qb.append(b[:E]); kb.append(b[E:2 * E]); vb.append(b[2 * E:])
+
+    blocks = {
+        "ln_1": {"scale": _stack([layer(i, "ln_1.weight")
+                                  for i in range(L)]),
+                 "bias": _stack([layer(i, "ln_1.bias")
+                                 for i in range(L)])},
+        "ln_2": {"scale": _stack([layer(i, "ln_2.weight")
+                                  for i in range(L)]),
+                 "bias": _stack([layer(i, "ln_2.bias")
+                                 for i in range(L)])},
+        "attn": {
+            "q_proj": {"weight": _stack(qs), "bias": _stack(qb)},
+            "k_proj": {"weight": _stack(ks), "bias": _stack(kb)},
+            "v_proj": {"weight": _stack(vs), "bias": _stack(vb)},
+            "out_proj": {
+                "weight": _stack([layer(i, "attn.c_proj.weight")
+                                  for i in range(L)]),
+                "bias": _stack([layer(i, "attn.c_proj.bias")
+                                for i in range(L)])},
+        },
+        "mlp": {
+            "fc_in": {"weight": _stack([layer(i, "mlp.c_fc.weight")
+                                        for i in range(L)]),
+                      "bias": _stack([layer(i, "mlp.c_fc.bias")
+                                      for i in range(L)])},
+            "fc_out": {"weight": _stack([layer(i, "mlp.c_proj.weight")
+                                         for i in range(L)]),
+                       "bias": _stack([layer(i, "mlp.c_proj.bias")
+                                       for i in range(L)])},
+        },
+    }
+    return {
+        "wte": {"weight": np.asarray(g["wte.weight"])},
+        "wpe": {"weight": np.asarray(g["wpe.weight"])},
+        "blocks": blocks,
+        "ln_f": {"scale": np.asarray(g["ln_f.weight"]),
+                 "bias": np.asarray(g["ln_f.bias"])},
+    }
+
+
+def convert_llama_from_hf(sd: Mapping[str, np.ndarray],
+                          cfg: LlamaConfig) -> dict:
+    """HF ``LlamaForCausalLM`` state dict → our Llama param tree."""
+    g = {k[len("model."):] if k.startswith("model.") else k: v
+         for k, v in sd.items()}
+    L = cfg.num_layers
+
+    def lin(i, name):  # torch Linear: (out, in) → (in, out)
+        return np.asarray(g[f"layers.{i}.{name}.weight"]).T
+
+    blocks = {
+        "input_norm": {"scale": _stack(
+            [g[f"layers.{i}.input_layernorm.weight"] for i in range(L)])},
+        "post_attn_norm": {"scale": _stack(
+            [g[f"layers.{i}.post_attention_layernorm.weight"]
+             for i in range(L)])},
+        "attn": {
+            "q_proj": {"weight": _stack(
+                [lin(i, "self_attn.q_proj") for i in range(L)])},
+            "k_proj": {"weight": _stack(
+                [lin(i, "self_attn.k_proj") for i in range(L)])},
+            "v_proj": {"weight": _stack(
+                [lin(i, "self_attn.v_proj") for i in range(L)])},
+            "out_proj": {"weight": _stack(
+                [lin(i, "self_attn.o_proj") for i in range(L)])},
+        },
+        "mlp": {
+            "gate_proj": {"weight": _stack(
+                [lin(i, "mlp.gate_proj") for i in range(L)])},
+            "up_proj": {"weight": _stack(
+                [lin(i, "mlp.up_proj") for i in range(L)])},
+            "fc_out": {"weight": _stack(
+                [lin(i, "mlp.down_proj") for i in range(L)])},
+        },
+    }
+    out = {
+        "wte": {"weight": np.asarray(g["embed_tokens.weight"])},
+        "blocks": blocks,
+        "final_norm": {"scale": np.asarray(g["norm.weight"])},
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = {"weight": np.asarray(sd["lm_head.weight"]).T}
+    return out

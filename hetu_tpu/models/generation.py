@@ -1,0 +1,96 @@
+"""Autoregressive generation with KV caches.
+
+The reference's inference path appends KV via a dynamic-concat op
+(``hetu/graph/ops`` dynamic concat; ``NDArrayMeta`` deprecated
+dynamic_shape was for padded inference). TPU-native: fixed-capacity KV
+buffers + ``dynamic_update_slice`` (static shapes for jit), prefill in one
+pass, then a ``lax.scan`` over decode steps with greedy / temperature /
+top-k sampling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _head_weight(model, params):
+    if hasattr(model, "_head_weight"):
+        return model._head_weight(params)
+    return params["wte"]["weight"]
+
+
+def init_kv_caches(model, batch: int, max_len: int, dtype=jnp.float32):
+    """(k, v) buffers stacked over layers: (L, b, max_len, hkv, d)."""
+    attn = model.blocks.block.attn
+    L = model.blocks.num_layers
+    shape = (L, batch, max_len, attn.num_kv_heads, attn.head_dim)
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def decode(model, params, input_ids, positions, caches):
+    """Run a chunk through the model in decode mode.
+
+    ``positions`` (b, s) absolute positions (identical across the batch —
+    batched decode). Returns (logits (b, s, V), new caches)."""
+    h = model.embed(params, input_ids, positions=positions)
+    h, caches = model.blocks.decode(params["blocks"], h, caches,
+                                    positions=positions)
+    h = model.hidden_norm(params, h)
+    w = _head_weight(model, params)
+    logits = jnp.einsum("bse,ve->bsv", h.astype(jnp.float32),
+                        w.astype(jnp.float32))
+    return logits, caches
+
+
+def _sample(logits, *, temperature: float, top_k: int, rng):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(model, params, input_ids, *, max_new_tokens: int,
+             max_len: Optional[int] = None, temperature: float = 0.0,
+             top_k: int = 0, rng: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None, cache_dtype=jnp.float32):
+    """Generate ``max_new_tokens`` continuations for a (b, s) prompt.
+
+    Returns (b, s + max_new_tokens) token ids; positions after an EOS are
+    filled with ``eos_id`` when given. jit-able end to end.
+    """
+    b, s = input_ids.shape
+    total = max_len or (s + max_new_tokens)
+    caches = init_kv_caches(model, b, total, cache_dtype)
+    rng = rng if rng is not None else jax.random.key(0)
+
+    # prefill the prompt in one pass
+    prefill_pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    logits, caches = decode(model, params, input_ids, prefill_pos, caches)
+    rng, sub = jax.random.split(rng)
+    tok = _sample(logits[:, -1], temperature=temperature, top_k=top_k,
+                  rng=sub)
+    done = jnp.zeros((b,), bool) if eos_id is None else (tok == eos_id)
+
+    def step(carry, i):
+        caches, tok, done, rng = carry
+        pos = jnp.broadcast_to((s + i)[None, None], (b, 1))
+        logits, caches = decode(model, params, tok[:, None], pos, caches)
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits[:, -1], temperature=temperature,
+                      top_k=top_k, rng=sub)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (caches, nxt, done, rng), tok
+
+    (_, last, _, _), toks = jax.lax.scan(
+        step, (caches, tok, done, rng), jnp.arange(max_new_tokens - 1))
+    out = jnp.concatenate(
+        [input_ids, jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+    return out

@@ -81,10 +81,19 @@ class GPTBlock(Module):
                                    bias=True, gated=False)
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
-                 attn_impl="auto"):
-        # positions accepted for pipeline-payload uniformity (GPT's learned
-        # position embedding is applied in embed(), not per block)
-        del positions
+                 attn_impl="auto", kv_cache=None):
+        if kv_cache is not None:
+            a, new_cache = self.attn(params["attn"],
+                                     self.ln_1(params["ln_1"], x),
+                                     positions=positions,
+                                     kv_cache=kv_cache)
+            x = x + a
+            h = self.mlp(params["mlp"], self.ln_2(params["ln_2"], x))
+            if self.returns_aux:
+                h = h[0]  # aux is train-only
+            return x + h, new_cache
+        # positions only matter for decode (GPT's learned position
+        # embedding is applied in embed(), not per block)
         x = x + self.attn(params["attn"], self.ln_1(params["ln_1"], x),
                           segment_ids=segment_ids, attn_impl=attn_impl)
         h = self.mlp(params["mlp"], self.ln_2(params["ln_2"], x))
@@ -134,9 +143,12 @@ class GPTLMHeadModel(Module):
             return out
         return out, jnp.zeros([], jnp.float32)
 
+    def hidden_norm(self, params, h):
+        return self.ln_f(params["ln_f"], h)
+
     def hidden_states(self, params, input_ids, **kwargs):
         h, _ = self.backbone(params, input_ids, **kwargs)
-        return self.ln_f(params["ln_f"], h)
+        return self.hidden_norm(params, h)
 
     def __call__(self, params, input_ids, **kwargs):
         """Full logits (inference / entry path)."""

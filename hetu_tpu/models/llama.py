@@ -84,7 +84,19 @@ class LlamaBlock(Module):
                                    bias=False, gated=True)
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
-                 attn_impl="auto"):
+                 attn_impl="auto", kv_cache=None):
+        if kv_cache is not None:
+            a, new_cache = self.attn(params["attn"],
+                                     self.input_norm(
+                                         params["input_norm"], x),
+                                     positions=positions,
+                                     kv_cache=kv_cache)
+            x = x + a
+            h = self.mlp(params["mlp"],
+                         self.post_attn_norm(params["post_attn_norm"], x))
+            if self.returns_aux:
+                h = h[0]  # aux is train-only
+            return x + h, new_cache
         x = x + self.attn(params["attn"],
                           self.input_norm(params["input_norm"], x),
                           positions=positions, segment_ids=segment_ids,
@@ -141,9 +153,12 @@ class LlamaLMHeadModel(Module):
             return out
         return out, jnp.zeros([], jnp.float32)
 
+    def hidden_norm(self, params, h):
+        return self.final_norm(params["final_norm"], h)
+
     def hidden_states(self, params, input_ids, **kwargs):
         h, _ = self.backbone(params, input_ids, **kwargs)
-        return self.final_norm(params["final_norm"], h)
+        return self.hidden_norm(params, h)
 
     def __call__(self, params, input_ids, **kwargs):
         h = self.hidden_states(params, input_ids, **kwargs)

@@ -31,7 +31,7 @@ from jax import shard_map
 
 from hetu_tpu.nn.module import Module, ParamSpec, normal_init, zeros_init
 from hetu_tpu.ops import activations as act_ops
-from hetu_tpu.ops.attention import flash_attention
+from hetu_tpu.ops.attention import attention_reference, flash_attention
 from hetu_tpu.ops.rotary import rope_frequencies, apply_rotary
 from hetu_tpu.parallel.sharding import act_constrain, current_act_sharding
 
@@ -226,7 +226,9 @@ class ParallelAttention(Module):
             self._rope = None
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
-                 attn_impl: str = "auto"):
+                 attn_impl: str = "auto", kv_cache=None):
+        if kv_cache is not None:
+            return self._decode(params, x, kv_cache, positions=positions)
         b, s, _ = x.shape
         q = self.q_proj(params["q_proj"], x).reshape(
             b, s, self.num_heads, self.head_dim)
@@ -255,6 +257,40 @@ class ParallelAttention(Module):
         out = act_constrain(out, "heads")
         out = out.reshape(b, s, self.num_heads * self.head_dim)
         return self.out_proj(params["out_proj"], out)
+
+    def _decode(self, params, x, kv_cache, *, positions=None):
+        """Incremental decoding with a KV cache.
+
+        ``kv_cache``: (k_buf, v_buf) of shape (b, max_len, hkv, d); the
+        write ``index`` arrives via ``positions[:, 0]``-style absolute
+        positions (all rows share the index — batched decode). Replaces
+        the reference's dynamic-concat KV append op (inference path of
+        ``graph/ops``: dynamic concat)."""
+        k_buf, v_buf = kv_cache
+        b, s, _ = x.shape
+        index = positions[0, 0] if positions is not None else 0
+        q = self.q_proj(params["q_proj"], x).reshape(
+            b, s, self.num_heads, self.head_dim)
+        k = self.k_proj(params["k_proj"], x).reshape(
+            b, s, self.num_kv_heads, self.head_dim)
+        v = self.v_proj(params["v_proj"], x).reshape(
+            b, s, self.num_kv_heads, self.head_dim)
+        if self._rope is not None:
+            cos, sin = self._rope
+            pos = positions if positions is not None \
+                else jnp.arange(s)[None, :]
+            q = apply_rotary(q, cos, sin, positions=pos)
+            k = apply_rotary(k, cos, sin, positions=pos)
+        k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k.astype(
+            k_buf.dtype), index, axis=1)
+        v_buf = jax.lax.dynamic_update_slice_in_dim(v_buf, v.astype(
+            v_buf.dtype), index, axis=1)
+        # causal offsets mask both the future and never-written slots
+        # (their positions exceed every live q position)
+        out = attention_reference(q, k_buf, v_buf, causal=self.causal,
+                                  q_offset=index, kv_offset=0)
+        out = out.reshape(b, s, self.num_heads * self.head_dim)
+        return self.out_proj(params["out_proj"], out), (k_buf, v_buf)
 
 
 def remat_policy(name: str):
@@ -345,3 +381,15 @@ class StackedBlocks(Module):
             return x, aux
         x, _ = jax.lax.scan(body, x, params)
         return x
+
+    def decode(self, params, x, caches, **kwargs):
+        """Incremental decoding: scan layers threading per-layer KV caches
+        (leaves shaped (layers, b, max_len, hkv, d))."""
+        def body(h, inputs):
+            layer_params, cache = inputs
+            h, new_cache = self._block(layer_params, h, kv_cache=cache,
+                                       **kwargs)
+            return h, new_cache
+
+        x, new_caches = jax.lax.scan(body, x, (params, caches))
+        return x, new_caches

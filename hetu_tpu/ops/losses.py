@@ -89,14 +89,21 @@ def vocab_parallel_lm_loss(hidden, vocab_weight, labels, *,
     import functools
     from hetu_tpu.parallel.sharding import current_act_sharding
 
+    from hetu_tpu.core.dtypes import current_policy
+
     ctx = current_act_sharding()
+    # MXU-friendly: bf16 operands, fp32 accumulation (the CE math that
+    # follows is fp32 regardless)
+    mm_dt = current_policy().compute_dtype
+
     # shard_map path needs a plain axis name (axis_index/psum take strings)
     tp_deg = ctx.mesh.shape[ctx.tp] \
         if (ctx and isinstance(ctx.tp, str)) else 1
     if ctx is None or tp_deg <= 1 or vocab_weight.shape[0] % tp_deg != 0:
         logits = jnp.einsum(
-            "bse,ve->bsv", hidden.astype(jnp.float32),
-            vocab_weight.astype(jnp.float32))
+            "bse,ve->bsv", hidden.astype(mm_dt),
+            vocab_weight.astype(mm_dt),
+            preferred_element_type=jnp.float32)
         return cross_entropy_mean(logits, labels, ignore_index)
 
     tp = ctx.tp
@@ -112,7 +119,8 @@ def vocab_parallel_lm_loss(hidden, vocab_weight, labels, *,
         check_vma=False)
     def head(h, w, y):
         local_logits = jnp.einsum(
-            "bse,ve->bsv", h.astype(jnp.float32), w.astype(jnp.float32))
+            "bse,ve->bsv", h.astype(mm_dt), w.astype(mm_dt),
+            preferred_element_type=jnp.float32)
         vocab_start = jax.lax.axis_index(tp) * v_local
         return vocab_parallel_cross_entropy(
             local_logits, y, axis_name=tp, vocab_start=vocab_start,

@@ -51,6 +51,7 @@ def token_batches(lengths: Sequence[int], max_tokens: int, *,
 
 def build_data_loader(dataset, *, seq_len: int, batch_rows: int,
                       pack: bool = True, pad_id: int = 0, cp: int = 1,
+                      cp_layout: str = "zigzag",
                       max_tokens: Optional[int] = None,
                       shuffle: bool = True, drop_last: bool = True,
                       seed: int = 0) -> Iterator[dict]:
@@ -58,7 +59,10 @@ def build_data_loader(dataset, *, seq_len: int, batch_rows: int,
     ``seq_len`` tokens (static shapes for jit).
 
     ``pack=True`` packs multiple documents per row with segment ids;
-    ``max_tokens`` switches to the token-budget sampler.
+    ``max_tokens`` switches to the token-budget sampler. ``cp``/
+    ``cp_layout`` validate seq_len divisibility up-front (zigzag needs
+    ``seq_len % (2*cp) == 0``) so a mismatch fails at data-prep time, not
+    at the first ``shard_batch``; pass the Strategy's values.
     """
     lengths = [len(dataset[i]) for i in range(len(dataset))]
     if max_tokens is not None:
@@ -91,7 +95,8 @@ def build_data_loader(dataset, *, seq_len: int, batch_rows: int,
 
     for batch_idx in sampler:
         seqs = [dataset[i] for i in batch_idx]
-        pb = (pack_sequences(seqs, seq_len, pad_id=pad_id, cp=cp)
+        pb = (pack_sequences(seqs, seq_len, pad_id=pad_id, cp=cp,
+                             cp_layout=cp_layout)
               if pack else pad_batch(seqs, seq_len, pad_id=pad_id))
         rows_ids.extend(pb.input_ids)
         rows_labels.extend(pb.labels)

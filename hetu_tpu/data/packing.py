@@ -37,20 +37,24 @@ class PackedBatch:
 
 def pack_sequences(seqs: Sequence[np.ndarray], seq_len: int, *,
                    pad_id: int = 0, ignore_index: int = -100,
-                   cp: int = 1) -> PackedBatch:
+                   cp: int = 1, cp_layout: str = "contiguous") -> PackedBatch:
     """Greedy first-fit packing of token sequences into rows of
     ``seq_len``.
 
-    ``cp``: context-parallel degree — asserts ``seq_len % cp == 0`` so rows
-    split evenly into contiguous ring chunks (the reference additionally
-    supports SYM splits for load balance; contiguous is what
-    ``parallel.ring_attention`` consumes).
+    ``cp``: context-parallel degree; ``cp_layout``: "contiguous" needs
+    ``seq_len % cp == 0``, "zigzag" (the load-balanced SYM split — see
+    :func:`zigzag_indices`) needs ``seq_len % (2*cp) == 0``. The permutation
+    itself is applied by ``TrainPlan.shard_batch``, not here — packed rows
+    stay in natural order.
 
     Sequences longer than ``seq_len`` are truncated. Each packed segment
     gets a distinct id; padding uses a trailing id with all-ignored labels.
     """
-    if seq_len % cp != 0:
-        raise ValueError(f"seq_len {seq_len} not divisible by cp {cp}")
+    div = 2 * cp if (cp_layout == "zigzag" and cp > 1) else cp
+    if seq_len % div != 0:
+        raise ValueError(
+            f"seq_len {seq_len} not divisible by {div} "
+            f"(cp={cp}, layout={cp_layout})")
     rows: list[list[np.ndarray]] = []
     space: list[int] = []
     for seq in seqs:
@@ -83,6 +87,48 @@ def pack_sequences(seqs: Sequence[np.ndarray], seq_len: int, *,
         # padding tail: its own segment id, positions 0, labels ignored
         segment_ids[r, off:] = len(segs)
     return PackedBatch(input_ids, labels, positions, segment_ids)
+
+
+def zigzag_indices(seq_len: int, cp: int) -> np.ndarray:
+    """Zigzag (CP-symmetric) permutation of global sequence positions.
+
+    The global sequence is cut into ``2*cp`` chunks; ring rank ``i`` owns
+    chunks ``(i, 2*cp-1-i)``, so under causal masking every rank touches
+    the same number of KV positions per ring hop (the reference's SYM
+    split, ``hetu/graph/ops/ParallelAttention.h:21-25`` fed by
+    ``data/bucket.py:193`` ``generate_cp_pack_data``; contiguous chunks
+    leave the causal ring ~2x unbalanced).
+
+    Returns ``idx`` with ``permuted[j] = original[idx[j]]``; contiguous
+    sharding of the permuted array over cp then yields the zigzag layout.
+    """
+    if seq_len % (2 * cp) != 0:
+        raise ValueError(f"seq_len {seq_len} not divisible by 2*cp={2 * cp}")
+    c = seq_len // (2 * cp)
+    chunks = np.arange(seq_len).reshape(2 * cp, c)
+    order = [x for i in range(cp) for x in (i, 2 * cp - 1 - i)]
+    return chunks[order].reshape(-1)
+
+
+def zigzag_permute(x, cp: int, axis: int = -1):
+    """Reorder ``x`` along ``axis`` into the zigzag CP layout.
+
+    Works on numpy and jax arrays (both expose ``.take``); identity when
+    ``cp == 1``.
+    """
+    if cp == 1:
+        return x
+    return x.take(zigzag_indices(x.shape[axis], cp), axis=axis)
+
+
+def zigzag_restore(x, cp: int, axis: int = -1):
+    """Inverse of :func:`zigzag_permute`."""
+    if cp == 1:
+        return x
+    idx = zigzag_indices(x.shape[axis], cp)
+    inv = np.empty_like(idx)
+    inv[idx] = np.arange(len(idx))
+    return x.take(inv, axis=axis)
 
 
 def pad_batch(seqs: Sequence[np.ndarray], seq_len: int, *,

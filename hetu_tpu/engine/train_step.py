@@ -55,7 +55,30 @@ class TrainPlan:
         return NamedSharding(self.mesh, self.strategy.data_spec(ndim))
 
     def shard_batch(self, batch: dict) -> dict:
-        """Place a host batch onto the mesh per the data spec."""
+        """Place a host batch onto the mesh per the data spec.
+
+        Under zigzag CP the sequence dim (axis 1) of every batch array is
+        permuted into the load-balanced layout first (tokens, labels,
+        positions and segment ids all move together, so the per-token loss
+        is unchanged); ``positions`` is synthesized when absent so rotary
+        still sees *original* positions.
+        """
+        st = self.strategy
+        if st.effective_cp_layout == "zigzag":
+            from hetu_tpu.data.packing import zigzag_permute
+            batch = dict(batch)
+            if batch.get("positions") is None and "input_ids" in batch:
+                b, s = batch["input_ids"].shape[:2]
+                batch["positions"] = jnp.broadcast_to(
+                    jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+            # only the known seq-dim arrays move; custom keys (per-row
+            # weights etc.) keep their layout
+            seq_keys = ("input_ids", "labels", "positions", "segment_ids")
+            batch = {
+                k: zigzag_permute(v, st.cp, axis=1)
+                if k in seq_keys and v is not None else v
+                for k, v in batch.items()
+            }
         return {
             k: jax.device_put(v, self.batch_sharding(jnp.ndim(v)))
             for k, v in batch.items() if v is not None
@@ -76,7 +99,7 @@ def make_plan(model: Module, opt: Transform, strategy: Strategy,
     act = ActivationSharding(
         mesh,
         batch=("dp", "ep") if strategy.ep > 1 else "dp",
-        seq="cp", tp="tp")
+        seq="cp", tp="tp", cp_layout=strategy.effective_cp_layout)
     return TrainPlan(strategy, mesh, param_specs, state_specs,
                      named_shardings(mesh, state_specs), act)
 

@@ -14,9 +14,14 @@ backward ring piggybacks dK/dV accumulators on the rotating KV blocks
 Differences from the reference, by design:
 - The ring is expressed *inside* ``shard_map`` with a ``custom_vjp``; XLA
   schedules the ppermute/compute overlap instead of hand-managed streams.
-- Chunking is contiguous (the reference's NORMAL split). Its SYM/STRIPE
-  load-balancing splits are a data-side concern
-  (``data/bucket.py:193`` CP-symmetric packing) layered on top.
+- Two sequence layouts (the reference's split patterns,
+  ``ParallelAttention.h:21-25``): ``"contiguous"`` (NORMAL) and
+  ``"zigzag"`` (SYM — rank ``i`` owns global chunks ``(i, 2cp-1-i)``; see
+  ``data.packing.zigzag_indices``). Under causal masking contiguous
+  chunks make hop cost depend on the rank (in lockstep SPMD total wall
+  ~= cp full hops); zigzag makes every hop cost ~half a full hop on
+  every rank (total ~= 1 + (cp-1)/2), the same balance the reference
+  gets from CP-symmetric packed data (``data/bucket.py:193``).
 - Packing/varlen uses segment ids (global across the sequence), which ride
   the ring alongside KV.
 """
@@ -139,10 +144,55 @@ def _combine(out_acc, lse_acc, out_h, lse_h):
 
 
 def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
-                    use_pallas: bool):
+                    use_pallas: bool, layout: str = "contiguous"):
     hop_fwd = _hop_fwd_pallas if use_pallas else _hop_fwd_ref
     hop_bwd = _hop_bwd_pallas if use_pallas else _hop_bwd_ref
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+    # zigzag only changes the *causal* structure; non-causal attention is
+    # permutation-equivariant, so every hop is FULL either way.
+    zig = layout == "zigzag" and causal and cp > 1
+
+    def _seg_lo(seg, c):
+        return seg[:, :c] if seg is not None else None
+
+    def _seg_hi(seg, c):
+        return seg[:, c:] if seg is not None else None
+
+    def _zig_diag_fwd(q, k, v, q_seg, kv_seg):
+        """Hop 0 (src == rank): local q chunks (a, b), kv chunks (a, b)
+        with a < b globally ⇒ blocks (a,a) causal, (b,b) causal, (b,a)
+        FULL, (a,b) EMPTY."""
+        c = q.shape[1] // 2
+        o_aa, l_aa = hop_fwd(q[:, :c], k[:, :c], v[:, :c],
+                             _seg_lo(q_seg, c), _seg_lo(kv_seg, c),
+                             causal=True, scale=scale)
+        o_bb, l_bb = hop_fwd(q[:, c:], k[:, c:], v[:, c:],
+                             _seg_hi(q_seg, c), _seg_hi(kv_seg, c),
+                             causal=True, scale=scale)
+        o_ba, l_ba = hop_fwd(q[:, c:], k[:, :c], v[:, :c],
+                             _seg_hi(q_seg, c), _seg_lo(kv_seg, c),
+                             causal=False, scale=scale)
+        o_b, l_b = _combine(o_bb, l_bb, o_ba, l_ba)
+        return (jnp.concatenate([o_aa, o_b], axis=1),
+                jnp.concatenate([l_aa, l_b], axis=2))
+
+    def _zig_diag_bwd(q, k, v, q_seg, kv_seg, lse, delta, do):
+        c = q.shape[1] // 2
+        dq_aa, dk_aa, dv_aa = hop_bwd(
+            q[:, :c], k[:, :c], v[:, :c], _seg_lo(q_seg, c),
+            _seg_lo(kv_seg, c), lse[:, :, :c], delta[:, :, :c], do[:, :c],
+            causal=True, scale=scale)
+        dq_bb, dk_bb, dv_bb = hop_bwd(
+            q[:, c:], k[:, c:], v[:, c:], _seg_hi(q_seg, c),
+            _seg_hi(kv_seg, c), lse[:, :, c:], delta[:, :, c:], do[:, c:],
+            causal=True, scale=scale)
+        dq_ba, dk_ba, dv_ba = hop_bwd(
+            q[:, c:], k[:, :c], v[:, :c], _seg_hi(q_seg, c),
+            _seg_lo(kv_seg, c), lse[:, :, c:], delta[:, :, c:], do[:, c:],
+            causal=False, scale=scale)
+        return (jnp.concatenate([dq_aa, dq_bb + dq_ba], axis=1),
+                jnp.concatenate([dk_aa + dk_ba, dk_bb], axis=1),
+                jnp.concatenate([dv_aa + dv_ba, dv_bb], axis=1))
 
     def rotate(tree):
         return jax.tree.map(
@@ -158,13 +208,46 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
         b, sq, hq, d = q.shape
         out_acc = jnp.zeros(q.shape, jnp.float32)
         lse_acc = jnp.full((b, hq, sq), NEG_INF, jnp.float32)
+        c = sq // 2
         kv_cur = (k, v, kv_seg) if kv_seg is not None else (k, v)
         for hop in range(cp):
             kvseg_cur = kv_cur[2] if kv_seg is not None else None
             if hop == 0:
-                out_h, lse_h = hop_fwd(q, kv_cur[0], kv_cur[1], q_seg,
-                                       kvseg_cur, causal=causal,
-                                       scale=scale)
+                if zig:
+                    out_h, lse_h = _zig_diag_fwd(q, kv_cur[0], kv_cur[1],
+                                                 q_seg, kvseg_cur)
+                else:
+                    out_h, lse_h = hop_fwd(q, kv_cur[0], kv_cur[1], q_seg,
+                                           kvseg_cur, causal=causal,
+                                           scale=scale)
+            elif zig:
+                src = (idx - hop) % cp
+
+                # src < idx: src's lo chunk is earlier than both local q
+                # chunks, its hi chunk later than both ⇒ all q rows attend
+                # only the lo KV half. src > idx: local lo q chunk sees
+                # nothing, local hi q chunk (global 2cp-1-idx) is after
+                # both of src's chunks ⇒ hi q rows attend all KV. Either
+                # branch costs sq*sk/2 — balanced hops.
+                def kv_lo(kv):
+                    o, l = hop_fwd(q, kv[0][:, :c], kv[1][:, :c], q_seg,
+                                   _seg_lo(kv[2] if kv_seg is not None
+                                           else None, c),
+                                   causal=False, scale=scale)
+                    return o, l
+
+                def q_hi(kv):
+                    o, l = hop_fwd(q[:, c:], kv[0], kv[1],
+                                   _seg_hi(q_seg, c),
+                                   kv[2] if kv_seg is not None else None,
+                                   causal=False, scale=scale)
+                    return (jnp.concatenate(
+                        [jnp.zeros((b, c, hq, d), jnp.float32), o], axis=1),
+                        jnp.concatenate(
+                            [jnp.full((b, hq, c), NEG_INF, jnp.float32), l],
+                            axis=2))
+
+                out_h, lse_h = jax.lax.cond(src < idx, kv_lo, q_hi, kv_cur)
             else:
                 src = (idx - hop) % cp
 
@@ -179,8 +262,10 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
                             jnp.full((b, hq, sq), NEG_INF, jnp.float32))
 
                 # contiguous chunks: src<idx ⇒ all kv earlier ⇒ FULL;
-                # src>idx ⇒ all kv later ⇒ EMPTY (skip). Non-causal
-                # attention needs every hop.
+                # src>idx ⇒ all kv later ⇒ EMPTY. The cond is needed for
+                # correctness, but in lockstep SPMD it saves no wall time
+                # (some rank always takes the live branch) — that is why
+                # "zigzag" is the default layout for causal CP.
                 pred = (src < idx) if causal else jnp.bool_(True)
                 out_h, lse_h = jax.lax.cond(pred, live, dead, kv_cur)
             out_acc, lse_acc = _combine(out_acc, lse_acc, out_h, lse_h)
@@ -202,12 +287,45 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
         kv_cur = (k, v, kv_seg) if kv_seg is not None else (k, v)
         dkv = (jnp.zeros(k.shape, jnp.float32),
                jnp.zeros(v.shape, jnp.float32))
+        c = q.shape[1] // 2
         for hop in range(cp):
             kvseg_cur = kv_cur[2] if kv_seg is not None else None
             if hop == 0:
-                dq_h, dk_h, dv_h = hop_bwd(q, kv_cur[0], kv_cur[1], q_seg,
-                                           kvseg_cur, lse, delta, do,
-                                           causal=causal, scale=scale)
+                if zig:
+                    dq_h, dk_h, dv_h = _zig_diag_bwd(
+                        q, kv_cur[0], kv_cur[1], q_seg, kvseg_cur,
+                        lse, delta, do)
+                else:
+                    dq_h, dk_h, dv_h = hop_bwd(q, kv_cur[0], kv_cur[1],
+                                               q_seg, kvseg_cur, lse, delta,
+                                               do, causal=causal,
+                                               scale=scale)
+            elif zig:
+                src = (idx - hop) % cp
+                hkv = k.shape[2]
+
+                def kv_lo(kv):
+                    dq, dk, dv = hop_bwd(
+                        q, kv[0][:, :c], kv[1][:, :c], q_seg,
+                        _seg_lo(kv[2] if kv_seg is not None else None, c),
+                        lse, delta, do, causal=False, scale=scale)
+                    pad = jnp.zeros((q.shape[0], c, hkv, k.shape[3]),
+                                    jnp.float32)
+                    return (dq, jnp.concatenate([dk, pad], axis=1),
+                            jnp.concatenate([dv, pad], axis=1))
+
+                def q_hi(kv):
+                    dq, dk, dv = hop_bwd(
+                        q[:, c:], kv[0], kv[1], _seg_hi(q_seg, c),
+                        kv[2] if kv_seg is not None else None,
+                        lse[:, :, c:], delta[:, :, c:], do[:, c:],
+                        causal=False, scale=scale)
+                    pad = jnp.zeros((q.shape[0], c, q.shape[2], q.shape[3]),
+                                    jnp.float32)
+                    return jnp.concatenate([pad, dq], axis=1), dk, dv
+
+                dq_h, dk_h, dv_h = jax.lax.cond(src < idx, kv_lo, q_hi,
+                                                kv_cur)
             else:
                 src = (idx - hop) % cp
 
@@ -243,26 +361,43 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
 
 def ring_attention(q, k, v, *, ctx, causal: bool = True,
                    segment_ids: Optional[jnp.ndarray] = None,
-                   scale: Optional[float] = None, impl: str = "auto"):
+                   scale: Optional[float] = None, impl: str = "auto",
+                   layout: Optional[str] = None):
     """Context-parallel attention over ``ctx.seq`` (global arrays in,
     global arrays out; seq dim sharded over the cp axis).
 
     ``ctx`` is the active ActivationSharding; heads shard over ``ctx.tp``
-    when that is a plain axis name.
+    when that is a plain axis name. ``layout`` ("contiguous"|"zigzag")
+    describes how the *global* seq dim was laid out (see
+    ``data.packing.zigzag_permute``); defaults to ``ctx.cp_layout``. The
+    caller is responsible for feeding data in that layout —
+    ``TrainPlan.shard_batch`` does it for the trainer paths.
     """
     assert isinstance(ctx.seq, str), "ring attention needs a named cp axis"
     cp = ctx.mesh.shape[ctx.seq]
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if layout is None:
+        layout = getattr(ctx, "cp_layout", "contiguous")
 
     s_local = q.shape[1] // cp
+    if layout == "zigzag" and causal and cp > 1 \
+            and q.shape[1] % (2 * cp) != 0:
+        raise ValueError(
+            f"zigzag layout needs seq {q.shape[1]} divisible by 2*cp="
+            f"{2 * cp} (equal-size global chunks)")
+    # zigzag hops run flash on half-chunks, so the pallas tile constraint
+    # applies to s_local // 2
+    s_tile = s_local // 2 if (layout == "zigzag" and causal and cp > 1) \
+        else s_local
     if impl == "auto":
         use_pallas = (jax.default_backend() == "tpu"
-                      and d in (64, 128, 256) and s_local % 128 == 0)
+                      and d in (64, 128, 256) and s_tile % 128 == 0)
     else:
         use_pallas = impl == "pallas"
 
-    ring = _make_ring_core(ctx.seq, cp, causal, scale, use_pallas)
+    ring = _make_ring_core(ctx.seq, cp, causal, scale, use_pallas,
+                           layout=layout)
     tp_ax = ctx.tp if isinstance(ctx.tp, str) else None
     qkv_spec = P(ctx.batch, ctx.seq, tp_ax, None)
 

@@ -119,6 +119,8 @@ class ActivationSharding:
     seq: Any = None         # mesh axes for the sequence dim (cp; "tp" if Megatron-SP)
     tp: Any = None          # plain axis NAME for tp-sharded feature/head dims
                             # (the shard_map vocab-parallel paths need a string)
+    cp_layout: str = "contiguous"   # how the global seq maps to cp shards:
+                            # "contiguous" | "zigzag" (see data.packing)
 
     def spec(self, kind: str) -> Optional[P]:
         if kind == "tokens":        # (batch, seq, embed)

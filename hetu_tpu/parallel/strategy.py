@@ -42,11 +42,24 @@ class Strategy:
     num_microbatches: int = 1   # pipeline / grad-accumulation microbatches
     remat: str = "none"          # "none" | "full" | "selective"
     offload: bool = False        # host offload of remat'd activations
+    cp_layout: str = "zigzag"    # "zigzag" (load-balanced causal ring — the
+                                 # reference's SYM split) | "contiguous"
 
     # -- derived -----------------------------------------------------------
     @property
     def num_devices(self) -> int:
         return self.dp * self.tp * self.pp * self.cp * self.ep
+
+    @property
+    def effective_cp_layout(self) -> str:
+        """The layout actually in force: pp>1 runs attention under GSPMD
+        inside the pipeline region (no ring), which assumes the plain
+        contiguous causal mask — zigzag only applies to the ring path.
+        Both ``shard_batch`` and ``make_plan`` consult this single source
+        of truth."""
+        if self.pp > 1 or self.cp == 1:
+            return "contiguous"
+        return self.cp_layout
 
     def mesh_shape(self) -> dict[str, int]:
         return {"pp": self.pp, "dp": self.dp, "ep": self.ep,
@@ -87,6 +100,8 @@ class Strategy:
     def validate(self, n_devices: Optional[int] = None):
         if self.num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
+        if self.cp_layout not in ("zigzag", "contiguous"):
+            raise ValueError(f"unknown cp_layout {self.cp_layout!r}")
         if self.pp > 1 and self.num_microbatches % self.pp != 0:
             raise ValueError(
                 f"num_microbatches ({self.num_microbatches}) must be a "

@@ -19,6 +19,86 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 
+# Tests measured >~7s on the 8-CPU mesh (mostly multi-strategy parity runs
+# that compile many XLA programs). `pytest -m quick` is the builder's inner
+# loop (<2 min); `pytest` runs everything. Central list so the split stays
+# visible and maintainable.
+SLOW_TESTS = {
+    # trainer / hot switch
+    "test_hot_switch_loss_curve_identical",
+    "test_trainer_switch_to_pipeline",
+    "test_trainer_checkpoint_resume",
+    "test_trainer_trains_and_logs",
+    "test_trainer_evaluate",
+    # train-step parity matrix
+    "test_strategy_parity_with_single_device",
+    "test_microbatch_accumulation_parity",
+    "test_fsdp_parity_with_single_device",
+    "test_single_device_baseline",
+    "test_fsdp_shards_params",
+    # pipeline
+    "test_pp_with_zero_and_fsdp",
+    "test_llama_pp_parity",
+    "test_gpt_pp4",
+    "test_gpt_pp_parity",
+    "test_pp_block_params_sharded_over_pp",
+    # ring attention / CP
+    "test_ring_matches_oracle_fwd",
+    "test_ring_matches_oracle_grads",
+    "test_ring_with_dp_and_tp",
+    "test_model_uses_ring_under_cp",
+    "test_ring_pallas_interpret",
+    "test_zigzag_matches_oracle_grads",
+    "test_zigzag_default_strategy_end_to_end",
+    # checkpoint
+    "test_cross_strategy_reshard_and_bitwise_continuation",
+    "test_roundtrip_same_strategy",
+    "test_async_save_matches_sync",
+    # moe
+    "test_gpt_moe_trains",
+    "test_gpt_moe_with_pipeline",
+    "test_ep_matches_dense",
+    "test_gpt_moe_ep_loss_matches_dense",
+    "test_dense_moe_matches_manual",
+    "test_zigzag_matches_oracle_fwd",
+    "test_zigzag_packed_segments",
+    # generation
+    "test_hf_gpt2_converter_logit_parity",
+    "test_generate_greedy_deterministic",
+    "test_generate_sampling_and_eos",
+    "test_cached_decode_matches_full_forward",
+    # misc heavy
+    "test_packed_loss_equals_unpacked",
+    "test_loader_feeds_training",
+    "test_quantized_checkpoint",
+    "test_lora_injection_preserves_forward",
+    "test_lora_training_updates_only_adapters",
+    "test_lora_merge_matches_adapter_forward",
+    "test_stacked_blocks_remat_parity",
+    "test_flash_grads_match_reference",
+    "test_loss_decreases",
+    "test_packed_segment_ids_isolate_sequences",
+    "test_attention_tp_parity",
+    "test_gpt_tp_loss_parity",
+    "test_gate_topk_and_aux",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavy multi-strategy tests (full runs only)")
+    config.addinivalue_line(
+        "markers", "quick: fast tests — `pytest -m quick` < 2 min")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        name = getattr(item, "originalname", None) or item.name
+        if name in SLOW_TESTS or "slow" in item.keywords:
+            item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.quick)
+
 
 @pytest.fixture
 def rng():

@@ -152,7 +152,126 @@ def test_model_uses_ring_under_cp(rng):
     @jax.jit
     def loss_fn(p, b):
         with plan.act:
-            return model.loss(p, b["input_ids"], b["labels"])
+            return model.loss(p, b["input_ids"], b["labels"],
+                              positions=b.get("positions"))
+
+    got = float(loss_fn(sp, sbatch))
+    np.testing.assert_allclose(ref, got, rtol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# Zigzag (load-balanced SYM) layout
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+def test_zigzag_matches_oracle_fwd(rng, cp):
+    from hetu_tpu.data.packing import zigzag_permute, zigzag_restore
+    ctx, mesh = _env(cp)
+    q, k, v = _qkv(rng)
+    ref = attention_reference(q, k, v, causal=True)
+
+    @jax.jit
+    def f(q, k, v):
+        return ring_attention(q, k, v, ctx=ctx, causal=True,
+                              layout="zigzag")
+
+    sh = NamedSharding(mesh, P("dp", "cp", None, None))
+    out = f(*(jax.device_put(zigzag_permute(x, cp, axis=1), sh)
+              for x in (q, k, v)))
+    out = zigzag_restore(np.asarray(out), cp, axis=1)
+    np.testing.assert_allclose(np.asarray(ref), out, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+def test_zigzag_matches_oracle_grads(rng, cp):
+    from hetu_tpu.data.packing import zigzag_permute, zigzag_restore
+    ctx, mesh = _env(cp)
+    q, k, v = _qkv(rng)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 3)
+
+    refs = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+
+    @jax.jit
+    def g(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, ctx=ctx, causal=True,
+                                          layout="zigzag") ** 3)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    sh = NamedSharding(mesh, P("dp", "cp", None, None))
+    grads = g(*(jax.device_put(zigzag_permute(x, cp, axis=1), sh)
+                for x in (q, k, v)))
+    for gref, got in zip(refs, grads):
+        got = zigzag_restore(np.asarray(got), cp, axis=1)
+        np.testing.assert_allclose(np.asarray(gref), got,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_zigzag_packed_segments(rng):
+    """Packing + zigzag: segment ids ride the ring in permuted order."""
+    from hetu_tpu.data.packing import zigzag_permute, zigzag_restore
+    cp = 4
+    ctx, mesh = _env(cp)
+    q, k, v = _qkv(rng, s=32)
+    segs = (jnp.arange(32) >= 20).astype(jnp.int32)[None, :].repeat(2, 0)
+    ref = attention_reference(q, k, v, causal=True, segment_ids=segs)
+
+    @jax.jit
+    def f(q, k, v, s):
+        return ring_attention(q, k, v, ctx=ctx, causal=True,
+                              segment_ids=s, layout="zigzag")
+
+    sh = NamedSharding(mesh, P("dp", "cp", None, None))
+    ssh = NamedSharding(mesh, P("dp", "cp"))
+    out = f(*(jax.device_put(zigzag_permute(x, cp, axis=1), sh)
+              for x in (q, k, v)),
+            jax.device_put(zigzag_permute(segs, cp, axis=1), ssh))
+    out = zigzag_restore(np.asarray(out), cp, axis=1)
+    np.testing.assert_allclose(np.asarray(ref), out, rtol=2e-5, atol=2e-5)
+
+
+def test_zigzag_indices_roundtrip():
+    from hetu_tpu.data.packing import (
+        zigzag_indices, zigzag_permute, zigzag_restore)
+    idx = zigzag_indices(16, 2)
+    # rank 0 owns chunks (0, 3), rank 1 owns (1, 2)
+    np.testing.assert_array_equal(
+        idx, [0, 1, 2, 3, 12, 13, 14, 15, 4, 5, 6, 7, 8, 9, 10, 11])
+    x = np.arange(32).reshape(2, 16)
+    np.testing.assert_array_equal(
+        zigzag_restore(zigzag_permute(x, 4, axis=1), 4, axis=1), x)
+
+
+def test_zigzag_default_strategy_end_to_end(rng):
+    """Strategy defaults to cp_layout=zigzag; shard_batch permutes +
+    synthesizes positions; loss matches the unpermuted single-device run."""
+    from hetu_tpu import optim
+    from hetu_tpu.engine import make_plan
+    from hetu_tpu.models import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel.sharding import shard_params
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaLMHeadModel(cfg)
+    params = model.init(rng, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (2, 33), 0, cfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    ref = float(model.loss(params, batch["input_ids"], batch["labels"]))
+
+    strategy = Strategy(dp=2, cp=4)
+    assert strategy.cp_layout == "zigzag"
+    plan = make_plan(model, optim.adam(1e-3), strategy)
+    sp = shard_params(params, plan.mesh, plan.param_specs)
+    sbatch = plan.shard_batch(batch)
+    assert "positions" in sbatch
+
+    @jax.jit
+    def loss_fn(p, b):
+        with plan.act:
+            return model.loss(p, b["input_ids"], b["labels"],
+                              positions=b.get("positions"))
 
     got = float(loss_fn(sp, sbatch))
     np.testing.assert_allclose(ref, got, rtol=1e-5)

@@ -85,3 +85,19 @@ def test_sharded_init_no_replication(rng):
 def test_data_spec():
     assert Strategy(dp=2, cp=2).data_spec() == P("dp", "cp")
     assert Strategy(dp=2, ep=2).data_spec(3) == P(("dp", "ep"), "cp", None)
+
+
+def test_effective_cp_layout():
+    """pp>1 runs attention under GSPMD (no ring) — zigzag must switch off
+    everywhere (shard_batch AND the activation ctx the eval path uses)."""
+    from hetu_tpu.engine import make_plan
+    from hetu_tpu import optim
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+
+    assert Strategy(cp=2).effective_cp_layout == "zigzag"
+    assert Strategy(cp=2, pp=2, num_microbatches=2).effective_cp_layout \
+        == "contiguous"
+    assert Strategy(cp=1).effective_cp_layout == "contiguous"
+    plan = make_plan(GPTLMHeadModel(GPTConfig.tiny()), optim.adam(1e-3),
+                     Strategy(cp=2, pp=2, dp=2, num_microbatches=2))
+    assert plan.act.cp_layout == "contiguous"

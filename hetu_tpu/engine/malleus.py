@@ -1,0 +1,108 @@
+"""Malleus-style straggler-aware hetero-parallel planner.
+
+The reference's Malleus planner (``python/hetu/engine/strategy.py:99``) takes
+per-device straggler ratios and solves a PuLP ILP that (a) groups devices
+into TP groups so stragglers share a group, and (b) assigns pipeline layers
+to groups proportional to group throughput. This module solves the same
+problem with an exact enumeration over group-size compositions (the search
+space on a TPU pod slice is tiny — group sizes are powers of two), which
+avoids the PuLP dependency while keeping the ILP's optimality for the
+objective below.
+
+Model: a TP group executes in lockstep, so its throughput is
+``size × min(speed of members)`` with ``speed = 1/ratio``. For a fixed
+partition of devices into groups and fractional layer assignment, the
+pipeline's steady-state step time is ``total_layers / Σ group_throughput`` —
+so the planner (1) maximizes total throughput by choosing group sizes and a
+sorted device assignment (grouping similar speeds together is optimal; the
+ILP's core insight), then (2) rounds per-group layer counts by largest
+remainder.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Sequence
+
+from hetu_tpu.engine.straggler import StragglerReport
+from hetu_tpu.parallel.hetero import HeteroStrategy, StageSpec
+
+
+def _compositions(n: int, k: int, allowed: Sequence[int]):
+    """All ways to write n as an ordered sum of k values from ``allowed``."""
+    if k == 1:
+        if n in allowed:
+            yield (n,)
+        return
+    for first in allowed:
+        if first < n - max(allowed) * (k - 1):
+            continue
+        if first <= n - (k - 1) * min(allowed):
+            for rest in _compositions(n - first, k - 1, allowed):
+                yield (first,) + rest
+
+
+def _largest_remainder(weights: Sequence[float], total: int,
+                       minimum: int = 1) -> list[int]:
+    """Integer allocation of ``total`` proportional to ``weights``."""
+    k = len(weights)
+    wsum = sum(weights) or 1.0
+    raw = [w / wsum * (total - minimum * k) for w in weights]
+    out = [minimum + int(r) for r in raw]
+    rem = total - sum(out)
+    order = sorted(range(k), key=lambda i: raw[i] - int(raw[i]), reverse=True)
+    for i in range(rem):
+        out[order[i % k]] += 1
+    return out
+
+
+def plan_hetero(report: StragglerReport, num_layers: int, *,
+                num_stages: int, max_tp: int = 8,
+                num_microbatches: Optional[int] = None,
+                remat: str = "none") -> HeteroStrategy:
+    """Emit a HeteroStrategy from measured straggler ratios.
+
+    Devices are sorted fastest-first and cut into ``num_stages`` contiguous
+    TP groups (sizes chosen over power-of-two compositions to maximize
+    total lockstep throughput); layers are assigned per group by
+    throughput. Stragglers therefore end up co-located in a group that
+    gets few layers instead of dragging every TP matmul of a fast group —
+    the Malleus objective.
+    """
+    ids = sorted(report.ratios, key=lambda d: report.ratios[d])
+    speeds = [1.0 / report.ratios[d] for d in ids]
+    n = len(ids)
+    if num_stages < 1 or num_stages > n:
+        raise ValueError(f"num_stages={num_stages} with {n} devices")
+    if num_layers < num_stages:
+        raise ValueError("need at least one layer per stage")
+
+    allowed = [s for s in (1, 2, 4, 8, 16, 32) if s <= max_tp]
+    best = None
+    for sizes in _compositions(n, num_stages, allowed):
+        # contiguous cut of the sorted-by-speed device list
+        cuts, k = [], 0
+        for s in sizes:
+            cuts.append((k, k + s))
+            k += s
+        thr = [sizes[i] * min(speeds[lo:hi])
+               for i, (lo, hi) in enumerate(cuts)]
+        total = sum(thr)
+        if best is None or total > best[0]:
+            best = (total, sizes, cuts, thr)
+    if best is None:
+        raise ValueError(
+            f"no power-of-two composition of {n} devices into "
+            f"{num_stages} stages with max_tp={max_tp}")
+    _, sizes, cuts, thr = best
+
+    layers = _largest_remainder(thr, num_layers)
+    # faster stages first is conventional (embedding stage does extra work)
+    order = sorted(range(num_stages), key=lambda i: thr[i], reverse=True)
+    stages = tuple(StageSpec(layers=layers[i], tp=sizes[i]) for i in order)
+    device_ids = tuple(
+        d for i in order for d in ids[cuts[i][0]:cuts[i][1]])
+    nm = num_microbatches if num_microbatches is not None \
+        else max(2 * num_stages, 4)
+    return HeteroStrategy(stages=stages, num_microbatches=nm, remat=remat,
+                          device_ids=device_ids).validate(n)

@@ -67,6 +67,10 @@ SLOW_TESTS = {
     "test_generate_greedy_deterministic",
     "test_generate_sampling_and_eos",
     "test_cached_decode_matches_full_forward",
+    # hetero pipeline
+    "test_hetero_matches_homogeneous",
+    "test_hetero_shared_embedding_grads",
+    "test_malleus_planner_trains",
     # misc heavy
     "test_packed_loss_equals_unpacked",
     "test_loader_feeds_training",

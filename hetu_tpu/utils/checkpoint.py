@@ -130,6 +130,12 @@ def save_checkpoint(path: str, state: TrainState, *,
         with open(os.path.join(path, _META_FILE), "w") as f:
             json.dump(tmp_meta, f)
 
+    return _run_write(write, async_save)
+
+
+def _run_write(write, async_save: bool) -> CheckpointWriter:
+    """Run ``write()`` inline or on a daemon thread, surfacing errors on
+    ``writer.wait()`` (shared by the gathered and sharded save paths)."""
     writer = CheckpointWriter()
     if async_save:
         def run():

@@ -1,0 +1,247 @@
+"""Sharded distributed checkpoint: every host writes only its own shards.
+
+This is the scalable counterpart of ``utils.checkpoint`` (which assembles
+each leaf's *global* value on one host — fine for small models, ~100GB of
+host RAM for Llama-7B+Adam). Parity target: the reference's ds-aware
+per-shard save/load (``python/hetu/utils/checkpoint/ht_safetensors.py:223,
+519`` — each rank saves its local slices, an index maps slices to files).
+
+Design:
+- **Save**: for every leaf (a possibly-sharded ``jax.Array``), each process
+  writes the data of its *addressable* shards with ``replica_id == 0`` into
+  its own ``ckpt-host{p:05d}.safetensors`` file, one entry per (tensor,
+  device-shard piece). A per-host ``index-host{p:05d}.json`` records, for
+  every piece: file, entry name, global offset, and piece shape. No global
+  gather ever happens.
+- **Load**: the merged piece index describes the full logical tensor. Each
+  destination device shard is assembled via
+  ``jax.make_array_from_callback``: the callback reads only the overlapping
+  byte ranges from the relevant files (``safetensors.safe_open`` lazy
+  slicing), so a host never touches shards it does not need — the
+  reference's ``ParamSlice`` intersection, done with numpy slices.
+- Cross-strategy and cross-topology restore follow for free: the piece
+  index is layout-independent, so save under dp×tp and load under
+  pp×fsdp — or under a different device count (the elastic path).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from safetensors import safe_open
+from safetensors.numpy import save_file
+
+from hetu_tpu.engine.state import TrainState
+from hetu_tpu.utils.checkpoint import (
+    CheckpointWriter, _META_FILE, _MODEL_PREFIX, _OPT_PREFIX, _flatten,
+    _key_str, _run_write,
+)
+
+
+def _host_file(p: int) -> str:
+    return f"ckpt-host{p:05d}.safetensors"
+
+
+def _host_index(p: int) -> str:
+    return f"index-host{p:05d}.json"
+
+
+def _leaf_pieces(leaf) -> list[dict]:
+    """This process's owned pieces of one (possibly sharded) array.
+
+    A piece = {entry-local name suffix, data, start offsets, shape}. For a
+    replicated/unsharded array exactly one process-0 replica owns it.
+    """
+    if not isinstance(leaf, jax.Array):
+        arr = np.asarray(leaf)
+        if jax.process_index() == 0:
+            return [{"data": arr, "start": [0] * arr.ndim,
+                     "shape": list(arr.shape)}]
+        return []
+    pieces = []
+    for shard in leaf.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        idx = shard.index  # tuple of slices into the global shape
+        start = [0 if s.start is None else int(s.start) for s in idx]
+        data = np.asarray(shard.data)
+        pieces.append({"data": data, "start": start,
+                       "shape": list(data.shape)})
+    return pieces
+
+
+def save_checkpoint_distributed(path: str, state: TrainState, *,
+                                async_save: bool = False
+                                ) -> CheckpointWriter:
+    """Write this process's shards of ``state`` (params + opt + step).
+
+    Safe to call from every process concurrently — files are disjoint.
+    """
+    flat = {_MODEL_PREFIX + k: v for k, v in _flatten(state.params).items()}
+    flat.update({_OPT_PREFIX + k: v
+                 for k, v in _flatten(state.opt_state).items()})
+    p = jax.process_index()
+    step = int(jax.device_get(state.step))
+
+    tensors: dict[str, np.ndarray] = {}
+    index: dict[str, list[dict]] = {}
+    for key, leaf in flat.items():
+        entries = []
+        for i, piece in enumerate(_leaf_pieces(leaf)):
+            entry = f"{key}#p{i}"
+            tensors[entry] = piece["data"]
+            entries.append({"entry": entry, "file": _host_file(p),
+                            "start": piece["start"],
+                            "shape": piece["shape"]})
+        if entries:
+            index[key] = entries
+        gshape = list(leaf.shape) if hasattr(leaf, "shape") else []
+        for e in entries:
+            e["global_shape"] = gshape
+
+    def write():
+        os.makedirs(path, exist_ok=True)
+        save_file(tensors, os.path.join(path, _host_file(p)))
+        with open(os.path.join(path, _host_index(p)), "w") as f:
+            json.dump(index, f)
+        if p == 0:
+            with open(os.path.join(path, _META_FILE), "w") as f:
+                json.dump({"step": step, "format_version": 2,
+                           "framework": "hetu_tpu",
+                           "layout": "sharded"}, f)
+
+    return _run_write(write, async_save)
+
+
+class _PieceReader:
+    """Lazy reader assembling arbitrary windows from saved pieces."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.index: dict[str, list[dict]] = {}
+        for fname in sorted(os.listdir(path)):
+            if fname.startswith("index-host") and fname.endswith(".json"):
+                with open(os.path.join(path, fname)) as f:
+                    for k, v in json.load(f).items():
+                        self.index.setdefault(k, []).extend(v)
+        if not self.index:
+            raise FileNotFoundError(
+                f"no index-host*.json under {path} — not a sharded "
+                f"checkpoint (use utils.checkpoint.load_checkpoint?)")
+        self._files: dict[str, Any] = {}
+
+    def _open(self, fname: str):
+        if fname not in self._files:
+            self._files[fname] = safe_open(
+                os.path.join(self.path, fname), framework="numpy")
+        return self._files[fname]
+
+    def close(self):
+        self._files.clear()  # drops safe_open handles / mmaps
+
+    def keys(self):
+        return self.index.keys()
+
+    def global_shape(self, key: str) -> tuple[int, ...]:
+        return tuple(self.index[key][0]["global_shape"])
+
+    def read(self, key: str, window: tuple[slice, ...],
+             shape: tuple[int, ...], dtype) -> np.ndarray:
+        """Assemble ``tensor[window]`` (window: absolute slices)."""
+        lo = [0 if s.start is None else s.start for s in window]
+        hi = [shape[d] if window[d].stop is None else window[d].stop
+              for d in range(len(shape))]
+        if not shape:  # scalar
+            e = self.index[key][0]
+            return self._open(e["file"]).get_tensor(e["entry"]) \
+                .astype(dtype, copy=False)
+        out = None
+        covered = 0
+        for e in self.index[key]:
+            ps = e["start"]
+            pe = [ps[d] + e["shape"][d] for d in range(len(ps))]
+            if any(pe[d] <= lo[d] or ps[d] >= hi[d]
+                   for d in range(len(ps))):
+                continue  # no overlap
+            olo = [max(lo[d], ps[d]) for d in range(len(ps))]
+            ohi = [min(hi[d], pe[d]) for d in range(len(ps))]
+            piece_sl = tuple(slice(olo[d] - ps[d], ohi[d] - ps[d])
+                             for d in range(len(ps)))
+            sl = self._open(e["file"]).get_slice(e["entry"])
+            data = sl[piece_sl]
+            if out is None:
+                out = np.empty([hi[d] - lo[d] for d in range(len(lo))],
+                               dtype=data.dtype)
+            out[tuple(slice(olo[d] - lo[d], ohi[d] - lo[d])
+                      for d in range(len(lo)))] = data
+            covered += data.size
+        want = int(np.prod([hi[d] - lo[d] for d in range(len(lo))]))
+        # pieces are disjoint (device shards), so volume accounting detects
+        # holes from e.g. a host's files missing after a partial save
+        if out is None or covered != want:
+            raise KeyError(
+                f"{key}: window {window} only covered for {covered}/{want} "
+                f"elements — checkpoint incomplete (missing host files?)")
+        return out.astype(dtype, copy=False)
+
+
+def load_checkpoint_distributed(path: str, model, opt, plan=None
+                                ) -> TrainState:
+    """Rebuild a TrainState reading only the slices each device needs.
+
+    With ``plan``: every leaf is created with
+    ``jax.make_array_from_callback`` under the plan's shardings — each
+    piece is read at most once per destination shard, nothing global is
+    materialized. Without ``plan``: full arrays are assembled on host
+    (single-device flows).
+    """
+    reader = _PieceReader(path)
+    try:
+        return _load_with_reader(reader, path, model, opt, plan)
+    finally:
+        reader.close()
+
+
+def _load_with_reader(reader, path, model, opt, plan) -> TrainState:
+    with open(os.path.join(path, _META_FILE)) as f:
+        meta = json.load(f)
+
+    params_struct = model.abstract_params()
+    opt_struct = jax.eval_shape(opt.init, params_struct)
+
+    def build(prefix, template, shardings):
+        paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+        shard_leaves = None
+        if shardings is not None:
+            shard_leaves = jax.tree_util.tree_flatten(shardings)[0]
+        leaves = []
+        for i, (kpath, tmpl) in enumerate(paths):
+            key = prefix + ".".join(_key_str(k) for k in kpath)
+            shape, dtype = tuple(tmpl.shape), tmpl.dtype
+            if tuple(reader.global_shape(key)) != shape:
+                raise ValueError(
+                    f"{key}: checkpoint shape {reader.global_shape(key)} "
+                    f"!= expected {shape}")
+            if shard_leaves is not None:
+                sharding = shard_leaves[i]
+                leaves.append(jax.make_array_from_callback(
+                    shape, sharding,
+                    lambda idx, key=key, shape=shape, dtype=dtype:
+                        reader.read(key, idx, shape, dtype)))
+            else:
+                full = (slice(None),) * len(shape)
+                leaves.append(reader.read(key, full, shape, dtype))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    p_sh = o_sh = None
+    if plan is not None:
+        p_sh = plan.state_shardings.params
+        o_sh = plan.state_shardings.opt_state
+    params = build(_MODEL_PREFIX, params_struct, p_sh)
+    opt_state = build(_OPT_PREFIX, opt_struct, o_sh)
+    return TrainState(np.int32(meta["step"]), params, opt_state)

@@ -1,0 +1,252 @@
+"""In-tree tokenizers: byte-level BPE (GPT-2 scheme), trainer, HF wrapper.
+
+Parity target: the reference vendors tokenizer wrappers in
+``python/hetu/data`` (GPT2 BPE, HuggingFace, sentencepiece, tiktoken).
+Here the byte-level BPE encoder/decoder and a small corpus trainer are
+implemented natively (no network, no vendored vocab needed); pretrained
+vocabularies load from the standard ``vocab.json``/``merges.txt`` files,
+and any installed HuggingFace tokenizer can be wrapped.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import Counter
+from typing import Iterable, Optional, Sequence
+
+# GPT-2's pre-tokenization regex (contractions, letter runs, digit runs,
+# punctuation runs, whitespace handling) — the published pattern.
+_PRETOKEN_RE = re.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?[^\s\w]+|\s+(?!\S)|\s+",
+    re.UNICODE)
+
+
+def bytes_to_unicode() -> dict[int, str]:
+    """GPT-2's reversible byte→printable-unicode map: printable ASCII and
+    latin-1 glyphs map to themselves, the rest shift to 256+."""
+    bs = list(range(ord("!"), ord("~") + 1)) + \
+        list(range(ord("¡"), ord("¬") + 1)) + \
+        list(range(ord("®"), ord("ÿ") + 1))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+_B2U = bytes_to_unicode()
+_U2B = {v: k for k, v in _B2U.items()}
+
+
+def _word_to_symbols(word: str) -> tuple[str, ...]:
+    return tuple(_B2U[b] for b in word.encode("utf-8"))
+
+
+class ByteLevelBPETokenizer:
+    """GPT-2-style byte-level BPE: lossless on arbitrary text.
+
+    ``vocab``: token string → id. ``merges``: ordered list of symbol
+    pairs. Load pretrained files with :meth:`from_files` or build one
+    with :func:`train_bpe`.
+    """
+
+    def __init__(self, vocab: dict[str, int],
+                 merges: Sequence[tuple[str, str]], *,
+                 special_tokens: Optional[dict[str, int]] = None):
+        self.vocab = dict(vocab)
+        self.merge_ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.special = dict(special_tokens or {})
+        self.id_to_token = {v: k for k, v in self.vocab.items()}
+        self.id_to_token.update({v: k for k, v in self.special.items()})
+        self._cache: dict[str, tuple[str, ...]] = {}
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def from_files(cls, vocab_json: str, merges_txt: str, **kw):
+        with open(vocab_json) as f:
+            vocab = json.load(f)
+        merges = []
+        with open(merges_txt) as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#version"):
+                    continue
+                a, b = line.split(" ")
+                merges.append((a, b))
+        return cls(vocab, merges, **kw)
+
+    def save(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        with open(os.path.join(directory, "vocab.json"), "w") as f:
+            json.dump(self.vocab, f)
+        merges = sorted(self.merge_ranks, key=self.merge_ranks.get)
+        with open(os.path.join(directory, "merges.txt"), "w") as f:
+            f.write("#version: 0.2\n")
+            for a, b in merges:
+                f.write(f"{a} {b}\n")
+
+    # -- BPE core ------------------------------------------------------------
+    def _bpe(self, word: str) -> tuple[str, ...]:
+        if word in self._cache:
+            return self._cache[word]
+        symbols = list(_word_to_symbols(word))
+        while len(symbols) > 1:
+            pairs = [(symbols[i], symbols[i + 1])
+                     for i in range(len(symbols) - 1)]
+            ranked = [(self.merge_ranks[p], i) for i, p in enumerate(pairs)
+                      if p in self.merge_ranks]
+            if not ranked:
+                break
+            best_rank = min(r for r, _ in ranked)
+            pair = None
+            merged = []
+            i = 0
+            while i < len(symbols):
+                if i < len(symbols) - 1 and \
+                        self.merge_ranks.get(
+                            (symbols[i], symbols[i + 1])) == best_rank:
+                    merged.append(symbols[i] + symbols[i + 1])
+                    i += 2
+                else:
+                    merged.append(symbols[i])
+                    i += 1
+            symbols = merged
+        out = tuple(symbols)
+        self._cache[word] = out
+        return out
+
+    # -- public API ----------------------------------------------------------
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab) + len(self.special)
+
+    def encode(self, text: str) -> list[int]:
+        # special tokens split first so their literal text maps to the
+        # reserved ids (matching decode's treatment)
+        segments = [text]
+        for sp in sorted(self.special, key=len, reverse=True):
+            segments = [piece
+                        for seg in segments
+                        for piece in self._split_keep(seg, sp)]
+        ids = []
+        for seg in segments:
+            if seg in self.special:
+                ids.append(self.special[seg])
+                continue
+            for word in _PRETOKEN_RE.findall(seg):
+                for tok in self._bpe(word):
+                    ids.append(self.vocab[tok])
+        return ids
+
+    @staticmethod
+    def _split_keep(seg: str, sp: str) -> list[str]:
+        if seg == sp:
+            return [seg]
+        out = []
+        parts = seg.split(sp)
+        for i, part in enumerate(parts):
+            if part:
+                out.append(part)
+            if i < len(parts) - 1:
+                out.append(sp)
+        return out
+
+    def decode(self, ids: Iterable[int]) -> str:
+        # bytes accumulate across tokens before utf-8 decoding — BPE merges
+        # may split a multi-byte character between tokens
+        parts: list[str] = []
+        buf = bytearray()
+        special_ids = set(self.special.values())
+        for i in ids:
+            i = int(i)
+            if i in special_ids:
+                if buf:
+                    parts.append(buf.decode("utf-8", errors="replace"))
+                    buf = bytearray()
+                parts.append(self.id_to_token[i])
+            else:
+                buf.extend(_U2B[c] for c in self.id_to_token[i])
+        if buf:
+            parts.append(buf.decode("utf-8", errors="replace"))
+        return "".join(parts)
+
+    def __call__(self, text: str) -> list[int]:
+        return self.encode(text)
+
+
+def train_bpe(corpus: Iterable[str], vocab_size: int, *,
+              special_tokens: Sequence[str] = ("<|endoftext|>",)
+              ) -> ByteLevelBPETokenizer:
+    """Train byte-level BPE merges on a corpus (standard greedy BPE:
+    repeatedly merge the most frequent adjacent pair).
+
+    Byte alphabet (256) is the base vocabulary; merges are added until
+    ``vocab_size`` (minus specials) is reached or no pair repeats.
+    """
+    n_merges = vocab_size - 256 - len(special_tokens)
+    if n_merges < 0:
+        raise ValueError("vocab_size must be >= 256 + #special_tokens")
+    words = Counter()
+    for text in corpus:
+        for w in _PRETOKEN_RE.findall(text):
+            words[w] += 1
+    seqs = {w: list(_word_to_symbols(w)) for w in words}
+
+    merges: list[tuple[str, str]] = []
+    for _ in range(n_merges):
+        pair_counts: Counter = Counter()
+        for w, syms in seqs.items():
+            c = words[w]
+            for i in range(len(syms) - 1):
+                pair_counts[(syms[i], syms[i + 1])] += c
+        if not pair_counts:
+            break
+        pair, cnt = pair_counts.most_common(1)[0]
+        if cnt < 2:
+            break
+        merges.append(pair)
+        new_sym = pair[0] + pair[1]
+        for w, syms in seqs.items():
+            i, out = 0, []
+            while i < len(syms):
+                if i < len(syms) - 1 and (syms[i], syms[i + 1]) == pair:
+                    out.append(new_sym)
+                    i += 2
+                else:
+                    out.append(syms[i])
+                    i += 1
+            seqs[w] = out
+
+    vocab = {c: i for i, c in enumerate(_B2U.values())}
+    for a, b in merges:
+        vocab[a + b] = len(vocab)
+    special = {t: len(vocab) + i for i, t in enumerate(special_tokens)}
+    return ByteLevelBPETokenizer(vocab, merges, special_tokens=special)
+
+
+class HFTokenizer:
+    """Wrapper for an installed HuggingFace tokenizer (reference:
+    ``python/hetu/data`` HF wrapper). Local files only — no downloads."""
+
+    def __init__(self, name_or_path: str, **kw):
+        from transformers import AutoTokenizer
+        self.tk = AutoTokenizer.from_pretrained(
+            name_or_path, local_files_only=True, **kw)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.tk)
+
+    def encode(self, text: str) -> list[int]:
+        return self.tk.encode(text)
+
+    def decode(self, ids) -> str:
+        return self.tk.decode(ids)
+
+    def __call__(self, text: str) -> list[int]:
+        return self.encode(text)

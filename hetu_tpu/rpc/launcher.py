@@ -1,0 +1,229 @@
+"""Multi-process launch + elastic restart plane.
+
+TPU-native counterpart of the reference's launcher stack:
+``rpc/pssh_start.py:17`` (SSH fan-out, per-process env + log files) and
+``rpc/heturpc_elastic_server.py:497-559`` (death detection → restart the
+worker pool, resume from checkpoint). Here the fan-out is local
+``subprocess`` workers (the SSH hop is an env-provided command prefix away)
+and the cross-process device runtime is ``jax.distributed`` — the
+Coordinator supplies rank assignment, the KV used to exchange the JAX
+coordinator address, heartbeats, and barriers; JAX's own distributed
+service then owns collective bootstrap (the role NCCL-id exchange plays in
+the reference).
+
+Elastic model (same as the reference's): individual processes cannot be
+re-admitted into a running JAX job, so on any worker death the pool kills
+the generation and relaunches all workers; workers resume from the latest
+(sharded) checkpoint. Generations are namespaced in worker names and KV
+keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+from hetu_tpu.engine.elastic import HeartbeatSender
+from hetu_tpu.rpc.client import CoordinatorClient
+from hetu_tpu.rpc.coordinator import Coordinator
+from hetu_tpu.utils.logging import get_logger
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class DistContext:
+    """A worker's view of the cluster after bootstrap."""
+
+    rank: int
+    num_processes: int
+    generation: int
+    client: CoordinatorClient
+    heartbeat: Optional[HeartbeatSender]
+
+    def shutdown(self):
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+        self.client.close()
+
+
+def bootstrap_distributed(*, coord_port: Optional[int] = None,
+                          num_processes: Optional[int] = None,
+                          rank: Optional[int] = None,
+                          name: Optional[str] = None,
+                          heartbeat: bool = True,
+                          timeout_s: float = 60.0) -> DistContext:
+    """Connect to the Coordinator, resolve rank, and bring up
+    ``jax.distributed`` across the worker set.
+
+    Reference flow: ``distributed_init`` → Connect/GetRank → NCCL-id via
+    coordinator (SURVEY §3.1). Here: rank from the Coordinator (or the
+    launcher's HETU_RANK), JAX service address via the coordinator KV
+    (rank 0 publishes, everyone else polls), then
+    ``jax.distributed.initialize``.
+    """
+    port = coord_port if coord_port is not None \
+        else int(os.environ["HETU_COORD_PORT"])
+    n = num_processes if num_processes is not None \
+        else int(os.environ.get("HETU_NUM_PROCS", "1"))
+    gen = int(os.environ.get("HETU_GENERATION", "0"))
+    name = name or os.environ.get("HETU_WORKER_NAME",
+                                  f"worker-{os.getpid()}")
+    client = CoordinatorClient(port)
+    if rank is None:
+        env_rank = os.environ.get("HETU_RANK")
+        rank = int(env_rank) if env_rank is not None else client.rank(name)
+
+    if n > 1:
+        key = f"jax_coordinator/g{gen}"
+        if rank == 0:
+            addr = f"127.0.0.1:{_free_port()}"
+            client.put(key, addr)
+        else:
+            deadline = time.monotonic() + timeout_s
+            addr = client.get(key)
+            while addr is None:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"rank {rank}: no {key} published within "
+                        f"{timeout_s}s")
+                time.sleep(0.05)
+                addr = client.get(key)
+        import jax
+        jax.distributed.initialize(addr, num_processes=n, process_id=rank)
+
+    hb = HeartbeatSender(port, name).start() if heartbeat else None
+    return DistContext(rank, n, gen, client, hb)
+
+
+class ElasticWorkerPool:
+    """Spawn N worker processes; on any death, restart the generation.
+
+    Parity: the elastic server's restart-with-PSSH-pool loop
+    (``heturpc_elastic_server.py:497-559``) with ``max_restart_times``
+    semantics from the host yaml (``pssh_start.py:27-36``).
+    """
+
+    def __init__(self, script: str, num_workers: int, *,
+                 args: Sequence[str] = (),
+                 max_restarts: int = 1,
+                 log_dir: Optional[str] = None,
+                 env: Optional[dict] = None,
+                 poll_s: float = 0.2):
+        self.script = script
+        self.num_workers = num_workers
+        self.args = list(args)
+        self.max_restarts = max_restarts
+        self.log_dir = log_dir
+        self.extra_env = dict(env or {})
+        self.poll_s = poll_s
+        self.coordinator: Optional[Coordinator] = None
+        self.procs: list[subprocess.Popen] = []
+        self.generation = 0
+        self._logs: list = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def __enter__(self):
+        self.coordinator = Coordinator()
+        return self
+
+    def __exit__(self, *exc):
+        self._kill_all()
+        if self.coordinator is not None:
+            self.coordinator.shutdown()
+        return False
+
+    def _worker_env(self, rank: int) -> dict:
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.update({
+            "HETU_COORD_PORT": str(self.coordinator.port),
+            "HETU_NUM_PROCS": str(self.num_workers),
+            "HETU_RANK": str(rank),
+            "HETU_GENERATION": str(self.generation),
+            "HETU_WORKER_NAME": f"g{self.generation}-w{rank}",
+            # workers own exactly one virtual device each
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "JAX_PLATFORMS": "cpu",
+        })
+        return env
+
+    def _spawn_all(self):
+        self.procs = []
+        for r in range(self.num_workers):
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                log = open(os.path.join(
+                    self.log_dir,
+                    f"g{self.generation}-w{r}.log"), "w")
+            else:
+                log = subprocess.DEVNULL
+            self._logs.append(log)
+            self.procs.append(subprocess.Popen(
+                [sys.executable, self.script, *self.args],
+                env=self._worker_env(r), stdout=log, stderr=log))
+        get_logger().info(
+            f"pool: generation {self.generation} spawned "
+            f"{self.num_workers} workers")
+
+    def _kill_all(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in self._logs:
+            if log is not subprocess.DEVNULL and not log.closed:
+                log.close()
+        self._logs = []
+
+    def kill_worker(self, rank: int, sig=signal.SIGKILL):
+        """Fault injection for chaos tests."""
+        self.procs[rank].send_signal(sig)
+
+    # -- supervision ---------------------------------------------------------
+    def run(self, timeout_s: float = 300.0) -> dict:
+        """Launch and supervise until the generation exits cleanly (all rc
+        0) or restarts are exhausted. Returns a summary dict."""
+        if self.coordinator is None:
+            raise RuntimeError("use ElasticWorkerPool as a context manager")
+        self._spawn_all()
+        deadline = time.monotonic() + timeout_s
+        restarts = 0
+        while True:
+            if time.monotonic() > deadline:
+                self._kill_all()
+                raise TimeoutError("worker pool timed out")
+            codes = [p.poll() for p in self.procs]
+            if all(c == 0 for c in codes):
+                return {"generations": self.generation + 1,
+                        "restarts": restarts, "exit_codes": codes}
+            if any(c is not None and c != 0 for c in codes):
+                dead = [i for i, c in enumerate(codes)
+                        if c is not None and c != 0]
+                get_logger().warning(
+                    f"pool: generation {self.generation} lost workers "
+                    f"{dead} (codes {[codes[i] for i in dead]})")
+                self._kill_all()
+                if restarts >= self.max_restarts:
+                    return {"generations": self.generation + 1,
+                            "restarts": restarts, "exit_codes": codes,
+                            "failed": True}
+                restarts += 1
+                self.generation += 1
+                self._spawn_all()
+            time.sleep(self.poll_s)

@@ -106,9 +106,16 @@ def save_checkpoint_distributed(path: str, state: TrainState, *,
 
     def write():
         os.makedirs(path, exist_ok=True)
-        save_file(tensors, os.path.join(path, _host_file(p)))
-        with open(os.path.join(path, _host_index(p)), "w") as f:
-            json.dump(index, f)
+        # write-then-rename so a crash mid-save leaves the previous files
+        # intact; the per-host step stamp lets the loader reject a torn
+        # multi-host save (some hosts at step N, a crashed one still at N-1)
+        tmp = os.path.join(path, _host_file(p) + ".tmp")
+        save_file(tensors, tmp)
+        os.replace(tmp, os.path.join(path, _host_file(p)))
+        tmp = os.path.join(path, _host_index(p) + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump({"step": step, "pieces": index}, f)
+        os.replace(tmp, os.path.join(path, _host_index(p)))
         if p == 0:
             with open(os.path.join(path, _META_FILE), "w") as f:
                 json.dump({"step": step, "format_version": 2,
@@ -124,15 +131,22 @@ class _PieceReader:
     def __init__(self, path: str):
         self.path = path
         self.index: dict[str, list[dict]] = {}
+        self.steps: dict[str, int] = {}
         for fname in sorted(os.listdir(path)):
             if fname.startswith("index-host") and fname.endswith(".json"):
                 with open(os.path.join(path, fname)) as f:
-                    for k, v in json.load(f).items():
-                        self.index.setdefault(k, []).extend(v)
+                    doc = json.load(f)
+                self.steps[fname] = doc.get("step", -1)
+                for k, v in doc["pieces"].items():
+                    self.index.setdefault(k, []).extend(v)
         if not self.index:
             raise FileNotFoundError(
                 f"no index-host*.json under {path} — not a sharded "
                 f"checkpoint (use utils.checkpoint.load_checkpoint?)")
+        if len(set(self.steps.values())) > 1:
+            raise ValueError(
+                f"torn checkpoint: host indexes disagree on step "
+                f"({self.steps}) — a multi-host save was interrupted")
         self._files: dict[str, Any] = {}
 
     def _open(self, fname: str):
@@ -148,6 +162,8 @@ class _PieceReader:
         return self.index.keys()
 
     def global_shape(self, key: str) -> tuple[int, ...]:
+        if key not in self.index:
+            raise KeyError(f"checkpoint missing tensor {key!r}")
         return tuple(self.index[key][0]["global_shape"])
 
     def read(self, key: str, window: tuple[slice, ...],

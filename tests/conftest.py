@@ -67,6 +67,10 @@ SLOW_TESTS = {
     "test_generate_greedy_deterministic",
     "test_generate_sampling_and_eos",
     "test_cached_decode_matches_full_forward",
+    # multi-process (real OS processes + jax.distributed)
+    "test_two_process_dp_training",
+    "test_kill_restart_resumes_from_checkpoint",
+    "test_restarts_exhausted_reports_failure",
     # hetero pipeline
     "test_hetero_matches_homogeneous",
     "test_hetero_shared_embedding_grads",

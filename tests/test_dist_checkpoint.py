@@ -45,7 +45,7 @@ def test_save_writes_only_local_shards(tmp_path, setup):
     assert "ckpt-host00000.safetensors" in files
     assert "index-host00000.json" in files and "meta.json" in files
     with open(tmp_path / "index-host00000.json") as f:
-        index = json.load(f)
+        index = json.load(f)["pieces"]
     # a tp-sharded tensor must be stored as per-device pieces, each
     # strictly smaller than the global tensor (never gathered)
     key = "model.wte.weight"  # vocab-sharded over tp=4, fsdp over dp=2
@@ -112,10 +112,24 @@ def test_incomplete_checkpoint_detected(tmp_path, setup):
     # simulate a lost host: drop half of every sharded tensor's pieces
     # from the index (as if a second host's index/file never synced)
     with open(tmp_path / "index-host00000.json") as f:
-        index = json.load(f)
+        doc = json.load(f)
     key = "model.wte.weight"
-    index[key] = index[key][:4]
+    doc["pieces"][key] = doc["pieces"][key][:4]
     with open(tmp_path / "index-host00000.json", "w") as f:
-        json.dump(index, f)
+        json.dump(doc, f)
     with pytest.raises(KeyError, match="incomplete"):
+        load_checkpoint_distributed(str(tmp_path), model, opt)
+
+
+def test_torn_multihost_save_detected(tmp_path, setup):
+    """Host indexes that disagree on step (one host crashed before
+    rewriting its files) must be rejected, not silently mixed."""
+    cfg, model, opt, plan, state = setup
+    save_checkpoint_distributed(str(tmp_path), state)
+    with open(tmp_path / "index-host00000.json") as f:
+        doc = json.load(f)
+    doc["step"] = doc["step"] + 1  # pretend a second host lagged a step
+    with open(tmp_path / "index-host00001.json", "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ValueError, match="torn"):
         load_checkpoint_distributed(str(tmp_path), model, opt)

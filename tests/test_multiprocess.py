@@ -1,0 +1,79 @@
+"""Multi-process tests: real OS processes, jax.distributed over CPU, DP
+training across process boundaries, kill-based elastic restart.
+
+Parity targets: ``rpc/pssh_start.py:17`` (launcher), SURVEY §3.1 cluster
+bring-up, ``heturpc_elastic_server.py:497-559`` (restart pool). The
+reference has no kill-based chaos test (SURVEY §5.3) — this adds one.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hetu_tpu.rpc.launcher import ElasticWorkerPool
+
+_WORKER = os.path.join(os.path.dirname(__file__), "workers",
+                       "dp_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read_results(out_dir, gen, n):
+    out = []
+    for r in range(n):
+        with open(os.path.join(out_dir, f"result-g{gen}-r{r}.json")) as f:
+            out.append(json.load(f))
+    return out
+
+
+def test_two_process_dp_training(tmp_path):
+    """One DP step spans two OS processes (Gloo collectives); both ranks
+    see identical, decreasing losses."""
+    env = {"HETU_OUT": str(tmp_path), "HETU_STEPS": "4",
+           "HETU_REPO": _REPO}
+    with ElasticWorkerPool(_WORKER, 2, env=env,
+                           log_dir=str(tmp_path / "logs")) as pool:
+        summary = pool.run(timeout_s=300)
+    assert summary.get("failed") is None
+    assert summary["generations"] == 1 and summary["restarts"] == 0
+    res = _read_results(tmp_path, 0, 2)
+    assert [r["final_step"] for r in res] == [4, 4]
+    # grad allreduce crossed the process boundary: identical loss streams
+    np.testing.assert_allclose(res[0]["losses"], res[1]["losses"],
+                               rtol=1e-6)
+    assert res[0]["losses"][-1] < res[0]["losses"][0]
+
+
+def test_kill_restart_resumes_from_checkpoint(tmp_path):
+    """Rank 1 dies after step 2's checkpoint; the pool restarts the
+    generation and the workers resume from step 2, not step 0."""
+    env = {"HETU_OUT": str(tmp_path), "HETU_STEPS": "5",
+           "HETU_REPO": _REPO,
+           "HETU_DIE_AT_STEP": "2", "HETU_DIE_RANK": "1"}
+    with ElasticWorkerPool(_WORKER, 2, env=env, max_restarts=1,
+                           log_dir=str(tmp_path / "logs")) as pool:
+        summary = pool.run(timeout_s=420)
+    assert summary.get("failed") is None
+    assert summary["generations"] == 2 and summary["restarts"] == 1
+    res = _read_results(tmp_path, 1, 2)
+    for r in res:
+        assert r["generation"] == 1
+        assert r["start_step"] == 2          # resumed, not restarted
+        assert r["final_step"] == 5
+    np.testing.assert_allclose(res[0]["losses"], res[1]["losses"],
+                               rtol=1e-6)
+
+
+def test_restarts_exhausted_reports_failure(tmp_path):
+    env = {"HETU_OUT": str(tmp_path), "HETU_STEPS": "3",
+           "HETU_REPO": _REPO,
+           "HETU_DIE_AT_STEP": "1", "HETU_DIE_RANK": "0"}
+
+    # die in EVERY generation: make the worker die regardless of generation
+    # by reusing generation 0 logic — here we instead allow only 0 restarts
+    with ElasticWorkerPool(_WORKER, 2, env=env, max_restarts=0,
+                           log_dir=str(tmp_path / "logs")) as pool:
+        summary = pool.run(timeout_s=300)
+    assert summary.get("failed") is True
+    assert summary["restarts"] == 0

@@ -1,0 +1,86 @@
+"""Tokenizer tests: byte-level BPE trainer/encoder/decoder roundtrips.
+
+Parity target: the reference's in-tree tokenizer wrappers
+(``python/hetu/data``: GPT2 BPE / HF / sentencepiece / tiktoken)."""
+
+import numpy as np
+import pytest
+
+from hetu_tpu.data.tokenizers import (
+    ByteLevelBPETokenizer, bytes_to_unicode, train_bpe,
+)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quick brown fox likes the lazy dog",
+    "hello world, hello tokenizer world",
+    "don't stop believing 12345",
+] * 8
+
+
+def test_bytes_to_unicode_is_bijective():
+    m = bytes_to_unicode()
+    assert len(m) == 256 and len(set(m.values())) == 256
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return train_bpe(CORPUS, vocab_size=350)
+
+
+def test_train_bpe_learns_merges(tok):
+    assert len(tok.merge_ranks) > 0
+    assert 256 < tok.vocab_size <= 350
+    # frequent words compress below byte length
+    ids = tok.encode("the quick brown fox")
+    assert len(ids) < len("the quick brown fox".encode())
+
+
+def test_roundtrip_exact(tok):
+    for text in ["hello world", "don't stop!", "  spaces   and\ttabs\n",
+                 "unicode: héllo wörld ünïcode", "数字 and 中文 mix"]:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_roundtrip_unseen_bytes(tok):
+    # byte fallback covers symbols never in the corpus
+    text = "\x00\x7f\xff émoji: 🙂"
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_save_load_identical(tok, tmp_path):
+    tok.save(str(tmp_path))
+    tok2 = ByteLevelBPETokenizer.from_files(
+        str(tmp_path / "vocab.json"), str(tmp_path / "merges.txt"),
+        special_tokens=tok.special)
+    for text in CORPUS[:4]:
+        assert tok2.encode(text) == tok.encode(text)
+    assert tok2.decode(tok.encode(CORPUS[0])) == CORPUS[0]
+
+
+def test_special_tokens(tok):
+    eot = tok.special["<|endoftext|>"]
+    assert tok.decode([eot]) == "<|endoftext|>"
+    assert eot == tok.vocab_size - 1
+
+
+def test_feeds_dataset(tok, tmp_path):
+    """Tokenizer plugs into JsonDataset as the reference's wrappers do."""
+    import json
+    from hetu_tpu.data.dataset import JsonDataset
+    p = tmp_path / "d.jsonl"
+    with open(p, "w") as f:
+        for t in CORPUS[:3]:
+            f.write(json.dumps({"text": t}) + "\n")
+    ds = JsonDataset(str(p), tokenizer=tok)
+    assert len(ds) == 3
+    assert ds[0].dtype == np.int32 and len(ds[0]) > 0
+    assert tok.decode(ds[0].tolist()) == CORPUS[0]
+
+
+def test_encode_emits_special_ids(tok):
+    eot = tok.special["<|endoftext|>"]
+    ids = tok.encode("hello<|endoftext|>world")
+    assert eot in ids
+    assert tok.decode(ids) == "hello<|endoftext|>world"
+    assert tok.encode("<|endoftext|>") == [eot]

@@ -78,6 +78,13 @@ class ByteLevelBPETokenizer:
                     continue
                 a, b = line.split(" ")
                 merges.append((a, b))
+        # specials saved alongside (save() writes them); an explicit
+        # special_tokens kwarg wins
+        sp_file = os.path.join(os.path.dirname(vocab_json),
+                               "special_tokens.json")
+        if "special_tokens" not in kw and os.path.exists(sp_file):
+            with open(sp_file) as f:
+                kw["special_tokens"] = json.load(f)
         return cls(vocab, merges, **kw)
 
     def save(self, directory: str):
@@ -89,6 +96,10 @@ class ByteLevelBPETokenizer:
             f.write("#version: 0.2\n")
             for a, b in merges:
                 f.write(f"{a} {b}\n")
+        if self.special:
+            with open(os.path.join(directory, "special_tokens.json"),
+                      "w") as f:
+                json.dump(self.special, f)
 
     # -- BPE core ------------------------------------------------------------
     def _bpe(self, word: str) -> tuple[str, ...]:

@@ -145,16 +145,20 @@ class ElasticWorkerPool:
 
     def _worker_env(self, rank: int) -> dict:
         env = dict(os.environ)
+        # platform defaults for the CPU-simulation flow; the caller's env
+        # overrides them (e.g. JAX_PLATFORMS=tpu on real TPU hosts)
+        env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "JAX_PLATFORMS": "cpu",
+        })
         env.update(self.extra_env)
+        # launcher-owned keys always win — they define the worker identity
         env.update({
             "HETU_COORD_PORT": str(self.coordinator.port),
             "HETU_NUM_PROCS": str(self.num_workers),
             "HETU_RANK": str(rank),
             "HETU_GENERATION": str(self.generation),
             "HETU_WORKER_NAME": f"g{self.generation}-w{rank}",
-            # workers own exactly one virtual device each
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-            "JAX_PLATFORMS": "cpu",
         })
         return env
 

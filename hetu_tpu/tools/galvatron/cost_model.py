@@ -132,6 +132,11 @@ def estimate(dims: ModelDims, strategy: Strategy,
     layers_per_stage = dims.num_layers / s.pp
     flops_dev = (flops_layer + flops_attn) * layers_per_stage \
         / (s.tp * s.cp)
+    # remat recomputes forward work during bwd: fwd share is 1/3 of 6N
+    # (full = whole block fwd again; selective ≈ attention+norms only)
+    remat_factor = {"none": 1.0, "selective": 1.12, "full": 4.0 / 3.0,
+                    "offload": 4.0 / 3.0}.get(s.remat, 1.0)
+    flops_dev *= remat_factor
     # embedding + lm head on the last/first stage
     flops_head = 6.0 * tokens_loc * dims.vocab * h / (s.tp * s.cp)
     t_compute = (flops_dev + flops_head) \

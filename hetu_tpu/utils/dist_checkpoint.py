@@ -117,10 +117,12 @@ def save_checkpoint_distributed(path: str, state: TrainState, *,
             json.dump({"step": step, "pieces": index}, f)
         os.replace(tmp, os.path.join(path, _host_index(p)))
         if p == 0:
-            with open(os.path.join(path, _META_FILE), "w") as f:
+            tmp = os.path.join(path, _META_FILE + ".tmp")
+            with open(tmp, "w") as f:
                 json.dump({"step": step, "format_version": 2,
                            "framework": "hetu_tpu",
                            "layout": "sharded"}, f)
+            os.replace(tmp, os.path.join(path, _META_FILE))
 
     return _run_write(write, async_save)
 
@@ -128,25 +130,39 @@ def save_checkpoint_distributed(path: str, state: TrainState, *,
 class _PieceReader:
     """Lazy reader assembling arbitrary windows from saved pieces."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, expected_step: Optional[int] = None):
         self.path = path
         self.index: dict[str, list[dict]] = {}
         self.steps: dict[str, int] = {}
+        found = 0
         for fname in sorted(os.listdir(path)):
             if fname.startswith("index-host") and fname.endswith(".json"):
+                found += 1
                 with open(os.path.join(path, fname)) as f:
                     doc = json.load(f)
+                if "pieces" not in doc:
+                    raise ValueError(
+                        f"{fname}: old index format (format_version 1?) — "
+                        f"re-save the checkpoint or load with the matching "
+                        f"framework version")
                 self.steps[fname] = doc.get("step", -1)
+                # an elastic shrink leaves stale higher-numbered host files
+                # behind; only indexes matching meta's step participate —
+                # real holes then surface via coverage accounting in read()
+                if expected_step is not None \
+                        and doc.get("step", -1) != expected_step:
+                    continue
                 for k, v in doc["pieces"].items():
                     self.index.setdefault(k, []).extend(v)
-        if not self.index:
+        if not found:
             raise FileNotFoundError(
                 f"no index-host*.json under {path} — not a sharded "
                 f"checkpoint (use utils.checkpoint.load_checkpoint?)")
-        if len(set(self.steps.values())) > 1:
+        if not self.index:
             raise ValueError(
-                f"torn checkpoint: host indexes disagree on step "
-                f"({self.steps}) — a multi-host save was interrupted")
+                f"torn checkpoint: no host index matches meta step "
+                f"{expected_step} (host steps: {self.steps}) — the last "
+                f"multi-host save was interrupted")
         self._files: dict[str, Any] = {}
 
     def _open(self, fname: str):
@@ -216,17 +232,20 @@ def load_checkpoint_distributed(path: str, model, opt, plan=None
     materialized. Without ``plan``: full arrays are assembled on host
     (single-device flows).
     """
-    reader = _PieceReader(path)
+    with open(os.path.join(path, _META_FILE)) as f:
+        meta = json.load(f)
+    if meta.get("layout") != "sharded":
+        raise FileNotFoundError(
+            f"{path} is not a sharded checkpoint (layout="
+            f"{meta.get('layout')!r}) — use utils.checkpoint.load_checkpoint")
+    reader = _PieceReader(path, expected_step=meta["step"])
     try:
-        return _load_with_reader(reader, path, model, opt, plan)
+        return _load_with_reader(reader, meta, model, opt, plan)
     finally:
         reader.close()
 
 
-def _load_with_reader(reader, path, model, opt, plan) -> TrainState:
-    with open(os.path.join(path, _META_FILE)) as f:
-        meta = json.load(f)
-
+def _load_with_reader(reader, meta, model, opt, plan) -> TrainState:
     params_struct = model.abstract_params()
     opt_struct = jax.eval_shape(opt.init, params_struct)
 

@@ -100,3 +100,131 @@ def xla_trace(logdir: str):
 def live_array_bytes() -> int:
     """Total bytes of live device arrays (coarse leak/occupancy check)."""
     return sum(x.nbytes for x in jax.live_arrays())
+
+
+# -- per-module timing -------------------------------------------------------
+#
+# The reference records per-subgraph fwd/bwd/update times via CUDA events on
+# the module tree (``subgraph.h:53-56``, ``Graph::SubGraphProfiling``). XLA
+# fuses across module boundaries inside one jit, so the TPU-native
+# equivalent measures each module *as its own jit* on real shapes — embed /
+# one transformer block / LM head — which is also exactly the decomposition
+# the Galvatron cost model needs for calibration.
+
+@dataclasses.dataclass
+class ModuleTiming:
+    name: str
+    fwd_ms: float
+    bwd_ms: float        # fwd+bwd walltime of grad-of-sum (includes fwd)
+    param_bytes: int
+    count: int = 1       # e.g. num_layers for the block entry
+
+    @property
+    def total_fwd_ms(self):
+        return self.fwd_ms * self.count
+
+    @property
+    def total_bwd_ms(self):
+        return self.bwd_ms * self.count
+
+
+def sync_result(o):
+    """Force completion via a host fetch of one element —
+    ``block_until_ready`` can be lazy through remote PJRT relays."""
+    import numpy as np
+    leaf = jax.tree.leaves(o)[0]
+    np.asarray(jax.device_get(
+        leaf.ravel()[0] if getattr(leaf, "ndim", 0) else leaf))
+
+
+def time_fn_ms(fn, *args, iters: int = 10, warmup: int = 2) -> float:
+    """Mean wall-clock ms/call of a (jitted) function, relay-safe."""
+    for _ in range(warmup):
+        o = fn(*args)
+    sync_result(o)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        o = fn(*args)
+    sync_result(o)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+_time_fn = time_fn_ms  # internal alias used by profile_modules
+
+
+def profile_modules(model, params, batch, *, iters: int = 10,
+                    warmup: int = 2, attn_impl: str = "auto"
+                    ) -> list[ModuleTiming]:
+    """Per-module fwd and fwd+bwd wall times on real shapes.
+
+    ``model`` must follow the embed/blocks/head_loss protocol (GPT/Llama).
+    Returns embed, block (per layer, with ``count=num_layers``), and head
+    entries. Calibration consumers: ``tools.galvatron.calibrate``.
+    """
+    import functools
+
+    import jax.numpy as jnp
+
+    ids, labels = batch["input_ids"], batch["labels"]
+    B, S = ids.shape
+
+    def pbytes(tree):
+        return sum(x.nbytes for x in jax.tree.leaves(tree))
+
+    out = []
+    # embed
+    embed_params = {k: v for k, v in params.items() if k != "blocks"}
+    fwd = jax.jit(lambda p, i: model.embed(p, i))
+    bwd = jax.jit(jax.grad(
+        lambda p, i: model.embed(p, i).astype(jnp.float32).sum()))
+    out.append(ModuleTiming(
+        "embed", _time_fn(fwd, embed_params, ids, iters=iters,
+                          warmup=warmup),
+        _time_fn(bwd, embed_params, ids, iters=iters, warmup=warmup),
+        pbytes(params.get("wte", {})) + pbytes(params.get("wpe", {}))))
+
+    # one transformer block (layer 0 of the stacked params)
+    h = jax.jit(lambda p, i: model.embed(p, i))(embed_params, ids)
+    layer0 = jax.tree.map(lambda x: x[0], params["blocks"])
+    block = functools.partial(model.blocks.block, attn_impl=attn_impl)
+
+    def block_fwd(lp, x):
+        o = block(lp, x)
+        return o[0] if isinstance(o, tuple) else o
+
+    bfwd = jax.jit(block_fwd)
+    bbwd = jax.jit(jax.grad(
+        lambda lp, x: block_fwd(lp, x).astype(jnp.float32).sum()))
+    nl = model.blocks.num_layers
+    out.append(ModuleTiming(
+        "block", _time_fn(bfwd, layer0, h, iters=iters, warmup=warmup),
+        _time_fn(bbwd, layer0, h, iters=iters, warmup=warmup),
+        pbytes(layer0), count=nl))
+
+    # head (final norm + vocab projection + CE)
+    hfwd = jax.jit(lambda p, x, y: model.head_loss(p, x, y))
+    hbwd = jax.jit(jax.grad(
+        lambda p, x, y: model.head_loss(p, x, y), argnums=(0, 1)))
+    head_bytes = sum(pbytes(params.get(k, {}))
+                     for k in ("ln_f", "final_norm", "lm_head"))
+    if "lm_head" not in params:
+        head_bytes += pbytes(params.get("wte", {}))  # tied projection
+    out.append(ModuleTiming(
+        "head", _time_fn(hfwd, embed_params, h, labels, iters=iters,
+                         warmup=warmup),
+        _time_fn(hbwd, embed_params, h, labels, iters=iters,
+                 warmup=warmup),
+        head_bytes))
+    return out
+
+
+def format_module_table(timings: list[ModuleTiming]) -> str:
+    lines = [f"{'module':<8} {'n':>3} {'fwd ms':>8} {'fwd+bwd ms':>11} "
+             f"{'params MB':>10}"]
+    for t in timings:
+        lines.append(f"{t.name:<8} {t.count:>3} {t.fwd_ms:>8.2f} "
+                     f"{t.bwd_ms:>11.2f} {t.param_bytes/2**20:>10.1f}")
+    tot_f = sum(t.total_fwd_ms for t in timings)
+    tot_b = sum(t.total_bwd_ms for t in timings)
+    lines.append(f"{'TOTAL':<8} {'':>3} {tot_f:>8.2f} {tot_b:>11.2f}")
+    return "\n".join(lines)

@@ -122,14 +122,41 @@ def test_incomplete_checkpoint_detected(tmp_path, setup):
 
 
 def test_torn_multihost_save_detected(tmp_path, setup):
-    """Host indexes that disagree on step (one host crashed before
-    rewriting its files) must be rejected, not silently mixed."""
+    """meta advanced to step N but a host's index still says N-1 (that
+    host crashed before rewriting): must be rejected, not silently
+    mixed."""
+    cfg, model, opt, plan, state = setup
+    save_checkpoint_distributed(str(tmp_path), state)
+    with open(tmp_path / "meta.json") as f:
+        meta = json.load(f)
+    meta["step"] += 1  # rank 0 got further than the shard writers
+    with open(tmp_path / "meta.json", "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match="torn"):
+        load_checkpoint_distributed(str(tmp_path), model, opt)
+
+
+def test_stale_host_file_after_shrink_is_ignored(tmp_path, setup):
+    """After an elastic shrink, higher-numbered host files from the old
+    (larger) world linger at an older step — they must be filtered by
+    step, not break the load."""
     cfg, model, opt, plan, state = setup
     save_checkpoint_distributed(str(tmp_path), state)
     with open(tmp_path / "index-host00000.json") as f:
         doc = json.load(f)
-    doc["step"] = doc["step"] + 1  # pretend a second host lagged a step
-    with open(tmp_path / "index-host00001.json", "w") as f:
+    doc["step"] -= 1  # an old-generation leftover from a removed host
+    with open(tmp_path / "index-host00007.json", "w") as f:
         json.dump(doc, f)
-    with pytest.raises(ValueError, match="torn"):
+    restored = load_checkpoint_distributed(str(tmp_path), model, opt)
+    _assert_states_equal(state, restored)
+
+
+def test_old_index_format_rejected_with_hint(tmp_path, setup):
+    cfg, model, opt, plan, state = setup
+    save_checkpoint_distributed(str(tmp_path), state)
+    with open(tmp_path / "index-host00000.json") as f:
+        doc = json.load(f)
+    with open(tmp_path / "index-host00000.json", "w") as f:
+        json.dump(doc["pieces"], f)  # the pre-format-2 flat layout
+    with pytest.raises(ValueError, match="format"):
         load_checkpoint_distributed(str(tmp_path), model, opt)

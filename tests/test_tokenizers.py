@@ -84,3 +84,12 @@ def test_encode_emits_special_ids(tok):
     assert eot in ids
     assert tok.decode(ids) == "hello<|endoftext|>world"
     assert tok.encode("<|endoftext|>") == [eot]
+
+
+def test_save_load_preserves_specials(tok, tmp_path):
+    tok.save(str(tmp_path))
+    tok2 = ByteLevelBPETokenizer.from_files(
+        str(tmp_path / "vocab.json"), str(tmp_path / "merges.txt"))
+    ids = tok.encode("a<|endoftext|>b")
+    assert tok2.decode(ids) == "a<|endoftext|>b"
+    assert tok2.encode("a<|endoftext|>b") == ids

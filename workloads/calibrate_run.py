@@ -1,0 +1,74 @@
+"""Run the cost-model calibration on the real chip and print the table
+recorded in docs/PERF.md (VERDICT r2 item 7).
+
+Usage: python workloads/calibrate_run.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import optim
+from hetu_tpu.core.dtypes import Policy
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.parallel.strategy import Strategy
+from hetu_tpu.tools.galvatron import ModelDims, TPUTopology
+from hetu_tpu.tools.galvatron.calibrate import (
+    calibrate_topology, measure_matmul_efficiency, measure_strategies,
+    predicted_times, validate_ranking,
+)
+
+PEAK_V5E = 197e12
+
+
+def main():
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print(json.dumps({"error": "needs the TPU chip"}))
+        return
+    cfg = GPTConfig.small()
+    model = GPTLMHeadModel(cfg)
+    opt = optim.adamw(1e-4)
+    B, S = 8, 1024
+    dims = ModelDims.from_config(cfg, seq_len=S, global_batch=B)
+    topo = TPUTopology(num_devices=1, peak_flops=PEAK_V5E,
+                       hbm_bytes=16e9)
+
+    print("== MXU efficiency curve ==")
+    for shape, eff in measure_matmul_efficiency(PEAK_V5E).items():
+        print(f"  {shape}: {eff:.3f}")
+
+    params = model.init(jax.random.key(0), dtype=jnp.bfloat16)
+    ids = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab_size)
+    batch = {"input_ids": ids, "labels": ids}
+    cal = calibrate_topology(model, params, batch, topo, dims)
+    print(f"== calibrated mxu_efficiency: {cal.mxu_efficiency:.3f} ==")
+    del params
+
+    strategies = [
+        Strategy(),
+        Strategy(remat="selective"),
+        Strategy(remat="full"),
+        Strategy(num_microbatches=4),
+        Strategy(remat="full", num_microbatches=4),
+    ]
+    pol = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)
+    measured = measure_strategies(model, opt, strategies, (B, S),
+                                  cfg.vocab_size, policy=pol)
+    predicted = predicted_times(dims, strategies, cal)
+    print("\nstrategy                          measured_ms predicted_ms")
+    for st, m, p in zip(strategies, measured, predicted):
+        tag = f"remat={st.remat},nm={st.num_microbatches}"
+        print(f"{tag:<34}{m * 1e3:>10.1f}{p * 1e3:>12.1f}")
+    print(json.dumps(validate_ranking(measured, predicted)))
+
+
+if __name__ == "__main__":
+    main()

@@ -11,9 +11,17 @@ from hetu_tpu.data.dataset import JsonDataset, SyntheticLMDataset
 from hetu_tpu.data.loader import (
     build_data_loader, sample_batches, token_batches,
 )
+from hetu_tpu.data.tokenizers import (
+    ByteLevelBPETokenizer, HFTokenizer, train_bpe,
+)
+from hetu_tpu.data.hydraulis import (
+    BucketPlan, DynamicDispatcher, plan_buckets,
+)
 
 __all__ = [
     "PackedBatch", "pack_sequences", "SeqLenBuckets",
     "JsonDataset", "SyntheticLMDataset",
     "build_data_loader", "sample_batches", "token_batches",
+    "ByteLevelBPETokenizer", "HFTokenizer", "train_bpe",
+    "BucketPlan", "DynamicDispatcher", "plan_buckets",
 ]

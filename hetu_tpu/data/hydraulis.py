@@ -1,0 +1,154 @@
+"""Hydraulis-style dynamic sequence-length planning.
+
+Parity target: ``/root/reference/examples/hydraulis/strategy/{static,
+new_dynamic,new_planning,cost_model}.py`` — given the corpus' length
+distribution, plan *per-bucket* batch composition (rows per micro-batch at
+each padded length) and a per-bucket parallel strategy (long buckets get
+context parallelism / remat) so every dispatched step costs roughly the
+same and pad waste stays low. The TPU twist: each (bucket, strategy) pair
+is one cached jit executable (``data.bucket.SeqLenBuckets``), so the plan
+also bounds the number of compilations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from hetu_tpu.data.bucket import SeqLenBuckets
+from hetu_tpu.parallel.strategy import Strategy
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """Dispatch recipe for one bucket length."""
+
+    bucket_len: int
+    batch_rows: int          # rows per dispatched batch at this length
+    strategy: Strategy
+    est_step_ms: float       # cost-model estimate (0 when no model given)
+
+    @property
+    def tokens(self) -> int:
+        return self.bucket_len * self.batch_rows
+
+
+def plan_buckets(lengths: Iterable[int], *,
+                 buckets: SeqLenBuckets,
+                 token_budget: int,
+                 dims_base=None, topo=None,
+                 max_cp: int = 1,
+                 base_strategy: Optional[Strategy] = None
+                 ) -> dict[int, BucketPlan]:
+    """Choose per-bucket rows + strategy for a roughly constant token
+    budget per dispatch.
+
+    ``dims_base``/``topo`` (galvatron ``ModelDims``/``TPUTopology``)
+    enable cost-model-guided cp/remat per bucket; without them the plan is
+    token-budget only. Only buckets that appear in ``lengths`` get plans.
+    """
+    lengths = list(lengths)
+    present = sorted(buckets.group(lengths))
+    base = base_strategy or Strategy()
+    plans: dict[int, BucketPlan] = {}
+    for L in present:
+        rows = max(1, token_budget // L)
+        strategy, est = base, 0.0
+        if dims_base is not None and topo is not None:
+            from hetu_tpu.tools.galvatron.cost_model import estimate
+            best = None
+            cp = 1
+            while cp <= max_cp and L % (2 * cp) == 0 \
+                    and cp * 2 <= topo.num_devices:
+                for remat in ("none", "full"):
+                    cand = dataclasses.replace(
+                        base, cp=cp, remat=remat,
+                        dp=max(1, topo.num_devices // (cp * base.tp
+                                                       * base.pp)))
+                    dims = dataclasses.replace(
+                        dims_base, seq_len=L,
+                        global_batch=max(rows, cand.dp))
+                    c = estimate(dims, cand, topo)
+                    if c.fits(topo) and (best is None
+                                         or c.step_time < best[0]):
+                        best = (c.step_time, cand)
+                cp *= 2
+            if best is not None:
+                est, strategy = best[0] * 1e3, best[1]
+        plans[L] = BucketPlan(L, rows, strategy, est)
+    return plans
+
+
+@dataclasses.dataclass
+class DispatchStats:
+    batches: int = 0
+    real_tokens: int = 0
+    padded_tokens: int = 0
+
+    @property
+    def pad_fraction(self) -> float:
+        return 1.0 - self.real_tokens / self.padded_tokens \
+            if self.padded_tokens else 0.0
+
+
+class DynamicDispatcher:
+    """Group samples by bucket and emit fixed-shape batches per plan.
+
+    The reference's Hydraulis dispatcher composes each global batch from
+    per-bucket sub-batches matched to strategies; here each emitted batch
+    carries its :class:`BucketPlan` so the trainer can route it to the
+    right (bucket, strategy) jit. Rows shorter than the bucket are padded
+    with ``pad_id`` and label ``ignore_index``.
+    """
+
+    def __init__(self, plans: dict[int, BucketPlan], *,
+                 pad_id: int = 0, ignore_index: int = -100):
+        self.plans = plans
+        self.pad_id = pad_id
+        self.ignore_index = ignore_index
+        self.stats = DispatchStats()
+
+    def batches(self, seqs: Sequence[np.ndarray], *,
+                drop_remainder: bool = False):
+        """Yield ``(batch_dict, plan)`` per full sub-batch, largest
+        buckets first (long-seq steps dominate; failing fast on them
+        matters)."""
+        buckets = SeqLenBuckets(sizes=sorted(self.plans))
+        by_bucket: dict[int, list[int]] = {}
+        for i, s in enumerate(seqs):
+            # +1: LM shift consumes one token
+            by_bucket.setdefault(
+                buckets.bucket_for(max(0, len(s) - 1)), []).append(i)
+        for L in sorted(by_bucket, reverse=True):
+            plan = self.plans[L]
+            idxs = by_bucket[L]
+            for k in range(0, len(idxs), plan.batch_rows):
+                group = idxs[k:k + plan.batch_rows]
+                if len(group) < plan.batch_rows and drop_remainder:
+                    break
+                yield self._emit(seqs, group, plan), plan
+
+    def _emit(self, seqs, group, plan: BucketPlan) -> dict:
+        L = plan.bucket_len
+        n = plan.batch_rows
+        ids = np.full((n, L), self.pad_id, np.int32)
+        labels = np.full((n, L), self.ignore_index, np.int32)
+        for r, i in enumerate(group):
+            s = np.asarray(seqs[i])[:L + 1]
+            t = len(s) - 1
+            if t <= 0:
+                continue
+            ids[r, :t] = s[:-1]
+            labels[r, :t] = s[1:]
+            self.stats.real_tokens += t
+        self.stats.batches += 1
+        self.stats.padded_tokens += n * L
+        return {"input_ids": ids, "labels": labels}
+
+
+def naive_pad_fraction(seqs: Sequence[np.ndarray], max_len: int) -> float:
+    """Pad waste of the fixed-max-length baseline (for comparison)."""
+    real = sum(min(max(0, len(s) - 1), max_len) for s in seqs)
+    return 1.0 - real / (len(seqs) * max_len) if seqs else 0.0

@@ -9,7 +9,9 @@ from hetu_tpu.engine.train_step import (
     TrainPlan, make_plan, init_state, build_train_step, build_eval_step,
 )
 
+from hetu_tpu.engine.malleus import plan_hetero
+
 __all__ = [
     "TrainState", "TrainPlan", "make_plan", "init_state",
-    "build_train_step", "build_eval_step",
+    "build_train_step", "build_eval_step", "plan_hetero",
 ]

@@ -8,8 +8,15 @@ from hetu_tpu.parallel.sharding import (
     sharded_init,
 )
 
+from hetu_tpu.parallel.hetero import (
+    HeteroStrategy, StageSpec, build_hetero_train_step,
+    init_hetero_state, make_hetero_plan,
+)
+
 __all__ = [
     "Strategy", "MESH_AXES",
     "AxisRules", "param_partition_specs", "named_shardings",
     "shard_params", "constrain", "sharded_init",
+    "HeteroStrategy", "StageSpec", "build_hetero_train_step",
+    "init_hetero_state", "make_hetero_plan",
 ]

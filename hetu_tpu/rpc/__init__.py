@@ -7,4 +7,9 @@ Parity target: ``python/hetu/rpc`` — gRPC DeviceController servers
 from hetu_tpu.rpc.coordinator import Coordinator
 from hetu_tpu.rpc.client import CoordinatorClient
 
-__all__ = ["Coordinator", "CoordinatorClient"]
+from hetu_tpu.rpc.launcher import (
+    DistContext, ElasticWorkerPool, bootstrap_distributed,
+)
+
+__all__ = ["Coordinator", "CoordinatorClient",
+           "DistContext", "ElasticWorkerPool", "bootstrap_distributed"]

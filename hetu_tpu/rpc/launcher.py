@@ -29,7 +29,6 @@ import sys
 import time
 from typing import Optional, Sequence
 
-from hetu_tpu.engine.elastic import HeartbeatSender
 from hetu_tpu.rpc.client import CoordinatorClient
 from hetu_tpu.rpc.coordinator import Coordinator
 from hetu_tpu.utils.logging import get_logger
@@ -49,7 +48,8 @@ class DistContext:
     num_processes: int
     generation: int
     client: CoordinatorClient
-    heartbeat: Optional[HeartbeatSender]
+    heartbeat: Optional[object]   # HeartbeatSender (imported lazily —
+                                  # engine.elastic imports rpc.client)
 
     def shutdown(self):
         if self.heartbeat is not None:
@@ -102,7 +102,11 @@ def bootstrap_distributed(*, coord_port: Optional[int] = None,
         import jax
         jax.distributed.initialize(addr, num_processes=n, process_id=rank)
 
-    hb = HeartbeatSender(port, name).start() if heartbeat else None
+    if heartbeat:
+        from hetu_tpu.engine.elastic import HeartbeatSender
+        hb = HeartbeatSender(port, name).start()
+    else:
+        hb = None
     return DistContext(rank, n, gen, client, hb)
 
 
@@ -114,11 +118,20 @@ class ElasticWorkerPool:
     semantics from the host yaml (``pssh_start.py:27-36``).
     """
 
+    #: default worker platform: the CPU-simulation flow (one virtual
+    #: device per process). Pass ``platform_env={}`` (or your own) to run
+    #: workers on real TPU hosts with the inherited environment.
+    CPU_SIM_ENV = {
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "JAX_PLATFORMS": "cpu",
+    }
+
     def __init__(self, script: str, num_workers: int, *,
                  args: Sequence[str] = (),
                  max_restarts: int = 1,
                  log_dir: Optional[str] = None,
                  env: Optional[dict] = None,
+                 platform_env: Optional[dict] = None,
                  poll_s: float = 0.2):
         self.script = script
         self.num_workers = num_workers
@@ -126,6 +139,8 @@ class ElasticWorkerPool:
         self.max_restarts = max_restarts
         self.log_dir = log_dir
         self.extra_env = dict(env or {})
+        self.platform_env = dict(self.CPU_SIM_ENV if platform_env is None
+                                 else platform_env)
         self.poll_s = poll_s
         self.coordinator: Optional[Coordinator] = None
         self.procs: list[subprocess.Popen] = []
@@ -145,12 +160,7 @@ class ElasticWorkerPool:
 
     def _worker_env(self, rank: int) -> dict:
         env = dict(os.environ)
-        # platform defaults for the CPU-simulation flow; the caller's env
-        # overrides them (e.g. JAX_PLATFORMS=tpu on real TPU hosts)
-        env.update({
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-            "JAX_PLATFORMS": "cpu",
-        })
+        env.update(self.platform_env)
         env.update(self.extra_env)
         # launcher-owned keys always win — they define the worker identity
         env.update({

@@ -19,6 +19,7 @@ in ``docs/PERF.md``.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import Optional, Sequence
@@ -82,7 +83,6 @@ def measure_strategies(model, opt, strategies, batch_shape,
     times = []
     for st in strategies:
         ids = jax.random.randint(jax.random.key(1), (B, S + 1), 0, vocab)
-        import contextlib
         ctx = autocast(policy) if policy is not None \
             else contextlib.nullcontext()
         with ctx:
@@ -91,7 +91,7 @@ def measure_strategies(model, opt, strategies, batch_shape,
             step = build_train_step(model, opt, plan)
             b = plan.shard_batch({"input_ids": ids[:, :-1],
                                   "labels": ids[:, 1:]})
-            for _ in range(warmup):
+            for _ in range(max(1, warmup)):
                 state, m = step(state, b)
             _sync(m["loss"])
             t0 = time.perf_counter()

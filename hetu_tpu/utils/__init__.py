@@ -7,4 +7,9 @@ from hetu_tpu.utils.checkpoint import (
     save_checkpoint, load_checkpoint, CheckpointWriter,
 )
 
-__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointWriter"]
+from hetu_tpu.utils.dist_checkpoint import (
+    load_checkpoint_distributed, save_checkpoint_distributed,
+)
+
+__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointWriter",
+           "save_checkpoint_distributed", "load_checkpoint_distributed"]

@@ -138,8 +138,10 @@ def sync_result(o):
 
 
 def time_fn_ms(fn, *args, iters: int = 10, warmup: int = 2) -> float:
-    """Mean wall-clock ms/call of a (jitted) function, relay-safe."""
-    for _ in range(warmup):
+    """Mean wall-clock ms/call of a (jitted) function, relay-safe.
+
+    At least one warmup call always runs (compile must not be timed)."""
+    for _ in range(max(1, warmup)):
         o = fn(*args)
     sync_result(o)
     t0 = time.perf_counter()
@@ -149,7 +151,7 @@ def time_fn_ms(fn, *args, iters: int = 10, warmup: int = 2) -> float:
     return (time.perf_counter() - t0) / iters * 1e3
 
 
-_time_fn = time_fn_ms  # internal alias used by profile_modules
+
 
 
 def profile_modules(model, params, batch, *, iters: int = 10,
@@ -178,9 +180,9 @@ def profile_modules(model, params, batch, *, iters: int = 10,
     bwd = jax.jit(jax.grad(
         lambda p, i: model.embed(p, i).astype(jnp.float32).sum()))
     out.append(ModuleTiming(
-        "embed", _time_fn(fwd, embed_params, ids, iters=iters,
+        "embed", time_fn_ms(fwd, embed_params, ids, iters=iters,
                           warmup=warmup),
-        _time_fn(bwd, embed_params, ids, iters=iters, warmup=warmup),
+        time_fn_ms(bwd, embed_params, ids, iters=iters, warmup=warmup),
         pbytes(params.get("wte", {})) + pbytes(params.get("wpe", {}))))
 
     # one transformer block (layer 0 of the stacked params)
@@ -197,8 +199,8 @@ def profile_modules(model, params, batch, *, iters: int = 10,
         lambda lp, x: block_fwd(lp, x).astype(jnp.float32).sum()))
     nl = model.blocks.num_layers
     out.append(ModuleTiming(
-        "block", _time_fn(bfwd, layer0, h, iters=iters, warmup=warmup),
-        _time_fn(bbwd, layer0, h, iters=iters, warmup=warmup),
+        "block", time_fn_ms(bfwd, layer0, h, iters=iters, warmup=warmup),
+        time_fn_ms(bbwd, layer0, h, iters=iters, warmup=warmup),
         pbytes(layer0), count=nl))
 
     # head (final norm + vocab projection + CE)
@@ -210,9 +212,9 @@ def profile_modules(model, params, batch, *, iters: int = 10,
     if "lm_head" not in params:
         head_bytes += pbytes(params.get("wte", {}))  # tied projection
     out.append(ModuleTiming(
-        "head", _time_fn(hfwd, embed_params, h, labels, iters=iters,
+        "head", time_fn_ms(hfwd, embed_params, h, labels, iters=iters,
                          warmup=warmup),
-        _time_fn(hbwd, embed_params, h, labels, iters=iters,
+        time_fn_ms(hbwd, embed_params, h, labels, iters=iters,
                  warmup=warmup),
         head_bytes))
     return out

@@ -1,0 +1,88 @@
+"""Hydraulis dynamic seq-len planning tests.
+
+Parity target: ``examples/hydraulis/strategy/{static,new_dynamic,
+new_planning,cost_model}.py`` (per-bucket batch composition + strategy)."""
+
+import numpy as np
+import pytest
+
+from hetu_tpu.data.bucket import SeqLenBuckets
+from hetu_tpu.data.hydraulis import (
+    DynamicDispatcher, naive_pad_fraction, plan_buckets,
+)
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.parallel.strategy import Strategy
+from hetu_tpu.tools.galvatron import ModelDims, TPUTopology
+
+
+def _corpus(seed=0, n=400):
+    """Bimodal lengths: many short docs, a long-context tail."""
+    rs = np.random.RandomState(seed)
+    lens = np.concatenate([
+        rs.randint(40, 250, size=int(n * 0.8)),
+        rs.randint(1500, 4000, size=n - int(n * 0.8)),
+    ])
+    return [np.arange(L + 1, dtype=np.int32) % 250 for L in lens]
+
+
+def test_plan_constant_token_budget():
+    seqs = _corpus()
+    buckets = SeqLenBuckets(min_len=256, max_len=4096)
+    plans = plan_buckets([len(s) - 1 for s in seqs], buckets=buckets,
+                         token_budget=4096)
+    assert plans  # only buckets present in the corpus
+    for L, p in plans.items():
+        assert p.bucket_len == L
+        assert p.tokens <= 4096
+        assert p.tokens >= 4096 // 2  # rows rounding keeps budget tight
+    # short buckets batch many rows, long buckets few
+    assert plans[256].batch_rows > plans[4096].batch_rows
+
+
+def test_plan_gives_long_buckets_cp():
+    seqs = _corpus()
+    buckets = SeqLenBuckets(min_len=256, max_len=4096)
+    cfg = GPTConfig.small()
+    dims = ModelDims.from_config(cfg, seq_len=1024, global_batch=8)
+    # tiny HBM so long sequences cannot fit without cp/remat
+    topo = TPUTopology(num_devices=8, hbm_bytes=2e9, peak_flops=197e12)
+    plans = plan_buckets([len(s) - 1 for s in seqs], buckets=buckets,
+                         token_budget=8192, dims_base=dims, topo=topo,
+                         max_cp=4)
+    long_plan, short_plan = plans[4096], plans[256]
+    assert long_plan.strategy.cp > 1 or long_plan.strategy.remat != "none"
+    assert long_plan.est_step_ms > 0
+    # short bucket should not pay cp overhead it does not need
+    assert short_plan.strategy.cp <= long_plan.strategy.cp
+
+
+def test_dispatcher_shapes_and_pad_waste():
+    seqs = _corpus()
+    buckets = SeqLenBuckets(min_len=256, max_len=4096)
+    plans = plan_buckets([len(s) - 1 for s in seqs], buckets=buckets,
+                         token_budget=4096)
+    disp = DynamicDispatcher(plans)
+    seen_rows = 0
+    for batch, plan in disp.batches(seqs):
+        assert batch["input_ids"].shape == (plan.batch_rows,
+                                            plan.bucket_len)
+        assert batch["labels"].shape == batch["input_ids"].shape
+        seen_rows += plan.batch_rows
+    assert seen_rows >= len(seqs)
+    # bucketed padding must waste far less than pad-to-max
+    naive = naive_pad_fraction(seqs, 4096)
+    assert disp.stats.pad_fraction < naive / 2
+    assert disp.stats.pad_fraction < 0.45
+
+
+def test_dispatcher_labels_mask_padding():
+    seqs = [np.arange(10, dtype=np.int32)]
+    plans = {256: __import__("hetu_tpu.data.hydraulis",
+                             fromlist=["BucketPlan"]).BucketPlan(
+        256, 2, Strategy(), 0.0)}
+    disp = DynamicDispatcher(plans)
+    (batch, plan), = list(disp.batches(seqs))
+    assert (batch["labels"][0, 9:] == -100).all()
+    assert (batch["labels"][1] == -100).all()        # empty row
+    np.testing.assert_array_equal(batch["input_ids"][0, :9], seqs[0][:9])
+    np.testing.assert_array_equal(batch["labels"][0, :9], seqs[0][1:10])

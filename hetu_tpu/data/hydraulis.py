@@ -59,9 +59,13 @@ def plan_buckets(lengths: Iterable[int], *,
         if dims_base is not None and topo is not None:
             from hetu_tpu.tools.galvatron.cost_model import estimate
             best = None
-            cp = 1
+            cps = [1]   # cp=1 (remat-only) candidates need no extra devices
+            cp = 2
             while cp <= max_cp and L % (2 * cp) == 0 \
-                    and cp * 2 <= topo.num_devices:
+                    and cp <= topo.num_devices:
+                cps.append(cp)
+                cp *= 2
+            for cp in cps:
                 for remat in ("none", "full"):
                     cand = dataclasses.replace(
                         base, cp=cp, remat=remat,
@@ -74,7 +78,6 @@ def plan_buckets(lengths: Iterable[int], *,
                     if c.fits(topo) and (best is None
                                          or c.step_time < best[0]):
                         best = (c.step_time, cand)
-                cp *= 2
             if best is not None:
                 est, strategy = best[0] * 1e3, best[1]
         plans[L] = BucketPlan(L, rows, strategy, est)

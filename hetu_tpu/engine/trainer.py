@@ -44,6 +44,7 @@ class TrainerConfig:
     seed: int = 0
     precision: str = "bf16"      # "bf16" | "fp32"
     attn_impl: str = "auto"
+    distributed_ckpt: bool = False   # per-host shard files, no gather
 
     def policy(self) -> Policy:
         return BF16_COMPUTE if self.precision == "bf16" else FP32
@@ -97,7 +98,15 @@ class Trainer:
         return self.state
 
     def resume(self, path: str) -> TrainState:
-        self.state = load_checkpoint(path, self.model, self.opt, self.plan)
+        import os
+        if os.path.exists(os.path.join(path, "index-host00000.json")):
+            from hetu_tpu.utils.dist_checkpoint import (
+                load_checkpoint_distributed)
+            self.state = load_checkpoint_distributed(
+                path, self.model, self.opt, self.plan)
+        else:
+            self.state = load_checkpoint(path, self.model, self.opt,
+                                         self.plan)
         get_logger().info(
             f"resumed from {path} at step "
             f"{int(jax.device_get(self.state.step))}")
@@ -109,8 +118,16 @@ class Trainer:
             raise ValueError("no checkpoint path configured")
         if self._ckpt_writer is not None:
             self._ckpt_writer.wait()  # one in-flight save at a time
-        self._ckpt_writer = save_checkpoint(
-            path, self.state, async_save=self.config.async_ckpt and not wait)
+        if self.config.distributed_ckpt:
+            from hetu_tpu.utils.dist_checkpoint import (
+                save_checkpoint_distributed)
+            self._ckpt_writer = save_checkpoint_distributed(
+                path, self.state,
+                async_save=self.config.async_ckpt and not wait)
+        else:
+            self._ckpt_writer = save_checkpoint(
+                path, self.state,
+                async_save=self.config.async_ckpt and not wait)
         if wait:
             self._ckpt_writer.wait()
         return path

@@ -32,7 +32,9 @@ from jax.sharding import PartitionSpec as P
 
 from hetu_tpu.nn.module import Module, normal_init
 from hetu_tpu.ops import activations as act_ops
-from hetu_tpu.parallel.sharding import act_constrain, current_act_sharding
+from hetu_tpu.parallel.sharding import (
+    act_constrain, current_act_sharding, current_manual_axes,
+)
 
 
 class TopKGate(Module):
@@ -123,10 +125,29 @@ class MoEMLP(Module):
             h = self.activation(h)
         return jnp.einsum("ech,ehd->ecd", h, params["wo"].astype(dt))
 
+    def _expert_params(self, params):
+        return {n: params[n] for n in
+                (("wi", "wg", "wo") if self.gated else ("wi", "wo"))}
+
     def __call__(self, params, x):
         b, s, d = x.shape
         xf = x.reshape(b * s, d)
         idx, wgt, aux = self.gate(params["gate"], xf)
+
+        # inside a manual region (the pipeline executor) with a manual ep
+        # axis: run the dispatch body directly on the bound axis — the
+        # EP x PP composition (no nested shard_map allowed)
+        man = current_manual_axes()
+        if man is not None and "ep" in man.axes \
+                and man.mesh.shape.get("ep", 1) > 1 \
+                and self.num_experts % man.mesh.shape["ep"] == 0:
+            out = _ep_dispatch(
+                xf, idx, wgt, self._expert_params(params),
+                ep=man.mesh.shape["ep"], num_experts=self.num_experts,
+                k=self.k, capacity_factor=self.capacity_factor,
+                apply_experts=self._apply_experts)
+            aux = jax.lax.pmean(aux, "ep")
+            return out.reshape(b, s, d).astype(x.dtype), aux
 
         ctx = current_act_sharding()
         ep_deg = 0
@@ -154,49 +175,53 @@ class MoEMLP(Module):
 
     # -- expert-parallel path: capacity buffers + all_to_all ----------------
     def _ep_forward(self, params, xf, idx, wgt, ctx):
-        E, k = self.num_experts, self.k
-        ep = ctx.mesh.shape["ep"]
-        El = E // ep
-        cf = self.capacity_factor
-        expert_params = {n: params[n] for n in
-                         (("wi", "wg", "wo") if self.gated
-                          else ("wi", "wo"))}
-        apply_experts = self._apply_experts
-
+        expert_params = self._expert_params(params)
         tok_spec = P(("dp", "ep"))
         exp_spec = jax.tree.map(lambda _: P("ep"), expert_params)
+        body = functools.partial(
+            _ep_dispatch, ep=ctx.mesh.shape["ep"],
+            num_experts=self.num_experts, k=self.k,
+            capacity_factor=self.capacity_factor,
+            apply_experts=self._apply_experts)
 
-        @functools.partial(
-            shard_map, mesh=ctx.mesh,
+        fn = shard_map(
+            body, mesh=ctx.mesh,
             in_specs=(tok_spec, tok_spec, tok_spec, exp_spec),
             out_specs=tok_spec, axis_names={"dp", "ep"}, check_vma=False)
-        def dispatch(x, idx, wgt, eparams):
-            T = x.shape[0]                       # local tokens
-            C = max(1, math.ceil(cf * T * k / E))
-            idx_f = idx.reshape(T * k)           # token-major, k inner
-            oh = jax.nn.one_hot(idx_f, E, dtype=jnp.int32)      # (Tk, E)
-            pos = (jnp.cumsum(oh, axis=0) - oh)[
-                jnp.arange(T * k), idx_f]        # rank within expert
-            keep = (pos < C).astype(jnp.float32)
-            slot = idx_f * C + jnp.clip(pos, 0, C - 1)
-            disp = jax.nn.one_hot(slot, E * C, dtype=jnp.float32) \
-                * keep[:, None]                  # (Tk, E*C)
-            xk = jnp.repeat(x, k, axis=0)        # (Tk, d) matches idx_f
-            buf = jnp.einsum("ts,td->sd", disp,
-                             xk.astype(jnp.float32))   # (E*C, d)
-            buf = buf.reshape(ep, El, C, -1)
-            # send each expert block to its owner rank
-            buf = jax.lax.all_to_all(buf, "ep", split_axis=0,
-                                     concat_axis=0)    # (ep, El, C, d)
-            xe = jnp.swapaxes(buf, 0, 1).reshape(El, ep * C, -1)
-            ye = apply_experts(eparams, xe)            # (El, ep*C, d)
-            ye = jnp.swapaxes(ye.reshape(El, ep, C, -1), 0, 1)
-            ye = jax.lax.all_to_all(ye, "ep", split_axis=0,
-                                    concat_axis=0)     # (ep, El, C, d)
-            ye = ye.reshape(E * C, -1)
-            outk = jnp.einsum("ts,sd->td", disp,
-                              ye.astype(jnp.float32))  # (Tk, d)
-            w = (wgt.reshape(T * k) * keep)[:, None]
-            return jnp.sum((outk * w).reshape(T, k, -1), axis=1)
+        return fn(xf, idx, wgt, expert_params)
 
-        return dispatch(xf, idx, wgt, expert_params)
+
+def _ep_dispatch(x, idx, wgt, eparams, *, ep, num_experts, k,
+                 capacity_factor, apply_experts):
+    """Per-rank EP dispatch body: capacity scatter → all_to_all → local
+    experts → all_to_all → weighted combine. Requires a bound manual
+    ``"ep"`` axis (from ``_ep_forward``'s shard_map or the pipeline's
+    manual region)."""
+    E, El = num_experts, num_experts // ep
+    T = x.shape[0]                       # local tokens
+    C = max(1, math.ceil(capacity_factor * T * k / E))
+    idx_f = idx.reshape(T * k)           # token-major, k inner
+    oh = jax.nn.one_hot(idx_f, E, dtype=jnp.int32)      # (Tk, E)
+    pos = (jnp.cumsum(oh, axis=0) - oh)[
+        jnp.arange(T * k), idx_f]        # rank within expert
+    keep = (pos < C).astype(jnp.float32)
+    slot = idx_f * C + jnp.clip(pos, 0, C - 1)
+    disp = jax.nn.one_hot(slot, E * C, dtype=jnp.float32) \
+        * keep[:, None]                  # (Tk, E*C)
+    xk = jnp.repeat(x, k, axis=0)        # (Tk, d) matches idx_f
+    buf = jnp.einsum("ts,td->sd", disp,
+                     xk.astype(jnp.float32))   # (E*C, d)
+    buf = buf.reshape(ep, El, C, -1)
+    # send each expert block to its owner rank
+    buf = jax.lax.all_to_all(buf, "ep", split_axis=0,
+                             concat_axis=0)    # (ep, El, C, d)
+    xe = jnp.swapaxes(buf, 0, 1).reshape(El, ep * C, -1)
+    ye = apply_experts(eparams, xe)            # (El, ep*C, d)
+    ye = jnp.swapaxes(ye.reshape(El, ep, C, -1), 0, 1)
+    ye = jax.lax.all_to_all(ye, "ep", split_axis=0,
+                            concat_axis=0)     # (ep, El, C, d)
+    ye = ye.reshape(E * C, -1)
+    outk = jnp.einsum("ts,sd->td", disp,
+                      ye.astype(jnp.float32))  # (Tk, d)
+    w = (wgt.reshape(T * k) * keep)[:, None]
+    return jnp.sum((outk * w).reshape(T, k, -1), axis=1)

@@ -32,13 +32,15 @@ from hetu_tpu.engine.state import TrainState
 from hetu_tpu.nn.parallel import remat_policy
 from hetu_tpu.optim.base import apply_updates
 from hetu_tpu.optim.clipping import global_norm
-from hetu_tpu.parallel.sharding import no_act_sharding
+from hetu_tpu.parallel.sharding import ManualAxes, no_act_sharding
 
 
 def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
                     *, mesh: Mesh, num_microbatches: int,
                     pp_axis: str = "pp", remat: str = "none",
-                    block_returns_aux: bool = False):
+                    block_returns_aux: bool = False,
+                    manual_ep: bool = False,
+                    param_manual_specs: Any = None):
     """Run ``payload`` microbatches through pp pipeline stages.
 
     ``block_fn(layer_params, x, **extras)`` applies one transformer block
@@ -118,18 +120,34 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
             jnp.where(stage == pp - 1, v, jnp.zeros([], v.dtype)), pp_axis)
             for k, v in out_bufs.items()}
 
-    param_specs = jax.tree.map(lambda _: P(pp_axis), stacked_params)
-    payload_specs = jax.tree.map(lambda _: P(), payload)
+    manual = {pp_axis} | ({"ep"} if manual_ep else set())
+    param_specs = param_manual_specs if param_manual_specs is not None \
+        else jax.tree.map(lambda _: P(pp_axis), stacked_params)
+    if manual_ep:
+        # microbatch dim (axis 1 of every payload array) splits over the
+        # manual ep axis; aux is replicated (MoE pmeans it per layer)
+        payload_specs = {
+            k: (P() if k == "aux"
+                else P(None, "ep", *([None] * (v.ndim - 2))))
+            for k, v in payload.items()
+        }
+        out_specs = {k: (P() if k == "aux"
+                         else P(None, "ep", None, None))
+                     for k in collect}
+    else:
+        payload_specs = jax.tree.map(lambda _: P(), payload)
+        out_specs = {k: P() for k in collect}
 
     fn = shard_map(
         device_fn, mesh=mesh,
         in_specs=(param_specs, payload_specs),
-        out_specs={k: P() for k in collect},
-        axis_names={pp_axis}, check_vma=False)
+        out_specs=out_specs,
+        axis_names=manual, check_vma=False)
     # activation-sharding constraints don't apply inside the manual region
     # (and ring attention must not nest another shard_map) — trace with the
-    # context suppressed
-    with no_act_sharding():
+    # context suppressed; ManualAxes tells nested layers (MoE) which axes
+    # are bound so they use direct collectives
+    with no_act_sharding(), ManualAxes(mesh, frozenset(manual)):
         out = fn(stacked_params, payload)
     if block_returns_aux:
         return out["x"], out["aux"]
@@ -150,13 +168,27 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
     strategy, mesh = plan.strategy, plan.mesh
     nm = strategy.num_microbatches
     remat = effective_remat(strategy)
-    if strategy.ep > 1 and model.blocks.returns_aux:
-        from hetu_tpu.utils.logging import get_logger
-        get_logger().warning(
-            "pp>1 with ep>1: MoE layers inside the pipeline's manual "
-            "region use the dense fallback (every expert computes every "
-            "token) — the explicit all_to_all EP path cannot nest inside "
-            "the pp shard_map; prefer ep without pp for MoE models")
+    # EP x PP: the pipeline region goes manual over {pp, ep} and MoE
+    # layers run their all_to_all dispatch on the bound ep axis
+    manual_ep = strategy.ep > 1 and model.blocks.returns_aux
+    param_manual_specs = None
+    if manual_ep:
+        from hetu_tpu.parallel.sharding import param_partition_specs
+        full = param_partition_specs(model, strategy.axis_rules())["blocks"]
+
+        def keep_manual(spec: P) -> P:
+            parts = []
+            for p in spec:
+                if isinstance(p, tuple):
+                    kept = tuple(a for a in p if a in ("pp", "ep"))
+                    parts.append(kept[0] if len(kept) == 1
+                                 else (kept or None))
+                else:
+                    parts.append(p if p in ("pp", "ep") else None)
+            return P(*parts)
+
+        param_manual_specs = jax.tree.map(
+            keep_manual, full, is_leaf=lambda x: isinstance(x, P))
 
     def loss_fn(params, batch):
         with plan.act:
@@ -181,7 +213,9 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
             out = pipeline_blocks(
                 block_fn, params["blocks"], payload, mesh=mesh,
                 num_microbatches=nm, remat=remat,
-                block_returns_aux=block.returns_aux)
+                block_returns_aux=block.returns_aux,
+                manual_ep=manual_ep,
+                param_manual_specs=param_manual_specs)
             aux = jnp.zeros([], jnp.float32)
             if block.returns_aux:
                 h, aux_mb = out

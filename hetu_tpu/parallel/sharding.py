@@ -146,6 +146,33 @@ def current_act_sharding() -> Optional[ActivationSharding]:
     return _ACT_CTX[-1] if _ACT_CTX else None
 
 
+_MANUAL_CTX: list["ManualAxes"] = []
+
+
+@dataclasses.dataclass(frozen=True)
+class ManualAxes:
+    """Marks that tracing happens inside a ``shard_map`` manual over
+    ``axes`` of ``mesh`` (the pipeline region). Layers that would
+    otherwise open their own ``shard_map`` (MoE all_to_all, vocab-parallel
+    CE) consult this to use bound-axis collectives directly instead —
+    nested shard_maps are not allowed."""
+
+    mesh: Mesh
+    axes: frozenset
+
+    def __enter__(self):
+        _MANUAL_CTX.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _MANUAL_CTX.pop()
+        return False
+
+
+def current_manual_axes() -> Optional["ManualAxes"]:
+    return _MANUAL_CTX[-1] if _MANUAL_CTX else None
+
+
 class no_act_sharding:
     """Suppress the active ActivationSharding (pushes None).
 

@@ -19,6 +19,8 @@ collapse into "switch the whole state".
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 
 from hetu_tpu.engine.state import TrainState
@@ -27,8 +29,53 @@ from hetu_tpu.engine.state import TrainState
 def switch_strategy(state: TrainState, new_plan) -> TrainState:
     """Reshard a full train state onto ``new_plan``'s mesh/shardings.
 
-    Works across strategies of the same device set (the reference's hot
-    path); cross-topology elastic resharding goes through a checkpoint
-    (``utils.checkpoint`` saves global values, loads under any plan).
+    Same device set: one ``device_put`` (the reference's hot path).
+    Different device set (elastic grow/shrink): per-leaf reassembly —
+    each destination shard is built by reading the needed slices from the
+    source array's shards (the ``ParamSlice`` intersection,
+    ``switch_exec_graph.h:593-639``, computed host-side), so no global
+    gather and no on-disk round trip is required.
     """
-    return jax.device_put(state, new_plan.state_shardings)
+    old_devices = {d for leaf in jax.tree.leaves(state)
+                   if isinstance(leaf, jax.Array)
+                   for d in leaf.sharding.device_set}
+    new_devices = set(new_plan.mesh.devices.flat)
+    if old_devices <= new_devices or not old_devices:
+        return jax.device_put(state, new_plan.state_shardings)
+    return cross_topology_switch(state, new_plan)
+
+
+def cross_topology_switch(state: TrainState, new_plan) -> TrainState:
+    """Reshard onto a (possibly disjoint or differently-sized) device
+    set: destination shards are assembled via
+    ``jax.make_array_from_callback`` reading slices of the source shards
+    from host memory — the in-memory analogue of the sharded checkpoint's
+    restore path (same :func:`assemble_window` intersection core).
+
+    Sources must be fully addressable to this process (single-controller
+    flows); volume accounting raises otherwise — multi-process elastic
+    resharding goes through the sharded checkpoint instead.
+    """
+    from hetu_tpu.utils.windows import assemble_window
+
+    def move(leaf, sharding):
+        if not isinstance(leaf, jax.Array):
+            return jax.device_put(leaf, sharding)
+        seen = set()
+        pieces = []
+        for s in leaf.addressable_shards:
+            start = tuple((sl.start or 0) for sl in s.index)
+            if start in seen:       # replicas duplicate coverage
+                continue
+            seen.add(start)
+            data = np.asarray(s.data)
+            pieces.append((start, data.shape, data))
+
+        def window(idx):
+            return assemble_window(pieces, idx, leaf.shape, leaf.dtype,
+                                   lambda data, sl: data[sl],
+                                   what="switch")
+
+        return jax.make_array_from_callback(leaf.shape, sharding, window)
+
+    return jax.tree.map(move, state, new_plan.state_shardings)

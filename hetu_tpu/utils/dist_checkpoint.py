@@ -41,6 +41,7 @@ from hetu_tpu.utils.checkpoint import (
     CheckpointWriter, _META_FILE, _MODEL_PREFIX, _OPT_PREFIX, _flatten,
     _key_str, _run_write,
 )
+from hetu_tpu.utils.windows import assemble_window
 
 
 def _host_file(p: int) -> str:
@@ -184,42 +185,25 @@ class _PieceReader:
 
     def read(self, key: str, window: tuple[slice, ...],
              shape: tuple[int, ...], dtype) -> np.ndarray:
-        """Assemble ``tensor[window]`` (window: absolute slices)."""
-        lo = [0 if s.start is None else s.start for s in window]
-        hi = [shape[d] if window[d].stop is None else window[d].stop
-              for d in range(len(shape))]
-        if not shape:  # scalar
-            e = self.index[key][0]
-            return self._open(e["file"]).get_tensor(e["entry"]) \
-                .astype(dtype, copy=False)
-        out = None
-        covered = 0
-        for e in self.index[key]:
-            ps = e["start"]
-            pe = [ps[d] + e["shape"][d] for d in range(len(ps))]
-            if any(pe[d] <= lo[d] or ps[d] >= hi[d]
-                   for d in range(len(ps))):
-                continue  # no overlap
-            olo = [max(lo[d], ps[d]) for d in range(len(ps))]
-            ohi = [min(hi[d], pe[d]) for d in range(len(ps))]
-            piece_sl = tuple(slice(olo[d] - ps[d], ohi[d] - ps[d])
-                             for d in range(len(ps)))
-            sl = self._open(e["file"]).get_slice(e["entry"])
-            data = sl[piece_sl]
-            if out is None:
-                out = np.empty([hi[d] - lo[d] for d in range(len(lo))],
-                               dtype=data.dtype)
-            out[tuple(slice(olo[d] - lo[d], ohi[d] - lo[d])
-                      for d in range(len(lo)))] = data
-            covered += data.size
-        want = int(np.prod([hi[d] - lo[d] for d in range(len(lo))]))
-        # pieces are disjoint (device shards), so volume accounting detects
-        # holes from e.g. a host's files missing after a partial save
-        if out is None or covered != want:
-            raise KeyError(
-                f"{key}: window {window} only covered for {covered}/{want} "
-                f"elements — checkpoint incomplete (missing host files?)")
-        return out.astype(dtype, copy=False)
+        """Assemble ``tensor[window]`` (window: absolute slices).
+
+        Volume accounting in :func:`assemble_window` rejects incomplete
+        checkpoints (missing host files) instead of returning garbage.
+        """
+
+        def fetch(e, sl):
+            f = self._open(e["file"])
+            if not sl:  # scalar entry
+                return f.get_tensor(e["entry"])
+            return f.get_slice(e["entry"])[sl]
+
+        pieces = [(e["start"], e["shape"], e) for e in self.index[key]]
+        try:
+            return assemble_window(pieces, window, shape, dtype, fetch,
+                                   what=key)
+        except KeyError as e:
+            raise KeyError(f"{e.args[0]} — checkpoint incomplete "
+                           f"(missing host files?)") from None
 
 
 def load_checkpoint_distributed(path: str, model, opt, plan=None

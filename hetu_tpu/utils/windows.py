@@ -1,0 +1,62 @@
+"""N-dimensional window assembly from disjoint pieces.
+
+The ``ParamSlice`` intersection at the heart of both restore paths — the
+sharded checkpoint loader (``utils.dist_checkpoint``) and the
+cross-topology hot switch (``parallel.switch``). Reference:
+``switch_exec_graph.h:593-639``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Sequence
+
+import numpy as np
+
+
+def assemble_window(pieces: Iterable[tuple[Sequence[int], Sequence[int],
+                                           object]],
+                    window: Sequence[slice],
+                    shape: Sequence[int], dtype,
+                    fetch: Callable[[object, tuple[slice, ...]],
+                                    np.ndarray], *,
+                    what: str = "tensor") -> np.ndarray:
+    """Assemble ``tensor[window]`` from disjoint pieces.
+
+    ``pieces``: (start offsets, piece shape, handle) triples covering parts
+    of the global tensor; ``fetch(handle, slices)`` returns the requested
+    sub-slice of one piece. Pieces must be disjoint — volume accounting
+    then detects holes (missing host files, non-addressable source shards)
+    and raises instead of returning uninitialized memory.
+    """
+    nd = len(shape)
+    lo = [0 if w.start is None else w.start for w in window]
+    hi = [shape[d] if window[d].stop is None else window[d].stop
+          for d in range(nd)]
+    if nd == 0:
+        for _, _, handle in pieces:
+            return np.asarray(fetch(handle, ())).astype(dtype, copy=False)
+        raise KeyError(f"{what}: no piece for scalar window")
+    out = None
+    covered = 0
+    for start, pshape, handle in pieces:
+        end = [start[d] + pshape[d] for d in range(nd)]
+        if any(end[d] <= lo[d] or start[d] >= hi[d] for d in range(nd)):
+            continue
+        olo = [max(lo[d], start[d]) for d in range(nd)]
+        ohi = [min(hi[d], end[d]) for d in range(nd)]
+        src = tuple(slice(olo[d] - start[d], ohi[d] - start[d])
+                    for d in range(nd))
+        data = np.asarray(fetch(handle, src))
+        if out is None:
+            out = np.empty([hi[d] - lo[d] for d in range(nd)],
+                           dtype=data.dtype)
+        out[tuple(slice(olo[d] - lo[d], ohi[d] - lo[d])
+                  for d in range(nd))] = data
+        covered += data.size
+    want = int(np.prod([hi[d] - lo[d] for d in range(nd)]))
+    if out is None or covered != want:
+        raise KeyError(
+            f"{what}: window {tuple(window)} only covered for "
+            f"{covered}/{want} elements — source pieces incomplete "
+            f"(missing host files / non-addressable shards?)")
+    return out.astype(dtype, copy=False)

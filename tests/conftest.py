@@ -57,6 +57,7 @@ SLOW_TESTS = {
     # moe
     "test_gpt_moe_trains",
     "test_gpt_moe_with_pipeline",
+    "test_gpt_moe_ep_inside_pipeline_matches_dense",
     "test_ep_matches_dense",
     "test_gpt_moe_ep_loss_matches_dense",
     "test_dense_moe_matches_manual",

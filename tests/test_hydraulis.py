@@ -86,3 +86,17 @@ def test_dispatcher_labels_mask_padding():
     assert (batch["labels"][1] == -100).all()        # empty row
     np.testing.assert_array_equal(batch["input_ids"][0, :9], seqs[0][:9])
     np.testing.assert_array_equal(batch["labels"][0, :9], seqs[0][1:10])
+
+
+def test_plan_single_device_engages_remat():
+    """cp=1 candidates must be evaluated even on one device: a long
+    bucket that only fits with remat gets remat, not a silent OOM plan."""
+    cfg = GPTConfig.small()
+    dims = ModelDims.from_config(cfg, seq_len=1024, global_batch=8)
+    topo = TPUTopology(num_devices=1, hbm_bytes=2.5e9, peak_flops=197e12)
+    buckets = SeqLenBuckets(min_len=256, max_len=4096)
+    plans = plan_buckets([4000], buckets=buckets, token_budget=8192,
+                         dims_base=dims, topo=topo, max_cp=1)
+    p = plans[4096]
+    assert p.strategy.remat != "none"
+    assert p.est_step_ms > 0

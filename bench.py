@@ -80,12 +80,12 @@ def main():
     on_tpu = dev.platform == "tpu"
     if on_tpu:
         cfg = GPTConfig.small()      # 124M params
-        batch, seq, steps, warmup = 8, 1024, 20, 3
+        batches, seq, steps, warmup = (32, 16, 8), 1024, 20, 3
         dtype_policy = Policy(param_dtype=jnp.float32,
                               compute_dtype=jnp.bfloat16)
     else:  # CPU smoke fallback so the bench always emits a number
         cfg = GPTConfig.tiny()
-        batch, seq, steps, warmup = 4, 64, 3, 1
+        batches, seq, steps, warmup = (4,), 64, 3, 1
         dtype_policy = Policy(param_dtype=jnp.float32,
                               compute_dtype=jnp.float32)
 
@@ -93,31 +93,52 @@ def main():
     model = GPTLMHeadModel(cfg)
     opt = optim.adamw(1e-4, weight_decay=0.01)
     strategy = Strategy()  # single chip; driver runs multi-chip via dryrun
-    with autocast(dtype_policy):
-        plan = make_plan(model, opt, strategy)
-        state = init_state(model, opt, plan, jax.random.key(0))
-        step = build_train_step(model, opt, plan)
 
-        ids = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
-                                 cfg.vocab_size)
-        batch_data = plan.shard_batch(
-            {"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+    def run(batch):
+        with autocast(dtype_policy):
+            plan = make_plan(model, opt, strategy)
+            state = init_state(model, opt, plan, jax.random.key(0))
+            step = build_train_step(model, opt, plan)
+            ids = jax.random.randint(jax.random.key(1), (batch, seq + 1),
+                                     0, cfg.vocab_size)
+            batch_data = plan.shard_batch(
+                {"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+            for _ in range(warmup):
+                state, metrics = step(state, batch_data)
+            # host fetch forces the full dependency chain to finish
+            # (donated state chains step N → N+1), robust even where
+            # block_until_ready is lazy (remote PJRT relays)
+            float(jax.device_get(metrics["loss"]))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step(state, batch_data)
+            final_loss = float(jax.device_get(metrics["loss"]))
+            dt = (time.perf_counter() - t0) / steps
+            assert final_loss == final_loss, "NaN loss in bench"
+        n = sum(x.size for x in jax.tree.leaves(state.params))
+        return dt, n
 
-        for _ in range(warmup):
-            state, metrics = step(state, batch_data)
-        # host fetch forces the full dependency chain to finish (donated
-        # state chains step N → N+1), robust even where block_until_ready
-        # is lazy (remote PJRT relays)
-        float(jax.device_get(metrics["loss"]))
+    # largest batch that fits wins (chunked CE keeps logits memory flat,
+    # so batch is bounded by activations; OOM → halve and retry)
+    def is_oom(e) -> bool:
+        s = f"{type(e).__name__}: {e}"
+        return any(t in s for t in (
+            "RESOURCE_EXHAUSTED", "Out of memory", "OOM",
+            "Attempting to allocate", "exceeds the limit"))
 
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = step(state, batch_data)
-        final_loss = float(jax.device_get(metrics["loss"]))
-        dt = (time.perf_counter() - t0) / steps
-        assert final_loss == final_loss, "NaN loss in bench"
-
-    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    dt = n_params = batch = None
+    last_err = None
+    for b in batches:
+        try:
+            dt, n_params = run(b)
+            batch = b
+            break
+        except Exception as e:
+            if not is_oom(e):
+                raise    # NaN/compile regressions must not be masked
+            last_err = e
+    if dt is None:
+        raise last_err
     tokens_per_sec = batch * seq / dt
     flops = model_flops_per_token(cfg, n_params, seq) * tokens_per_sec
     peak = peak_flops(dev)

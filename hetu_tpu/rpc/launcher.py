@@ -74,12 +74,13 @@ def bootstrap_distributed(*, coord_port: Optional[int] = None,
     """
     port = coord_port if coord_port is not None \
         else int(os.environ["HETU_COORD_PORT"])
+    coord_host = os.environ.get("HETU_COORD_HOST", "127.0.0.1")
     n = num_processes if num_processes is not None \
         else int(os.environ.get("HETU_NUM_PROCS", "1"))
     gen = int(os.environ.get("HETU_GENERATION", "0"))
     name = name or os.environ.get("HETU_WORKER_NAME",
                                   f"worker-{os.getpid()}")
-    client = CoordinatorClient(port)
+    client = CoordinatorClient(port, host=coord_host)
     if rank is None:
         env_rank = os.environ.get("HETU_RANK")
         rank = int(env_rank) if env_rank is not None else client.rank(name)
@@ -87,7 +88,16 @@ def bootstrap_distributed(*, coord_port: Optional[int] = None,
     if n > 1:
         key = f"jax_coordinator/g{gen}"
         if rank == 0:
-            addr = f"127.0.0.1:{_free_port()}"
+            # cross-host workers must publish a routable address, not
+            # loopback; HETU_ADVERTISE_HOST overrides, else hostname when
+            # the coordinator itself is non-local
+            if coord_host in ("127.0.0.1", "localhost"):
+                my_host = "127.0.0.1"
+            else:
+                import socket as _socket
+                my_host = _socket.gethostname()
+            my_host = os.environ.get("HETU_ADVERTISE_HOST", my_host)
+            addr = f"{my_host}:{_free_port()}"
             client.put(key, addr)
         else:
             deadline = time.monotonic() + timeout_s
@@ -132,10 +142,25 @@ class ElasticWorkerPool:
                  log_dir: Optional[str] = None,
                  env: Optional[dict] = None,
                  platform_env: Optional[dict] = None,
+                 ssh_hosts: Optional[Sequence[str]] = None,
+                 coordinator_host: Optional[str] = None,
                  poll_s: float = 0.2):
         self.script = script
         self.num_workers = num_workers
         self.args = list(args)
+        # multi-host fan-out à la pssh_start.py: worker i runs on
+        # ssh_hosts[i % len] with its env serialized into the remote
+        # command (the coordinator address must then be reachable —
+        # bind-all is the operator's call, as in the reference)
+        self.ssh_hosts = list(ssh_hosts) if ssh_hosts else None
+        # routable address of THIS machine for remote workers' coordinator
+        # connections (required with ssh_hosts)
+        self.coordinator_host = coordinator_host
+        if self.ssh_hosts and not coordinator_host:
+            raise ValueError(
+                "ssh_hosts needs coordinator_host (a routable address of "
+                "the launcher machine — remote workers must reach the "
+                "coordinator and it binds 127.0.0.1 otherwise)")
         self.max_restarts = max_restarts
         self.log_dir = log_dir
         self.extra_env = dict(env or {})
@@ -183,9 +208,23 @@ class ElasticWorkerPool:
             else:
                 log = subprocess.DEVNULL
             self._logs.append(log)
+            env = self._worker_env(r)
+            cmd = [sys.executable, self.script, *self.args]
+            if self.ssh_hosts:
+                import shlex
+                host = self.ssh_hosts[r % len(self.ssh_hosts)]
+                env["HETU_COORD_HOST"] = self.coordinator_host
+                hetu_env = [shlex.quote(f"{k}={v}")
+                            for k, v in env.items()
+                            if k.startswith(("HETU_", "JAX_", "XLA_",
+                                             "PYTHONPATH"))]
+                # -tt: killing the local ssh client drops the remote tty,
+                # so the remote worker gets SIGHUP on generation teardown
+                cmd = ["ssh", "-tt", host, "env", *hetu_env, "python3",
+                       shlex.quote(self.script),
+                       *map(shlex.quote, self.args)]
             self.procs.append(subprocess.Popen(
-                [sys.executable, self.script, *self.args],
-                env=self._worker_env(r), stdout=log, stderr=log))
+                cmd, env=env, stdout=log, stderr=log))
         get_logger().info(
             f"pool: generation {self.generation} spawned "
             f"{self.num_workers} workers")

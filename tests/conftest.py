@@ -74,6 +74,7 @@ SLOW_TESTS = {
     "test_restarts_exhausted_reports_failure",
     # hetero pipeline
     "test_hetero_matches_homogeneous",
+    "test_bert_mlm_trains_and_strategies",
     "test_hetero_shared_embedding_grads",
     "test_malleus_planner_trains",
     # misc heavy

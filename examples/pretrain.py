@@ -36,11 +36,26 @@ def main():
     ap.add_argument("--auto", action="store_true",
                     help="pick the strategy with the Galvatron search")
     ap.add_argument("--ckpt", type=str, default=None)
+    ap.add_argument("--config", type=str, default=None,
+                    help="YAML experiment config (examples/configs/*.yaml)")
     args = ap.parse_args()
+
+    n = len(jax.devices())
+    if args.config:
+        from hetu_tpu.utils.config import build_experiment
+        exp = build_experiment(args.config)
+        cfg, model = exp["model_config"], exp["model"]
+        trainer = Trainer(model, optim.adamw(3e-3, weight_decay=0.01),
+                          exp["strategy"], config=exp["trainer_config"])
+        ds = SyntheticLMDataset(cfg.vocab_size, num_docs=4096, min_len=16,
+                                max_len=args.seq_len, seed=0)
+        loader = build_data_loader(ds, seq_len=args.seq_len,
+                                   batch_rows=args.batch_rows, pack=True)
+        trainer.train(loader)
+        return
 
     cfg = GPTConfig.tiny()
     model = GPTLMHeadModel(cfg)
-    n = len(jax.devices())
 
     if args.auto:
         from hetu_tpu.tools.galvatron import (

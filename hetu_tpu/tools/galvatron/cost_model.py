@@ -93,6 +93,11 @@ class CostBreakdown:
     dp_comm: float
     pp_bubble_factor: float
     mem_per_device: float
+    # per-micro-batch accounting (reference MicroBatchMemoryInfo,
+    # graph/profiler.h:31-38): the activation term is per LIVE microbatch
+    mem_params: float = 0.0
+    mem_opt: float = 0.0
+    mem_act_per_microbatch: float = 0.0
 
     def fits(self, topo: TPUTopology) -> bool:
         return self.mem_per_device <= topo.hbm_bytes
@@ -175,9 +180,15 @@ def estimate(dims: ModelDims, strategy: Strategy,
     mem_opt = p_shard * 8 / opt_div
     act_factor = {"none": 14.0, "selective": 6.0, "full": 2.0,
                   "offload": 1.0}.get(s.remat, 14.0)
-    mem_act = b_loc / nm * seq_loc * h * act_factor * layers_per_stage \
-        * dims.bytes_per_el / s.tp
+    mem_act_mb = b_loc / nm * seq_loc * h * act_factor \
+        * layers_per_stage * dims.bytes_per_el / s.tp
+    # the scan pipeline keeps activations for every in-flight tick;
+    # plain grad accumulation keeps one microbatch live at a time
+    live_mb = (nm + s.pp - 1) if (s.pp > 1 and s.remat == "none") else 1
+    mem_act = mem_act_mb * live_mb
     mem = mem_params + mem_opt + mem_act
 
     return CostBreakdown(step, t_compute * bubble, t_tp * bubble,
-                         t_cp * bubble, t_dp, bubble, mem)
+                         t_cp * bubble, t_dp, bubble, mem,
+                         mem_params=mem_params, mem_opt=mem_opt,
+                         mem_act_per_microbatch=mem_act_mb)

@@ -1,0 +1,98 @@
+"""YAML/JSON experiment configs.
+
+Parity target: the reference's Hydra/OmegaConf YAML trainer configs
+(``examples/pretrain/config/*.yaml`` with rpc/ds_parallel/trainer/model
+blocks, SURVEY §5.6) and the ds-parallel JSON strategy IR. One file
+describes model + strategy (homogeneous or hetero) + trainer knobs and
+compiles straight to framework objects.
+
+Schema::
+
+    model:
+      family: gpt | llama | bert
+      preset: tiny | small | ...          # classmethod on the config
+      overrides: {num_layers: 4, ...}     # dataclasses.replace fields
+    strategy:                             # Strategy fields, or
+      dp: 2
+      tp: 2
+      ...
+    hetero_strategy:                      # alternative to `strategy`
+      stages: [{layers: 3, tp: 2}, {layers: 1}]
+      num_microbatches: 2
+    trainer:
+      total_steps: 100
+      precision: bf16
+      ...
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+_FAMILIES = {
+    "gpt": ("hetu_tpu.models.gpt", "GPTConfig", "GPTLMHeadModel"),
+    "llama": ("hetu_tpu.models.llama", "LlamaConfig", "LlamaLMHeadModel"),
+    "bert": ("hetu_tpu.models.bert", "BertConfig", "BertModel"),
+}
+
+
+def load_config(path: str) -> dict:
+    with open(path) as f:
+        if path.endswith((".yaml", ".yml")):
+            import yaml
+            return yaml.safe_load(f)
+        return json.load(f)
+
+
+def build_model(spec: dict):
+    """``{family, preset?, overrides?}`` → (model, model_config)."""
+    import importlib
+
+    family = spec["family"].lower()
+    if family not in _FAMILIES:
+        raise ValueError(f"unknown model family {family!r} "
+                         f"(have {sorted(_FAMILIES)})")
+    mod_name, cfg_name, model_name = _FAMILIES[family]
+    mod = importlib.import_module(mod_name)
+    cfg_cls = getattr(mod, cfg_name)
+    preset = spec.get("preset")
+    cfg = getattr(cfg_cls, preset)() if preset else cfg_cls()
+    if spec.get("overrides"):
+        cfg = dataclasses.replace(cfg, **spec["overrides"])
+    return getattr(mod, model_name)(cfg), cfg
+
+
+def build_strategy(doc: dict):
+    """Returns a Strategy or HeteroStrategy from the config document."""
+    if "hetero_strategy" in doc:
+        from hetu_tpu.parallel.hetero import HeteroStrategy, StageSpec
+        h = dict(doc["hetero_strategy"])
+        h["stages"] = tuple(StageSpec(**s) for s in h["stages"])
+        if h.get("device_ids") is not None:
+            h["device_ids"] = tuple(h["device_ids"])
+        return HeteroStrategy(**h)
+    from hetu_tpu.parallel.strategy import Strategy
+    return Strategy(**doc.get("strategy", {}))
+
+
+def build_trainer_config(doc: dict):
+    from hetu_tpu.engine.trainer import TrainerConfig
+    return TrainerConfig(**doc.get("trainer", {}))
+
+
+def build_experiment(path_or_doc) -> dict:
+    """Load a config file (or dict) into ready framework objects:
+    ``{model, model_config, strategy, trainer_config, raw}``."""
+    doc = load_config(path_or_doc) if isinstance(path_or_doc, str) \
+        else dict(path_or_doc)
+    model, model_cfg = build_model(doc["model"])
+    return {
+        "model": model,
+        "model_config": model_cfg,
+        "strategy": build_strategy(doc),
+        "trainer_config": build_trainer_config(doc),
+        "raw": doc,
+    }

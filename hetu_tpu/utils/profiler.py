@@ -230,3 +230,29 @@ def format_module_table(timings: list[ModuleTiming]) -> str:
     tot_b = sum(t.total_bwd_ms for t in timings)
     lines.append(f"{'TOTAL':<8} {'':>3} {tot_f:>8.2f} {tot_b:>11.2f}")
     return "\n".join(lines)
+
+
+def memory_breakdown(state, batch: Optional[dict] = None,
+                     device=None) -> dict[str, Any]:
+    """Live memory accounting: state/batch bytes by component + allocator
+    peaks (per-micro-batch activation residency is the allocator peak
+    minus the resident state). Reference: ``MicroBatchMemoryInfo``
+    (``graph/profiler.h:31-38``)."""
+    def tree_bytes(t):
+        return int(sum(x.nbytes for x in jax.tree.leaves(t)
+                       if hasattr(x, "nbytes")))
+
+    out = {
+        "param_bytes": tree_bytes(getattr(state, "params", state)),
+        "opt_bytes": tree_bytes(getattr(state, "opt_state", ())),
+    }
+    if batch is not None:
+        out["batch_bytes"] = tree_bytes(batch)
+    stats = device_memory_stats(device)
+    out.update(stats)
+    if "peak_bytes_in_use" in stats:
+        resident = out["param_bytes"] + out["opt_bytes"] \
+            + out.get("batch_bytes", 0)
+        out["activation_peak_bytes"] = max(
+            0, stats["peak_bytes_in_use"] - resident)
+    return out

@@ -158,3 +158,21 @@ def test_calibration_pipeline_cpu():
     assert pred[1] > pred[0]  # remat costs compute in the model now
     rep = validate_ranking(measured, pred)
     assert set(rep) >= {"spearman_rho", "ranking_correct"}
+
+
+def test_microbatch_memory_accounting():
+    """Per-microbatch memory fields: more microbatches shrink the live
+    activation term; the scan pipeline without remat keeps nm+pp-1
+    microbatches live."""
+    dims = _dims_7b()
+    topo = TPUTopology(num_devices=8)
+    c1 = estimate(dims, Strategy(dp=8, num_microbatches=1), topo)
+    c4 = estimate(dims, Strategy(dp=8, num_microbatches=4), topo)
+    assert c4.mem_act_per_microbatch < c1.mem_act_per_microbatch
+    assert c1.mem_params > 0 and c1.mem_opt > 0
+    pp = estimate(dims, Strategy(dp=2, pp=4, num_microbatches=4), topo)
+    rem = estimate(dims, Strategy(dp=2, pp=4, num_microbatches=4,
+                                  remat="full"), topo)
+    # nm+pp-1 live microbatches without remat vs 1 with remat
+    assert pp.mem_per_device - pp.mem_params - pp.mem_opt \
+        > 3 * (rem.mem_per_device - rem.mem_params - rem.mem_opt)

@@ -8,7 +8,8 @@ LlamaLMHeadModel :446).
 from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
 from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
 from hetu_tpu.models.bert import BertConfig, BertModel
+from hetu_tpu.models.vision import CNNConfig, MLPClassifier, SimpleCNN
 from hetu_tpu.models.generation import generate, decode, init_kv_caches
 
-__all__ = ["GPTConfig", "GPTLMHeadModel", "LlamaConfig", "BertConfig", "BertModel", "LlamaLMHeadModel",
+__all__ = ["GPTConfig", "GPTLMHeadModel", "LlamaConfig", "BertConfig", "BertModel", "CNNConfig", "SimpleCNN", "MLPClassifier", "LlamaLMHeadModel",
            "generate", "decode", "init_kv_caches"]

@@ -1,0 +1,67 @@
+"""Small vision models: MLP and CNN classifiers.
+
+Parity target: the reference's model smoke tests
+(``tests/test_cifar10.py`` — CNN/MLP trained on CIFAR-10 against a torch
+oracle; BASELINE config 1). These are the single-device sanity models;
+they reuse the same Module system so dp/fsdp strategies apply if wanted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from hetu_tpu.nn.layers import Conv2D, Linear, MLP, max_pool2d
+from hetu_tpu.nn.module import Module
+from hetu_tpu.ops.losses import cross_entropy_mean
+
+
+@dataclasses.dataclass(frozen=True)
+class CNNConfig:
+    in_channels: int = 3
+    num_classes: int = 10
+    channels: tuple = (32, 64)
+    hidden: int = 256
+    image_size: int = 32
+
+
+class SimpleCNN(Module):
+    """conv-pool x N → MLP head (the reference's CIFAR CNN shape)."""
+
+    def __init__(self, cfg: CNNConfig = CNNConfig()):
+        super().__init__()
+        self.cfg = cfg
+        c_in = cfg.in_channels
+        for i, c in enumerate(cfg.channels):
+            setattr(self, f"conv{i}", Conv2D(c_in, c, 3))
+            c_in = c
+        side = cfg.image_size // (2 ** len(cfg.channels))
+        self.fc = Linear(c_in * side * side, cfg.hidden)
+        self.head = Linear(cfg.hidden, cfg.num_classes)
+
+    def __call__(self, params, x):
+        """x (B, H, W, C) → logits (B, num_classes)."""
+        for i in range(len(self.cfg.channels)):
+            conv = getattr(self, f"conv{i}")
+            x = jnp.maximum(conv(params[f"conv{i}"], x), 0.0)
+            x = max_pool2d(x)
+        x = x.reshape(x.shape[0], -1)
+        h = jnp.maximum(self.fc(params["fc"], x), 0.0)
+        return self.head(params["head"], h)
+
+    def loss(self, params, x, labels):
+        return cross_entropy_mean(self(params, x), labels)
+
+
+class MLPClassifier(Module):
+    def __init__(self, in_features: int, hidden: int, num_classes: int):
+        super().__init__()
+        self.body = MLP(in_features, hidden)
+        self.head = Linear(in_features, num_classes)
+
+    def __call__(self, params, x):
+        return self.head(params["head"], self.body(params["body"], x))
+
+    def loss(self, params, x, labels):
+        return cross_entropy_mean(self(params, x), labels)

@@ -119,3 +119,46 @@ class MLP(Module):
     def __call__(self, params, x):
         h = self.activation(self.fc_in(params["fc_in"], x))
         return self.fc_out(params["fc_out"], h)
+
+
+class Conv2D(Module):
+    """2-D convolution (NHWC), lowered to ``lax.conv_general_dilated``
+    (XLA tiles it onto the MXU). Reference kernels: ``impl/kernel``
+    Conv2d CPU/CUDA pair driven by ``tests/test_cifar10.py``."""
+
+    def __init__(self, in_channels: int, out_channels: int,
+                 kernel_size: int = 3, stride: int = 1,
+                 padding: str = "SAME", bias: bool = True, init=None):
+        super().__init__()
+        self.stride = (stride, stride)
+        self.padding = padding
+        init = init or normal_init(0.02)
+        self.param("kernel",
+                   (kernel_size, kernel_size, in_channels, out_channels),
+                   init, axes=(None, None, None, "mlp"))
+        if bias:
+            self.param("bias", (out_channels,), zeros_init(),
+                       axes=("mlp",))
+
+    def __call__(self, params, x):
+        dt = self.compute_dtype()
+        y = jax.lax.conv_general_dilated(
+            x.astype(dt), params["kernel"].astype(dt),
+            window_strides=self.stride, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if "bias" in params:
+            y = y + params["bias"].astype(dt)
+        return y
+
+
+def max_pool2d(x, window: int = 2, stride: int = 2):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, window, window, 1),
+        (1, stride, stride, 1), "VALID")
+
+
+def avg_pool2d(x, window: int = 2, stride: int = 2):
+    s = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, window, window, 1),
+        (1, stride, stride, 1), "VALID")
+    return s / (window * window)

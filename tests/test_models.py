@@ -160,3 +160,35 @@ def test_bert_mlm_trains_and_strategies():
             state, m = step(state, b)
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0] - 0.3, (strategy, losses)
+
+
+def test_cnn_classifier_trains():
+    """CIFAR-style CNN (config 1 parity with tests/test_cifar10.py):
+    overfits a small batch; conv/pool shapes check out."""
+    import numpy as np
+    from hetu_tpu import optim
+    from hetu_tpu.models.vision import CNNConfig, SimpleCNN
+    from hetu_tpu.optim.base import apply_updates
+
+    model = SimpleCNN(CNNConfig(image_size=16, channels=(8, 16),
+                                hidden=32))
+    params = model.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (16, 16, 16, 3))
+    y = jax.random.randint(jax.random.key(2), (16,), 0, 10)
+    logits = model(params, x)
+    assert logits.shape == (16, 10)
+
+    opt = optim.adamw(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, g = jax.value_and_grad(model.loss)(params, x, y)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(60):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] / 3, losses[:3] + losses[-3:]

@@ -71,20 +71,9 @@ def _lm_bench(model, cfg, strategy, batch, seq, *, steps=10, warmup=2,
 
 def config1_mlp():
     """Single-device MLP smoke (config 1): tiny classification train."""
-    from hetu_tpu.nn.layers import Linear, MLP
-    from hetu_tpu.nn.module import Module
+    from hetu_tpu.models.vision import MLPClassifier
 
-    class Classifier(Module):
-        def __init__(self):
-            super().__init__()
-            self.body = MLP(256, 512)
-            self.head = Linear(256, 10)
-
-        def __call__(self, params, x):
-            return self.head(params["head"],
-                             self.body(params["body"], x))
-
-    model = Classifier()
+    model = MLPClassifier(256, 512, 10)
     params = model.init(jax.random.key(0))
     opt = optim.adamw(1e-3)
     opt_state = opt.init(params)

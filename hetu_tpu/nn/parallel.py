@@ -308,7 +308,11 @@ def remat_policy(name: str):
     if name == "offload":
         make = getattr(jax.checkpoint_policies,
                        "offload_dot_with_no_batch_dims", None)
-        if make is None:  # older jax: degrade to plain remat
+        # host offload needs the TPU runtime's annotate_device_placement;
+        # the CPU backend has no implementation (and GSPMD on CPU chokes
+        # on the unsharded side-effect custom call) — degrade to full
+        # remat there so offload strategies stay runnable in simulation
+        if make is None or jax.default_backend() != "tpu":
             return jax.checkpoint_policies.nothing_saveable
         return make("device", "pinned_host")
     raise ValueError(

@@ -68,6 +68,11 @@ SLOW_TESTS = {
     "test_generate_greedy_deterministic",
     "test_generate_sampling_and_eos",
     "test_cached_decode_matches_full_forward",
+    # example-script smoke
+    "test_pretrain_with_yaml_config",
+    "test_hetero_malleus_example",
+    "test_hydraulis_example",
+    "test_elastic_train_example",
     # multi-process (real OS processes + jax.distributed)
     "test_two_process_dp_training",
     "test_kill_restart_resumes_from_checkpoint",

@@ -104,3 +104,18 @@ def test_fsdp_parity_with_single_device():
     _, ref = _run(Strategy())
     _, got = _run(Strategy(dp=4, tp=2, fsdp=True, zero=True))
     np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
+
+
+def test_offload_strategy_runs_on_cpu_mesh():
+    """remat='offload' degrades to full remat off-TPU instead of dying on
+    the missing annotate_device_placement runtime support."""
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    opt = optim.adamw(1e-3)
+    plan = make_plan(model, opt, Strategy(dp=2, offload=True))
+    state = init_state(model, opt, plan, jax.random.key(0))
+    step = build_train_step(model, opt, plan)
+    ids = jax.random.randint(jax.random.key(1), (4, 33), 0, cfg.vocab_size)
+    b = plan.shard_batch({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+    state, m = step(state, b)
+    assert np.isfinite(float(m["loss"]))

@@ -12,6 +12,7 @@ from hetu_tpu.parallel.hetero import (
     HeteroStrategy, StageSpec, build_hetero_train_step,
     init_hetero_state, make_hetero_plan,
 )
+from hetu_tpu.parallel.hetero_dp import DPGroupSpec, HeteroDPTrainStep
 
 __all__ = [
     "Strategy", "MESH_AXES",
@@ -19,4 +20,5 @@ __all__ = [
     "shard_params", "constrain", "sharded_init",
     "HeteroStrategy", "StageSpec", "build_hetero_train_step",
     "init_hetero_state", "make_hetero_plan",
+    "DPGroupSpec", "HeteroDPTrainStep",
 ]

@@ -1,0 +1,166 @@
+"""Heterogeneous data parallelism: unequal seq-lens / batch rows per dp
+group, computed simultaneously.
+
+The second half of the reference's hetero machinery
+(``distributed_states.h:158-321`` — unequal micro-batches/seq-lens per dp
+group, driven by Hydraulis planning): device groups of possibly different
+sizes each process a *different-shaped* batch (long sequences on a big
+tp×cp group, short ones on small groups) in the same optimizer step.
+Different shapes cannot share one SPMD program, so each group runs its own
+jitted grad over its own sub-mesh (same multi-jit design as
+``parallel.hetero``); gradients combine weighted by each group's valid
+token count — exactly the global-mean semantics of one fused batch.
+
+Params: the canonical copy lives on group 0's mesh; each step it is
+bridged (``device_put``) to the other groups — which is what dp
+replication is, expressed across meshes. The single optimizer update runs
+on group 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.engine.state import TrainState
+from hetu_tpu.engine.train_step import default_loss_fn, make_plan
+from hetu_tpu.nn.module import Module
+from hetu_tpu.optim.base import Transform, apply_updates
+from hetu_tpu.parallel.strategy import Strategy
+
+
+@dataclasses.dataclass(frozen=True)
+class DPGroupSpec:
+    """One dp group: its shape budget and intra-group parallelism."""
+
+    rows: int                # batch rows per step
+    seq_len: int             # padded sequence length
+    dp: int = 1
+    tp: int = 1
+    cp: int = 1
+    remat: str = "none"
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.tp * self.cp
+
+    def strategy(self) -> Strategy:
+        return Strategy(dp=self.dp, tp=self.tp, cp=self.cp,
+                        remat=self.remat)
+
+
+class HeteroDPTrainStep:
+    """``step(state, batches) -> (state, metrics)`` over per-group
+    batches — ``batches[i]`` has shape (groups[i].rows, groups[i].seq_len)
+    and may carry ``labels`` with ``ignore_index`` padding."""
+
+    def __init__(self, model: Module, opt: Transform,
+                 groups: Sequence[DPGroupSpec], *, devices=None,
+                 attn_impl: str = "auto"):
+        devices = list(devices if devices is not None else jax.devices())
+        need = sum(g.n_devices for g in groups)
+        if need > len(devices):
+            raise ValueError(f"groups need {need} devices, have "
+                             f"{len(devices)}")
+        self.model, self.opt, self.groups = model, opt, list(groups)
+        self.plans = []
+        k = 0
+        for g in groups:
+            sub = devices[k:k + g.n_devices]
+            k += g.n_devices
+            self.plans.append(make_plan(model, opt, g.strategy(),
+                                        devices=sub))
+
+        def make_grad(plan):
+            base = default_loss_fn(model, plan.strategy, attn_impl)
+
+            def loss_tokens(params, batch):
+                with plan.act:
+                    loss = base(params, batch)
+                valid = jnp.sum(batch["labels"] != -100)
+                return loss, valid
+
+            def grad_fn(params, batch):
+                (loss, valid), grads = jax.value_and_grad(
+                    loss_tokens, has_aux=True)(params, batch)
+                return loss, valid, grads
+
+            return jax.jit(grad_fn)
+
+        self._grads = [make_grad(p) for p in self.plans]
+        sh0 = self.plans[0].state_shardings
+        # pinned out shardings (same convention as build_train_step) so
+        # param shardings never drift step to step
+        self._update = jax.jit(
+            lambda p, g, o: (lambda u, no: (apply_updates(p, u), no))(
+                *opt.update(g, o, p)),
+            out_shardings=(sh0.params, sh0.opt_state))
+        self._acc = jax.jit(
+            lambda acc, g, w: jax.tree.map(
+                lambda a, b: a + w * b.astype(a.dtype), acc, g))
+        # seed = first group's grads scaled (no full-size zeros allocation)
+        self._seed = jax.jit(
+            lambda g, w: jax.tree.map(
+                lambda b: w * b.astype(jnp.float32), g))
+
+    def init_state(self, key, dtype=None) -> TrainState:
+        from hetu_tpu.engine.train_step import init_state
+        return init_state(self.model, self.opt, self.plans[0], key,
+                          dtype=dtype)
+
+    def __call__(self, state: TrainState, batches: Sequence[dict]):
+        if len(batches) != len(self.groups):
+            raise ValueError(
+                f"got {len(batches)} batches for {len(self.groups)} "
+                f"groups")
+        # fan params out to every group's mesh (dp replication across
+        # meshes), dispatch all grads before any host sync
+        results = []
+        for plan, grad_fn, batch in zip(self.plans, self._grads, batches):
+            params_g = jax.device_put(state.params,
+                                      plan.state_shardings.params) \
+                if plan is not self.plans[0] else state.params
+            sbatch = plan.shard_batch(batch)
+            results.append(grad_fn(params_g, sbatch))
+
+        # token-weighted combine on group 0's mesh = global-mean grads
+        tokens = [float(jax.device_get(v)) for _, v, _ in results]
+        total = max(sum(tokens), 1.0)
+        acc = None
+        loss = 0.0
+        for (l, _, g), t in zip(results, tokens):
+            g0 = jax.device_put(g, self.plans[0].state_shardings.params) \
+                if g is not results[0][2] else g
+            acc = self._seed(g0, t / total) if acc is None \
+                else self._acc(acc, g0, t / total)
+            loss += float(jax.device_get(l)) * t / total
+
+        new_params, new_opt = self._update(state.params, acc,
+                                           state.opt_state)
+        metrics = {"loss": jnp.asarray(loss),
+                   "tokens": jnp.asarray(sum(tokens))}
+        return TrainState(state.step + 1, new_params, new_opt), metrics
+
+
+def groups_from_bucket_plans(plans: dict, n_devices: int,
+                             *, max_groups: int = 2
+                             ) -> list[DPGroupSpec]:
+    """Turn Hydraulis ``BucketPlan``s into simultaneous dp groups: the
+    longest buckets get the larger (cp-capable) groups."""
+    chosen = sorted(plans.values(), key=lambda p: -p.bucket_len)
+    chosen = chosen[:max_groups]
+    per = max(1, n_devices // max(len(chosen), 1))
+    out = []
+    for p in chosen:
+        # carry the planner's full choice: cp, tp, and remat all shaped
+        # the memory/time estimate that made this bucket feasible
+        tp = min(p.strategy.tp, per)
+        cp = min(p.strategy.cp, max(1, per // tp))
+        out.append(DPGroupSpec(rows=p.batch_rows, seq_len=p.bucket_len,
+                               dp=max(1, per // (cp * tp)), tp=tp, cp=cp,
+                               remat=p.strategy.remat))
+    return out

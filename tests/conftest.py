@@ -79,6 +79,8 @@ SLOW_TESTS = {
     "test_restarts_exhausted_reports_failure",
     # hetero pipeline
     "test_hetero_matches_homogeneous",
+    "test_hetero_dp_matches_weighted_oracle",
+    "test_hetero_dp_trains",
     "test_bert_mlm_trains_and_strategies",
     "test_hetero_shared_embedding_grads",
     "test_malleus_planner_trains",

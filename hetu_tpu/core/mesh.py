@@ -54,3 +54,39 @@ def make_mesh(shape: dict[str, int] | Sequence[int],
             f"mesh shape {dims} needs {n} devices, have {len(devices)}")
     dev_array = np.asarray(devices[:n]).reshape(dims)
     return Mesh(dev_array, axis_names)
+
+
+def make_hybrid_mesh(ici_shape: dict[str, int], dcn_axis: str = "dp",
+                     *, num_slices: int | None = None) -> Mesh:
+    """Multi-slice mesh: ``dcn_axis`` spans slices over DCN, every other
+    axis stays within a slice on ICI.
+
+    The reference reaches multi-node scale by running NCCL over IB between
+    hosts (``nccl_comm_group``); the TPU equivalent is a hybrid mesh where
+    only the designated axis (normally dp — its grad allreduce is the only
+    per-step DCN traffic and it overlaps with backward) crosses slice
+    boundaries. Uses ``mesh_utils.create_hybrid_device_mesh`` when slice
+    information is available, else falls back to a flat mesh (CPU
+    simulation: any axis split works since there is no real DCN).
+    """
+    devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    n_slices = num_slices if num_slices is not None else len(slice_ids)
+    axis_names = tuple(ici_shape.keys())
+    if dcn_axis not in axis_names:
+        raise ValueError(f"dcn_axis {dcn_axis!r} not in {axis_names}")
+    if ici_shape[dcn_axis] % n_slices != 0:
+        raise ValueError(
+            f"{dcn_axis} degree {ici_shape[dcn_axis]} must be divisible "
+            f"by num_slices {n_slices}")
+    if n_slices <= 1:
+        return make_mesh(ici_shape)
+    from jax.experimental import mesh_utils
+    per_slice = dict(ici_shape)
+    per_slice[dcn_axis] = ici_shape[dcn_axis] // n_slices
+    dcn_shape = {a: (n_slices if a == dcn_axis else 1)
+                 for a in axis_names}
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        tuple(per_slice.values()), tuple(dcn_shape.values()),
+        devices=devices)
+    return Mesh(dev_array, axis_names)

@@ -101,3 +101,15 @@ def test_effective_cp_layout():
     plan = make_plan(GPTLMHeadModel(GPTConfig.tiny()), optim.adam(1e-3),
                      Strategy(cp=2, pp=2, dp=2, num_microbatches=2))
     assert plan.act.cp_layout == "contiguous"
+
+
+def test_hybrid_mesh_single_slice_falls_back():
+    """Multi-slice helper: on a single 'slice' (CPU sim) it degrades to a
+    flat mesh with the same axes; divisibility errors are caught."""
+    from hetu_tpu.core.mesh import make_hybrid_mesh
+    mesh = make_hybrid_mesh({"dp": 4, "tp": 2}, dcn_axis="dp")
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        make_hybrid_mesh({"dp": 3, "tp": 2}, dcn_axis="dp", num_slices=2)
+    with pytest.raises(ValueError):
+        make_hybrid_mesh({"dp": 4}, dcn_axis="pp")

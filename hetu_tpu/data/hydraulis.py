@@ -40,7 +40,8 @@ def plan_buckets(lengths: Iterable[int], *,
                  token_budget: int,
                  dims_base=None, topo=None,
                  max_cp: int = 1,
-                 base_strategy: Optional[Strategy] = None
+                 base_strategy: Optional[Strategy] = None,
+                 row_multiple: int = 1
                  ) -> dict[int, BucketPlan]:
     """Choose per-bucket rows + strategy for a roughly constant token
     budget per dispatch.
@@ -48,6 +49,8 @@ def plan_buckets(lengths: Iterable[int], *,
     ``dims_base``/``topo`` (galvatron ``ModelDims``/``TPUTopology``)
     enable cost-model-guided cp/remat per bucket; without them the plan is
     token-budget only. Only buckets that appear in ``lengths`` get plans.
+    ``row_multiple``: round rows up to this multiple (the consumer's dp
+    degree — batch dims must divide over the mesh).
     """
     lengths = list(lengths)
     present = sorted(buckets.group(lengths))
@@ -55,6 +58,8 @@ def plan_buckets(lengths: Iterable[int], *,
     plans: dict[int, BucketPlan] = {}
     for L in present:
         rows = max(1, token_budget // L)
+        if rows % row_multiple:
+            rows += row_multiple - rows % row_multiple
         strategy, est = base, 0.0
         if dims_base is not None and topo is not None:
             from hetu_tpu.tools.galvatron.cost_model import estimate

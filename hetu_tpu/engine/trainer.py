@@ -176,6 +176,27 @@ class Trainer:
             self.save(wait=True)
         return history
 
+    def train_dynamic(self, dispatcher, seqs,
+                      epochs: int = 1) -> list[dict]:
+        """Hydraulis flow: train over a DynamicDispatcher's per-bucket
+        batches, one cached jitted step per bucket length (the strategy
+        stays this Trainer's; per-bucket cp/remat overrides go through
+        HeteroDPTrainStep instead)."""
+        if self.state is None:
+            self.initialize()
+        history = []
+        for _ in range(epochs):
+            for batch, plan in dispatcher.batches(seqs):
+                metrics = self.train_step(batch)
+                step_no = int(jax.device_get(self.state.step))
+                if self.config.log_every and \
+                        step_no % self.config.log_every == 0:
+                    history.append(self.metrics.log(
+                        step_no,
+                        loss=float(jax.device_get(metrics["loss"])),
+                        bucket=plan.bucket_len))
+        return history
+
     def evaluate(self, batches: Iterable[dict]) -> float:
         total, n = 0.0, 0
         for batch in batches:

@@ -237,7 +237,11 @@ class HeteroTrainStep:
     """
 
     def __init__(self, model: Module, opt: Transform, plan: HeteroPlan, *,
-                 attn_impl: str = "auto"):
+                 attn_impl: str = "auto", schedule: str = "gpipe"):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"schedule must be gpipe|1f1b, got "
+                             f"{schedule!r}")
+        self.schedule = schedule
         self.model, self.opt, self.plan = model, opt, plan
         st = plan.strategy
         self.nm, self.pp = st.num_microbatches, st.pp
@@ -317,6 +321,70 @@ class HeteroTrainStep:
             })
         return out
 
+    def _forward_mb(self, state, mb, stage_in, extras_of):
+        """Run one microbatch's forward through stages 0..S-2, recording
+        each stage's input for the recompute backward."""
+        plan = self.plan
+        S = len(plan.meshes)
+        ids = jax.device_put(mb["input_ids"], plan.batch_shardings[0])
+        labels = jax.device_put(mb["labels"], plan.batch_shardings[-1])
+        positions = mb.get("positions")
+        if positions is None:
+            bsz, s = mb["input_ids"].shape
+            positions = jnp.broadcast_to(
+                jnp.arange(s, dtype=jnp.int32)[None, :], (bsz, s))
+        seg = mb.get("segment_ids")
+        # positions ride with every stage (rotary models need them per
+        # block); segment ids only when packing is active
+        extras = {"positions": positions}
+        if seg is not None:
+            extras["segment_ids"] = seg
+        extras_of.append(extras)
+        with self._acts[0]:
+            h = self._fwd_first(state.outer, state.blocks[0], ids,
+                                positions, extras)
+        stage_in[0].append((ids, positions, labels))
+        for i in range(1, S):
+            h = jax.device_put(h, plan.act_shardings[i])
+            stage_in[i].append(h)
+            if i < S - 1:
+                with self._acts[i]:
+                    h = self._fwd_mid(state.blocks[i], h, extras)
+        # the last stage's forward is fused into bwd_last (the vjp
+        # recomputes it)
+
+    def _backward_mb(self, state, j, head_outer, stage_in, extras_of,
+                     gscale, acc):
+        """Backward for microbatch ``j``; frees its stored inputs."""
+        plan = self.plan
+        S = len(plan.meshes)
+        extras = extras_of[j]
+        h_last = stage_in[S - 1][j]
+        _, _, labels = stage_in[0][j]
+        with self._acts[-1]:
+            loss, dho, dchunk, dh = self._bwd_last(
+                head_outer, state.blocks[S - 1], h_last, labels,
+                extras, gscale)
+        acc["head_outer"] = self._acc(acc["head_outer"], dho)
+        acc["blocks"][S - 1] = self._acc(acc["blocks"][S - 1], dchunk)
+        for i in range(S - 2, 0, -1):
+            g = jax.device_put(dh, plan.act_shardings[i])
+            with self._acts[i]:
+                dchunk, dh = self._bwd_mid(state.blocks[i],
+                                           stage_in[i][j], extras, g)
+            acc["blocks"][i] = self._acc(acc["blocks"][i], dchunk)
+        g = jax.device_put(dh, plan.act_shardings[0])
+        ids, positions, _ = stage_in[0][j]
+        with self._acts[0]:
+            douter, dchunk = self._bwd_first(
+                state.outer, state.blocks[0], ids, positions, extras, g)
+        acc["outer"] = self._acc(acc["outer"], douter)
+        acc["blocks"][0] = self._acc(acc["blocks"][0], dchunk)
+        # 1F1B memory bound: drop this microbatch's stored activations
+        for i in range(S):
+            stage_in[i][j] = None
+        return loss
+
     def __call__(self, state: HeteroState, batch: dict):
         plan, nm, pp = self.plan, self.nm, self.pp
         mbs = self._microbatches(batch)
@@ -327,67 +395,37 @@ class HeteroTrainStep:
         head_outer = jax.device_put(state.outer, plan.head_outer_shardings) \
             if S > 1 else state.outer
 
-        # ---- forward fill: stage inputs saved for the recompute bwd ----
         stage_in: list[list] = [[] for _ in range(S)]   # per stage, per mb
-        losses = []
         extras_of: list[dict] = []
-        for j, mb in enumerate(mbs):
-            ids = jax.device_put(mb["input_ids"], plan.batch_shardings[0])
-            labels = jax.device_put(mb["labels"], plan.batch_shardings[-1])
-            positions = mb.get("positions")
-            if positions is None:
-                bsz, s = mb["input_ids"].shape
-                positions = jnp.broadcast_to(
-                    jnp.arange(s, dtype=jnp.int32)[None, :], (bsz, s))
-            seg = mb.get("segment_ids")
-            # positions ride with every stage (rotary models need them per
-            # block); segment ids only when packing is active
-            extras = {"positions": positions}
-            if seg is not None:
-                extras["segment_ids"] = seg
-            extras_of.append(extras)
-            with self._acts[0]:
-                h = self._fwd_first(state.outer, state.blocks[0], ids,
-                                    positions, extras)
-            stage_in[0].append((ids, positions, labels))
-            for i in range(1, S):
-                h = jax.device_put(h, plan.act_shardings[i])
-                stage_in[i].append(h)
-                if i < S - 1:
-                    with self._acts[i]:
-                        h = self._fwd_mid(state.blocks[i], h, extras)
-            # the last stage's forward is fused into bwd_last (vjp
-            # recomputes it); only the loss needs the extra fwd when S == 1
-            losses.append(None)
+        losses: list = [None] * nm
+        acc = {"outer": self._zeros_f32(state.outer),
+               "head_outer": self._zeros_f32(head_outer),
+               "blocks": [self._zeros_f32(c) for c in state.blocks]}
 
-        # ---- backward drain ----
-        gouter = self._zeros_f32(state.outer)
-        ghead_outer = self._zeros_f32(head_outer)
-        gblocks = [self._zeros_f32(c) for c in state.blocks]
-        for j in reversed(range(nm)):
-            extras = extras_of[j]
-            h_last = stage_in[S - 1][j]
-            _, _, labels = stage_in[0][j]
-            with self._acts[-1]:
-                loss, dho, dchunk, dh = self._bwd_last(
-                    head_outer, state.blocks[S - 1], h_last, labels,
-                    extras, gscale)
-            losses[j] = loss
-            ghead_outer = self._acc(ghead_outer, dho)
-            gblocks[S - 1] = self._acc(gblocks[S - 1], dchunk)
-            for i in range(S - 2, 0, -1):
-                g = jax.device_put(dh, plan.act_shardings[i])
-                with self._acts[i]:
-                    dchunk, dh = self._bwd_mid(state.blocks[i],
-                                               stage_in[i][j], extras, g)
-                gblocks[i] = self._acc(gblocks[i], dchunk)
-            g = jax.device_put(dh, plan.act_shardings[0])
-            ids, positions, _ = stage_in[0][j]
-            with self._acts[0]:
-                douter, dchunk = self._bwd_first(
-                    state.outer, state.blocks[0], ids, positions, extras, g)
-            gouter = self._acc(gouter, douter)
-            gblocks[0] = self._acc(gblocks[0], dchunk)
+        if self.schedule == "1f1b":
+            # steady state: after S in-flight microbatches, alternate one
+            # forward with one backward — at most S microbatches of
+            # activations live at any time (1F1B's memory bound)
+            for j, mb in enumerate(mbs):
+                self._forward_mb(state, mb, stage_in, extras_of)
+                if j >= S - 1:
+                    k = j - (S - 1)
+                    losses[k] = self._backward_mb(
+                        state, k, head_outer, stage_in, extras_of,
+                        gscale, acc)
+            for k in range(max(0, nm - (S - 1)), nm):
+                losses[k] = self._backward_mb(
+                    state, k, head_outer, stage_in, extras_of, gscale,
+                    acc)
+        else:  # gpipe: all forwards, then all backwards (newest first)
+            for mb in mbs:
+                self._forward_mb(state, mb, stage_in, extras_of)
+            for j in reversed(range(nm)):
+                losses[j] = self._backward_mb(
+                    state, j, head_outer, stage_in, extras_of, gscale,
+                    acc)
+        gouter, ghead_outer = acc["outer"], acc["head_outer"]
+        gblocks = acc["blocks"]
 
         # ---- shared-weight bridge back + updates ----
         # NOTE: opt.update runs per partition (outer + each stage chunk).
@@ -416,8 +454,10 @@ class HeteroTrainStep:
 
 
 def build_hetero_train_step(model: Module, opt: Transform,
-                            plan: HeteroPlan, *, attn_impl: str = "auto"):
+                            plan: HeteroPlan, *, attn_impl: str = "auto",
+                            schedule: str = "gpipe"):
     if plan.pp < 2:
         raise ValueError("hetero executor needs >= 2 stages; use "
                          "build_train_step otherwise")
-    return HeteroTrainStep(model, opt, plan, attn_impl=attn_impl)
+    return HeteroTrainStep(model, opt, plan, attn_impl=attn_impl,
+                           schedule=schedule)

@@ -84,6 +84,7 @@ SLOW_TESTS = {
     "test_bert_mlm_trains_and_strategies",
     "test_hetero_shared_embedding_grads",
     "test_malleus_planner_trains",
+    "test_hetero_1f1b_matches_gpipe",
     # misc heavy
     "test_packed_loss_equals_unpacked",
     "test_loader_feeds_training",

@@ -77,13 +77,22 @@ def _leaf_pieces(leaf) -> list[dict]:
 
 
 def save_checkpoint_distributed(path: str, state: TrainState, *,
-                                async_save: bool = False
+                                async_save: bool = False,
+                                quantize: Optional[str] = None
                                 ) -> CheckpointWriter:
     """Write this process's shards of ``state`` (params + opt + step).
 
     Safe to call from every process concurrently — files are disjoint.
+    ``quantize="int8"`` stores 2-D+ float params as int8 with per-channel
+    scales, computed per piece (optimizer state stays full precision) —
+    the reference's quantized storage (``ht_safetensors.py:42-49``).
     """
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8', got "
+                         f"{quantize!r}")
     flat = {_MODEL_PREFIX + k: v for k, v in _flatten(state.params).items()}
+    opt_keys = {_OPT_PREFIX + k
+                for k in _flatten(state.opt_state)}
     flat.update({_OPT_PREFIX + k: v
                  for k, v in _flatten(state.opt_state).items()})
     p = jax.process_index()
@@ -95,10 +104,23 @@ def save_checkpoint_distributed(path: str, state: TrainState, *,
         entries = []
         for i, piece in enumerate(_leaf_pieces(leaf)):
             entry = f"{key}#p{i}"
-            tensors[entry] = piece["data"]
+            data = piece["data"]
+            q8 = bool(quantize == "int8" and key not in opt_keys
+                      and data.ndim >= 2
+                      and np.issubdtype(data.dtype, np.floating))
+            if q8:
+                from hetu_tpu.ops.quantization import quantize_int8
+                import jax.numpy as jnp
+                qv, scale = quantize_int8(jnp.asarray(
+                    np.float32(data)))
+                tensors[entry] = np.asarray(jax.device_get(qv))
+                tensors[entry + ".q8scale"] = np.asarray(
+                    jax.device_get(scale))
+            else:
+                tensors[entry] = data
             entries.append({"entry": entry, "file": _host_file(p),
                             "start": piece["start"],
-                            "shape": piece["shape"]})
+                            "shape": piece["shape"], "q8": q8})
         if entries:
             index[key] = entries
         gshape = list(leaf.shape) if hasattr(leaf, "shape") else []
@@ -193,6 +215,15 @@ class _PieceReader:
 
         def fetch(e, sl):
             f = self._open(e["file"])
+            if e.get("q8"):
+                # dequantize the whole piece (scales are per-channel of
+                # the piece), then slice — pieces are shard-sized
+                from hetu_tpu.ops.quantization import dequantize_int8
+                import jax.numpy as jnp
+                full = np.asarray(jax.device_get(dequantize_int8(
+                    jnp.asarray(f.get_tensor(e["entry"])),
+                    jnp.asarray(f.get_tensor(e["entry"] + ".q8scale")))))
+                return full[sl] if sl else full
             if not sl:  # scalar entry
                 return f.get_tensor(e["entry"])
             return f.get_slice(e["entry"])[sl]

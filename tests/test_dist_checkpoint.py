@@ -160,3 +160,23 @@ def test_old_index_format_rejected_with_hint(tmp_path, setup):
         json.dump(doc["pieces"], f)  # the pre-format-2 flat layout
     with pytest.raises(ValueError, match="format"):
         load_checkpoint_distributed(str(tmp_path), model, opt)
+
+
+def test_quantized_sharded_checkpoint(tmp_path, setup):
+    """int8 storage per piece: params dequantize within tolerance, opt
+    state stays exact, cross-layout restore still works."""
+    cfg, model, opt, plan, state = setup
+    save_checkpoint_distributed(str(tmp_path), state, quantize="int8")
+    restored = load_checkpoint_distributed(str(tmp_path), model, opt)
+    for (ka, a), (kb, b) in zip(
+            sorted(jax.tree_util.tree_flatten_with_path(state.params)[0],
+                   key=str),
+            sorted(jax.tree_util.tree_flatten_with_path(
+                restored.params)[0], key=str)):
+        av = np.asarray(jax.device_get(a))
+        np.testing.assert_allclose(av, np.asarray(b), atol=0.02
+                                   + 0.02 * np.abs(av).max())
+    for a, b in zip(jax.tree.leaves(state.opt_state),
+                    jax.tree.leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(a)),
+                                      np.asarray(b))

@@ -158,7 +158,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
 
 
 def _flash_fwd(q, k, v, q_seg, kv_seg, *, causal, scale,
-               q_offset=0, kv_offset=0, interpret=None):
+               q_offset=0, kv_offset=0, interpret=None,
+               block_q=None, block_k=None):
     """q (b,hq,sq,d); k/v (b,hkv,sk,d); seg ids (b,s) or None.
 
     Returns out (b,hq,sq,d) and lse (b,hq,sq) (natural-log-sum-exp of the
@@ -167,8 +168,8 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, *, causal, scale,
     b, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     rep = hq // hkv
-    block_q = _pick_block(sq)
-    block_k = _pick_block(sk)
+    block_q = block_q or _pick_block(sq)
+    block_k = block_k or _pick_block(sk)
     kv_blocks = sk // block_k
     interpret = _interpret_default() if interpret is None else interpret
 
@@ -346,7 +347,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
-               q_offset=0, kv_offset=0, interpret=None, delta=None):
+               q_offset=0, kv_offset=0, interpret=None, delta=None,
+               block_q=None, block_k=None):
     """Returns (dq, dk, dv) in input dtypes/shapes ((b,h,s,d) layout).
 
     ``delta`` (b,hq,sq) fp32 may be precomputed by the caller (ring
@@ -355,8 +357,8 @@ def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
     b, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     rep = hq // hkv
-    block_q = _pick_block(sq)
-    block_k = _pick_block(sk)
+    block_q = block_q or _pick_block(sq)
+    block_k = block_k or _pick_block(sk)
     interpret = _interpret_default() if interpret is None else interpret
 
     qf = (q.astype(jnp.float32) * scale).astype(q.dtype)
@@ -462,26 +464,30 @@ def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
 # Public custom_vjp entry point — (b, s, h, d) layout like ops.attention
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash_core(q, k, v, q_seg, kv_seg, causal, scale, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_core(q, k, v, q_seg, kv_seg, causal, scale, interpret, blocks):
     out, _ = _flash_fwd(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                         jnp.swapaxes(v, 1, 2), q_seg, kv_seg,
-                        causal=causal, scale=scale, interpret=interpret)
+                        causal=causal, scale=scale, interpret=interpret,
+                        block_q=blocks[0], block_k=blocks[1])
     return jnp.swapaxes(out, 1, 2)
 
 
-def _flash_core_fwd(q, k, v, q_seg, kv_seg, causal, scale, interpret):
+def _flash_core_fwd(q, k, v, q_seg, kv_seg, causal, scale, interpret,
+                    blocks):
     qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     out, lse = _flash_fwd(qh, kh, vh, q_seg, kv_seg, causal=causal,
-                          scale=scale, interpret=interpret)
+                          scale=scale, interpret=interpret,
+                          block_q=blocks[0], block_k=blocks[1])
     return jnp.swapaxes(out, 1, 2), (qh, kh, vh, q_seg, kv_seg, out, lse)
 
 
-def _flash_core_bwd(causal, scale, interpret, res, g):
+def _flash_core_bwd(causal, scale, interpret, blocks, res, g):
     qh, kh, vh, q_seg, kv_seg, out, lse = res
     dq, dk, dv = _flash_bwd(qh, kh, vh, q_seg, kv_seg, out, lse,
                             jnp.swapaxes(g, 1, 2), causal=causal,
-                            scale=scale, interpret=interpret)
+                            scale=scale, interpret=interpret,
+                            block_q=blocks[0], block_k=blocks[1])
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
             jnp.swapaxes(dv, 1, 2), None, None)
 
@@ -493,15 +499,19 @@ def flash_attention_pallas(q, k, v, *, causal: bool = False,
                            segment_ids: Optional[jnp.ndarray] = None,
                            kv_segment_ids: Optional[jnp.ndarray] = None,
                            scale: Optional[float] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           block_q: Optional[int] = None,
+                           block_k: Optional[int] = None):
     """Flash attention, (batch, seq, heads, head_dim) layout, GQA allowed.
 
     Differentiable via fused Pallas backward kernels. ``segment_ids`` enables
     packed/varlen batches (positions attend only within equal ids).
+    ``block_q``/``block_k`` override the default tiling (must divide the
+    seq lens) — see ``workloads/flash_tune.py`` for the autotune sweep.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     if segment_ids is not None and kv_segment_ids is None:
         kv_segment_ids = segment_ids
     return _flash_core(q, k, v, segment_ids, kv_segment_ids,
-                       causal, scale, interpret)
+                       causal, scale, interpret, (block_q, block_k))

@@ -1,0 +1,77 @@
+"""Flash-kernel block-size autotune on the real chip.
+
+Sweeps (block_q, block_k) for fwd and fwd+bwd at representative shapes
+and prints the best tiling per shape — feed the winners back as
+``flash_attention_pallas(..., block_q=, block_k=)`` defaults.
+
+Usage: python workloads/flash_tune.py [--seq 2048] [--heads 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.ops.flash_pallas import flash_attention_pallas
+from hetu_tpu.utils.profiler import time_fn_ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=4)
+    args = ap.parse_args()
+
+    if jax.devices()[0].platform != "tpu":
+        print(json.dumps({"error": "autotune needs the TPU chip"}))
+        return
+
+    b, s, h, d = args.batch, args.seq, args.heads, args.head_dim
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.bfloat16)
+
+    blocks = [x for x in (128, 256, 512, 1024) if s % x == 0]
+    results = []
+    for bq in blocks:
+        for bk in blocks:
+            fwd = jax.jit(lambda q, k, v, bq=bq, bk=bk:
+                          flash_attention_pallas(
+                              q, k, v, causal=True, interpret=False,
+                              block_q=bq, block_k=bk))
+            bwd = jax.jit(jax.grad(
+                lambda q, k, v, bq=bq, bk=bk: flash_attention_pallas(
+                    q, k, v, causal=True, interpret=False, block_q=bq,
+                    block_k=bk).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2)))
+            try:
+                f_ms = time_fn_ms(fwd, q, k, v)
+                b_ms = time_fn_ms(bwd, q, k, v)
+            except Exception as e:
+                results.append({"bq": bq, "bk": bk,
+                                "error": str(e)[:80]})
+                continue
+            rec = {"bq": bq, "bk": bk, "fwd_ms": round(f_ms, 3),
+                   "bwd_ms": round(b_ms, 3)}
+            results.append(rec)
+            print(json.dumps(rec))
+
+    ok = [r for r in results if "fwd_ms" in r]
+    if ok:
+        best_f = min(ok, key=lambda r: r["fwd_ms"])
+        best_b = min(ok, key=lambda r: r["bwd_ms"])
+        print(json.dumps({"seq": s, "best_fwd": best_f,
+                          "best_bwd": best_b}))
+
+
+if __name__ == "__main__":
+    main()

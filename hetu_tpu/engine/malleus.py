@@ -25,7 +25,6 @@ import itertools
 from typing import Optional, Sequence
 
 from hetu_tpu.engine.straggler import StragglerReport
-from hetu_tpu.parallel.hetero import HeteroStrategy, StageSpec
 
 
 def _compositions(n: int, k: int, allowed: Sequence[int]):
@@ -59,7 +58,7 @@ def _largest_remainder(weights: Sequence[float], total: int,
 def plan_hetero(report: StragglerReport, num_layers: int, *,
                 num_stages: int, max_tp: int = 8,
                 num_microbatches: Optional[int] = None,
-                remat: str = "none") -> HeteroStrategy:
+                remat: str = "none") -> "HeteroStrategy":
     """Emit a HeteroStrategy from measured straggler ratios.
 
     Devices are sorted fastest-first and cut into ``num_stages`` contiguous
@@ -69,6 +68,9 @@ def plan_hetero(report: StragglerReport, num_layers: int, *,
     gets few layers instead of dragging every TP matmul of a fast group —
     the Malleus objective.
     """
+    # function-level import: hetero imports engine.state, so a module-level
+    # import here would be circular through engine/__init__
+    from hetu_tpu.parallel.hetero import HeteroStrategy, StageSpec
     ids = sorted(report.ratios, key=lambda d: report.ratios[d])
     speeds = [1.0 / report.ratios[d] for d in ids]
     n = len(ids)

@@ -66,10 +66,39 @@ class Trainer:
         self.set_strategy(strategy)
 
     # -- strategy / hot switching ------------------------------------------
-    def set_strategy(self, strategy: Strategy):
-        """Compile the plan for ``strategy``; if training is live, hot-switch
-        the full train state onto the new shardings (HotSPa)."""
+    def set_strategy(self, strategy):
+        """Compile the plan for ``strategy`` (a :class:`Strategy` or a
+        Malleus :class:`~hetu_tpu.parallel.hetero.HeteroStrategy`); if
+        training is live, hot-switch the full train state — params AND
+        optimizer moments — onto the new layout (HotSPa; hetero via the
+        homo<->hetero converters)."""
+        from hetu_tpu.parallel.hetero import (
+            HeteroState, HeteroStrategy, build_hetero_train_step,
+            make_hetero_plan, state_from_hetero, state_to_hetero,
+        )
         strategy.validate(len(self.devices or jax.devices()))
+
+        def to_homo_state():
+            if isinstance(self.state, HeteroState):
+                return state_from_hetero(self.state, self.plan, self.model)
+            return self.state
+
+        if isinstance(strategy, HeteroStrategy):
+            with autocast(self.config.policy()):
+                plan = make_hetero_plan(self.model, strategy, self.devices)
+                step_fn = build_hetero_train_step(
+                    self.model, self.opt, plan,
+                    attn_impl=self.config.attn_impl)
+            if self.state is not None:
+                self.state = state_to_hetero(to_homo_state(), plan)
+                get_logger().info(
+                    f"hot-switched to hetero {strategy.to_json()} at "
+                    f"step {int(self.state.step)}")
+            self.plan = plan
+            self._step_fn = step_fn
+            self._eval_fn = None   # evaluate() under hetero: switch back
+            return plan
+
         with autocast(self.config.policy()):
             plan = make_plan(self.model, self.opt, strategy, self.devices)
             step_fn = build_train_step(self.model, self.opt, plan,
@@ -77,7 +106,7 @@ class Trainer:
             eval_fn = build_eval_step(self.model, plan,
                                       attn_impl=self.config.attn_impl)
         if self.state is not None:
-            self.state = switch_strategy(self.state, plan)
+            self.state = switch_strategy(to_homo_state(), plan)
             get_logger().info(
                 f"hot-switched to {strategy.to_json()} at step "
                 f"{int(jax.device_get(self.state.step))}")
@@ -92,21 +121,32 @@ class Trainer:
 
     # -- state lifecycle ---------------------------------------------------
     def initialize(self, key: Optional[jax.Array] = None) -> TrainState:
+        from hetu_tpu.parallel.hetero import HeteroPlan, init_hetero_state
         key = key if key is not None else jax.random.key(self.config.seed)
         with autocast(self.config.policy()):
-            self.state = init_state(self.model, self.opt, self.plan, key)
+            if isinstance(self.plan, HeteroPlan):
+                self.state = init_hetero_state(self.model, self.opt,
+                                               self.plan, key)
+            else:
+                self.state = init_state(self.model, self.opt, self.plan,
+                                        key)
         return self.state
 
     def resume(self, path: str) -> TrainState:
         import os
+        from hetu_tpu.parallel.hetero import HeteroPlan, state_to_hetero
+        hetero = isinstance(self.plan, HeteroPlan)
+        plan = None if hetero else self.plan
         if os.path.exists(os.path.join(path, "index-host00000.json")):
             from hetu_tpu.utils.dist_checkpoint import (
                 load_checkpoint_distributed)
             self.state = load_checkpoint_distributed(
-                path, self.model, self.opt, self.plan)
+                path, self.model, self.opt, plan)
         else:
             self.state = load_checkpoint(path, self.model, self.opt,
-                                         self.plan)
+                                         plan)
+        if hetero:
+            self.state = state_to_hetero(self.state, self.plan)
         get_logger().info(
             f"resumed from {path} at step "
             f"{int(jax.device_get(self.state.step))}")
@@ -118,15 +158,20 @@ class Trainer:
             raise ValueError("no checkpoint path configured")
         if self._ckpt_writer is not None:
             self._ckpt_writer.wait()  # one in-flight save at a time
+        from hetu_tpu.parallel.hetero import HeteroState, state_from_hetero
+        state = self.state
+        if isinstance(state, HeteroState):
+            # checkpoints are layout-independent: merge to one TrainState
+            state = state_from_hetero(state, self.plan, self.model)
         if self.config.distributed_ckpt:
             from hetu_tpu.utils.dist_checkpoint import (
                 save_checkpoint_distributed)
             self._ckpt_writer = save_checkpoint_distributed(
-                path, self.state,
+                path, state,
                 async_save=self.config.async_ckpt and not wait)
         else:
             self._ckpt_writer = save_checkpoint(
-                path, self.state,
+                path, state,
                 async_save=self.config.async_ckpt and not wait)
         if wait:
             self._ckpt_writer.wait()
@@ -198,6 +243,11 @@ class Trainer:
         return history
 
     def evaluate(self, batches: Iterable[dict]) -> float:
+        if self._eval_fn is None:
+            raise RuntimeError(
+                "evaluate() is not supported under a hetero strategy — "
+                "set_strategy(Strategy(...)) back to a homogeneous plan "
+                "first (the hot switch preserves the state)")
         total, n = 0.0, 0
         for batch in batches:
             loss = self._eval_fn(self.state.params,

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from hetu_tpu.engine.state import TrainState
 from hetu_tpu.nn.module import Module
 from hetu_tpu.optim.base import Transform, apply_updates
 from hetu_tpu.parallel.sharding import (
@@ -149,6 +150,11 @@ class HeteroPlan:
     @property
     def pp(self) -> int:
         return len(self.meshes)
+
+    def shard_batch(self, batch: dict) -> dict:
+        """Identity: the hetero executor places per-stage microbatches
+        itself (per-mesh device_put in ``_forward_mb``)."""
+        return batch
 
 
 def _stage_meshes(strategy: HeteroStrategy, devices=None) -> tuple[Mesh, ...]:
@@ -451,6 +457,122 @@ class HeteroTrainStep:
                    "grad_norm": jnp.sqrt(jnp.asarray(sq))}
         return HeteroState(state.step + 1, new_outer, tuple(new_blocks),
                            new_opt_outer, tuple(new_opt_blocks)), metrics
+
+
+# ---------------------------------------------------------------------------
+# Homo <-> hetero state conversion (hot switching into a Malleus plan)
+# ---------------------------------------------------------------------------
+
+def _map_param_subtrees(node, params_treedef, fn, leaf_fn=None):
+    """Rebuild an optimizer-state tree, applying ``fn`` to every subtree
+    whose structure equals the params tree (Adam moments etc.); other
+    leaves (scalar counts) go through ``leaf_fn`` (default identity)."""
+    if jax.tree_util.tree_structure(node) == params_treedef:
+        return fn(node)
+    if isinstance(node, tuple):
+        children = [_map_param_subtrees(c, params_treedef, fn, leaf_fn)
+                    for c in node]
+        return type(node)(*children) if hasattr(node, "_fields") \
+            else tuple(children)
+    if isinstance(node, dict):
+        return {k: _map_param_subtrees(v, params_treedef, fn, leaf_fn)
+                for k, v in node.items()}
+    if isinstance(node, list):
+        return [_map_param_subtrees(c, params_treedef, fn, leaf_fn)
+                for c in node]
+    return leaf_fn(node) if leaf_fn is not None else node
+
+
+def state_to_hetero(state: TrainState, plan: HeteroPlan) -> HeteroState:
+    """Split a homogeneous TrainState onto the hetero plan's meshes —
+    the hot-switch path INTO a Malleus hetero layout (params, optimizer
+    moments, and step all preserved)."""
+    params = state.params
+    pdef = jax.tree_util.tree_structure(params)
+    ranges = plan.strategy.layer_ranges()
+
+    def split(tree):
+        outer = {k: v for k, v in tree.items() if k != "blocks"}
+        outer = jax.device_put(jax.tree.map(np.asarray, outer),
+                               plan.outer_shardings)
+        # one host gather per leaf; each stage then slices its rows
+        blocks_host = jax.tree.map(np.asarray, tree["blocks"])
+        chunks = tuple(
+            jax.device_put(jax.tree.map(lambda x: x[lo:hi], blocks_host),
+                           sh)
+            for (lo, hi), sh in zip(ranges, plan.block_shardings))
+        return outer, chunks
+
+    outer, chunks = split(params)
+    # scalar transform state (counts) is COPIED to host: the source state
+    # may be donated by its train step later, and references would dangle
+    opt_parts = _map_param_subtrees(
+        state.opt_state, pdef, split,
+        leaf_fn=lambda x: np.asarray(jax.device_get(x))
+        if isinstance(x, jax.Array) else x)
+
+    def _project(node, idx):
+        if isinstance(node, tuple) and len(node) == 2 \
+                and isinstance(node[0], dict) \
+                and isinstance(node[1], tuple) and not hasattr(
+                    node, "_fields"):
+            # a split() result: (outer_dict, chunk_tuple)
+            return node[0] if idx == -1 else node[1][idx]
+        if isinstance(node, tuple):
+            children = [_project(c, idx) for c in node]
+            return type(node)(*children) if hasattr(node, "_fields") \
+                else tuple(children)
+        if isinstance(node, dict):
+            return {k: _project(v, idx) for k, v in node.items()}
+        if isinstance(node, list):
+            return [_project(c, idx) for c in node]
+        return node
+
+    opt_outer = _project(opt_parts, -1)
+    opt_chunks = tuple(_project(opt_parts, i) for i in range(plan.pp))
+    return HeteroState(int(jax.device_get(state.step)), outer, chunks,
+                       opt_outer, opt_chunks)
+
+
+def state_from_hetero(hstate: HeteroState, plan: HeteroPlan,
+                      model: Module) -> TrainState:
+    """Merge a hetero state back into one homogeneous TrainState (host
+    values) — the switch OUT of a hetero layout; place with
+    ``make_plan(...)`` shardings or ``device_put`` as needed."""
+
+    def merge(outer, chunks):
+        blocks = jax.tree.map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs],
+                                       axis=0), *chunks)
+        full = dict(jax.tree.map(np.asarray, outer))
+        full["blocks"] = blocks
+        return full
+
+    params = merge(hstate.outer, hstate.blocks)
+    pdef = jax.tree_util.tree_structure(params)
+
+    # zip the per-partition opt trees back together
+    def zip_opt(outer_node, chunk_nodes):
+        if isinstance(outer_node, dict) and "blocks" not in outer_node \
+                and jax.tree_util.tree_structure(
+                    {**outer_node, "blocks": chunk_nodes[0]}) == pdef:
+            return merge(outer_node, chunk_nodes)
+        if isinstance(outer_node, tuple):
+            children = [zip_opt(c, [cn[i] for cn in chunk_nodes])
+                        for i, c in enumerate(outer_node)]
+            return type(outer_node)(*children) \
+                if hasattr(outer_node, "_fields") else tuple(children)
+        if isinstance(outer_node, dict):
+            return {k: zip_opt(v, [cn[k] for cn in chunk_nodes])
+                    for k, v in outer_node.items()}
+        if isinstance(outer_node, list):
+            return [zip_opt(c, [cn[i] for cn in chunk_nodes])
+                    for i, c in enumerate(outer_node)]
+        return outer_node
+
+    opt_state = zip_opt(hstate.opt_outer, list(hstate.opt_blocks))
+    return TrainState(jnp.asarray(hstate.step, jnp.int32), params,
+                      opt_state)
 
 
 def build_hetero_train_step(model: Module, opt: Transform,

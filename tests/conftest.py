@@ -27,6 +27,8 @@ SLOW_TESTS = {
     # trainer / hot switch
     "test_hot_switch_loss_curve_identical",
     "test_trainer_switch_to_pipeline",
+    "test_trainer_hot_switch_to_hetero",
+    "test_trainer_save_resume_under_hetero",
     "test_trainer_checkpoint_resume",
     "test_trainer_trains_and_logs",
     "test_trainer_evaluate",
@@ -85,6 +87,7 @@ SLOW_TESTS = {
     "test_hetero_shared_embedding_grads",
     "test_malleus_planner_trains",
     "test_hetero_1f1b_matches_gpipe",
+    "test_hot_switch_homo_to_hetero_and_back",
     # misc heavy
     "test_packed_loss_equals_unpacked",
     "test_loader_feeds_training",

@@ -183,3 +183,50 @@ def test_hetero_1f1b_matches_gpipe():
 
     np.testing.assert_allclose(run("1f1b"), run("gpipe"),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_hot_switch_homo_to_hetero_and_back():
+    """Mid-training switch: homo state (with Adam moments) splits onto a
+    hetero plan, trains there, merges back, and continues homo — the
+    Malleus replan flow end to end."""
+    from hetu_tpu.parallel.hetero import (
+        state_from_hetero, state_to_hetero,
+    )
+    cfg = _cfg4()
+    batch = _batch(cfg)
+    model = GPTLMHeadModel(cfg)
+    opt = optim.adamw(1e-2)
+
+    # homo training for 2 steps
+    plan_h = make_plan(model, opt, Strategy(dp=2, num_microbatches=2))
+    state = init_state(model, opt, plan_h, jax.random.key(0))
+    step_h = build_train_step(model, opt, plan_h)
+    for _ in range(2):
+        state, m = step_h(state, plan_h.shard_batch(batch))
+
+    # switch to hetero FIRST (step_h donates its input buffers, so the
+    # conversion must read the state before the oracle continuation)
+    strategy = HeteroStrategy(stages=(StageSpec(layers=3, tp=2),
+                                      StageSpec(layers=1, tp=2)),
+                              num_microbatches=2).validate(8)
+    hplan = make_hetero_plan(model, strategy)
+    hstate = state_to_hetero(state, hplan)
+
+    # oracle: continue homo for 2 more steps
+    oracle = state
+    for _ in range(2):
+        oracle, mo = step_h(oracle, plan_h.shard_batch(batch))
+    assert hstate.step == 2
+    hstep = build_hetero_train_step(model, opt, hplan)
+    for _ in range(2):
+        hstate, mh = hstep(hstate, batch)
+    # same trajectory as never switching
+    np.testing.assert_allclose(float(mh["loss"]), float(mo["loss"]),
+                               rtol=2e-3, atol=2e-3)
+
+    # switch back and keep training homo
+    back = state_from_hetero(hstate, hplan, model)
+    back = jax.device_put(back, plan_h.state_shardings)
+    assert int(back.step) == 4
+    back, mb = step_h(back, plan_h.shard_batch(batch))
+    assert np.isfinite(float(mb["loss"]))

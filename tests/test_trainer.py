@@ -162,3 +162,43 @@ def test_trainer_train_dynamic_buckets():
     assert history
     assert len({h["bucket"] for h in history}) >= 2  # multiple shapes
     assert all(np.isfinite(h["loss"]) for h in history)
+
+
+def test_trainer_hot_switch_to_hetero():
+    """Trainer.set_strategy accepts a HeteroStrategy mid-training: the
+    Malleus replan flow (homo -> hetero -> homo) through the Trainer."""
+    from hetu_tpu.parallel.hetero import HeteroStrategy, StageSpec
+    t = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-2), Strategy(dp=2),
+                _cfg())
+    batches = list(_batches(6))
+    for b in batches[:2]:
+        t.train_step(b)
+    t.set_strategy(HeteroStrategy(
+        stages=(StageSpec(layers=1, tp=2), StageSpec(layers=1, tp=2)),
+        num_microbatches=2))
+    losses = [float(jax.device_get(t.train_step(b)["loss"]))
+              for b in batches[2:4]]
+    assert all(np.isfinite(l) for l in losses)
+    t.set_strategy(Strategy(dp=4))
+    m = t.train_step(batches[4])
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+    assert int(jax.device_get(t.state.step)) == 5
+
+
+def test_trainer_save_resume_under_hetero(tmp_path):
+    """save() under a live hetero strategy merges to the layout-free
+    checkpoint; a fresh hetero Trainer resumes from it."""
+    from hetu_tpu.parallel.hetero import HeteroStrategy, StageSpec
+    hs = HeteroStrategy(stages=(StageSpec(layers=1, tp=2),
+                                StageSpec(layers=1, tp=2)),
+                        num_microbatches=2)
+    t = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-2), hs,
+                _cfg(ckpt_dir=str(tmp_path)))
+    for b in _batches(2):
+        t.train_step(b)
+    t.save(wait=True)
+    t2 = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-2), hs, _cfg())
+    t2.resume(str(tmp_path))
+    assert int(t2.state.step) == 2
+    m = t2.train_step(next(iter(_batches(1, seed=9))))
+    assert np.isfinite(float(jax.device_get(m["loss"])))

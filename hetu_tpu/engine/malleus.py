@@ -108,3 +108,16 @@ def plan_hetero(report: StragglerReport, num_layers: int, *,
         else max(2 * num_stages, 4)
     return HeteroStrategy(stages=stages, num_microbatches=nm, remat=remat,
                           device_ids=device_ids).validate(n)
+
+
+def replan_if_straggling(report: StragglerReport, num_layers: int, *,
+                         threshold: float = 1.5, num_stages: int = 2,
+                         **kw) -> Optional["HeteroStrategy"]:
+    """The Malleus trigger: when stragglers exceed ``threshold``, emit a
+    hetero strategy that keeps them (with less work) instead of evicting
+    them (``engine.straggler.replan_for_stragglers``'s shrink approach);
+    None when the fleet is healthy. Feed the result to
+    ``Trainer.set_strategy`` — the hot switch preserves the state."""
+    if not report.stragglers(threshold):
+        return None
+    return plan_hetero(report, num_layers, num_stages=num_stages, **kw)

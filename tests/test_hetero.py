@@ -230,3 +230,14 @@ def test_hot_switch_homo_to_hetero_and_back():
     assert int(back.step) == 4
     back, mb = step_h(back, plan_h.shard_batch(batch))
     assert np.isfinite(float(mb["loss"]))
+
+
+def test_replan_if_straggling_trigger():
+    from hetu_tpu.engine.malleus import replan_if_straggling
+    healthy = StragglerReport(times_s={}, ratios={i: 1.0 for i in range(8)})
+    assert replan_if_straggling(healthy, num_layers=8) is None
+    ratios = {i: 1.0 for i in range(8)}
+    ratios[2] = 2.0
+    s = replan_if_straggling(StragglerReport(times_s={}, ratios=ratios),
+                             num_layers=8, max_tp=4)
+    assert s is not None and s.num_layers == 8

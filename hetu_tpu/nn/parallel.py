@@ -246,11 +246,20 @@ class ParallelAttention(Module):
         ctx = current_act_sharding()
         if ctx is not None and isinstance(ctx.seq, str) \
                 and ctx.mesh.shape[ctx.seq] > 1:
-            # context parallelism: seq dim is sharded — run the KV ring
-            # (reference: ParallelAttentionOp → AttnCommRing when cp>1)
-            from hetu_tpu.parallel.ring_attention import ring_attention
-            out = ring_attention(q, k, v, ctx=ctx, causal=self.causal,
-                                 segment_ids=segment_ids, impl=attn_impl)
+            # context parallelism: seq dim is sharded — KV ring
+            # (reference: ParallelAttentionOp → AttnCommRing) or the
+            # beyond-reference Ulysses all_to_all head scatter
+            if getattr(ctx, "cp_impl", "ring") == "ulysses":
+                from hetu_tpu.parallel.ulysses import ulysses_attention
+                out = ulysses_attention(q, k, v, ctx=ctx,
+                                        causal=self.causal,
+                                        segment_ids=segment_ids,
+                                        impl=attn_impl)
+            else:
+                from hetu_tpu.parallel.ring_attention import ring_attention
+                out = ring_attention(q, k, v, ctx=ctx, causal=self.causal,
+                                     segment_ids=segment_ids,
+                                     impl=attn_impl)
         else:
             out = flash_attention(q, k, v, causal=self.causal,
                                   segment_ids=segment_ids, impl=attn_impl)

@@ -13,6 +13,7 @@ from hetu_tpu.parallel.hetero import (
     init_hetero_state, make_hetero_plan,
 )
 from hetu_tpu.parallel.hetero_dp import DPGroupSpec, HeteroDPTrainStep
+from hetu_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
     "Strategy", "MESH_AXES",
@@ -20,5 +21,5 @@ __all__ = [
     "shard_params", "constrain", "sharded_init",
     "HeteroStrategy", "StageSpec", "build_hetero_train_step",
     "init_hetero_state", "make_hetero_plan",
-    "DPGroupSpec", "HeteroDPTrainStep",
+    "DPGroupSpec", "HeteroDPTrainStep", "ulysses_attention",
 ]

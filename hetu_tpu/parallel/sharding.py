@@ -121,6 +121,7 @@ class ActivationSharding:
                             # (the shard_map vocab-parallel paths need a string)
     cp_layout: str = "contiguous"   # how the global seq maps to cp shards:
                             # "contiguous" | "zigzag" (see data.packing)
+    cp_impl: str = "ring"   # attention impl for the sharded seq dim
 
     def spec(self, kind: str) -> Optional[P]:
         if kind == "tokens":        # (batch, seq, embed)

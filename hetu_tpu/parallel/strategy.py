@@ -44,6 +44,9 @@ class Strategy:
     offload: bool = False        # host offload of remat'd activations
     cp_layout: str = "zigzag"    # "zigzag" (load-balanced causal ring — the
                                  # reference's SYM split) | "contiguous"
+    cp_impl: str = "ring"        # "ring" (KV ppermute ring, reference
+                                 # AttnCommRing) | "ulysses" (all_to_all
+                                 # head scatter — beyond-reference)
 
     # -- derived -----------------------------------------------------------
     @property
@@ -57,8 +60,8 @@ class Strategy:
         contiguous causal mask — zigzag only applies to the ring path.
         Both ``shard_batch`` and ``make_plan`` consult this single source
         of truth."""
-        if self.pp > 1 or self.cp == 1:
-            return "contiguous"
+        if self.pp > 1 or self.cp == 1 or self.cp_impl == "ulysses":
+            return "contiguous"   # ulysses reassembles global order
         return self.cp_layout
 
     def mesh_shape(self) -> dict[str, int]:
@@ -102,6 +105,8 @@ class Strategy:
             raise ValueError("num_microbatches must be >= 1")
         if self.cp_layout not in ("zigzag", "contiguous"):
             raise ValueError(f"unknown cp_layout {self.cp_layout!r}")
+        if self.cp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"unknown cp_impl {self.cp_impl!r}")
         if self.pp > 1 and self.num_microbatches % self.pp != 0:
             raise ValueError(
                 f"num_microbatches ({self.num_microbatches}) must be a "

@@ -1,0 +1,94 @@
+"""Ulysses-style context parallelism: all_to_all head scatter.
+
+The reference scales sequence length with ring attention only (SURVEY
+§2.7: "no Ulysses variant exists — ring only"); this module goes beyond
+parity with the DeepSpeed-Ulysses formulation, which is often faster than
+the ring at moderate cp: two all_to_alls per attention call move
+activations once, instead of cp-1 KV hops.
+
+Mechanics (inside ``shard_map`` manual over the cp axis, dp/tp staying
+GSPMD-auto): Q/K/V arrive sequence-sharded (b, s/cp, h, d); an
+``all_to_all`` scatters heads and gathers sequence to (b, s, h/cp, d);
+attention runs over the FULL sequence on the local head subset (flash
+kernel as usual — exact causal mask, no per-hop LSE combining); a second
+``all_to_all`` restores the sequence-sharded layout. Requires the
+contiguous cp layout (global positions reassemble in order) and
+``local_heads % cp == 0``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from hetu_tpu.ops.attention import flash_attention
+
+
+def _a2a_heads(x, axis):
+    """(b, s_loc, h, d) -> (b, s_glob, h/cp, d)."""
+    return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def _a2a_seq(x, axis):
+    """(b, s_glob, h/cp, d) -> (b, s_loc, h, d)."""
+    return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention(q, k, v, *, ctx, causal: bool = True,
+                      segment_ids: Optional[jnp.ndarray] = None,
+                      impl: str = "auto"):
+    """Attention over a cp-sharded sequence via head scatter.
+
+    ``q`` (b, s_local, hq, d); ``k``/``v`` (b, s_local, hkv, d); all
+    sequence-sharded over ``ctx.seq``. GQA allowed as long as cp divides
+    both head counts.
+    """
+    axis = ctx.seq
+    cp = ctx.mesh.shape[axis]
+    if cp <= 1:
+        return flash_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids, impl=impl)
+    if ctx.cp_layout != "contiguous":
+        raise ValueError(
+            "ulysses needs the contiguous cp layout (global positions "
+            "must reassemble in order); zigzag is a ring-only layout")
+    hq, hkv = q.shape[2], k.shape[2]
+    tp = ctx.mesh.shape[ctx.tp] if isinstance(ctx.tp, str) else 1
+    if (hq // max(tp, 1)) % cp or (hkv // max(tp, 1)) % cp:
+        raise ValueError(
+            f"ulysses needs cp ({cp}) to divide local head counts "
+            f"(hq={hq}, hkv={hkv}, tp={tp})")
+
+    def body(q, k, v, seg):
+        qg = _a2a_heads(q, axis)
+        kg = _a2a_heads(k, axis)
+        vg = _a2a_heads(v, axis)
+        seg_g = None
+        if seg is not None:
+            seg_g = jax.lax.all_gather(seg, axis, axis=1, tiled=True)
+        out = flash_attention(qg, kg, vg, causal=causal,
+                              segment_ids=seg_g, impl=impl)
+        return _a2a_seq(out, axis)
+
+    # fully-manual shard_map over the whole mesh (same pattern as the
+    # ring): tp splits heads, dp/ep split batch, cp splits seq
+    tp_ax = ctx.tp if isinstance(ctx.tp, str) else None
+    specs_qkv = P(ctx.batch, axis, tp_ax, None)
+    if segment_ids is None:
+        fn = shard_map(lambda q, k, v: body(q, k, v, None),
+                       mesh=ctx.mesh,
+                       in_specs=(specs_qkv, specs_qkv, specs_qkv),
+                       out_specs=specs_qkv, check_vma=False)
+        return fn(q, k, v)
+    seg_spec = P(ctx.batch, axis)
+    fn = shard_map(body, mesh=ctx.mesh,
+                   in_specs=(specs_qkv, specs_qkv, specs_qkv, seg_spec),
+                   out_specs=specs_qkv, check_vma=False)
+    return fn(q, k, v, segment_ids)

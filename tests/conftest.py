@@ -52,6 +52,7 @@ SLOW_TESTS = {
     "test_ring_pallas_interpret",
     "test_zigzag_matches_oracle_grads",
     "test_zigzag_default_strategy_end_to_end",
+    "test_ulysses_strategy_end_to_end",
     # checkpoint
     "test_cross_strategy_reshard_and_bitwise_continuation",
     "test_roundtrip_same_strategy",

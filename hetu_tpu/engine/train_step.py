@@ -100,7 +100,7 @@ def make_plan(model: Module, opt: Transform, strategy: Strategy,
         mesh,
         batch=("dp", "ep") if strategy.ep > 1 else "dp",
         seq="cp", tp="tp", cp_layout=strategy.effective_cp_layout,
-        cp_impl=strategy.cp_impl)
+        cp_impl=strategy.cp_impl, sp=strategy.sp)
     return TrainPlan(strategy, mesh, param_specs, state_specs,
                      named_shardings(mesh, state_specs), act)
 

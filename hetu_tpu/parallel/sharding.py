@@ -122,9 +122,17 @@ class ActivationSharding:
     cp_layout: str = "contiguous"   # how the global seq maps to cp shards:
                             # "contiguous" | "zigzag" (see data.packing)
     cp_impl: str = "ring"   # attention impl for the sharded seq dim
+    sp: bool = False        # Megatron-SP: "tokens" activations (norms,
+                            # residual stream) also shard seq over tp —
+                            # GSPMD emits the reduce-scatter/all-gather
+                            # pairs Megatron inserts by hand
 
     def spec(self, kind: str) -> Optional[P]:
         if kind == "tokens":        # (batch, seq, embed)
+            if self.sp and isinstance(self.tp, str):
+                seq = (self.seq, self.tp) if isinstance(self.seq, str) \
+                    else self.tp
+                return P(self.batch, seq, None)
             return P(self.batch, self.seq, None)
         if kind == "hidden":        # (batch, seq, features/tp)
             return P(self.batch, self.seq, self.tp)

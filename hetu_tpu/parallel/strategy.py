@@ -47,6 +47,8 @@ class Strategy:
     cp_impl: str = "ring"        # "ring" (KV ppermute ring, reference
                                  # AttnCommRing) | "ulysses" (all_to_all
                                  # head scatter — beyond-reference)
+    sp: bool = False             # Megatron-SP: norms/residuals shard seq
+                                 # over tp (activation memory / tp)
 
     # -- derived -----------------------------------------------------------
     @property

@@ -36,6 +36,7 @@ SLOW_TESTS = {
     "test_strategy_parity_with_single_device",
     "test_microbatch_accumulation_parity",
     "test_fsdp_parity_with_single_device",
+    "test_megatron_sp_parity_and_sharding",
     "test_single_device_baseline",
     "test_fsdp_shards_params",
     # pipeline

@@ -63,6 +63,10 @@ class Trainer:
         self._eval_fn = None
         self._ckpt_writer: Optional[CheckpointWriter] = None
         self.metrics = MetricsLogger()
+        # plan pool: one compiled (plan, step, eval) per strategy, so
+        # switching A -> B -> A reuses executables (the reference's
+        # ExecGraphPlan pool, define_and_run_graph.h:23-64)
+        self._plan_cache: dict = {}
         self.set_strategy(strategy)
 
     # -- strategy / hot switching ------------------------------------------
@@ -84,11 +88,16 @@ class Trainer:
             return self.state
 
         if isinstance(strategy, HeteroStrategy):
-            with autocast(self.config.policy()):
-                plan = make_hetero_plan(self.model, strategy, self.devices)
-                step_fn = build_hetero_train_step(
-                    self.model, self.opt, plan,
-                    attn_impl=self.config.attn_impl)
+            if strategy in self._plan_cache:
+                plan, step_fn, _ = self._plan_cache[strategy]
+            else:
+                with autocast(self.config.policy()):
+                    plan = make_hetero_plan(self.model, strategy,
+                                            self.devices)
+                    step_fn = build_hetero_train_step(
+                        self.model, self.opt, plan,
+                        attn_impl=self.config.attn_impl)
+                self._plan_cache[strategy] = (plan, step_fn, None)
             if self.state is not None:
                 self.state = state_to_hetero(to_homo_state(), plan)
                 get_logger().info(
@@ -99,12 +108,17 @@ class Trainer:
             self._eval_fn = None   # evaluate() under hetero: switch back
             return plan
 
-        with autocast(self.config.policy()):
-            plan = make_plan(self.model, self.opt, strategy, self.devices)
-            step_fn = build_train_step(self.model, self.opt, plan,
-                                       attn_impl=self.config.attn_impl)
-            eval_fn = build_eval_step(self.model, plan,
-                                      attn_impl=self.config.attn_impl)
+        if strategy in self._plan_cache:
+            plan, step_fn, eval_fn = self._plan_cache[strategy]
+        else:
+            with autocast(self.config.policy()):
+                plan = make_plan(self.model, self.opt, strategy,
+                                 self.devices)
+                step_fn = build_train_step(self.model, self.opt, plan,
+                                           attn_impl=self.config.attn_impl)
+                eval_fn = build_eval_step(self.model, plan,
+                                          attn_impl=self.config.attn_impl)
+            self._plan_cache[strategy] = (plan, step_fn, eval_fn)
         if self.state is not None:
             self.state = switch_strategy(to_homo_state(), plan)
             get_logger().info(

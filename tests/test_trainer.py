@@ -202,3 +202,18 @@ def test_trainer_save_resume_under_hetero(tmp_path):
     assert int(t2.state.step) == 2
     m = t2.train_step(next(iter(_batches(1, seed=9))))
     assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+def test_plan_pool_reuses_executables_on_switch_back():
+    """A -> B -> A reuses the cached plan/step (ExecGraphPlan-pool
+    semantics): same objects, no rebuild."""
+    t = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3), Strategy(dp=2),
+                _cfg())
+    plan_a, step_a = t.plan, t._step_fn
+    t.train_step(next(iter(_batches(1))))
+    t.set_strategy(Strategy(dp=4))
+    assert t.plan is not plan_a
+    t.set_strategy(Strategy(dp=2))
+    assert t.plan is plan_a and t._step_fn is step_a
+    m = t.train_step(next(iter(_batches(1, seed=5))))
+    assert np.isfinite(float(jax.device_get(m["loss"])))

@@ -72,6 +72,7 @@ SLOW_TESTS = {
     "test_generate_greedy_deterministic",
     "test_generate_sampling_and_eos",
     "test_cached_decode_matches_full_forward",
+    "test_generate_under_tp_mesh_matches_single_device",
     # example-script smoke
     "test_pretrain_with_yaml_config",
     "test_hetero_malleus_example",

@@ -60,13 +60,19 @@ void send_line(int fd, const std::string& s) {
 
 int main(int argc, char** argv) {
   int port = argc > 1 ? std::atoi(argv[1]) : 23456;
+  // loopback by default; "0.0.0.0" (or another address) for multi-host
+  // worker fleets (rpc/launcher.py ssh_hosts)
+  const char* bind_addr = argc > 2 ? argv[2] : "127.0.0.1";
 
   int srv = ::socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   ::setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (::inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1) {
+    std::fprintf(stderr, "bad bind address %s\n", bind_addr);
+    return 1;
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
     std::perror("bind");

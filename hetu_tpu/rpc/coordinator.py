@@ -31,8 +31,10 @@ class Coordinator:
     """Owns a running coordinator server (native or Python fallback)."""
 
     def __init__(self, port: Optional[int] = None, *,
-                 prefer_native: bool = True):
+                 prefer_native: bool = True,
+                 bind: str = "127.0.0.1"):
         self.port = port or _free_port()
+        self.bind = bind
         self._proc: Optional[subprocess.Popen] = None
         self._py_server = None
         if prefer_native and self._start_native():
@@ -54,7 +56,8 @@ class Coordinator:
                     ["g++", "-O2", "-std=c++17", _CSRC, "-o", exe],
                     check=True, capture_output=True)
             self._proc = subprocess.Popen(
-                [exe, str(self.port)], stdout=subprocess.PIPE, text=True)
+                [exe, str(self.port), self.bind],
+                stdout=subprocess.PIPE, text=True)
             line = self._proc.stdout.readline()
             return line.startswith("COORDINATOR READY")
         except Exception:
@@ -66,7 +69,7 @@ class Coordinator:
     # -- python fallback ----------------------------------------------------
     def _start_python(self):
         from hetu_tpu.rpc.py_server import PyCoordinatorServer
-        self._py_server = PyCoordinatorServer(self.port)
+        self._py_server = PyCoordinatorServer(self.port, bind=self.bind)
         self._py_server.start()
         self._py_server.wait_ready()
 

@@ -174,7 +174,9 @@ class ElasticWorkerPool:
 
     # -- lifecycle -----------------------------------------------------------
     def __enter__(self):
-        self.coordinator = Coordinator()
+        # multi-host fleets need a reachable coordinator
+        self.coordinator = Coordinator(
+            bind="0.0.0.0" if self.ssh_hosts else "127.0.0.1")
         return self
 
     def __exit__(self, *exc):

@@ -88,7 +88,8 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class PyCoordinatorServer:
-    def __init__(self, port: int):
+    def __init__(self, port: int, bind: str = "127.0.0.1"):
+        self.bind = bind
         self.port = port
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -97,7 +98,7 @@ class PyCoordinatorServer:
     def start(self):
         socketserver.ThreadingTCPServer.allow_reuse_address = True
         self._server = socketserver.ThreadingTCPServer(
-            ("127.0.0.1", self.port), _Handler)
+            (self.bind, self.port), _Handler)
         self._server.state = _State()  # type: ignore[attr-defined]
         self._server.daemon_threads = True
         self._thread = threading.Thread(

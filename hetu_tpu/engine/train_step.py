@@ -128,7 +128,8 @@ def default_loss_fn(model: Module, strategy: Strategy,
         return model.loss(params, batch["input_ids"], batch["labels"],
                           positions=batch.get("positions"),
                           segment_ids=batch.get("segment_ids"),
-                          attn_impl=attn_impl, remat=remat)
+                          attn_impl=attn_impl, remat=remat,
+                          remat_mask=strategy.remat_mask)
 
     return loss_fn
 

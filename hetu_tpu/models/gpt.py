@@ -132,12 +132,14 @@ class GPTLMHeadModel(Module):
                                       ignore_index=ignore_index)
 
     def backbone(self, params, input_ids, *, positions=None,
-                 segment_ids=None, attn_impl="auto", remat="none"):
+                 segment_ids=None, attn_impl="auto", remat="none",
+                 remat_mask=None):
         """embed + blocks, WITHOUT the final norm (head_loss applies it).
         Returns ``(h, aux)`` — aux is 0 for dense models, the accumulated
         MoE load-balance loss otherwise."""
         h = self.embed(params, input_ids, positions=positions)
         out = self.blocks(params["blocks"], h, remat=remat,
+                          remat_mask=remat_mask,
                           segment_ids=segment_ids, attn_impl=attn_impl)
         if self.blocks.returns_aux:
             return out

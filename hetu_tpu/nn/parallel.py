@@ -375,7 +375,13 @@ class StackedBlocks(Module):
     def returns_aux(self):
         return self._block.returns_aux
 
-    def __call__(self, params, x, *, remat: str = "none", **kwargs):
+    def __call__(self, params, x, *, remat: str = "none",
+                 remat_mask: Optional[Sequence[bool]] = None, **kwargs):
+        """``remat_mask``: per-layer recompute flags (the reference's
+        per-block recompute config, ``recompute.h:12`` via ds-config
+        ``recompute_config``; emitted by ``search_layerwise``). Layers are
+        grouped into consecutive runs, one scan per run, remat applied to
+        the True runs (policy = ``remat`` or "full" when remat is none)."""
         if self._block.returns_aux:
             def body(carry, layer_params):
                 h, aux = carry
@@ -385,12 +391,39 @@ class StackedBlocks(Module):
             def body(carry, layer_params):
                 return self._block(layer_params, carry, **kwargs), None
 
-        if remat != "none":
-            body = jax.checkpoint(body, policy=remat_policy(remat),
+        def rematted(b, policy_name):
+            return jax.checkpoint(b, policy=remat_policy(policy_name),
                                   prevent_cse=False)
+
+        aux0 = jnp.zeros([], jnp.float32)
+        carry0 = (x, aux0) if self._block.returns_aux else x
+
+        if remat_mask is not None:
+            if len(remat_mask) != self.num_layers:
+                raise ValueError(
+                    f"remat_mask has {len(remat_mask)} entries for "
+                    f"{self.num_layers} layers")
+            policy_name = remat if remat != "none" else "full"
+            runs = []  # (start, stop, flag) consecutive same-flag runs
+            start = 0
+            for i in range(1, self.num_layers + 1):
+                if i == self.num_layers \
+                        or bool(remat_mask[i]) != bool(remat_mask[start]):
+                    runs.append((start, i, bool(remat_mask[start])))
+                    start = i
+            carry = carry0
+            for lo, hi, flag in runs:
+                seg = jax.tree.map(lambda p: p[lo:hi], params)
+                b = rematted(body, policy_name) if flag else body
+                carry, _ = jax.lax.scan(b, carry, seg)
+            if self._block.returns_aux:
+                return carry
+            return carry
+
+        if remat != "none":
+            body = rematted(body, remat)
         if self._block.returns_aux:
-            (x, aux), _ = jax.lax.scan(
-                body, (x, jnp.zeros([], jnp.float32)), params)
+            (x, aux), _ = jax.lax.scan(body, carry0, params)
             return x, aux
         x, _ = jax.lax.scan(body, x, params)
         return x

@@ -49,6 +49,8 @@ class Strategy:
                                  # head scatter — beyond-reference)
     sp: bool = False             # Megatron-SP: norms/residuals shard seq
                                  # over tp (activation memory / tp)
+    remat_mask: Optional[tuple] = None   # per-layer recompute flags
+                                 # (search_layerwise output; None = uniform)
 
     # -- derived -----------------------------------------------------------
     @property

@@ -128,3 +128,12 @@ def search_layerwise(dims: ModelDims, topo: TPUTopology,
     if choice is None:
         return float("inf"), None
     return total, [candidates[int(j)] for j in choice]
+
+
+def remat_mask_from_layerwise(per_layer: Sequence[Strategy]
+                              ) -> tuple[bool, ...]:
+    """Compress a layerwise search result into the executable per-layer
+    recompute mask (``Strategy(remat_mask=...)`` →
+    ``StackedBlocks(remat_mask=...)``): True where that layer's chosen
+    strategy uses recompute."""
+    return tuple(s.remat != "none" for s in per_layer)

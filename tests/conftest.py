@@ -37,6 +37,7 @@ SLOW_TESTS = {
     "test_microbatch_accumulation_parity",
     "test_fsdp_parity_with_single_device",
     "test_megatron_sp_parity_and_sharding",
+    "test_per_layer_remat_mask_parity",
     "test_single_device_baseline",
     "test_fsdp_shards_params",
     # pipeline

@@ -150,3 +150,42 @@ def test_megatron_sp_parity_and_sharding():
                              batch="dp", seq="cp", tp="tp", sp=True)
     assert act.spec("tokens") == P("dp", ("cp", "tp"), None)
     assert act.spec("hidden") == P("dp", "cp", "tp")
+
+
+def test_per_layer_remat_mask_parity():
+    """Per-layer recompute (recompute.h:12 per-block config): a mixed
+    mask trains identically to uniform remat, and the layerwise search
+    output compiles into an executable mask."""
+    cfg = GPTConfig(vocab_size=256, max_positions=128, hidden_size=64,
+                    num_layers=4, num_heads=4)
+    ids = jax.random.randint(jax.random.key(1), (4, 65), 0, cfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    def run(strategy):
+        model = GPTLMHeadModel(cfg)
+        opt = optim.adamw(1e-2)
+        plan = make_plan(model, opt, strategy)
+        state = init_state(model, opt, plan, jax.random.key(0))
+        step = build_train_step(model, opt, plan)
+        out = []
+        for _ in range(3):
+            state, m = step(state, plan.shard_batch(batch))
+            out.append(float(m["loss"]))
+        return out
+
+    base = run(Strategy(dp=2))
+    mixed = run(Strategy(dp=2, remat_mask=(False, True, True, False)))
+    np.testing.assert_allclose(mixed, base, rtol=1e-5, atol=1e-6)
+
+    from hetu_tpu.tools.galvatron import ModelDims, TPUTopology
+    from hetu_tpu.tools.galvatron.search import (
+        remat_mask_from_layerwise, search_layerwise,
+    )
+    dims = ModelDims.from_config(cfg, seq_len=64, global_batch=4)
+    topo = TPUTopology(num_devices=2, peak_flops=1e12, hbm_bytes=1e9)
+    cands = [Strategy(dp=2), Strategy(dp=2, remat="full")]
+    total, per_layer = search_layerwise(dims, topo, cands)
+    if per_layer is not None:
+        mask = remat_mask_from_layerwise(per_layer)
+        assert len(mask) == cfg.num_layers
+        run(Strategy(dp=2, remat_mask=mask))  # executes

@@ -74,6 +74,10 @@ SLOW_TESTS = {
     "test_generate_sampling_and_eos",
     "test_cached_decode_matches_full_forward",
     "test_generate_under_tp_mesh_matches_single_device",
+    # driver artifacts
+    "test_bench_emits_json_contract",
+    "test_graft_entry_fn_runs",
+    "test_dryrun_multichip_smoke",
     # example-script smoke
     "test_pretrain_with_yaml_config",
     "test_hetero_malleus_example",

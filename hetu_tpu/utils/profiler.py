@@ -130,11 +130,17 @@ class ModuleTiming:
 
 def sync_result(o):
     """Force completion via a host fetch of one element —
-    ``block_until_ready`` can be lazy through remote PJRT relays."""
+    ``block_until_ready`` can be lazy through remote PJRT relays.
+
+    Sharded arrays are fetched through their first addressable shard
+    (indexing a sharded array eagerly is a collective / type error)."""
     import numpy as np
     leaf = jax.tree.leaves(o)[0]
-    np.asarray(jax.device_get(
-        leaf.ravel()[0] if getattr(leaf, "ndim", 0) else leaf))
+    if isinstance(leaf, jax.Array) and leaf.ndim:
+        local = leaf.addressable_shards[0].data   # single-device view
+        np.asarray(jax.device_get(local[(0,) * local.ndim]))
+    else:
+        np.asarray(jax.device_get(leaf))
 
 
 def time_fn_ms(fn, *args, iters: int = 10, warmup: int = 2) -> float:

@@ -28,12 +28,14 @@ from jax.sharding import PartitionSpec as P
 
 
 def bench(fn, x, iters=10):
-    fn(x)[0].block_until_ready() if isinstance(fn(x), tuple) else \
-        jax.block_until_ready(fn(x))
+    # host fetch, not block_until_ready: the latter is lazy through the
+    # remote PJRT relay (see utils.profiler.sync_result)
+    from hetu_tpu.utils.profiler import sync_result
+    sync_result(fn(x))
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(x)
-    jax.block_until_ready(out)
+    sync_result(out)
     return (time.perf_counter() - t0) / iters
 
 

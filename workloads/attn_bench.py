@@ -42,15 +42,10 @@ def _segments(b, s, n_seg=4):
 
 
 def _time(fn, *args, iters=20, warmup=3):
-    out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    # relay-safe host-fetch sync (block_until_ready can be lazy through
+    # the remote PJRT relay)
+    from hetu_tpu.utils.profiler import time_fn_ms
+    return time_fn_ms(fn, *args, iters=iters, warmup=warmup) / 1e3
 
 
 def attn_flops(b, s, hq, d, causal):

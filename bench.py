@@ -124,7 +124,14 @@ def main():
         s = f"{type(e).__name__}: {e}"
         return any(t in s for t in (
             "RESOURCE_EXHAUSTED", "Out of memory", "OOM",
-            "Attempting to allocate", "exceeds the limit"))
+            "Attempting to allocate", "exceeds the limit",
+            # the axon compile relay reports HBM-exhausted compiles as an
+            # opaque INTERNAL/HTTP-500 ("tpu_compile_helper subprocess
+            # exit code 1") — the real "Ran out of memory in memory space
+            # hbm" text only reaches the helper's log. Retrying a smaller
+            # batch is correct for OOM and harmless for a genuine compile
+            # bug (every batch fails → the last error still surfaces).
+            "tpu_compile_helper", "remote_compile"))
 
     dt = n_params = batch = None
     last_err = None

@@ -129,7 +129,8 @@ def default_loss_fn(model: Module, strategy: Strategy,
                           positions=batch.get("positions"),
                           segment_ids=batch.get("segment_ids"),
                           attn_impl=attn_impl, remat=remat,
-                          remat_mask=strategy.remat_mask)
+                          remat_mask=strategy.remat_mask,
+                          unroll=strategy.unroll)
 
     return loss_fn
 

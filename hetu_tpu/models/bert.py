@@ -110,11 +110,11 @@ class BertModel(Module):
 
     def backbone(self, params, input_ids, *, positions=None,
                  segment_ids=None, token_type_ids=None,
-                 attn_impl="auto", remat="none", remat_mask=None):
+                 attn_impl="auto", remat="none", remat_mask=None, unroll=False):
         h = self.embed(params, input_ids, positions=positions,
                        token_type_ids=token_type_ids)
         h = self.blocks(params["blocks"], h, remat=remat,
-                        remat_mask=remat_mask,
+                        remat_mask=remat_mask, unroll=unroll,
                         segment_ids=segment_ids, attn_impl=attn_impl)
         return h, jnp.zeros([], jnp.float32)
 

@@ -143,12 +143,12 @@ class LlamaLMHeadModel(Module):
 
     def backbone(self, params, input_ids, *, positions=None,
                  segment_ids=None, attn_impl="auto", remat="none",
-                 remat_mask=None):
+                 remat_mask=None, unroll=False):
         """embed + blocks, WITHOUT the final norm (head_loss applies it).
         Returns ``(h, aux)`` — aux is 0 for dense models."""
         h = self.embed(params, input_ids)
         out = self.blocks(params["blocks"], h, remat=remat,
-                          remat_mask=remat_mask,
+                          remat_mask=remat_mask, unroll=unroll,
                           positions=positions, segment_ids=segment_ids,
                           attn_impl=attn_impl)
         if self.blocks.returns_aux:

@@ -313,7 +313,13 @@ def remat_policy(name: str):
     if name == "full":
         return jax.checkpoint_policies.nothing_saveable
     if name == "selective":
-        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        # dots + the flash-attention kernel residuals (tagged in
+        # ``ops.flash_pallas._flash_core_fwd``): saving out/lse means the
+        # backward runs only the flash bwd kernels, not fwd again
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"))
     if name == "offload":
         make = getattr(jax.checkpoint_policies,
                        "offload_dot_with_no_batch_dims", None)
@@ -376,12 +382,19 @@ class StackedBlocks(Module):
         return self._block.returns_aux
 
     def __call__(self, params, x, *, remat: str = "none",
-                 remat_mask: Optional[Sequence[bool]] = None, **kwargs):
+                 remat_mask: Optional[Sequence[bool]] = None,
+                 unroll: bool = False, **kwargs):
         """``remat_mask``: per-layer recompute flags (the reference's
         per-block recompute config, ``recompute.h:12`` via ds-config
         ``recompute_config``; emitted by ``search_layerwise``). Layers are
         grouped into consecutive runs, one scan per run, remat applied to
-        the True runs (policy = ``remat`` or "full" when remat is none)."""
+        the True runs (policy = ``remat`` or "full" when remat is none).
+
+        ``unroll`` unrolls the layer scan into straight-line code: XLA
+        then schedules across layer boundaries and drops the per-layer
+        dynamic-update-slice residual stacking (measurably faster on a
+        single chip; costs compile time ∝ layers)."""
+        unroll_n = self.num_layers if unroll else 1
         if self._block.returns_aux:
             def body(carry, layer_params):
                 h, aux = carry
@@ -415,7 +428,8 @@ class StackedBlocks(Module):
             for lo, hi, flag in runs:
                 seg = jax.tree.map(lambda p: p[lo:hi], params)
                 b = rematted(body, policy_name) if flag else body
-                carry, _ = jax.lax.scan(b, carry, seg)
+                carry, _ = jax.lax.scan(b, carry, seg,
+                                        unroll=hi - lo if unroll else 1)
             if self._block.returns_aux:
                 return carry
             return carry
@@ -423,9 +437,9 @@ class StackedBlocks(Module):
         if remat != "none":
             body = rematted(body, remat)
         if self._block.returns_aux:
-            (x, aux), _ = jax.lax.scan(body, carry0, params)
+            (x, aux), _ = jax.lax.scan(body, carry0, params, unroll=unroll_n)
             return x, aux
-        x, _ = jax.lax.scan(body, x, params)
+        x, _ = jax.lax.scan(body, x, params, unroll=unroll_n)
         return x
 
     def decode(self, params, x, caches, **kwargs):

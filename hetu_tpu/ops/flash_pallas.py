@@ -475,10 +475,19 @@ def _flash_core(q, k, v, q_seg, kv_seg, causal, scale, interpret, blocks):
 
 def _flash_core_fwd(q, k, v, q_seg, kv_seg, causal, scale, interpret,
                     blocks):
+    from jax.ad_checkpoint import checkpoint_name
     qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     out, lse = _flash_fwd(qh, kh, vh, q_seg, kv_seg, causal=causal,
                           scale=scale, interpret=interpret,
                           block_q=blocks[0], block_k=blocks[1])
+    # Name the kernel residuals so remat policies can pin them: without
+    # these tags, ``remat="selective"`` recomputes the whole forward
+    # kernel inside the backward (saving dots doesn't cover a Pallas
+    # custom call). ``remat_policy`` adds save_only_these_names on top of
+    # the dots policy; cost is one (b,s,h,d) bf16 + one (b,h,s) fp32 per
+    # layer.
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return jnp.swapaxes(out, 1, 2), (qh, kh, vh, q_seg, kv_seg, out, lse)
 
 

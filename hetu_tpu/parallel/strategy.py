@@ -51,6 +51,9 @@ class Strategy:
                                  # over tp (activation memory / tp)
     remat_mask: Optional[tuple] = None   # per-layer recompute flags
                                  # (search_layerwise output; None = uniform)
+    unroll: bool = False         # unroll the layer scan (straight-line
+                                 # code: faster single-stage, compile
+                                 # time grows with depth; pp>1 ignores)
 
     # -- derived -----------------------------------------------------------
     @property

@@ -1,0 +1,96 @@
+"""Sweep batch x remat for the GPT-2 pretrain step on the local chip.
+
+Finds the highest-MFU configuration for ``bench.py`` (BASELINE config 2).
+MFU accounting counts model FLOPs only (PaLM appendix B), so remat must buy
+a bigger batch than its recompute overhead costs to win.
+
+Usage: python workloads/mfu_sweep.py [--steps 10]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=1024)
+    args = ap.parse_args()
+
+    from bench import peak_flops, model_flops_per_token
+    from hetu_tpu.utils.profiler import sync_result
+    from hetu_tpu import optim
+    from hetu_tpu.core.dtypes import Policy, autocast
+    from hetu_tpu.engine import make_plan, init_state, build_train_step
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+    from hetu_tpu.parallel.strategy import Strategy
+
+    dev = jax.devices()[0]
+    peak = peak_flops(dev)
+    if not peak:
+        raise SystemExit(f"no TPU (device {dev.device_kind!r}) — the sweep "
+                         "measures MFU on real hardware only; use bench.py "
+                         "for the CPU smoke path")
+    cfg = GPTConfig.small()
+    model = GPTLMHeadModel(cfg)
+    opt = optim.adamw(1e-4, weight_decay=0.01)
+    policy = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)
+    seq = args.seq
+
+    grid = [
+        (8, "none", False), (8, "none", True),
+        (16, "selective", True), (32, "selective", False),
+        (32, "selective", True), (64, "selective", True),
+        (32, "full", True),
+    ]
+    print(f"device={dev.device_kind} peak={peak/1e12:.0f}TF/s seq={seq}")
+    print(f"{'batch':>5} {'remat':>10} {'unroll':>6} {'step_ms':>8} "
+          f"{'tok/s':>9} {'mfu':>6}")
+    results = []
+    for batch, remat, unroll in grid:
+        strategy = Strategy(remat=remat, unroll=unroll)
+        try:
+            with autocast(policy):
+                plan = make_plan(model, opt, strategy)
+                state = init_state(model, opt, plan, jax.random.key(0))
+                step = build_train_step(model, opt, plan)
+                ids = jax.random.randint(jax.random.key(1),
+                                         (batch, seq + 1), 0, cfg.vocab_size)
+                b = plan.shard_batch({"input_ids": ids[:, :-1],
+                                      "labels": ids[:, 1:]})
+                for _ in range(max(1, args.warmup)):
+                    state, m = step(state, b)
+                sync_result(m["loss"])
+                t0 = time.perf_counter()
+                for _ in range(args.steps):
+                    state, m = step(state, b)
+                sync_result(m["loss"])
+                dt = (time.perf_counter() - t0) / args.steps
+            n = sum(x.size for x in jax.tree.leaves(state.params))
+            tps = batch * seq / dt
+            mfu = model_flops_per_token(cfg, n, seq) * tps / peak
+            print(f"{batch:>5} {remat:>10} {unroll!s:>6} {dt*1e3:>8.1f} "
+                  f"{tps:>9.0f} {mfu:>6.4f}")
+            results.append((mfu, batch, remat, unroll))
+        except Exception as e:
+            msg = str(e).splitlines()[0][:80] if str(e) else type(e).__name__
+            print(f"{batch:>5} {remat:>10} {unroll!s:>6}   FAIL {msg}")
+        finally:
+            # free HBM between configs (state/step hold the arrays)
+            state = step = plan = b = None
+    if results:
+        best = max(results)
+        print(f"best: batch={best[1]} remat={best[2]} unroll={best[3]} "
+              f"mfu={best[0]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -119,18 +119,31 @@ def effective_remat(strategy: Strategy) -> str:
     return strategy.remat
 
 
+def model_dropout_active(model: Module) -> bool:
+    """True iff the model's config enables any dropout rate."""
+    cfg = getattr(model, "cfg", None)
+    return any(getattr(cfg, f, 0.0) > 0.0 for f in
+               ("embd_pdrop", "resid_pdrop", "hidden_pdrop"))
+
+
 def default_loss_fn(model: Module, strategy: Strategy,
                     attn_impl: str = "auto") -> Callable:
-    """loss(params, batch) for LM models exposing ``.loss``."""
+    """loss(params, batch[, dropout_key]) for LM models exposing ``.loss``.
+
+    ``dropout_key`` is threaded by the train step (derived from
+    ``state.step``, so a resumed run reproduces the same mask sequence);
+    eval paths omit it and dropout is off.
+    """
     remat = effective_remat(strategy)
 
-    def loss_fn(params, batch):
+    def loss_fn(params, batch, dropout_key=None):
         return model.loss(params, batch["input_ids"], batch["labels"],
                           positions=batch.get("positions"),
                           segment_ids=batch.get("segment_ids"),
                           attn_impl=attn_impl, remat=remat,
                           remat_mask=strategy.remat_mask,
-                          unroll=strategy.unroll)
+                          unroll=strategy.unroll,
+                          dropout_key=dropout_key)
 
     return loss_fn
 
@@ -151,6 +164,12 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
                 "custom loss_fn is not supported with pp > 1 — the pipeline "
                 "executor schedules model.embed/blocks/head_loss itself; "
                 "override model.head_loss instead")
+        if model_dropout_active(model):
+            raise NotImplementedError(
+                "dropout under pp > 1 is not wired into the pipeline "
+                "executor yet — set the config's *_pdrop rates to 0 for "
+                "pipeline strategies (silently skipping dropout would "
+                "change the training recipe)")
         from hetu_tpu.parallel.pipeline import build_pipeline_train_step
         return build_pipeline_train_step(model, opt, plan,
                                          attn_impl=attn_impl, donate=donate)
@@ -158,20 +177,40 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
     base_loss = loss_fn or default_loss_fn(model, strategy, attn_impl)
     nm = strategy.num_microbatches
 
-    def compute_loss(params, batch):
+    # thread dropout keys only when the model config asks for dropout AND
+    # the loss fn accepts them (custom loss fns keep their 2-arg form)
+    import inspect
+    thread_dropout = model_dropout_active(model) and \
+        "dropout_key" in inspect.signature(base_loss).parameters
+    if model_dropout_active(model) and not thread_dropout:
+        import warnings
+        warnings.warn(
+            "model config enables dropout but the custom loss_fn has no "
+            "dropout_key parameter — dropout will be OFF; accept a "
+            "dropout_key kwarg (and pass it to model.loss) to enable it",
+            stacklevel=2)
+
+    def compute_loss(params, batch, dropout_key=None):
         with plan.act:
+            if thread_dropout:
+                return base_loss(params, batch, dropout_key=dropout_key)
             return base_loss(params, batch)
 
     grad_fn = jax.value_and_grad(compute_loss)
 
     def step(state: TrainState, batch: dict):
+        # deterministic per-step key: resume-at-step-N reproduces masks
+        key = jax.random.fold_in(jax.random.key(0x0d0), state.step) \
+            if thread_dropout else None
         if nm > 1:
             mbs = jax.tree.map(
                 lambda x: x.reshape((nm, x.shape[0] // nm) + x.shape[1:]),
                 batch)
 
-            def body(acc, mb):
-                loss, grads = grad_fn(state.params, mb)
+            def body(acc, xs):
+                mb, i = xs
+                mb_key = None if key is None else jax.random.fold_in(key, i)
+                loss, grads = grad_fn(state.params, mb, mb_key)
                 acc_loss, acc_g = acc
                 return (acc_loss + loss,
                         jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
@@ -180,11 +219,12 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
             zeros = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
             (loss, grads), _ = jax.lax.scan(
-                body, (jnp.zeros([], jnp.float32), zeros), mbs)
+                body, (jnp.zeros([], jnp.float32), zeros),
+                (mbs, jnp.arange(nm)))
             loss = loss / nm
             grads = jax.tree.map(lambda g: g / nm, grads)
         else:
-            loss, grads = grad_fn(state.params, batch)
+            loss, grads = grad_fn(state.params, batch, key)
 
         gnorm = global_norm(grads)
         updates, new_opt = opt.update(grads, state.opt_state, state.params)

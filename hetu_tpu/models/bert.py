@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 
 from hetu_tpu.nn.layers import Embedding, LayerNorm
@@ -20,6 +21,7 @@ from hetu_tpu.nn.module import Module, normal_init
 from hetu_tpu.nn.parallel import (
     ParallelAttention, ParallelMLP, StackedBlocks, VocabParallelEmbedding,
 )
+from hetu_tpu.ops.dropout import dropout
 from hetu_tpu.ops.losses import vocab_parallel_lm_loss
 from hetu_tpu.parallel.sharding import act_constrain
 
@@ -35,6 +37,8 @@ class BertConfig:
     mlp_ratio: int = 4
     layer_norm_eps: float = 1e-12
     init_std: float = 0.02
+    hidden_pdrop: float = 0.0   # BERT-standard is 0.1; keys come from
+                                # the train step, eval never drops
 
     @classmethod
     def base(cls):
@@ -59,15 +63,21 @@ class BertBlock(Module):
                                cfg.mlp_ratio * cfg.hidden_size,
                                bias=True, gated=False)
         self.ln_mlp = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
+        self.hidden_pdrop = cfg.hidden_pdrop
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
-                 attn_impl="auto"):
+                 attn_impl="auto", dropout_key=None):
+        k1 = k2 = None
+        if dropout_key is not None and self.hidden_pdrop > 0:
+            k1, k2 = jax.random.split(dropout_key)
         a = self.attn(params["attn"], x, segment_ids=segment_ids,
                       attn_impl=attn_impl)
-        x = self.ln_attn(params["ln_attn"], x + a)
+        x = self.ln_attn(params["ln_attn"],
+                         x + dropout(a, self.hidden_pdrop, k1))
         h = self.mlp(params["mlp"], x)
-        return act_constrain(self.ln_mlp(params["ln_mlp"], x + h),
-                             "tokens")
+        return act_constrain(
+            self.ln_mlp(params["ln_mlp"],
+                        x + dropout(h, self.hidden_pdrop, k2)), "tokens")
 
 
 class BertModel(Module):
@@ -110,12 +120,18 @@ class BertModel(Module):
 
     def backbone(self, params, input_ids, *, positions=None,
                  segment_ids=None, token_type_ids=None,
-                 attn_impl="auto", remat="none", remat_mask=None, unroll=False):
+                 attn_impl="auto", remat="none", remat_mask=None,
+                 unroll=False, dropout_key=None):
+        k_embd = k_blocks = None
+        if dropout_key is not None:
+            k_embd, k_blocks = jax.random.split(dropout_key)
         h = self.embed(params, input_ids, positions=positions,
                        token_type_ids=token_type_ids)
+        h = dropout(h, self.cfg.hidden_pdrop, k_embd)
         h = self.blocks(params["blocks"], h, remat=remat,
                         remat_mask=remat_mask, unroll=unroll,
-                        segment_ids=segment_ids, attn_impl=attn_impl)
+                        segment_ids=segment_ids, attn_impl=attn_impl,
+                        dropout_key=k_blocks)
         return h, jnp.zeros([], jnp.float32)
 
     def hidden_states(self, params, input_ids, **kw):

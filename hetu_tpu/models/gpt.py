@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 
 from hetu_tpu.nn.layers import Embedding, LayerNorm
@@ -19,6 +20,7 @@ from hetu_tpu.nn.module import Module, normal_init
 from hetu_tpu.nn.parallel import (
     ParallelAttention, ParallelMLP, StackedBlocks, VocabParallelEmbedding,
 )
+from hetu_tpu.ops.dropout import dropout
 from hetu_tpu.ops.losses import vocab_parallel_lm_loss
 from hetu_tpu.parallel.sharding import act_constrain
 
@@ -33,6 +35,12 @@ class GPTConfig:
     mlp_ratio: int = 4
     layer_norm_eps: float = 1e-5
     init_std: float = 0.02
+    # dropout (reference: ``graph/ops/Dropout.*`` wired into its GPT
+    # model; 0.0 default keeps pretrain benches deterministic — GPT-2's
+    # original recipe uses 0.1). Applied via explicit PRNG keys threaded
+    # by the train step; eval paths never drop.
+    embd_pdrop: float = 0.0
+    resid_pdrop: float = 0.0
     # MoE (0 experts = dense; parity: HetuMoE GPT, BASELINE config 4)
     num_experts: int = 0
     moe_top_k: int = 2
@@ -68,6 +76,7 @@ class GPTBlock(Module):
             cfg.hidden_size, cfg.num_heads, bias=True, causal=True,
             use_rope=False, init=normal_init(cfg.init_std))
         self.ln_2 = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
+        self.resid_pdrop = cfg.resid_pdrop
         if cfg.num_experts > 0:
             from hetu_tpu.nn.moe import MoEMLP
             self.mlp = MoEMLP(cfg.hidden_size,
@@ -81,7 +90,7 @@ class GPTBlock(Module):
                                    bias=True, gated=False)
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
-                 attn_impl="auto", kv_cache=None):
+                 attn_impl="auto", kv_cache=None, dropout_key=None):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
                                      self.ln_1(params["ln_1"], x),
@@ -94,13 +103,18 @@ class GPTBlock(Module):
             return x + h, new_cache
         # positions only matter for decode (GPT's learned position
         # embedding is applied in embed(), not per block)
-        x = x + self.attn(params["attn"], self.ln_1(params["ln_1"], x),
-                          segment_ids=segment_ids, attn_impl=attn_impl)
+        k1 = k2 = None
+        if dropout_key is not None and self.resid_pdrop > 0:
+            k1, k2 = jax.random.split(dropout_key)
+        a = self.attn(params["attn"], self.ln_1(params["ln_1"], x),
+                      segment_ids=segment_ids, attn_impl=attn_impl)
+        x = x + dropout(a, self.resid_pdrop, k1)
         h = self.mlp(params["mlp"], self.ln_2(params["ln_2"], x))
         if self.returns_aux:
             h, aux = h
-            return act_constrain(x + h, "tokens"), aux
-        return act_constrain(x + h, "tokens")
+            return act_constrain(
+                x + dropout(h, self.resid_pdrop, k2), "tokens"), aux
+        return act_constrain(x + dropout(h, self.resid_pdrop, k2), "tokens")
 
 
 class GPTLMHeadModel(Module):
@@ -133,14 +147,20 @@ class GPTLMHeadModel(Module):
 
     def backbone(self, params, input_ids, *, positions=None,
                  segment_ids=None, attn_impl="auto", remat="none",
-                 remat_mask=None, unroll=False):
+                 remat_mask=None, unroll=False, dropout_key=None):
         """embed + blocks, WITHOUT the final norm (head_loss applies it).
         Returns ``(h, aux)`` — aux is 0 for dense models, the accumulated
-        MoE load-balance loss otherwise."""
+        MoE load-balance loss otherwise. ``dropout_key=None`` (the eval
+        default) disables dropout regardless of config rates."""
+        k_embd = k_blocks = None
+        if dropout_key is not None:
+            k_embd, k_blocks = jax.random.split(dropout_key)
         h = self.embed(params, input_ids, positions=positions)
+        h = dropout(h, self.cfg.embd_pdrop, k_embd)
         out = self.blocks(params["blocks"], h, remat=remat,
                           remat_mask=remat_mask, unroll=unroll,
-                          segment_ids=segment_ids, attn_impl=attn_impl)
+                          segment_ids=segment_ids, attn_impl=attn_impl,
+                          dropout_key=k_blocks)
         if self.blocks.returns_aux:
             return out
         return out, jnp.zeros([], jnp.float32)

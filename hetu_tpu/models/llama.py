@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from hetu_tpu.nn.layers import RMSNorm
@@ -20,6 +21,7 @@ from hetu_tpu.nn.parallel import (
     ColumnParallelLinear, ParallelAttention, ParallelMLP, StackedBlocks,
     VocabParallelEmbedding,
 )
+from hetu_tpu.ops.dropout import dropout
 from hetu_tpu.ops.losses import vocab_parallel_lm_loss
 from hetu_tpu.parallel.sharding import act_constrain
 
@@ -38,6 +40,9 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     init_std: float = 0.02
     tie_embeddings: bool = False
+    # residual dropout (0.0 = Llama-standard; nonzero is the common SFT
+    # regularizer). Keys threaded by the train step; eval never drops.
+    resid_pdrop: float = 0.0
     # MoE (0 experts = dense; experts are SwiGLU like the dense MLP)
     num_experts: int = 0
     moe_top_k: int = 2
@@ -82,9 +87,10 @@ class LlamaBlock(Module):
         else:
             self.mlp = ParallelMLP(cfg.hidden_size, cfg.intermediate_size,
                                    bias=False, gated=True)
+        self.resid_pdrop = cfg.resid_pdrop
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
-                 attn_impl="auto", kv_cache=None):
+                 attn_impl="auto", kv_cache=None, dropout_key=None):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
                                      self.input_norm(
@@ -97,16 +103,22 @@ class LlamaBlock(Module):
             if self.returns_aux:
                 h = h[0]  # aux is train-only
             return x + h, new_cache
-        x = x + self.attn(params["attn"],
-                          self.input_norm(params["input_norm"], x),
-                          positions=positions, segment_ids=segment_ids,
-                          attn_impl=attn_impl)
+        k1 = k2 = None
+        if dropout_key is not None and self.resid_pdrop > 0:
+            k1, k2 = jax.random.split(dropout_key)
+        a = self.attn(params["attn"],
+                      self.input_norm(params["input_norm"], x),
+                      positions=positions, segment_ids=segment_ids,
+                      attn_impl=attn_impl)
+        x = x + dropout(a, self.resid_pdrop, k1)
         h = self.mlp(params["mlp"],
                      self.post_attn_norm(params["post_attn_norm"], x))
         if self.returns_aux:
             h, aux = h
-            return act_constrain(x + h, "tokens"), aux
-        return act_constrain(x + h, "tokens")
+            return act_constrain(
+                x + dropout(h, self.resid_pdrop, k2), "tokens"), aux
+        return act_constrain(x + dropout(h, self.resid_pdrop, k2),
+                             "tokens")
 
 
 class LlamaLMHeadModel(Module):
@@ -143,14 +155,14 @@ class LlamaLMHeadModel(Module):
 
     def backbone(self, params, input_ids, *, positions=None,
                  segment_ids=None, attn_impl="auto", remat="none",
-                 remat_mask=None, unroll=False):
+                 remat_mask=None, unroll=False, dropout_key=None):
         """embed + blocks, WITHOUT the final norm (head_loss applies it).
         Returns ``(h, aux)`` — aux is 0 for dense models."""
         h = self.embed(params, input_ids)
         out = self.blocks(params["blocks"], h, remat=remat,
                           remat_mask=remat_mask, unroll=unroll,
                           positions=positions, segment_ids=segment_ids,
-                          attn_impl=attn_impl)
+                          attn_impl=attn_impl, dropout_key=dropout_key)
         if self.blocks.returns_aux:
             return out
         return out, jnp.zeros([], jnp.float32)

@@ -395,14 +395,27 @@ class StackedBlocks(Module):
         dynamic-update-slice residual stacking (measurably faster on a
         single chip; costs compile time ∝ layers)."""
         unroll_n = self.num_layers if unroll else 1
+        # per-layer dropout keys ride the scan as xs (None = deterministic)
+        dropout_key = kwargs.pop("dropout_key", None)
+        layer_keys = None if dropout_key is None \
+            else jax.random.split(dropout_key, self.num_layers)
+
+        def call_block(layer_params, h, xs_key):
+            if xs_key is not None:
+                return self._block(layer_params, h, dropout_key=xs_key,
+                                   **kwargs)
+            return self._block(layer_params, h, **kwargs)
+
         if self._block.returns_aux:
-            def body(carry, layer_params):
+            def body(carry, xs):
+                layer_params, xs_key = xs
                 h, aux = carry
-                h, a = self._block(layer_params, h, **kwargs)
+                h, a = call_block(layer_params, h, xs_key)
                 return (h, aux + a), None
         else:
-            def body(carry, layer_params):
-                return self._block(layer_params, carry, **kwargs), None
+            def body(carry, xs):
+                layer_params, xs_key = xs
+                return call_block(layer_params, carry, xs_key), None
 
         def rematted(b, policy_name):
             return jax.checkpoint(b, policy=remat_policy(policy_name),
@@ -427,8 +440,9 @@ class StackedBlocks(Module):
             carry = carry0
             for lo, hi, flag in runs:
                 seg = jax.tree.map(lambda p: p[lo:hi], params)
+                seg_keys = None if layer_keys is None else layer_keys[lo:hi]
                 b = rematted(body, policy_name) if flag else body
-                carry, _ = jax.lax.scan(b, carry, seg,
+                carry, _ = jax.lax.scan(b, carry, (seg, seg_keys),
                                         unroll=hi - lo if unroll else 1)
             if self._block.returns_aux:
                 return carry
@@ -437,9 +451,10 @@ class StackedBlocks(Module):
         if remat != "none":
             body = rematted(body, remat)
         if self._block.returns_aux:
-            (x, aux), _ = jax.lax.scan(body, carry0, params, unroll=unroll_n)
+            (x, aux), _ = jax.lax.scan(body, carry0, (params, layer_keys),
+                                       unroll=unroll_n)
             return x, aux
-        x, _ = jax.lax.scan(body, x, params, unroll=unroll_n)
+        x, _ = jax.lax.scan(body, x, (params, layer_keys), unroll=unroll_n)
         return x
 
     def decode(self, params, x, caches, **kwargs):

@@ -7,6 +7,7 @@ from hetu_tpu.ops.losses import (
     vocab_parallel_cross_entropy,
 )
 from hetu_tpu.ops.attention import attention_reference, flash_attention
+from hetu_tpu.ops.dropout import dropout
 
 __all__ = [
     "rms_norm", "layer_norm",
@@ -15,4 +16,5 @@ __all__ = [
     "softmax_cross_entropy", "cross_entropy_mean",
     "vocab_parallel_cross_entropy",
     "attention_reference", "flash_attention",
+    "dropout",
 ]

@@ -1,0 +1,31 @@
+"""Dropout.
+
+Equivalent of the reference's dropout ops (``hetu/graph/ops/Dropout.*``,
+kernels ``impl/kernel/Dropout.cu``) re-expressed functionally: no RNG
+state object — the caller supplies an explicit PRNG key (the train step
+derives one from ``state.step``, so a resumed run reproduces the same
+mask sequence, which is stronger than the reference's per-device RNG
+state snapshot).
+
+Inverted dropout: scales survivors by 1/(1-rate) so eval needs no
+rescale. ``key=None`` (eval / deterministic paths) or ``rate=0`` is the
+identity and costs nothing under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dropout(x: jnp.ndarray, rate: float,
+            key: Optional[jax.Array]) -> jnp.ndarray:
+    if key is None or rate <= 0.0:
+        return x
+    if rate >= 1.0:
+        raise ValueError(f"dropout rate must be < 1, got {rate}")
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros([], x.dtype)).astype(x.dtype)

@@ -27,7 +27,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from hetu_tpu.engine.state import TrainState
-from hetu_tpu.engine.train_step import default_loss_fn, make_plan
+from hetu_tpu.engine.train_step import (
+    default_loss_fn, make_plan, model_dropout_active,
+)
 from hetu_tpu.nn.module import Module
 from hetu_tpu.optim.base import Transform, apply_updates
 from hetu_tpu.parallel.strategy import Strategy
@@ -75,18 +77,20 @@ class HeteroDPTrainStep:
             self.plans.append(make_plan(model, opt, g.strategy(),
                                         devices=sub))
 
+        self._thread_dropout = model_dropout_active(model)
+
         def make_grad(plan):
             base = default_loss_fn(model, plan.strategy, attn_impl)
 
-            def loss_tokens(params, batch):
+            def loss_tokens(params, batch, key):
                 with plan.act:
-                    loss = base(params, batch)
+                    loss = base(params, batch, dropout_key=key)
                 valid = jnp.sum(batch["labels"] != -100)
                 return loss, valid
 
-            def grad_fn(params, batch):
+            def grad_fn(params, batch, key):
                 (loss, valid), grads = jax.value_and_grad(
-                    loss_tokens, has_aux=True)(params, batch)
+                    loss_tokens, has_aux=True)(params, batch, key)
                 return loss, valid, grads
 
             return jax.jit(grad_fn)
@@ -119,13 +123,20 @@ class HeteroDPTrainStep:
                 f"groups")
         # fan params out to every group's mesh (dp replication across
         # meshes), dispatch all grads before any host sync
+        # per-step dropout key, folded per group (same derivation as
+        # build_train_step, so resume reproduces the mask sequence)
+        step_key = jax.random.fold_in(jax.random.key(0x0d0), state.step) \
+            if self._thread_dropout else None
         results = []
-        for plan, grad_fn, batch in zip(self.plans, self._grads, batches):
+        for i, (plan, grad_fn, batch) in enumerate(
+                zip(self.plans, self._grads, batches)):
             params_g = jax.device_put(state.params,
                                       plan.state_shardings.params) \
                 if plan is not self.plans[0] else state.params
             sbatch = plan.shard_batch(batch)
-            results.append(grad_fn(params_g, sbatch))
+            key_g = None if step_key is None \
+                else jax.random.fold_in(step_key, i)
+            results.append(grad_fn(params_g, sbatch, key_g))
 
         # token-weighted combine on group 0's mesh = global-mean grads
         tokens = [float(jax.device_get(v)) for _, v, _ in results]

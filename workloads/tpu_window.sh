@@ -1,0 +1,28 @@
+#!/bin/bash
+# Run the full TPU measurement batch in priority order — the tunnel to the
+# chip has limited availability windows, so when one opens, fire this once
+# and collect everything. Outputs land in workloads/out/.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p workloads/out
+run() {
+  name=$1; shift; tmo=$1; shift
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  timeout "$tmo" "$@" >"workloads/out/$name.txt" 2>"workloads/out/$name.err"
+  echo "rc=$? (tail)"; tail -5 "workloads/out/$name.txt"
+}
+# 0. health probe (fail fast if the tunnel is down)
+timeout 120 python -c "import jax; x=jax.numpy.ones((512,512)); print((x@x).sum(), jax.devices()[0].device_kind)" || { echo "TPU DOWN"; exit 1; }
+# 1. the bench config sweep (feeds bench.py defaults)
+run mfu_sweep 1500 python workloads/mfu_sweep.py
+# 2. the headline bench itself
+run bench 900 python bench.py
+# 3. flash kernel vs XLA attention
+run attn_bench 900 python workloads/attn_bench.py
+# 4. BASELINE configs 1/3/4/5
+run bench_suite 1800 python workloads/bench_suite.py
+# 5. cost-model calibration against real step times
+run calibrate 1500 python workloads/calibrate_run.py
+# 6. ICI collectives (single chip: dispatch overhead reference)
+run collectives 600 python workloads/collectives.py
+echo "=== done ($(date +%H:%M:%S)) ==="

@@ -119,6 +119,13 @@ def effective_remat(strategy: Strategy) -> str:
     return strategy.remat
 
 
+def step_dropout_key(step) -> jax.Array:
+    """Per-step dropout base key. One definition shared by every train
+    path (plain/pipeline/hetero-dp) — the resume-reproducibility guarantee
+    (same step => same masks) depends on them deriving keys identically."""
+    return jax.random.fold_in(jax.random.key(0x0d0), step)
+
+
 def model_dropout_active(model: Module) -> bool:
     """True iff the model's config enables any dropout rate."""
     cfg = getattr(model, "cfg", None)
@@ -164,12 +171,6 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
                 "custom loss_fn is not supported with pp > 1 — the pipeline "
                 "executor schedules model.embed/blocks/head_loss itself; "
                 "override model.head_loss instead")
-        if model_dropout_active(model):
-            raise NotImplementedError(
-                "dropout under pp > 1 is not wired into the pipeline "
-                "executor yet — set the config's *_pdrop rates to 0 for "
-                "pipeline strategies (silently skipping dropout would "
-                "change the training recipe)")
         from hetu_tpu.parallel.pipeline import build_pipeline_train_step
         return build_pipeline_train_step(model, opt, plan,
                                          attn_impl=attn_impl, donate=donate)
@@ -180,8 +181,11 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
     # thread dropout keys only when the model config asks for dropout AND
     # the loss fn accepts them (custom loss fns keep their 2-arg form)
     import inspect
-    thread_dropout = model_dropout_active(model) and \
-        "dropout_key" in inspect.signature(base_loss).parameters
+    sig = inspect.signature(base_loss)
+    accepts_key = "dropout_key" in sig.parameters or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD
+        for p in sig.parameters.values())
+    thread_dropout = model_dropout_active(model) and accepts_key
     if model_dropout_active(model) and not thread_dropout:
         import warnings
         warnings.warn(
@@ -200,8 +204,7 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
 
     def step(state: TrainState, batch: dict):
         # deterministic per-step key: resume-at-step-N reproduces masks
-        key = jax.random.fold_in(jax.random.key(0x0d0), state.step) \
-            if thread_dropout else None
+        key = step_dropout_key(state.step) if thread_dropout else None
         if nm > 1:
             mbs = jax.tree.map(
                 lambda x: x.reshape((nm, x.shape[0] // nm) + x.shape[1:]),

@@ -28,7 +28,7 @@ import numpy as np
 
 from hetu_tpu.engine.state import TrainState
 from hetu_tpu.engine.train_step import (
-    default_loss_fn, make_plan, model_dropout_active,
+    default_loss_fn, make_plan, model_dropout_active, step_dropout_key,
 )
 from hetu_tpu.nn.module import Module
 from hetu_tpu.optim.base import Transform, apply_updates
@@ -125,7 +125,7 @@ class HeteroDPTrainStep:
         # meshes), dispatch all grads before any host sync
         # per-step dropout key, folded per group (same derivation as
         # build_train_step, so resume reproduces the mask sequence)
-        step_key = jax.random.fold_in(jax.random.key(0x0d0), state.step) \
+        step_key = step_dropout_key(state.step) \
             if self._thread_dropout else None
         results = []
         for i, (plan, grad_fn, batch) in enumerate(

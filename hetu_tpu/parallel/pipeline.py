@@ -62,28 +62,44 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
 
     def device_fn(params_local, payload_all):
         stage = jax.lax.axis_index(pp_axis)
+        n_local = jax.tree.leaves(params_local)[0].shape[0]
 
-        def one_block(h, layer_params, extras):
+        def one_block(h, layer_params, extras, layer_idx):
+            extras = dict(extras)
+            rng = extras.pop("dropout_rng", None)
+            if rng is not None:
+                # per-microbatch raw key rides the payload (key arrays
+                # can't ppermute); fold by the *global* layer index so
+                # each (microbatch, layer) gets an independent mask
+                key = jax.random.wrap_key_data(rng)
+                key = jax.random.fold_in(key, stage * n_local + layer_idx)
+                if manual_ep:   # decorrelate the ep-sharded row groups
+                    key = jax.random.fold_in(
+                        key, jax.lax.axis_index("ep"))
+                extras["dropout_key"] = key
             return block_fn(layer_params, h, **extras)
 
         if remat != "none":
             one_block = jax.checkpoint(
                 one_block, policy=remat_policy(remat), prevent_cse=False)
 
+        layer_ids = jnp.arange(n_local)
+
         def stage_fn(cur):
             extras = {k: v for k, v in cur.items()
                       if k not in ("x", "aux")}
             if block_returns_aux:
-                def body(carry, lp):
+                def body(carry, xs):
+                    lp, li = xs
                     h, aux = carry
-                    h, a = one_block(h, lp, extras)
+                    h, a = one_block(h, lp, extras, li)
                     return (h, aux + a), None
                 (x, aux), _ = jax.lax.scan(
-                    body, (cur["x"], cur["aux"]), params_local)
+                    body, (cur["x"], cur["aux"]), (params_local, layer_ids))
                 return {**cur, "x": x, "aux": aux}
             x, _ = jax.lax.scan(
-                lambda h, lp: (one_block(h, lp, extras), None),
-                cur["x"], params_local)
+                lambda h, xs: (one_block(h, xs[0], extras, xs[1]), None),
+                cur["x"], (params_local, layer_ids))
             return {**cur, "x": x}
 
         zero = jax.tree.map(lambda v: jnp.zeros_like(v[0]), payload_all)
@@ -127,7 +143,8 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
         # microbatch dim (axis 1 of every payload array) splits over the
         # manual ep axis; aux is replicated (MoE pmeans it per layer)
         payload_specs = {
-            k: (P() if k == "aux"
+            k: (P() if k in ("aux", "dropout_rng")   # rng: per-microbatch,
+                                                     # not per-row — replicate
                 else P(None, "ep", *([None] * (v.ndim - 2))))
             for k, v in payload.items()
         }
@@ -190,7 +207,7 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
         param_manual_specs = jax.tree.map(
             keep_manual, full, is_leaf=lambda x: isinstance(x, P))
 
-    def loss_fn(params, batch):
+    def loss_fn(params, batch, dropout_key=None):
         with plan.act:
             ids, labels = batch["input_ids"], batch["labels"]
             B, s = ids.shape
@@ -201,12 +218,27 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
             seg = batch.get("segment_ids")
 
             h0 = model.embed(params, ids, positions=positions)
+            if dropout_key is not None:
+                from hetu_tpu.ops.dropout import dropout as _drop
+                k_embd, k_blocks = jax.random.split(dropout_key)
+                # same rate the model's own backbone applies to the
+                # embedding output (GPT: embd_pdrop; BERT: hidden_pdrop)
+                embd_rate = getattr(
+                    model.cfg, "embd_pdrop",
+                    getattr(model.cfg, "hidden_pdrop", 0.0))
+                h0 = _drop(h0, embd_rate, k_embd)
             payload = {
                 "x": h0.reshape(nm, mb, *h0.shape[1:]),
                 "positions": positions.reshape(nm, mb, s),
             }
             if seg is not None:
                 payload["segment_ids"] = seg.reshape(nm, mb, s)
+            if dropout_key is not None:
+                # raw uint32 key data per microbatch (key arrays can't
+                # cross the shard_map/ppermute boundary)
+                payload["dropout_rng"] = jax.vmap(
+                    lambda i: jax.random.key_data(
+                        jax.random.fold_in(k_blocks, i)))(jnp.arange(nm))
 
             block = model.blocks.block
             block_fn = functools.partial(block, attn_impl=attn_impl)
@@ -228,9 +260,14 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
             return lm + coef * aux
 
     grad_fn = jax.value_and_grad(loss_fn)
+    from hetu_tpu.engine.train_step import (
+        model_dropout_active, step_dropout_key,
+    )
+    thread_dropout = model_dropout_active(model)
 
     def step(state: TrainState, batch: dict):
-        loss, grads = grad_fn(state.params, batch)
+        key = step_dropout_key(state.step) if thread_dropout else None
+        loss, grads = grad_fn(state.params, batch, key)
         gnorm = global_norm(grads)
         updates, new_opt = opt.update(grads, state.opt_state, state.params)
         new_params = apply_updates(state.params, updates)

@@ -46,3 +46,8 @@ def test_elastic_train_example():
     out = _run("elastic_train.py", timeout=600)
     assert '"generations": 2' in out
     assert "resumed at step" in out
+
+
+def test_sft_example():
+    out = _run("sft.py")
+    assert "final:" in out

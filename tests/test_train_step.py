@@ -221,10 +221,9 @@ def test_unroll_parity():
 
 
 def test_dropout_training():
-    """Dropout (reference ``graph/ops/Dropout.*``): active + stochastic
-    across steps in training, inert at rate 0, off in eval, and blocked
-    under pp (not yet threaded through the pipeline executor)."""
-    import pytest
+    """Dropout (reference ``graph/ops/Dropout.*``): active in training,
+    inert at rate 0, off in eval, and threaded through the pipeline
+    executor under pp."""
     from hetu_tpu.engine import build_eval_step
 
     kw = dict(vocab_size=256, max_positions=128, hidden_size=64,
@@ -255,9 +254,13 @@ def test_dropout_training():
     assert float(ev(state.params, plan.shard_batch(batch))) \
         == float(ev(state.params, plan.shard_batch(batch)))
 
-    with pytest.raises(NotImplementedError):
-        first_loss(GPTConfig(**kw, resid_pdrop=0.1),
-                   Strategy(pp=2, num_microbatches=2))
+    # dropout threads through the pipeline executor too (per-microbatch
+    # keys in the payload, folded by global layer index)
+    pp_base, _ = first_loss(GPTConfig(**kw),
+                            Strategy(pp=2, num_microbatches=2))
+    pp_drop, _ = first_loss(GPTConfig(**kw, resid_pdrop=0.3),
+                            Strategy(pp=2, num_microbatches=2))
+    assert abs(pp_drop - pp_base) > 1e-6
 
 
 def test_dropout_op():

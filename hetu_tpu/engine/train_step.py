@@ -182,17 +182,24 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
     # the loss fn accepts them (custom loss fns keep their 2-arg form)
     import inspect
     sig = inspect.signature(base_loss)
-    accepts_key = "dropout_key" in sig.parameters or any(
-        p.kind is inspect.Parameter.VAR_KEYWORD
-        for p in sig.parameters.values())
-    thread_dropout = model_dropout_active(model) and accepts_key
-    if model_dropout_active(model) and not thread_dropout:
+    explicit_key = "dropout_key" in sig.parameters
+    var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                 for p in sig.parameters.values())
+    thread_dropout = model_dropout_active(model) and \
+        (explicit_key or var_kw)
+    if model_dropout_active(model) and loss_fn is not None:
         import warnings
-        warnings.warn(
-            "model config enables dropout but the custom loss_fn has no "
-            "dropout_key parameter — dropout will be OFF; accept a "
-            "dropout_key kwarg (and pass it to model.loss) to enable it",
-            stacklevel=2)
+        if not thread_dropout:
+            warnings.warn(
+                "model config enables dropout but the custom loss_fn has "
+                "no dropout_key parameter — dropout will be OFF; accept a "
+                "dropout_key kwarg (and pass it to model.loss) to enable "
+                "it", stacklevel=2)
+        elif var_kw and not explicit_key:
+            warnings.warn(
+                "dropout_key will be passed to the custom loss_fn via "
+                "**kwargs — make sure it forwards the key to model.loss, "
+                "or dropout silently stays off", stacklevel=2)
 
     def compute_loss(params, batch, dropout_key=None):
         with plan.act:

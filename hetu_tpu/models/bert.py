@@ -101,6 +101,10 @@ class BertModel(Module):
         self.blocks = StackedBlocks(lambda: BertBlock(cfg), cfg.num_layers)
         self.ln_f = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
 
+    @property
+    def embed_dropout_rate(self) -> float:
+        return self.cfg.hidden_pdrop
+
     def embed(self, params, input_ids, *, positions=None,
               token_type_ids=None):
         s = input_ids.shape[-1]

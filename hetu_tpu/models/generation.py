@@ -5,7 +5,7 @@ The reference's inference path appends KV via a dynamic-concat op
 dynamic_shape was for padded inference). TPU-native: fixed-capacity KV
 buffers + ``dynamic_update_slice`` (static shapes for jit), prefill in one
 pass, then a ``lax.scan`` over decode steps with greedy / temperature /
-top-k sampling.
+top-k / nucleus (top-p) sampling.
 """
 
 from __future__ import annotations
@@ -45,19 +45,30 @@ def decode(model, params, input_ids, positions, caches):
     return logits, caches
 
 
-def _sample(logits, *, temperature: float, top_k: int, rng):
+def _sample(logits, *, temperature: float, top_k: int, top_p: float, rng):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass exceeds top_p (the top token always survives)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p           # mass *before* this token
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
 def generate(model, params, input_ids, *, max_new_tokens: int,
              max_len: Optional[int] = None, temperature: float = 0.0,
-             top_k: int = 0, rng: Optional[jax.Array] = None,
+             top_k: int = 0, top_p: float = 0.0,
+             rng: Optional[jax.Array] = None,
              eos_id: Optional[int] = None, cache_dtype=jnp.float32):
     """Generate ``max_new_tokens`` continuations for a (b, s) prompt.
 
@@ -74,7 +85,7 @@ def generate(model, params, input_ids, *, max_new_tokens: int,
     logits, caches = decode(model, params, input_ids, prefill_pos, caches)
     rng, sub = jax.random.split(rng)
     tok = _sample(logits[:, -1], temperature=temperature, top_k=top_k,
-                  rng=sub)
+                  top_p=top_p, rng=sub)
     done = jnp.zeros((b,), bool) if eos_id is None else (tok == eos_id)
 
     def step(carry, i):
@@ -83,7 +94,7 @@ def generate(model, params, input_ids, *, max_new_tokens: int,
         logits, caches = decode(model, params, tok[:, None], pos, caches)
         rng, sub = jax.random.split(rng)
         nxt = _sample(logits[:, -1], temperature=temperature,
-                      top_k=top_k, rng=sub)
+                      top_k=top_k, top_p=top_p, rng=sub)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)

@@ -130,6 +130,12 @@ class GPTLMHeadModel(Module):
         self.blocks = StackedBlocks(lambda: GPTBlock(cfg), cfg.num_layers)
         self.ln_f = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
 
+    @property
+    def embed_dropout_rate(self) -> float:
+        """Rate the backbone applies to the embedding output — consumed
+        by executors that schedule embed themselves (pipeline)."""
+        return self.cfg.embd_pdrop
+
     def embed(self, params, input_ids, *, positions=None):
         s = input_ids.shape[-1]
         if positions is None:

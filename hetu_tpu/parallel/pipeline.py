@@ -221,12 +221,10 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
             if dropout_key is not None:
                 from hetu_tpu.ops.dropout import dropout as _drop
                 k_embd, k_blocks = jax.random.split(dropout_key)
-                # same rate the model's own backbone applies to the
-                # embedding output (GPT: embd_pdrop; BERT: hidden_pdrop)
-                embd_rate = getattr(
-                    model.cfg, "embd_pdrop",
-                    getattr(model.cfg, "hidden_pdrop", 0.0))
-                h0 = _drop(h0, embd_rate, k_embd)
+                # the model owns its embed-dropout semantics — executors
+                # must not guess config spellings
+                h0 = _drop(h0, getattr(model, "embed_dropout_rate", 0.0),
+                           k_embd)
             payload = {
                 "x": h0.reshape(nm, mb, *h0.shape[1:]),
                 "positions": positions.reshape(nm, mb, s),

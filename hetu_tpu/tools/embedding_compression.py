@@ -1,0 +1,134 @@
+"""Embedding memory compression (recsys-scale embedding tables).
+
+TPU-native essential subset of the reference's
+``tools/EmbeddingMemoryCompression`` (~9.5k LoC of compression methods for
+HET/v1 recsys training — SURVEY §2.6 marks the full tool optional). The
+three methods that cover the tool's practical span, each a drop-in
+``nn.Module`` with the same ``(params, ids) -> (..., features)`` contract
+as :class:`~hetu_tpu.nn.layers.Embedding`:
+
+- :class:`HashEmbedding` — the hash trick with K independent hashes into a
+  small table, combined by sum (compositional/"QR"-style collision
+  mitigation). Memory: ``buckets × features`` regardless of vocab.
+- :class:`LowRankEmbedding` — factorized ``(V, r) @ (r, E)``; the dense
+  matmul form maps straight onto the MXU.
+- :class:`QuantizedEmbedding` — int8 rows + per-row fp32 scale, dequantized
+  at lookup (storage 4× smaller than fp32; XLA fuses the dequant into the
+  gather's consumer). Train-time: straight-through estimator — forward
+  uses the quantized value, gradients flow to the latent fp table.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.nn.module import Module, normal_init
+from hetu_tpu.ops.quantization import dequantize_int8, quantize_int8
+
+# per-hash xor salts; the shared avalanche mixer decorrelates the hash
+# family — a bare multiplicative hash ((id*p) % B) collides identically
+# under EVERY odd multiplier for ids congruent mod B, so salting before
+# bit-mixing (murmur3-style finalizer) is what makes K hashes independent
+_HASH_SALTS = (0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F)
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 fmix32: full-avalanche 32-bit mixer."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+class HashEmbedding(Module):
+    """Hash-trick embedding: ids hash into ``buckets`` rows ``num_hashes``
+    ways; the looked-up rows sum. Each hash salts then bit-mixes the id
+    (full avalanche), so two ids colliding under one hash almost surely
+    differ under another."""
+
+    def __init__(self, num_embeddings: int, features: int, *,
+                 buckets: int, num_hashes: int = 2, init=None):
+        super().__init__()
+        if num_hashes > len(_HASH_SALTS):
+            raise ValueError(f"num_hashes must be <= {len(_HASH_SALTS)}")
+        self.num_embeddings = num_embeddings
+        self.buckets = buckets
+        self.num_hashes = num_hashes
+        self.param("weight", (buckets, features),
+                   init or normal_init(0.02), axes=(None, "embed"))
+
+    def __call__(self, params, ids):
+        w = params["weight"].astype(self.compute_dtype())
+        out = 0
+        for i in range(self.num_hashes):
+            h = _mix32(ids.astype(jnp.uint32) ^ jnp.uint32(_HASH_SALTS[i]))
+            h = h % jnp.uint32(self.buckets)
+            out = out + jnp.take(w, h.astype(jnp.int32), axis=0)
+        return out
+
+    @property
+    def compression_ratio(self) -> float:
+        return self.num_embeddings / self.buckets
+
+
+class LowRankEmbedding(Module):
+    """Rank-``r`` factorized table: lookup in (V, r), project with (r, E)."""
+
+    def __init__(self, num_embeddings: int, features: int, *, rank: int,
+                 init=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.rank = rank
+        # balanced factor scales: std_f = std_p = sqrt(0.02/sqrt(r))
+        # gives the product a dense table's 0.02 init scale AND equal
+        # gradient magnitudes on both factors (unbalanced splits
+        # condition plain SGD badly: one factor's grads scale with the
+        # other's magnitude squared)
+        std = (0.02 / rank ** 0.5) ** 0.5
+        self.param("factors", (num_embeddings, rank),
+                   init or normal_init(std), axes=("vocab", None))
+        self.param("proj", (rank, features),
+                   init or normal_init(std), axes=(None, "embed"))
+
+    def __call__(self, params, ids):
+        dt = self.compute_dtype()
+        f = jnp.take(params["factors"].astype(dt), ids, axis=0)
+        return jnp.matmul(f, params["proj"].astype(dt))
+
+    @property
+    def compression_ratio(self) -> float:
+        E = self._param_specs["proj"].shape[1]
+        dense = self.num_embeddings * E
+        return dense / (self.num_embeddings * self.rank + self.rank * E)
+
+
+class QuantizedEmbedding(Module):
+    """int8-stored embedding with a latent fp32 table for training.
+
+    Forward looks up the *quantized* value (what inference will see);
+    the straight-through estimator routes gradients to the latent table.
+    ``quantized_state(params)`` exports (int8 rows, scales) for serving —
+    4x smaller than fp32, same layout the sharded checkpoint writer's
+    int8 storage uses (``utils/dist_checkpoint.py``).
+    """
+
+    def __init__(self, num_embeddings: int, features: int, init=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.param("weight", (num_embeddings, features),
+                   init or normal_init(0.02), axes=("vocab", "embed"))
+
+    def __call__(self, params, ids):
+        w = params["weight"]
+        rows = jnp.take(w, ids, axis=0)
+        q, scale = quantize_int8(rows, axis=-1)
+        deq = dequantize_int8(q, scale, jnp.float32)
+        # straight-through: forward sees deq, backward sees identity
+        out = rows + jax.lax.stop_gradient(deq - rows)
+        return out.astype(self.compute_dtype())
+
+    def quantized_state(self, params):
+        return quantize_int8(params["weight"], axis=-1)

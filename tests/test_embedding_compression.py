@@ -1,0 +1,69 @@
+"""Embedding compression tool (reference tools/EmbeddingMemoryCompression
+essential subset): each method trains a toy embedding regression to lower
+loss while actually compressing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.tools.embedding_compression import (
+    HashEmbedding, LowRankEmbedding, QuantizedEmbedding,
+)
+
+V, E, N = 1024, 32, 256
+
+
+def _fit(emb, steps=120, lr=300.0):
+    params = emb.init(jax.random.key(0), dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (N,), 0, V)
+    targets = jax.random.normal(jax.random.key(2), (N, E))
+
+    @jax.jit
+    def step(params):
+        def loss(p):
+            return jnp.mean((emb(p, ids) - targets) ** 2)
+        l, g = jax.value_and_grad(loss)(params)
+        return jax.tree.map(lambda p, gg: p - lr * gg, params, g), l
+
+    first = None
+    for _ in range(steps):
+        params, l = step(params)
+        first = first if first is not None else float(l)
+    return first, float(l), params
+
+
+def test_hash_embedding_compresses_and_trains():
+    emb = HashEmbedding(V, E, buckets=128, num_hashes=2)
+    assert emb.compression_ratio == V / 128
+    first, last, params = _fit(emb)
+    assert params["weight"].shape == (128, E)
+    # 2x128x32 params fitting 256x32 values: partial fit is the point
+    assert last < first * 0.8, (first, last)
+
+
+def test_lowrank_embedding_compresses_and_trains():
+    emb = LowRankEmbedding(V, E, rank=8)
+    assert emb.compression_ratio > 3
+    # the balanced factors need a gentler step than the direct tables
+    first, last, _ = _fit(emb, lr=30.0)
+    # rank-8 approximation of gaussian targets captures only the top
+    # singular directions — expect partial but real progress (floor ~0.66)
+    assert last < first * 0.75, (first, last)
+
+
+def test_quantized_embedding_ste_and_export():
+    emb = QuantizedEmbedding(V, E)
+    first, last, params = _fit(emb)
+    assert last < first * 0.15, (first, last)  # full capacity, just int8
+    q, scale = emb.quantized_state(params)
+    assert q.dtype == jnp.int8 and q.shape == (V, E)
+    # export reconstructs the table to int8 precision
+    np.testing.assert_allclose(
+        np.asarray(q, np.float32) * np.asarray(scale),
+        np.asarray(params["weight"]), atol=float(scale.max()) + 1e-6)
+
+
+def test_hash_embedding_rejects_too_many_hashes():
+    with pytest.raises(ValueError):
+        HashEmbedding(V, E, buckets=64, num_hashes=9)

@@ -148,3 +148,28 @@ def test_generate_under_tp_mesh_matches_single_device(rng):
         out = generate(model, sp, prompt, max_new_tokens=8,
                        temperature=0.0)
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_topp_sampling_restricts_support(rng):
+    """Nucleus sampling: with a peaked distribution and small top_p, only
+    the top token can be drawn; top_p≈1 leaves the support unrestricted."""
+    from hetu_tpu.models.generation import _sample
+
+    logits = jnp.log(jnp.asarray([[0.6, 0.25, 0.1, 0.05]]))
+    draws = jax.vmap(lambda k: _sample(
+        logits, temperature=1.0, top_k=0, top_p=0.5, rng=k))(
+        jax.random.split(jax.random.key(0), 64))
+    assert set(np.unique(np.asarray(draws))) == {0}
+    draws = jax.vmap(lambda k: _sample(
+        logits, temperature=1.0, top_k=0, top_p=0.999, rng=k))(
+        jax.random.split(jax.random.key(0), 256))
+    assert len(set(np.unique(np.asarray(draws)))) >= 3
+    # threads through generate()
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    params = model.init(rng, dtype=jnp.float32)
+    prompt = jax.random.randint(jax.random.key(3), (1, 4), 0,
+                                cfg.vocab_size)
+    out = generate(model, params, prompt, max_new_tokens=4,
+                   temperature=0.8, top_p=0.9, rng=jax.random.key(7))
+    assert out.shape == (1, 8)

@@ -1,0 +1,106 @@
+// Native BPE merge core — the data-path hot loop of the in-tree
+// byte-level BPE tokenizer (hetu_tpu/data/tokenizers.py).
+//
+// Equivalent role: the reference keeps its data-plane hot loops native
+// (C++ dataloader, hetu/graph/data/dataloader.h:18; vendored fast
+// tokenizers). Python side lowers token strings to int32 symbol ids
+// once, so the ABI here is integer-only: merges arrive as
+// (left_id, right_id) -> (rank, merged_id) and encoding a pre-token is
+// the classic greedy lowest-rank adjacent-merge loop.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 bpe.cpp -o libbpe.so
+// (compiled at first use by tokenizers.py, loaded via ctypes).
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct BpeTable {
+  // key: (left id << 32) | right id
+  std::unordered_map<uint64_t, std::pair<int32_t, int32_t>> merges;
+};
+
+inline uint64_t key_of(int32_t a, int32_t b) {
+  return (static_cast<uint64_t>(static_cast<uint32_t>(a)) << 32) |
+         static_cast<uint32_t>(b);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_create(int64_t n, const int32_t* left, const int32_t* right,
+                 const int32_t* merged, const int32_t* rank) {
+  auto* t = new BpeTable();
+  t->merges.reserve(static_cast<size_t>(n) * 2);
+  for (int64_t i = 0; i < n; ++i) {
+    t->merges.emplace(key_of(left[i], right[i]),
+                      std::make_pair(rank[i], merged[i]));
+  }
+  return t;
+}
+
+void bpe_free(void* handle) { delete static_cast<BpeTable*>(handle); }
+
+// Encode one pre-token: `syms` (len symbol ids) -> merged ids in `out`
+// (capacity >= len). Returns the output length.
+int32_t bpe_encode(void* handle, const int32_t* syms, int32_t len,
+                   int32_t* out) {
+  const auto& merges = static_cast<BpeTable*>(handle)->merges;
+  std::vector<int32_t> cur(syms, syms + len);
+  while (cur.size() > 1) {
+    // find the lowest-rank adjacent pair
+    int32_t best_rank = INT32_MAX;
+    int32_t best_merged = -1;
+    for (size_t i = 0; i + 1 < cur.size(); ++i) {
+      auto it = merges.find(key_of(cur[i], cur[i + 1]));
+      if (it != merges.end() && it->second.first < best_rank) {
+        best_rank = it->second.first;
+        best_merged = it->second.second;
+      }
+    }
+    if (best_merged < 0) break;
+    // apply every occurrence of that rank's pair left-to-right
+    std::vector<int32_t> next;
+    next.reserve(cur.size());
+    for (size_t i = 0; i < cur.size();) {
+      if (i + 1 < cur.size()) {
+        auto it = merges.find(key_of(cur[i], cur[i + 1]));
+        if (it != merges.end() && it->second.first == best_rank) {
+          next.push_back(it->second.second);
+          i += 2;
+          continue;
+        }
+      }
+      next.push_back(cur[i]);
+      ++i;
+    }
+    cur.swap(next);
+  }
+  for (size_t i = 0; i < cur.size(); ++i) out[i] = cur[i];
+  return static_cast<int32_t>(cur.size());
+}
+
+// Batched encode: many pre-tokens in one ABI crossing (per-word ctypes
+// overhead otherwise dominates for short words). `syms` concatenates all
+// words; `offsets` (n_words+1) delimits them. Output written to `out`
+// (capacity >= total input length) with `out_offsets` (n_words+1)
+// filled. Returns total output length.
+int64_t bpe_encode_batch(void* handle, const int32_t* syms,
+                         const int64_t* offsets, int32_t n_words,
+                         int32_t* out, int64_t* out_offsets) {
+  int64_t pos = 0;
+  out_offsets[0] = 0;
+  for (int32_t w = 0; w < n_words; ++w) {
+    const int32_t len = static_cast<int32_t>(offsets[w + 1] - offsets[w]);
+    pos += bpe_encode(handle, syms + offsets[w], len, out + pos);
+    out_offsets[w + 1] = pos;
+  }
+  return pos;
+}
+
+}  // extern "C"

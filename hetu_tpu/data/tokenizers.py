@@ -10,11 +10,48 @@ and any installed HuggingFace tokenizer can be wrapped.
 
 from __future__ import annotations
 
+import ctypes
 import json
 import os
 import re
 from collections import Counter
 from typing import Iterable, Optional, Sequence
+
+# ---- native BPE merge core (csrc/bpe.cpp, ctypes) -----------------------
+# The merge loop is the encode hot path; like the reference we keep the
+# data-plane hot loop native (C++ dataloader / vendored fast tokenizers),
+# with the pure-Python implementation as the always-available fallback.
+_BPE_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "csrc", "bpe.cpp")
+_BPE_LIB: Optional[ctypes.CDLL] = None
+_BPE_LIB_FAILED = False
+
+
+def _bpe_lib() -> Optional[ctypes.CDLL]:
+    global _BPE_LIB, _BPE_LIB_FAILED
+    if _BPE_LIB is not None or _BPE_LIB_FAILED:
+        return _BPE_LIB
+    try:
+        from hetu_tpu.utils.native import build_native
+        so = build_native(_BPE_CSRC, "libbpe.so")
+        if so is None:
+            raise RuntimeError("native build unavailable")
+        lib = ctypes.CDLL(so)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.bpe_create.restype = ctypes.c_void_p
+        lib.bpe_create.argtypes = [ctypes.c_int64, i32p, i32p, i32p, i32p]
+        lib.bpe_free.argtypes = [ctypes.c_void_p]
+        lib.bpe_encode.restype = ctypes.c_int32
+        lib.bpe_encode.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int32,
+                                   i32p]
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.bpe_encode_batch.restype = ctypes.c_int64
+        lib.bpe_encode_batch.argtypes = [ctypes.c_void_p, i32p, i64p,
+                                         ctypes.c_int32, i32p, i64p]
+        _BPE_LIB = lib
+    except Exception:
+        _BPE_LIB_FAILED = True
+    return _BPE_LIB
 
 # GPT-2's pre-tokenization regex (contractions, letter runs, digit runs,
 # punctuation runs, whitespace handling) — the published pattern.
@@ -64,6 +101,44 @@ class ByteLevelBPETokenizer:
         self.id_to_token = {v: k for k, v in self.vocab.items()}
         self.id_to_token.update({v: k for k, v in self.special.items()})
         self._cache: dict[str, tuple[str, ...]] = {}
+        self._id_cache: dict[str, list[int]] = {}
+        # bound the per-word caches: high-cardinality text (numbers,
+        # URLs, hashes) would otherwise grow them without limit in a
+        # long-running dataloader
+        self._cache_limit = 1 << 18
+        self._native = None
+        self._init_native(merges)
+
+    def _init_native(self, merges) -> None:
+        """Build the id-level merge table for the C++ encode core.
+
+        Degrades silently to the Python merge loop when the toolchain is
+        missing or any merge side falls outside the vocab."""
+        lib = _bpe_lib()
+        if lib is None:
+            return
+        try:
+            left = [self.vocab[a] for a, b in merges]
+            right = [self.vocab[b] for a, b in merges]
+            merged = [self.vocab[a + b] for a, b in merges]
+        except KeyError:
+            return
+        n = len(merges)
+        arr = lambda xs: (ctypes.c_int32 * len(xs))(*xs)
+        rank = list(range(n))
+        handle = lib.bpe_create(n, arr(left), arr(right), arr(merged),
+                                arr(rank))
+        if handle:
+            self._native = (lib, handle)
+
+    def __del__(self):
+        native = getattr(self, "_native", None)
+        if native:
+            lib, handle = native
+            try:
+                lib.bpe_free(handle)
+            except Exception:
+                pass
 
     # -- construction --------------------------------------------------------
     @classmethod
@@ -145,14 +220,53 @@ class ByteLevelBPETokenizer:
                         for seg in segments
                         for piece in self._split_keep(seg, sp)]
         ids = []
+        pending: list[str] = []     # uncached words, encode-order
         for seg in segments:
             if seg in self.special:
                 ids.append(self.special[seg])
                 continue
             for word in _PRETOKEN_RE.findall(seg):
-                for tok in self._bpe(word):
-                    ids.append(self.vocab[tok])
-        return ids
+                if word not in self._id_cache:
+                    pending.append(word)
+                ids.append(word)    # placeholder, resolved below
+        if pending:
+            self._encode_words(pending)
+        out: list[int] = []
+        for item in ids:
+            if isinstance(item, int):
+                out.append(item)
+            else:
+                out.extend(self._id_cache[item])
+        return out
+
+    def _encode_words(self, words: list[str]) -> None:
+        """Fill ``_id_cache`` for ``words`` — one batched native call
+        (csrc/bpe.cpp) so ctypes overhead amortizes over the whole text;
+        pure-Python merge loop as the fallback."""
+        if len(self._id_cache) > self._cache_limit:
+            self._id_cache.clear()
+        if len(self._cache) > self._cache_limit:
+            self._cache.clear()
+        uniq = list(dict.fromkeys(words))
+        if self._native is None:
+            for w in uniq:
+                self._id_cache[w] = [self.vocab[t] for t in self._bpe(w)]
+            return
+        lib, handle = self._native
+        syms: list[int] = []
+        offsets = [0]
+        for w in uniq:
+            syms.extend(self.vocab[c] for c in _word_to_symbols(w))
+            offsets.append(len(syms))
+        n = len(syms)
+        buf_in = (ctypes.c_int32 * max(n, 1))(*syms)
+        buf_off = (ctypes.c_int64 * len(offsets))(*offsets)
+        buf_out = (ctypes.c_int32 * max(n, 1))()
+        buf_out_off = (ctypes.c_int64 * len(offsets))()
+        lib.bpe_encode_batch(handle, buf_in, buf_off, len(uniq),
+                             buf_out, buf_out_off)
+        for i, w in enumerate(uniq):
+            self._id_cache[w] = buf_out[buf_out_off[i]:buf_out_off[i + 1]]
 
     @staticmethod
     def _split_keep(seg: str, sp: str) -> list[str]:

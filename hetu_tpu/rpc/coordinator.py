@@ -12,7 +12,6 @@ from __future__ import annotations
 import os
 import socket
 import subprocess
-import tempfile
 import threading
 import time
 from typing import Optional
@@ -46,15 +45,10 @@ class Coordinator:
     # -- native server ------------------------------------------------------
     def _start_native(self) -> bool:
         try:
-            build_dir = os.path.join(tempfile.gettempdir(),
-                                     "hetu_tpu_native")
-            os.makedirs(build_dir, exist_ok=True)
-            exe = os.path.join(build_dir, "coordinator")
-            if not os.path.exists(exe) or \
-                    os.path.getmtime(exe) < os.path.getmtime(_CSRC):
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", _CSRC, "-o", exe],
-                    check=True, capture_output=True)
+            from hetu_tpu.utils.native import build_native
+            exe = build_native(_CSRC, "coordinator", shared=False)
+            if exe is None:
+                return False
             self._proc = subprocess.Popen(
                 [exe, str(self.port), self.bind],
                 stdout=subprocess.PIPE, text=True)

@@ -11,8 +11,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import tempfile
 from typing import Optional, Sequence
 
 import numpy as np
@@ -27,15 +25,10 @@ def _build_lib() -> Optional[ctypes.CDLL]:
     if _LIB is not None or _LIB_FAILED:
         return _LIB
     try:
-        build_dir = os.path.join(tempfile.gettempdir(), "hetu_tpu_native")
-        os.makedirs(build_dir, exist_ok=True)
-        so = os.path.join(build_dir, "libdp_core.so")
-        if not os.path.exists(so) or \
-                os.path.getmtime(so) < os.path.getmtime(_CSRC):
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                 _CSRC, "-o", so],
-                check=True, capture_output=True)
+        from hetu_tpu.utils.native import build_native
+        so = build_native(_CSRC, "libdp_core.so")
+        if so is None:
+            raise RuntimeError("native build unavailable")
         lib = ctypes.CDLL(so)
         lib.solve_dp.restype = ctypes.c_double
         lib.solve_dp.argtypes = [

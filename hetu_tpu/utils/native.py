@@ -1,0 +1,63 @@
+"""Shared native-code builder: compile csrc/*.cpp with g++ at first use.
+
+One implementation for every native component (BPE core, Galvatron DP
+core, coordinator daemon) so the hardening lives in one place:
+
+- per-user cache dir with mode 0700 (a fixed world-writable /tmp path
+  would let another local user plant a malicious library that ctypes
+  would happily dlopen);
+- atomic publish via compile-to-temp + ``os.rename`` (compiling onto the
+  target path O_TRUNCs a file other live processes may have mapped —
+  SIGBUS — and concurrent builders could load a half-written object).
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+
+def native_cache_dir() -> str:
+    d = os.path.join(tempfile.gettempdir(),
+                     f"hetu_tpu_native_{os.getuid()}")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    st = os.stat(d)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o077):
+        raise RuntimeError(
+            f"native cache dir {d} is not exclusively ours "
+            f"(uid {st.st_uid}, mode {stat.filemode(st.st_mode)})")
+    return d
+
+
+def build_native(csrc_path: str, out_name: str, *, shared: bool = True,
+                 extra_flags: Sequence[str] = ()) -> Optional[str]:
+    """Compile ``csrc_path`` into the per-user cache; returns the output
+    path, or None when the toolchain is unavailable/fails. Rebuilds when
+    the source is newer than the artifact; concurrent builders race
+    benignly (last atomic rename wins, both outputs are valid)."""
+    try:
+        out = os.path.join(native_cache_dir(), out_name)
+        if os.path.exists(out) and \
+                os.path.getmtime(out) >= os.path.getmtime(csrc_path):
+            return out
+        fd, tmp = tempfile.mkstemp(prefix=out_name + ".",
+                                   dir=os.path.dirname(out))
+        os.close(fd)
+        cmd = ["g++", "-O2", "-std=c++17", *extra_flags]
+        if shared:
+            cmd += ["-shared", "-fPIC"]
+        cmd += [csrc_path, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.chmod(tmp, 0o700)
+        os.rename(tmp, out)
+        return out
+    except Exception:
+        try:
+            if "tmp" in locals() and os.path.exists(tmp):
+                os.unlink(tmp)
+        except OSError:
+            pass
+        return None

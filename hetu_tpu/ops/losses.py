@@ -81,6 +81,10 @@ def chunked_lm_loss(hidden, vocab_weight, labels, *, mm_dt=None,
     yc = jnp.swapaxes(labels.reshape(B, S // c, c), 0, 1)
 
     def one(args):
+        # NOTE: the weight cast stays INSIDE the loop on purpose — the
+        # cast's transpose is what routes each chunk's dW cotangent back
+        # to fp32 before the cross-chunk accumulation; hoisting it would
+        # accumulate the (tied-embedding) head grad in bf16
         h_c, y_c = args
         logits = jnp.einsum("bce,ve->bcv", h_c.astype(mm_dt),
                             vocab_weight.astype(mm_dt),

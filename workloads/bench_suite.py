@@ -33,8 +33,6 @@ from hetu_tpu.engine import build_train_step, init_state, make_plan
 from hetu_tpu.parallel.strategy import Strategy
 from hetu_tpu.utils.profiler import sync_result
 
-PEAK = {"TPU v5 lite": 197e12, "TPU v5": 459e12, "TPU v4": 275e12}
-
 
 def _bench_steps(step, state, batch, steps, warmup):
     for _ in range(warmup):
@@ -64,9 +62,36 @@ def _lm_bench(model, cfg, strategy, batch, seq, *, steps=10, warmup=2,
                               "labels": ids[:, 1:]})
         dt, loss = _bench_steps(step, state, b, steps, warmup)
     n = sum(x.size for x in jax.tree.leaves(state.params))
-    return {"step_ms": round(dt * 1e3, 2),
-            "tokens_per_sec": round(batch * seq / dt, 1),
-            "params": n, "loss": round(loss, 3)}
+    out = {"step_ms": round(dt * 1e3, 2),
+           "tokens_per_sec": round(batch * seq / dt, 1),
+           "params": n, "loss": round(loss, 3)}
+    from bench import model_flops_per_token, peak_flops
+    peak = peak_flops(jax.devices()[0])
+    if peak:
+        # PaLM appendix-B accounting via bench.py's shared formula, on
+        # ACTIVE params: top-k MoE executes only k/E of each expert
+        # tensor per token — charging all experts would inflate MoE MFU
+        n_active = _active_params(state.params, cfg)
+        fpt = model_flops_per_token(cfg, n_active, seq)
+        out["mfu"] = round(fpt * out["tokens_per_sec"] / peak, 4)
+    return out
+
+
+_EXPERT_LEAVES = ("wi", "wg", "wo")   # MoEMLP expert tensors (nn/moe.py)
+
+
+def _active_params(params, cfg) -> float:
+    """Params touched per token: expert tensors count at k/E."""
+    E = getattr(cfg, "num_experts", 0)
+    k = getattr(cfg, "moe_top_k", 0)
+    frac = (k / E) if E and k else 1.0
+    from jax.tree_util import keystr, tree_flatten_with_path
+    flat, _ = tree_flatten_with_path(params)
+    total = 0.0
+    for path, leaf in flat:
+        name = keystr((path[-1],)).strip("[]'\"")
+        total += leaf.size * (frac if name in _EXPERT_LEAVES else 1.0)
+    return total
 
 
 def config1_mlp():
@@ -122,7 +147,8 @@ def config3_llama_autoparallel(on_tpu):
                                  max_positions=2048)
     model = LlamaLMHeadModel(scaled)
     batch, seq = (4, 2048) if on_tpu else (2, 128)
-    r = _lm_bench(model, scaled, Strategy(remat="selective"), batch, seq,
+    r = _lm_bench(model, scaled,
+                  Strategy(remat="selective", unroll=True), batch, seq,
                   policy=Policy(param_dtype=jnp.bfloat16,
                                 compute_dtype=jnp.bfloat16))
     return {"config": 3, "metric": "llama7b_dims_2layer_tokens_per_sec",
@@ -143,7 +169,7 @@ def config4_moe(on_tpu):
         cfg = dataclasses.replace(cfg, num_layers=6)
     model = GPTLMHeadModel(cfg)
     batch, seq = (8, 1024) if on_tpu else (4, 64)
-    r = _lm_bench(model, cfg, Strategy(), batch, seq,
+    r = _lm_bench(model, cfg, Strategy(unroll=True), batch, seq,
                   policy=Policy(param_dtype=jnp.float32,
                                 compute_dtype=jnp.bfloat16))
     return {"config": 4, "metric": "gpt_moe8e_tokens_per_sec",
@@ -161,7 +187,7 @@ def config5_long_context(on_tpu):
                               intermediate_size=2816, num_layers=4,
                               max_positions=seq, vocab_size=32000)
     model = LlamaLMHeadModel(cfg)
-    r = _lm_bench(model, cfg, Strategy(remat="full"), 1, seq,
+    r = _lm_bench(model, cfg, Strategy(remat="full", unroll=True), 1, seq,
                   steps=5, warmup=2,
                   policy=Policy(param_dtype=jnp.bfloat16,
                                 compute_dtype=jnp.bfloat16))

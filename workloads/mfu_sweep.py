@@ -23,6 +23,13 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--param-dtype", choices=("fp32", "bf16"),
+                    default="fp32",
+                    help="bf16 halves param/grad HBM traffic (Adam "
+                         "moments stay fp32)")
+    ap.add_argument("--grid", default=None,
+                    help="comma list of batch:remat:unroll triples, e.g. "
+                         "32:selective:1,64:full:1 (default: built-in)")
     args = ap.parse_args()
 
     from bench import peak_flops, model_flops_per_token
@@ -33,6 +40,13 @@ def main():
     from hetu_tpu.models import GPTConfig, GPTLMHeadModel
     from hetu_tpu.parallel.strategy import Strategy
 
+    # out-of-process probe first: on a dead tunnel the axon plugin hangs
+    # in-process backend init (jax.devices()) indefinitely
+    from bench import probe_tpu
+    if not probe_tpu(timeout=120):
+        raise SystemExit("no live TPU — the sweep measures MFU on real "
+                         "hardware only; use bench.py for the CPU smoke "
+                         "path")
     dev = jax.devices()[0]
     peak = peak_flops(dev)
     if not peak:
@@ -42,16 +56,24 @@ def main():
     cfg = GPTConfig.small()
     model = GPTLMHeadModel(cfg)
     opt = optim.adamw(1e-4, weight_decay=0.01)
-    policy = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)
+    param_dt = jnp.float32 if args.param_dtype == "fp32" else jnp.bfloat16
+    policy = Policy(param_dtype=param_dt, compute_dtype=jnp.bfloat16)
     seq = args.seq
 
-    grid = [
-        (8, "none", False), (8, "none", True),
-        (16, "selective", True), (32, "selective", False),
-        (32, "selective", True), (64, "selective", True),
-        (32, "full", True),
-    ]
-    print(f"device={dev.device_kind} peak={peak/1e12:.0f}TF/s seq={seq}")
+    if args.grid:
+        grid = []
+        for item in args.grid.split(","):
+            b, r, u = item.split(":")
+            grid.append((int(b), r, bool(int(u))))
+    else:
+        grid = [
+            (8, "none", False), (8, "none", True),
+            (16, "selective", True), (32, "selective", False),
+            (32, "selective", True), (64, "selective", True),
+            (32, "full", True),
+        ]
+    print(f"device={dev.device_kind} peak={peak/1e12:.0f}TF/s seq={seq} "
+          f"params={args.param_dtype}")
     print(f"{'batch':>5} {'remat':>10} {'unroll':>6} {'step_ms':>8} "
           f"{'tok/s':>9} {'mfu':>6}")
     results = []

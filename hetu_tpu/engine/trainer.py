@@ -45,6 +45,9 @@ class TrainerConfig:
     precision: str = "bf16"      # "bf16" | "fp32"
     attn_impl: str = "auto"
     distributed_ckpt: bool = False   # per-host shard files, no gather
+    prefetch: int = 2            # device-prefetch depth for train();
+                                 # 0 disables (reference: async C++
+                                 # dataloader + dedicated H2D stream)
 
     def policy(self) -> Policy:
         return BF16_COMPUTE if self.precision == "bf16" else FP32
@@ -202,35 +205,57 @@ class Trainer:
     def train(self, batches: Iterable[dict],
               steps: Optional[int] = None) -> list[dict]:
         """Run up to ``steps`` (default config.total_steps) steps; returns
-        the logged metric records."""
+        the logged metric records.
+
+        The loop keeps the device pipeline full: the step counter is
+        tracked host-side (a per-step ``device_get(state.step)`` would
+        sync every step and serialize dispatch), the host only blocks on
+        metrics at log boundaries, and batches are staged through the
+        device prefetcher (``data/prefetch.py``) so H2D transfers overlap
+        the previous step's compute."""
         if self.state is None:
             self.initialize()
         steps = steps if steps is not None else self.config.total_steps
         history = []
         t_last = time.perf_counter()
         tokens_since = 0
-        it: Iterator[dict] = iter(batches)
-        for _ in range(steps):
-            try:
-                batch = next(it)
-            except StopIteration:
-                break
-            metrics = self.train_step(batch)
-            tokens_since += int(batch["input_ids"].size)
-            step_no = int(jax.device_get(self.state.step))
-            if self.config.log_every and \
-                    step_no % self.config.log_every == 0:
-                now = time.perf_counter()
-                loss = float(jax.device_get(metrics["loss"]))
-                rec = self.metrics.log(
-                    step_no, loss=loss,
-                    grad_norm=float(jax.device_get(metrics["grad_norm"])),
-                    tokens_per_sec=round(tokens_since / (now - t_last), 1))
-                history.append(rec)
-                t_last, tokens_since = now, 0
-            if self.config.ckpt_every and self.config.ckpt_dir and \
-                    step_no % self.config.ckpt_every == 0:
-                self.save()
+        host_step = int(jax.device_get(self.state.step))
+        prefetcher = None
+        if self.config.prefetch > 0:
+            from hetu_tpu.data.prefetch import DevicePrefetcher
+            prefetcher = DevicePrefetcher(
+                batches, self.plan.shard_batch,
+                buffer_size=self.config.prefetch, max_items=steps)
+            it: Iterator[dict] = iter(prefetcher)
+        else:
+            it = (self.plan.shard_batch(b) for b in batches)
+        try:
+            for _ in range(steps):
+                try:
+                    sbatch = next(it)
+                except StopIteration:
+                    break
+                self.state, metrics = self._step_fn(self.state, sbatch)
+                host_step += 1
+                tokens_since += int(sbatch["input_ids"].size)
+                if self.config.log_every and \
+                        host_step % self.config.log_every == 0:
+                    loss = float(jax.device_get(metrics["loss"]))
+                    now = time.perf_counter()
+                    rec = self.metrics.log(
+                        host_step, loss=loss,
+                        grad_norm=float(
+                            jax.device_get(metrics["grad_norm"])),
+                        tokens_per_sec=round(
+                            tokens_since / (now - t_last), 1))
+                    history.append(rec)
+                    t_last, tokens_since = now, 0
+                if self.config.ckpt_every and self.config.ckpt_dir and \
+                        host_step % self.config.ckpt_every == 0:
+                    self.save()
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
         if self.config.ckpt_dir:
             self.save(wait=True)
         return history

@@ -92,7 +92,24 @@ def chunked_lm_loss(hidden, vocab_weight, labels, *, mm_dt=None,
         loss, valid = softmax_cross_entropy(logits, y_c, ignore_index)
         return loss.sum(), valid.sum()
 
-    ls, vs = jax.lax.map(jax.checkpoint(one), (hc, yc))
+    n_chunks = hc.shape[0]
+    one_ckpt = jax.checkpoint(one)
+    if n_chunks <= 16:
+        # static unroll: straight-line chunks avoid the scan's carry /
+        # dynamic-update-slice machinery. The optimization_barrier chains
+        # chunk i's input on chunk i-1's accumulated loss so XLA cannot
+        # schedule two chunks' ~O(chunk_tokens x V) logits buffers live
+        # at once — preserving the memory bound that is this function's
+        # whole purpose.
+        loss_sum = jnp.zeros([], jnp.float32)
+        valid_sum = jnp.zeros([], jnp.int32)
+        for i in range(n_chunks):
+            h_i, _ = jax.lax.optimization_barrier((hc[i], loss_sum))
+            l, v = one_ckpt((h_i, yc[i]))
+            loss_sum = loss_sum + l
+            valid_sum = valid_sum + v.astype(jnp.int32)
+        return loss_sum / jnp.maximum(valid_sum, 1)
+    ls, vs = jax.lax.map(one_ckpt, (hc, yc))
     return ls.sum() / jnp.maximum(vs.sum(), 1)
 
 

@@ -129,3 +129,58 @@ def test_loader_feeds_training(rng):
         if len(losses) >= 5:
             break
     assert len(losses) == 5 and all(np.isfinite(losses))
+
+
+def test_device_prefetcher_order_and_errors():
+    """Prefetcher (reference: async C++ dataloader role): preserves batch
+    order, applies the placement fn, propagates producer exceptions, and
+    respects back-pressure."""
+    import time
+
+    from hetu_tpu.data.prefetch import DevicePrefetcher
+
+    seen = []
+
+    def gen():
+        for i in range(6):
+            seen.append(i)
+            yield {"x": i}
+
+    pf = DevicePrefetcher(gen(), lambda b: {"x": b["x"] * 10},
+                          buffer_size=2)
+    out = [b["x"] for b in pf]
+    assert out == [0, 10, 20, 30, 40, 50]
+
+    # back-pressure: with buffer 2 the producer pulls at most
+    # buffer + 1 items from the source before the consumer reads any
+    pulled = []
+
+    def counting():
+        for i in range(100):
+            pulled.append(i)
+            yield i
+
+    slow = DevicePrefetcher(counting(), lambda x: x, buffer_size=2)
+    time.sleep(0.3)
+    assert len(pulled) <= 3, pulled
+    slow.close()
+
+    # max_items: exactly that many consumed from a shared iterator
+    src = iter(range(100))
+    pf = DevicePrefetcher(src, lambda x: x, buffer_size=2, max_items=5)
+    assert list(pf) == [0, 1, 2, 3, 4]
+    assert next(src) == 5          # nothing stolen past the budget
+    import pytest
+    with pytest.raises(StopIteration):
+        next(pf)                   # exhausted iterator keeps raising
+
+    def bad():
+        yield {"x": 1}
+        raise RuntimeError("boom")
+
+    pf = DevicePrefetcher(bad(), lambda b: b, buffer_size=2)
+    assert next(pf)["x"] == 1
+    import pytest
+    with pytest.raises(RuntimeError, match="boom"):
+        for _ in pf:
+            pass

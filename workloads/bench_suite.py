@@ -35,9 +35,13 @@ from hetu_tpu.utils.profiler import sync_result
 
 
 def _bench_steps(step, state, batch, steps, warmup):
-    for _ in range(warmup):
+    """Relay-safe timing loop (shared by the workload scripts). At least
+    one warmup step always runs (compile must not land in the timed
+    region) and ``steps`` is clamped to >= 1."""
+    for _ in range(max(1, warmup)):
         state, m = step(state, batch)
     sync_result(m["loss"])
+    steps = max(1, steps)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, m = step(state, batch)

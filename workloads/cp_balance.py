@@ -21,11 +21,12 @@ import argparse
 import json
 import os
 import sys
-import time
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the axon TPU plugin overrides the env var; pinning via jax.config
+    # is what actually forces the CPU backend (same guard as examples/)
     import jax
     jax.config.update("jax_platforms", "cpu")
 import jax
@@ -35,7 +36,7 @@ from hetu_tpu import optim
 from hetu_tpu.engine import build_train_step, init_state, make_plan
 from hetu_tpu.models import LlamaConfig, LlamaLMHeadModel
 from hetu_tpu.parallel.strategy import Strategy
-from hetu_tpu.utils.profiler import sync_result
+from bench_suite import _bench_steps
 
 
 def measure(layout: str, cp: int, seq: int, steps: int, warmup: int):
@@ -55,15 +56,7 @@ def measure(layout: str, cp: int, seq: int, steps: int, warmup: int):
                              cfg.vocab_size)
     batch = plan.shard_batch({"input_ids": ids[:, :-1],
                               "labels": ids[:, 1:]})
-    for _ in range(warmup):
-        state, m = step(state, batch)
-    sync_result(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = step(state, batch)
-    loss = float(jax.device_get(m["loss"]))
-    dt = (time.perf_counter() - t0) / steps
-    return dt, loss
+    return _bench_steps(step, state, batch, steps, warmup)
 
 
 def main():

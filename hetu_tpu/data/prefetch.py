@@ -15,18 +15,58 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, Callable, Iterable, Iterator, Optional
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+_SENTINEL = object()
+
+
+def _producer_loop(q: "queue.Queue", place: Callable[[Any], Any],
+                   it: Iterator[Any], max_items: Optional[int],
+                   stop: threading.Event, err_box: List[BaseException]):
+    """Module-level so the thread holds NO reference to the prefetcher —
+    an abandoned DevicePrefetcher stays collectable and its ``__del__``
+    can stop this loop (a bound-method target would pin ``self`` and leak
+    the thread plus every staged device batch)."""
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    try:
+        # ``max_items`` caps how far we read — checked BEFORE each
+        # ``next`` so a shared iterator loses nothing: an eager pull past
+        # the consumer's step budget would silently drop batches from a
+        # chained train() call
+        n = 0
+        while not stop.is_set() and (max_items is None or n < max_items):
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            # device_put inside shard_batch is async — this enqueues the
+            # H2D copies without blocking on them
+            if not put(place(batch)):
+                return
+            n += 1
+    except BaseException as e:   # propagate to the consumer
+        err_box.append(e)
+    finally:
+        put(_SENTINEL)
 
 
 class DevicePrefetcher:
     """Wrap a host batch iterable; yields device-resident batches.
 
     ``place`` defaults to the plan's ``shard_batch``; pass a custom
-    callable for non-dict batches. The background thread dies with the
-    consumer (daemon) and propagates iterator exceptions at ``__next__``.
+    callable for non-dict batches. Usable as a context manager; an
+    abandoned instance is garbage-collected (``__del__`` stops the
+    producer). Producer exceptions surface at ``__next__``.
     """
-
-    _SENTINEL = object()
 
     def __init__(self, batches: Iterable[Any], place: Callable[[Any], Any],
                  *, buffer_size: int = 2,
@@ -34,47 +74,15 @@ class DevicePrefetcher:
         if buffer_size < 1:
             raise ValueError("buffer_size must be >= 1")
         self._q: queue.Queue = queue.Queue(maxsize=buffer_size)
-        self._err: Optional[BaseException] = None
-        self._place = place
-        self._stopped = False
+        self._err_box: List[BaseException] = []
+        self._stop = threading.Event()
         self._done = False
         self._thread = threading.Thread(
-            target=self._producer, args=(iter(batches), max_items),
+            target=_producer_loop,
+            args=(self._q, place, iter(batches), max_items, self._stop,
+                  self._err_box),
             daemon=True)
         self._thread.start()
-
-    def _put(self, item) -> bool:
-        """Blocking put that aborts when the consumer closed us."""
-        while not self._stopped:
-            try:
-                self._q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def _producer(self, it: Iterator[Any], max_items) -> None:
-        try:
-            # ``max_items`` caps how far we read — checked BEFORE each
-            # ``next`` so a shared iterator loses nothing: an eager pull
-            # past the consumer's step budget would silently drop batches
-            # from a chained train() call
-            n = 0
-            while not self._stopped and \
-                    (max_items is None or n < max_items):
-                try:
-                    batch = next(it)
-                except StopIteration:
-                    break
-                # device_put inside shard_batch is async — this enqueues
-                # the H2D copies without blocking on them
-                if not self._put(self._place(batch)):
-                    return
-                n += 1
-        except BaseException as e:   # propagate to the consumer
-            self._err = e
-        finally:
-            self._put(self._SENTINEL)
 
     def __iter__(self):
         return self
@@ -83,16 +91,15 @@ class DevicePrefetcher:
         if self._done:
             raise StopIteration   # iterator contract: keep raising
         item = self._q.get()
-        if item is self._SENTINEL:
+        if item is _SENTINEL:
             self._done = True
-            if self._err is not None:
-                err, self._err = self._err, None
-                raise err
+            if self._err_box:
+                raise self._err_box.pop()
             raise StopIteration
         return item
 
     def close(self) -> None:
-        self._stopped = True      # _put() aborts within its timeout
+        self._stop.set()          # producer aborts within its put timeout
         self._done = True
         # release any staged device batches immediately
         try:
@@ -101,9 +108,22 @@ class DevicePrefetcher:
         except queue.Empty:
             pass
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
 
 def prefetch_to_device(batches: Iterable[Any], plan, *,
-                       buffer_size: int = 2) -> DevicePrefetcher:
+                       buffer_size: int = 2,
+                       max_items: Optional[int] = None) -> DevicePrefetcher:
     """Prefetch ``batches`` through ``plan.shard_batch`` (TrainPlan)."""
     return DevicePrefetcher(batches, plan.shard_batch,
-                            buffer_size=buffer_size)
+                            buffer_size=buffer_size, max_items=max_items)

@@ -7,11 +7,13 @@ planners, straggler monitor).
 from hetu_tpu.engine.state import TrainState
 from hetu_tpu.engine.train_step import (
     TrainPlan, make_plan, init_state, build_train_step, build_eval_step,
+    build_grad_accum_steps,
 )
 
 from hetu_tpu.engine.malleus import plan_hetero
 
 __all__ = [
     "TrainState", "TrainPlan", "make_plan", "init_state",
-    "build_train_step", "build_eval_step", "plan_hetero",
+    "build_train_step", "build_eval_step", "build_grad_accum_steps",
+    "plan_hetero",
 ]

@@ -22,6 +22,7 @@ consumed here:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Optional
 
 import jax
@@ -258,3 +259,59 @@ def build_eval_step(model: Module, plan: TrainPlan, *,
             return base_loss(params, batch)
 
     return jax.jit(step)
+
+
+def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
+                           *, loss_fn: Optional[Callable] = None,
+                           attn_impl: str = "auto"):
+    """Split-phase training — the reference's partial-execution RunLevels
+    (``graph.h:33-39``): RunLevel::GRAD accumulates gradients across
+    *separate step calls* (arbitrary-size global batches without holding
+    every microbatch in one feed), RunLevel::UPDATE applies them.
+
+    Returns ``(init_acc, grad_step, apply_step)``:
+
+    - ``acc = init_acc()`` — zeroed fp32 grad buffer (param-sharded)
+    - ``acc, loss = grad_step(state, acc, batch)`` — one forward/backward,
+      grads added into ``acc`` (donated)
+    - ``state, metrics = apply_step(state, acc, n_accum)`` — mean over
+      ``n_accum`` accumulations, optimizer update; ``acc`` is consumed
+    """
+    strategy = plan.strategy
+    if strategy.pp > 1:
+        raise NotImplementedError(
+            "split-phase accumulation with pp > 1: use "
+            "num_microbatches inside the pipeline step instead")
+    base_loss = loss_fn or default_loss_fn(model, strategy, attn_impl)
+
+    def compute_loss(params, batch):
+        with plan.act:
+            return base_loss(params, batch)
+
+    grad_fn = jax.value_and_grad(compute_loss)
+    param_shardings = plan.state_shardings.params
+
+    @functools.partial(jax.jit, out_shardings=param_shardings)
+    def init_acc():
+        return jax.tree.map(
+            lambda s: jnp.zeros(s.shape, jnp.float32),
+            model.abstract_params())
+
+    @functools.partial(jax.jit, donate_argnums=(1,),
+                       out_shardings=(param_shardings, None))
+    def grad_step(state: TrainState, acc, batch):
+        loss, grads = grad_fn(state.params, batch)
+        return jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                            acc, grads), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1),
+                       out_shardings=(plan.state_shardings, None))
+    def apply_step(state: TrainState, acc, n_accum):
+        grads = jax.tree.map(lambda g: g / n_accum, acc)
+        gnorm = global_norm(grads)
+        updates, new_opt = opt.update(grads, state.opt_state, state.params)
+        new_params = apply_updates(state.params, updates)
+        return (TrainState(state.step + 1, new_params, new_opt),
+                {"grad_norm": gnorm})
+
+    return init_acc, grad_step, apply_step

@@ -35,16 +35,24 @@ def get_logger() -> logging.Logger:
 class MetricsLogger:
     """Append-only JSONL metrics stream (stdout and/or a file)."""
 
-    def __init__(self, path: Optional[str] = None, echo: bool = True):
+    def __init__(self, path: Optional[str] = None, echo: bool = True,
+                 max_history: int = 100_000):
         self._f = open(path, "a") if path else None
         self._echo = echo
         self._t0 = time.perf_counter()
+        # bounded in-memory tail for plot(); the durable record is the
+        # JSONL file (1M-step runs must not grow host memory unboundedly)
+        self._history: list[dict] = []
+        self._max_history = max_history
 
     def log(self, step: int, **metrics):
         rec = {"step": step,
                "elapsed_s": round(time.perf_counter() - self._t0, 3),
                **{k: (float(v) if hasattr(v, "__float__") else v)
                   for k, v in metrics.items()}}
+        self._history.append(rec)
+        if len(self._history) > self._max_history:
+            del self._history[:len(self._history) // 2]
         line = json.dumps(rec)
         if self._f:
             self._f.write(line + "\n")
@@ -52,6 +60,32 @@ class MetricsLogger:
         if self._echo:
             get_logger().info(line)
         return rec
+
+    def clear_history(self) -> None:
+        """Drop the in-memory tail (e.g. between distinct train runs
+        sharing one logger, so plot() doesn't mix their curves)."""
+        self._history.clear()
+
+    def plot(self, path: str, *, keys=("loss",)):
+        """Render logged curves to ``path`` (png/svg) — the reference
+        trainer's loss plotting (``engine/trainer.py:779``). Covers this
+        logger's (bounded) in-memory history; call :meth:`clear_history`
+        between runs to keep curves separate."""
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        fig, ax = plt.subplots(figsize=(7, 4))
+        for key in keys:
+            pts = [(r["step"], r[key]) for r in self._history if key in r]
+            if pts:
+                ax.plot(*zip(*pts), label=key)
+        ax.set_xlabel("step")
+        ax.legend()
+        ax.grid(True, alpha=0.3)
+        fig.tight_layout()
+        fig.savefig(path)
+        plt.close(fig)
+        return path
 
     def close(self):
         if self._f:

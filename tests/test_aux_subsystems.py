@@ -168,3 +168,15 @@ def test_yaml_experiment_configs():
             seen_hetero = True
             assert exp["model_config"].num_layers == st.num_layers
     assert seen_hetero
+
+
+def test_metrics_logger_plot(tmp_path):
+    """Loss plotting parity (reference engine/trainer.py:779)."""
+    from hetu_tpu.utils.logging import MetricsLogger
+
+    m = MetricsLogger(echo=False)
+    for i in range(5):
+        m.log(i * 10, loss=5.0 - i, grad_norm=1.0)
+    out = m.plot(str(tmp_path / "loss.png"), keys=("loss", "grad_norm"))
+    import os
+    assert os.path.getsize(out) > 1000

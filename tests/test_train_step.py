@@ -277,3 +277,45 @@ def test_dropout_op():
     y2 = dropout(x, 0.5, jax.random.key(1))
     assert not bool((y == y2).all())
     np.testing.assert_array_equal(y, dropout(x, 0.5, jax.random.key(0)))
+
+
+def test_split_phase_grad_accumulation():
+    """RunLevel GRAD/UPDATE parity (``graph.h:33-39``): accumulating
+    grads over k separate grad_step calls then applying once matches a
+    single step over the concatenated batch."""
+    from hetu_tpu.engine import build_grad_accum_steps
+
+    strategy = Strategy(dp=2)
+    model = GPTLMHeadModel(CFG)
+    opt = optim.adamw(1e-3)
+    plan = make_plan(model, opt, strategy)
+    batches = _batches(2)
+    big = {k: jnp.concatenate([b[k] for b in batches])
+           for k in batches[0]}
+
+    # reference: one fused step over both batches
+    state_ref = init_state(model, opt, plan, jax.random.key(42),
+                           dtype=jnp.float32)
+    fused = build_train_step(model, opt, plan, donate=False)
+    state_ref, m_ref = fused(state_ref, plan.shard_batch(big))
+
+    # split-phase: two grad calls + one apply
+    state = init_state(model, opt, plan, jax.random.key(42),
+                       dtype=jnp.float32)
+    init_acc, grad_step, apply_step = build_grad_accum_steps(
+        model, opt, plan)
+    acc = init_acc()
+    losses = []
+    for b in batches:
+        acc, loss = grad_step(state, acc, plan.shard_batch(b))
+        losses.append(float(loss))
+    state, m = apply_step(state, acc, 2.0)
+
+    np.testing.assert_allclose(float(np.mean(losses)),
+                               float(m_ref["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(float(m["grad_norm"]),
+                               float(m_ref["grad_norm"]), rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(state_ref.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)

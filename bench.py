@@ -92,7 +92,12 @@ def main():
     seq = min(seq, cfg.max_positions)
     model = GPTLMHeadModel(cfg)
     opt = optim.adamw(1e-4, weight_decay=0.01)
-    strategy = Strategy()  # single chip; driver runs multi-chip via dryrun
+    # single chip (the driver validates multi-chip via dryrun_multichip).
+    # selective remat + unrolled layers won the r3 sweep
+    # (workloads/mfu_sweep.py): remat buys batch 32 (vs 8 without) and
+    # the pinned flash residuals keep its recompute to elementwise ops.
+    strategy = Strategy(remat="selective", unroll=True) if on_tpu \
+        else Strategy()
 
     def run(batch):
         with autocast(dtype_policy):

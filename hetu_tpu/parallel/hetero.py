@@ -258,29 +258,40 @@ class HeteroTrainStep:
             return blocks(chunk, h, remat=remat, attn_impl=attn_impl,
                           **extras)
 
-        # ---- forward jits (one per distinct stage role) ----
-        def fwd_first(outer, chunk, ids, positions, extras):
-            h = model.embed({**outer, "blocks": None}, ids,
-                            positions=positions)
-            return run_chunk(chunk, h, extras)
+        # Every traced function carries its stage's ActivationSharding
+        # context INSIDE the function, and the mid-stage fns are built by
+        # a per-stage factory so each stage traces a DISTINCT code
+        # object. Both halves matter: pjit's lowering cache keys on
+        # (function identity, avals, HloSharding proto) — two stages'
+        # block chunks have identical avals and identical sharding protos
+        # (their meshes differ only in concrete device ids), so a shared
+        # function object lets stage i>1 cache-hit stage 1's lowering and
+        # inherit act_constrains pinned to the wrong devices (manifested
+        # as 'incompatible devices' errors at pp>=4, where more than one
+        # mid stage exists).
+        S = len(plan.meshes)
+        acts = [ActivationSharding(m, batch="dp", tp="tp")
+                for m in plan.meshes]
+        act_first, act_last = acts[0], acts[-1]
 
-        def fwd_mid(chunk, h, extras):
-            return run_chunk(chunk, h, extras)
+        def fwd_first(outer, chunk, ids, positions, extras):
+            with act_first:
+                h = model.embed({**outer, "blocks": None}, ids,
+                                positions=positions)
+                return run_chunk(chunk, h, extras)
 
         def loss_last(outer, chunk, h, labels, extras):
-            h = run_chunk(chunk, h, extras)
-            return model.head_loss({**outer, "blocks": None}, h, labels)
+            with act_last:
+                h = run_chunk(chunk, h, extras)
+                return model.head_loss({**outer, "blocks": None}, h,
+                                       labels)
 
-        # ---- backward jits: recompute forward under vjp (full remat) ----
+        # ---- backward: recompute forward under vjp (full remat) ----
         def bwd_first(outer, chunk, ids, positions, extras, g):
             def f(outer, chunk):
                 return fwd_first(outer, chunk, ids, positions, extras)
             _, vjp = jax.vjp(f, outer, chunk)
             return vjp(g)                       # (douter, dchunk)
-
-        def bwd_mid(chunk, h, extras, g):
-            _, vjp = jax.vjp(lambda c, x: fwd_mid(c, x, extras), chunk, h)
-            return vjp(g)                       # (dchunk, dh)
 
         def bwd_last(outer, chunk, h, labels, extras, gscale):
             def f(outer, chunk, h):
@@ -289,12 +300,28 @@ class HeteroTrainStep:
             douter, dchunk, dh = vjp(gscale)
             return loss, douter, dchunk, dh
 
-        # per-stage activation sharding contexts are applied at call time
-        # (tracing happens inside jit on first call per stage)
+        def make_mid(i):
+            act = acts[i]
+
+            def fwd_mid(chunk, h, extras):
+                with act:
+                    return run_chunk(chunk, h, extras)
+
+            def bwd_mid(chunk, h, extras, g):
+                _, vjp = jax.vjp(lambda c, x: fwd_mid(c, x, extras),
+                                 chunk, h)
+                return vjp(g)                   # (dchunk, dh)
+
+            return jax.jit(fwd_mid), jax.jit(bwd_mid)
+
+        # mid jits exist only for the interior stages (1 <= i <= S-2);
+        # ends are padded with None to keep stage indexing direct
+        mids = [make_mid(i) if 0 < i < S - 1 else (None, None)
+                for i in range(S)]
         self._fwd_first = jax.jit(fwd_first)
-        self._fwd_mid = jax.jit(fwd_mid)
+        self._fwd_mid = [f for f, _ in mids]
         self._bwd_first = jax.jit(bwd_first)
-        self._bwd_mid = jax.jit(bwd_mid)
+        self._bwd_mid = [b for _, b in mids]
         self._bwd_last = jax.jit(bwd_last)
         self._acc = jax.jit(
             lambda acc, g: jax.tree.map(
@@ -311,10 +338,6 @@ class HeteroTrainStep:
             return apply_updates(params, updates), new_opt
 
         self._update = jax.jit(update)
-        self._acts = [
-            ActivationSharding(m, batch="dp", tp="tp")
-            for m in plan.meshes
-        ]
 
     # -- helpers -----------------------------------------------------------
     def _microbatches(self, batch: dict):
@@ -346,16 +369,14 @@ class HeteroTrainStep:
         if seg is not None:
             extras["segment_ids"] = seg
         extras_of.append(extras)
-        with self._acts[0]:
-            h = self._fwd_first(state.outer, state.blocks[0], ids,
-                                positions, extras)
+        h = self._fwd_first(state.outer, state.blocks[0], ids,
+                            positions, extras)
         stage_in[0].append((ids, positions, labels))
         for i in range(1, S):
             h = jax.device_put(h, plan.act_shardings[i])
             stage_in[i].append(h)
             if i < S - 1:
-                with self._acts[i]:
-                    h = self._fwd_mid(state.blocks[i], h, extras)
+                h = self._fwd_mid[i](state.blocks[i], h, extras)
         # the last stage's forward is fused into bwd_last (the vjp
         # recomputes it)
 
@@ -367,23 +388,20 @@ class HeteroTrainStep:
         extras = extras_of[j]
         h_last = stage_in[S - 1][j]
         _, _, labels = stage_in[0][j]
-        with self._acts[-1]:
-            loss, dho, dchunk, dh = self._bwd_last(
-                head_outer, state.blocks[S - 1], h_last, labels,
-                extras, gscale)
+        loss, dho, dchunk, dh = self._bwd_last(
+            head_outer, state.blocks[S - 1], h_last, labels,
+            extras, gscale)
         acc["head_outer"] = self._acc(acc["head_outer"], dho)
         acc["blocks"][S - 1] = self._acc(acc["blocks"][S - 1], dchunk)
         for i in range(S - 2, 0, -1):
             g = jax.device_put(dh, plan.act_shardings[i])
-            with self._acts[i]:
-                dchunk, dh = self._bwd_mid(state.blocks[i],
-                                           stage_in[i][j], extras, g)
+            dchunk, dh = self._bwd_mid[i](state.blocks[i],
+                                          stage_in[i][j], extras, g)
             acc["blocks"][i] = self._acc(acc["blocks"][i], dchunk)
         g = jax.device_put(dh, plan.act_shardings[0])
         ids, positions, _ = stage_in[0][j]
-        with self._acts[0]:
-            douter, dchunk = self._bwd_first(
-                state.outer, state.blocks[0], ids, positions, extras, g)
+        douter, dchunk = self._bwd_first(
+            state.outer, state.blocks[0], ids, positions, extras, g)
         acc["outer"] = self._acc(acc["outer"], douter)
         acc["blocks"][0] = self._acc(acc["blocks"][0], dchunk)
         # 1F1B memory bound: drop this microbatch's stored activations

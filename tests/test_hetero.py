@@ -66,7 +66,11 @@ def _hetero_losses(cfg, batch, steps, strategy):
     (StageSpec(layers=2, tp=2), StageSpec(layers=2, tp=2)),
     (StageSpec(layers=3, tp=1), StageSpec(layers=1, tp=1)),
     (StageSpec(layers=1, tp=2, dp=1), StageSpec(layers=3, tp=1)),
-], ids=["equal_2x_tp2", "unequal_3_1", "mixed_tp"])
+    # pp=4 regression: >1 mid stage — a shared mid-stage trace would
+    # cache-collide across meshes that differ only in device ids
+    (StageSpec(layers=1, tp=2), StageSpec(layers=1, tp=2),
+     StageSpec(layers=1, tp=2), StageSpec(layers=1, tp=2)),
+], ids=["equal_2x_tp2", "unequal_3_1", "mixed_tp", "pp4_mid_stages"])
 def test_hetero_matches_homogeneous(stages):
     """Unequal stage splits compute the same network: loss trajectories
     must match the single-mesh train step on identical init/batches."""

@@ -272,8 +272,11 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
     Returns ``(init_acc, grad_step, apply_step)``:
 
     - ``acc = init_acc()`` — zeroed fp32 grad buffer (param-sharded)
-    - ``acc, loss = grad_step(state, acc, batch)`` — one forward/backward,
-      grads added into ``acc`` (donated)
+    - ``acc, loss = grad_step(state, acc, batch, accum_index=i)`` — one
+      forward/backward, grads added into ``acc`` (donated). Pass the
+      per-update accumulation counter ``i`` when dropout is active —
+      dropout keys fold (step, i) so every grad call draws independent
+      masks (``i`` is a traced operand: no recompile per index)
     - ``state, metrics = apply_step(state, acc, n_accum)`` — mean over
       ``n_accum`` accumulations, optimizer update; ``acc`` is consumed
     """
@@ -284,12 +287,30 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
             "num_microbatches inside the pipeline step instead")
     base_loss = loss_fn or default_loss_fn(model, strategy, attn_impl)
 
-    def compute_loss(params, batch):
+    def compute_loss(params, batch, key):
         with plan.act:
+            if key is not None:
+                return base_loss(params, batch, dropout_key=key)
             return base_loss(params, batch)
 
     grad_fn = jax.value_and_grad(compute_loss)
     param_shardings = plan.state_shardings.params
+    # same dropout contract as build_train_step: thread keys when the
+    # model wants dropout AND the loss fn can take them; warn otherwise
+    import inspect
+    sig = inspect.signature(base_loss)
+    accepts_key = "dropout_key" in sig.parameters or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD
+        for p in sig.parameters.values())
+    thread_dropout = model_dropout_active(model) and accepts_key
+    if model_dropout_active(model) and loss_fn is not None \
+            and not accepts_key:
+        import warnings
+        warnings.warn(
+            "model config enables dropout but the custom loss_fn has no "
+            "dropout_key parameter — dropout will be OFF in "
+            "build_grad_accum_steps; accept a dropout_key kwarg to "
+            "enable it", stacklevel=2)
 
     @functools.partial(jax.jit, out_shardings=param_shardings)
     def init_acc():
@@ -299,8 +320,12 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
 
     @functools.partial(jax.jit, donate_argnums=(1,),
                        out_shardings=(param_shardings, None))
-    def grad_step(state: TrainState, acc, batch):
-        loss, grads = grad_fn(state.params, batch)
+    def grad_step(state: TrainState, acc, batch, accum_index=0):
+        # accum_index is traced (fold_in takes traced ints): one compile
+        # serves every index
+        key = jax.random.fold_in(step_dropout_key(state.step),
+                                 accum_index) if thread_dropout else None
+        loss, grads = grad_fn(state.params, batch, key)
         return jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
                             acc, grads), loss
 

@@ -226,7 +226,7 @@ class Trainer:
             prefetcher = DevicePrefetcher(
                 batches, self.plan.shard_batch,
                 buffer_size=self.config.prefetch, max_items=steps)
-            it: Iterator[dict] = iter(prefetcher)
+            it: Iterator[dict] = prefetcher
         else:
             it = (self.plan.shard_batch(b) for b in batches)
         try:

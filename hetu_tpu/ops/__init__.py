@@ -5,6 +5,7 @@ from hetu_tpu.ops.losses import (
     softmax_cross_entropy,
     cross_entropy_mean,
     vocab_parallel_cross_entropy,
+    mse_loss, nll_loss, bce_loss, bce_with_logits_loss, kl_div_loss,
 )
 from hetu_tpu.ops.attention import attention_reference, flash_attention
 from hetu_tpu.ops.dropout import dropout
@@ -15,6 +16,8 @@ __all__ = [
     "rope_frequencies", "apply_rotary",
     "softmax_cross_entropy", "cross_entropy_mean",
     "vocab_parallel_cross_entropy",
+    "mse_loss", "nll_loss", "bce_loss", "bce_with_logits_loss",
+    "kl_div_loss",
     "attention_reference", "flash_attention",
     "dropout",
 ]

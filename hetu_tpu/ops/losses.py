@@ -208,3 +208,63 @@ def vocab_parallel_lm_loss(hidden, vocab_weight, labels, *,
 
     loss, valid = head(hidden, vocab_weight, labels)
     return loss.sum() / jnp.maximum(valid.sum(), 1)
+
+
+# ---------------------------------------------------------------------------
+# Auxiliary losses (reference op library: KLDivLoss / MSELoss / NLLLoss /
+# BCELoss in ``hetu/graph/ops``; plain jnp compositions — XLA fuses them)
+# ---------------------------------------------------------------------------
+
+def mse_loss(pred, target, *, reduction: str = "mean"):
+    d = (pred.astype(jnp.float32) - target.astype(jnp.float32)) ** 2
+    return _reduce(d, reduction)
+
+
+def nll_loss(log_probs, labels, *, ignore_index: int = -100,
+             reduction: str = "mean"):
+    """Negative log likelihood over pre-computed log-probs (..., C)."""
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    ll = jnp.take_along_axis(log_probs.astype(jnp.float32),
+                             safe[..., None], axis=-1).squeeze(-1)
+    loss = -ll * valid
+    if reduction == "mean":
+        return loss.sum() / jnp.maximum(valid.sum(), 1)
+    return _reduce(loss, reduction)
+
+
+def bce_loss(probs, target, *, eps: float = 1e-7,
+             reduction: str = "mean"):
+    p = jnp.clip(probs.astype(jnp.float32), eps, 1.0 - eps)
+    t = target.astype(jnp.float32)
+    loss = -(t * jnp.log(p) + (1.0 - t) * jnp.log1p(-p))
+    return _reduce(loss, reduction)
+
+
+def bce_with_logits_loss(logits, target, *, reduction: str = "mean"):
+    """Numerically-stable sigmoid + BCE (log-sum-exp form)."""
+    x = logits.astype(jnp.float32)
+    t = target.astype(jnp.float32)
+    loss = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return _reduce(loss, reduction)
+
+
+def kl_div_loss(log_pred, target_probs, *, eps: float = 1e-12,
+                reduction: str = "batchmean"):
+    """KL(target || pred) with pred given as log-probs (torch semantics)."""
+    t = target_probs.astype(jnp.float32)
+    lp = log_pred.astype(jnp.float32)
+    point = t * (jnp.log(jnp.maximum(t, eps)) - lp)
+    if reduction == "batchmean":
+        return point.sum() / point.shape[0]
+    return _reduce(point, reduction)
+
+
+def _reduce(x, reduction: str):
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    if reduction == "none":
+        return x
+    raise ValueError(f"unknown reduction {reduction!r}")

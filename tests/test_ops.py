@@ -151,3 +151,56 @@ def test_chunked_lm_loss_matches_dense():
     gc = jax.grad(chunked, argnums=(0, 1))(h, w)
     for a, b in zip(gc, gd):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_aux_losses_match_torch_semantics():
+    """KLDiv/MSE/NLL/BCE (reference graph/ops loss family) vs torch CPU."""
+    import numpy as np
+    import pytest
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    from hetu_tpu.ops.losses import (
+        bce_loss, bce_with_logits_loss, kl_div_loss, mse_loss, nll_loss,
+    )
+
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(4, 7)).astype(np.float32)
+    b = rng.normal(size=(4, 7)).astype(np.float32)
+    labels = rng.integers(0, 7, size=(4,))
+    probs = rng.uniform(0.01, 0.99, size=(4, 7)).astype(np.float32)
+    targ01 = rng.integers(0, 2, size=(4, 7)).astype(np.float32)
+
+    np.testing.assert_allclose(
+        float(mse_loss(a, b)),
+        float(F.mse_loss(torch.tensor(a), torch.tensor(b))), rtol=1e-5,
+        atol=1e-7)
+
+    logp = np.log(probs / probs.sum(-1, keepdims=True))
+    np.testing.assert_allclose(
+        float(nll_loss(logp, labels)),
+        float(F.nll_loss(torch.tensor(logp), torch.tensor(labels))),
+        rtol=1e-5)
+    # ignore_index zeroes masked rows
+    lab2 = labels.copy(); lab2[0] = -100
+    np.testing.assert_allclose(
+        float(nll_loss(logp, lab2)),
+        float(F.nll_loss(torch.tensor(logp), torch.tensor(lab2),
+                         ignore_index=-100)), rtol=1e-5, atol=1e-7)
+
+    np.testing.assert_allclose(
+        float(bce_loss(probs, targ01)),
+        float(F.binary_cross_entropy(torch.tensor(probs),
+                                     torch.tensor(targ01))), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(bce_with_logits_loss(a, targ01)),
+        float(F.binary_cross_entropy_with_logits(
+            torch.tensor(a), torch.tensor(targ01))), rtol=1e-5)
+
+    # pred distinct from target so KL is far from the 0 fixed point
+    lpred = np.log(np.exp(a) / np.exp(a).sum(-1, keepdims=True))
+    tprobs = probs / probs.sum(-1, keepdims=True)
+    np.testing.assert_allclose(
+        float(kl_div_loss(lpred, tprobs)),
+        float(F.kl_div(torch.tensor(lpred), torch.tensor(tprobs),
+                       reduction="batchmean")), rtol=1e-5, atol=1e-7)

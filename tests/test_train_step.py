@@ -306,8 +306,9 @@ def test_split_phase_grad_accumulation():
         model, opt, plan)
     acc = init_acc()
     losses = []
-    for b in batches:
-        acc, loss = grad_step(state, acc, plan.shard_batch(b))
+    for i, b in enumerate(batches):
+        acc, loss = grad_step(state, acc, plan.shard_batch(b),
+                              accum_index=i)
         losses.append(float(loss))
     state, m = apply_step(state, acc, 2.0)
 

@@ -269,14 +269,15 @@ class Trainer:
         if self.state is None:
             self.initialize()
         history = []
+        host_step = int(jax.device_get(self.state.step))
         for _ in range(epochs):
             for batch, plan in dispatcher.batches(seqs):
                 metrics = self.train_step(batch)
-                step_no = int(jax.device_get(self.state.step))
+                host_step += 1   # host-side: no per-step device sync
                 if self.config.log_every and \
-                        step_no % self.config.log_every == 0:
+                        host_step % self.config.log_every == 0:
                     history.append(self.metrics.log(
-                        step_no,
+                        host_step,
                         loss=float(jax.device_get(metrics["loss"])),
                         bucket=plan.bucket_len))
         return history

@@ -51,3 +51,9 @@ def test_elastic_train_example():
 def test_sft_example():
     out = _run("sft.py")
     assert "final:" in out
+
+
+@pytest.mark.parametrize("script", ["hot_switch.py", "long_context.py",
+                                    "lora_sft.py"])
+def test_remaining_examples_run(script):
+    _run(script, timeout=600)

@@ -83,6 +83,8 @@ SLOW_TESTS = {
     "test_hetero_malleus_example",
     "test_hydraulis_example",
     "test_elastic_train_example",
+    "test_sft_example",
+    "test_remaining_examples_run",
     # multi-process (real OS processes + jax.distributed)
     "test_two_process_dp_training",
     "test_kill_restart_resumes_from_checkpoint",

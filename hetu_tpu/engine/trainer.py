@@ -48,6 +48,8 @@ class TrainerConfig:
     prefetch: int = 2            # device-prefetch depth for train();
                                  # 0 disables (reference: async C++
                                  # dataloader + dedicated H2D stream)
+    eval_every: int = 0          # validation cadence for train(); 0 = off
+                                 # (needs eval_batches passed to train)
 
     def policy(self) -> Policy:
         return BF16_COMPUTE if self.precision == "bf16" else FP32
@@ -203,7 +205,8 @@ class Trainer:
         return metrics
 
     def train(self, batches: Iterable[dict],
-              steps: Optional[int] = None) -> list[dict]:
+              steps: Optional[int] = None, *,
+              eval_batches=None) -> list[dict]:
         """Run up to ``steps`` (default config.total_steps) steps; returns
         the logged metric records.
 
@@ -212,7 +215,12 @@ class Trainer:
         sync every step and serialize dispatch), the host only blocks on
         metrics at log boundaries, and batches are staged through the
         device prefetcher (``data/prefetch.py``) so H2D transfers overlap
-        the previous step's compute."""
+        the previous step's compute.
+
+        ``eval_batches``: a *callable returning an iterable* of held-out
+        batches; every ``config.eval_every`` steps it is re-invoked and
+        the mean validation loss (dropout off) is logged as
+        ``eval_loss``."""
         if self.state is None:
             self.initialize()
         steps = steps if steps is not None else self.config.total_steps
@@ -250,6 +258,11 @@ class Trainer:
                             tokens_since / (now - t_last), 1))
                     history.append(rec)
                     t_last, tokens_since = now, 0
+                if self.config.eval_every and eval_batches is not None \
+                        and host_step % self.config.eval_every == 0:
+                    ev = self.evaluate(eval_batches())
+                    history.append(self.metrics.log(host_step,
+                                                    eval_loss=ev))
                 if self.config.ckpt_every and self.config.ckpt_dir and \
                         host_step % self.config.ckpt_every == 0:
                     self.save()

@@ -217,3 +217,21 @@ def test_plan_pool_reuses_executables_on_switch_back():
     assert t.plan is plan_a and t._step_fn is step_a
     m = t.train_step(next(iter(_batches(1, seed=5))))
     assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+def test_periodic_eval_during_train():
+    """config.eval_every: validation loss (dropout off) logged on cadence
+    alongside training metrics."""
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    tr = Trainer(model, optim.adamw(1e-3), Strategy(dp=2),
+                 config=TrainerConfig(total_steps=6, log_every=0,
+                                      eval_every=3, precision="fp32"))
+    ids = np.asarray(jax.random.randint(jax.random.key(1), (8, 17), 0,
+                                        cfg.vocab_size))
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    hist = tr.train(iter([batch] * 6),
+                    eval_batches=lambda: [batch, batch])
+    evals = [h for h in hist if "eval_loss" in h]
+    assert [h["step"] for h in evals] == [3, 6]
+    assert all(np.isfinite(h["eval_loss"]) for h in evals)

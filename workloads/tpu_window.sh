@@ -13,13 +13,14 @@ run() {
 }
 # 0. health probe (fail fast if the tunnel is down)
 timeout 120 python -c "import jax; x=jax.numpy.ones((512,512)); print((x@x).sum(), jax.devices()[0].device_kind)" || { echo "TPU DOWN"; exit 1; }
-# 1. the bench config sweep (feeds bench.py defaults)
+# 1. the headline bench FIRST — a short window must capture the MFU
+# number before anything else
+run bench 900 python bench.py
+# 2. the config sweep (feeds bench.py defaults for next time)
 run mfu_sweep 1500 python workloads/mfu_sweep.py
-# 1b. bf16-param variant on the contenders (halves param/grad traffic)
+# 2b. bf16-param variant on the contenders (halves param/grad traffic)
 run mfu_sweep_bf16 900 python workloads/mfu_sweep.py --param-dtype bf16 \
     --grid 32:selective:1,64:selective:1,16:none:1
-# 2. the headline bench itself
-run bench 900 python bench.py
 # 3. flash kernel vs XLA attention
 run attn_bench 900 python workloads/attn_bench.py
 # 4. BASELINE configs 1/3/4/5

@@ -38,8 +38,12 @@ void* bpe_create(int64_t n, const int32_t* left, const int32_t* right,
   auto* t = new BpeTable();
   t->merges.reserve(static_cast<size_t>(n) * 2);
   for (int64_t i = 0; i < n; ++i) {
-    t->merges.emplace(key_of(left[i], right[i]),
-                      std::make_pair(rank[i], merged[i]));
+    // last occurrence wins for duplicate (left,right) pairs — matching
+    // the Python fallback's dict assignment semantics (emplace would
+    // keep the FIRST and make token ids depend on whether this core
+    // compiled)
+    t->merges[key_of(left[i], right[i])] =
+        std::make_pair(rank[i], merged[i]);
   }
   return t;
 }

@@ -101,6 +101,14 @@ class DevicePrefetcher:
     def close(self) -> None:
         self._stop.set()          # producer aborts within its put timeout
         self._done = True
+        # join BEFORE draining: a producer blocked in put() could
+        # otherwise succeed after the drain and leave one staged device
+        # batch pinned in the queue until GC. Short timeout: the join
+        # only needs to cover a put() already in flight (0.1s poll); a
+        # producer stuck in next(it) can't enqueue after _stop anyway,
+        # and __del__ → close() must not stall GC.
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=0.3)
         # release any staged device batches immediately
         try:
             while True:

@@ -219,6 +219,13 @@ class ByteLevelBPETokenizer:
             segments = [piece
                         for seg in segments
                         for piece in self._split_keep(seg, sp)]
+        # evict BEFORE scanning cache membership — clearing inside
+        # _encode_words would invalidate placeholder words this call
+        # already saw in the cache and left out of `pending`
+        if len(self._id_cache) > self._cache_limit:
+            self._id_cache.clear()
+        if len(self._cache) > self._cache_limit:
+            self._cache.clear()
         ids = []
         pending: list[str] = []     # uncached words, encode-order
         for seg in segments:
@@ -242,11 +249,8 @@ class ByteLevelBPETokenizer:
     def _encode_words(self, words: list[str]) -> None:
         """Fill ``_id_cache`` for ``words`` — one batched native call
         (csrc/bpe.cpp) so ctypes overhead amortizes over the whole text;
-        pure-Python merge loop as the fallback."""
-        if len(self._id_cache) > self._cache_limit:
-            self._id_cache.clear()
-        if len(self._cache) > self._cache_limit:
-            self._cache.clear()
+        pure-Python merge loop as the fallback. Eviction happens in
+        encode() (before membership scans), never here."""
         uniq = list(dict.fromkeys(words))
         if self._native is None:
             for w in uniq:

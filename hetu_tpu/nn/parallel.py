@@ -33,7 +33,9 @@ from hetu_tpu.nn.module import Module, ParamSpec, normal_init, zeros_init
 from hetu_tpu.ops import activations as act_ops
 from hetu_tpu.ops.attention import attention_reference, flash_attention
 from hetu_tpu.ops.rotary import rope_frequencies, apply_rotary
-from hetu_tpu.parallel.sharding import act_constrain, current_act_sharding
+from hetu_tpu.parallel.sharding import (
+    act_constrain, current_act_sharding, current_manual_axes,
+)
 
 
 class ColumnParallelLinear(Module):
@@ -244,7 +246,19 @@ class ParallelAttention(Module):
         k = act_constrain(k, "heads")
         v = act_constrain(v, "heads")
         ctx = current_act_sharding()
-        if ctx is not None and isinstance(ctx.seq, str) \
+        mctx = current_manual_axes()
+        if ctx is None and mctx is not None and "cp" in mctx.axes \
+                and mctx.mesh.shape["cp"] > 1:
+            # inside a manual region (pipeline executor) with cp bound:
+            # run the ring core directly on the bound axis — x/q/k/v here
+            # are the per-device local seq chunks
+            from hetu_tpu.parallel.ring_attention import \
+                ring_attention_manual
+            out = ring_attention_manual(
+                q, k, v, axis_name="cp", cp=mctx.mesh.shape["cp"],
+                causal=self.causal, segment_ids=segment_ids,
+                impl=attn_impl, layout=mctx.cp_layout)
+        elif ctx is not None and isinstance(ctx.seq, str) \
                 and ctx.mesh.shape[ctx.seq] > 1:
             # context parallelism: seq dim is sharded — KV ring
             # (reference: ParallelAttentionOp → AttnCommRing) or the
@@ -394,11 +408,15 @@ class StackedBlocks(Module):
         then schedules across layer boundaries and drops the per-layer
         dynamic-update-slice residual stacking (measurably faster on a
         single chip; costs compile time ∝ layers)."""
-        unroll_n = self.num_layers if unroll else 1
+        # layer count comes from the params actually passed — pipeline /
+        # hetero executors call this with a per-stage CHUNK whose leading
+        # axis is shorter than the full model's num_layers
+        n_layers = jax.tree.leaves(params)[0].shape[0]
+        unroll_n = n_layers if unroll else 1
         # per-layer dropout keys ride the scan as xs (None = deterministic)
         dropout_key = kwargs.pop("dropout_key", None)
         layer_keys = None if dropout_key is None \
-            else jax.random.split(dropout_key, self.num_layers)
+            else jax.random.split(dropout_key, n_layers)
 
         def call_block(layer_params, h, xs_key):
             if xs_key is not None:
@@ -425,15 +443,15 @@ class StackedBlocks(Module):
         carry0 = (x, aux0) if self._block.returns_aux else x
 
         if remat_mask is not None:
-            if len(remat_mask) != self.num_layers:
+            if len(remat_mask) != n_layers:
                 raise ValueError(
                     f"remat_mask has {len(remat_mask)} entries for "
-                    f"{self.num_layers} layers")
+                    f"{n_layers} layers")
             policy_name = remat if remat != "none" else "full"
             runs = []  # (start, stop, flag) consecutive same-flag runs
             start = 0
-            for i in range(1, self.num_layers + 1):
-                if i == self.num_layers \
+            for i in range(1, n_layers + 1):
+                if i == n_layers \
                         or bool(remat_mask[i]) != bool(remat_mask[start]):
                     runs.append((start, i, bool(remat_mask[start])))
                     start = i

@@ -253,8 +253,21 @@ class HeteroTrainStep:
         self.nm, self.pp = st.num_microbatches, st.pp
         remat = st.remat
         blocks = model.blocks
+        from hetu_tpu.engine.train_step import model_dropout_active
+        self._dropout = model_dropout_active(model)
+        self._embed_rate = getattr(model, "embed_dropout_rate", 0.0)
 
-        def run_chunk(chunk, h, extras):
+        def run_chunk(chunk, h, extras, stage):
+            # dropout rides as a host-derived uint32 seed (NOT a key
+            # array: keys are committed to the default device and the
+            # stages live on distinct meshes); each stage folds in its
+            # static index so masks differ per stage, and the backward's
+            # vjp recompute closes over the same extras → same masks
+            extras = dict(extras)
+            seed = extras.pop("dropout_seed", None)
+            if seed is not None:
+                extras["dropout_key"] = jax.random.fold_in(
+                    jax.random.key(seed), stage)
             return blocks(chunk, h, remat=remat, attn_impl=attn_impl,
                           **extras)
 
@@ -274,15 +287,24 @@ class HeteroTrainStep:
                 for m in plan.meshes]
         act_first, act_last = acts[0], acts[-1]
 
+        embed_rate = self._embed_rate
+
         def fwd_first(outer, chunk, ids, positions, extras):
             with act_first:
                 h = model.embed({**outer, "blocks": None}, ids,
                                 positions=positions)
-                return run_chunk(chunk, h, extras)
+                seed = extras.get("dropout_seed")
+                if seed is not None and embed_rate > 0:
+                    from hetu_tpu.ops.dropout import dropout as _drop
+                    # stage index S = one past the last block stage —
+                    # a stream no run_chunk call uses
+                    h = _drop(h, embed_rate,
+                              jax.random.fold_in(jax.random.key(seed), S))
+                return run_chunk(chunk, h, extras, 0)
 
         def loss_last(outer, chunk, h, labels, extras):
             with act_last:
-                h = run_chunk(chunk, h, extras)
+                h = run_chunk(chunk, h, extras, S - 1)
                 return model.head_loss({**outer, "blocks": None}, h,
                                        labels)
 
@@ -305,7 +327,7 @@ class HeteroTrainStep:
 
             def fwd_mid(chunk, h, extras):
                 with act:
-                    return run_chunk(chunk, h, extras)
+                    return run_chunk(chunk, h, extras, i)
 
             def bwd_mid(chunk, h, extras, g):
                 _, vjp = jax.vjp(lambda c, x: fwd_mid(c, x, extras),
@@ -368,6 +390,13 @@ class HeteroTrainStep:
         extras = {"positions": positions}
         if seg is not None:
             extras["segment_ids"] = seg
+        if self._dropout:
+            # per-(step, microbatch) stream; stage folded in per chunk.
+            # Host-side uint32 (same aval every call → no retrace) keeps
+            # resume-reproducibility: same step ⇒ same masks.
+            j = len(extras_of)
+            extras["dropout_seed"] = np.uint32(
+                (int(state.step) * self.nm + j) & 0xFFFFFFFF)
         extras_of.append(extras)
         h = self._fwd_first(state.outer, state.blocks[0], ids,
                             positions, extras)

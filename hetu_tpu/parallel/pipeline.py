@@ -6,7 +6,8 @@ P2P between stages (.cc:987-1008), shared-embedding send/recv classification
 (.cc:1868-1960). The TPU-native design is one SPMD program: the stacked
 ``layers`` axis of the block params is sharded over the ``pp`` mesh axis
 (axis rule ``"layers" → "pp"``), and inside a *partial-manual* ``shard_map``
-(manual over pp only — dp/tp/cp stay GSPMD-auto) microbatches stream through
+(manual over pp — plus ep for MoE dispatch and cp for ring attention;
+dp/tp stay GSPMD-auto) microbatches stream through
 stages with ``ppermute``; a ``lax.scan`` over ``num_microbatches + pp - 1``
 ticks realizes the fill/steady/drain schedule. Reverse-mode AD through the
 scan+ppermute yields the flush-style backward automatically, and per-stage
@@ -40,6 +41,8 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
                     pp_axis: str = "pp", remat: str = "none",
                     block_returns_aux: bool = False,
                     manual_ep: bool = False,
+                    manual_cp: bool = False,
+                    cp_layout: str = "contiguous",
                     param_manual_specs: Any = None):
     """Run ``payload`` microbatches through pp pipeline stages.
 
@@ -76,6 +79,9 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
                 if manual_ep:   # decorrelate the ep-sharded row groups
                     key = jax.random.fold_in(
                         key, jax.lax.axis_index("ep"))
+                if manual_cp:   # decorrelate the cp-sharded seq chunks
+                    key = jax.random.fold_in(
+                        key, jax.lax.axis_index("cp") + 1_000_003)
                 extras["dropout_key"] = key
             return block_fn(layer_params, h, **extras)
 
@@ -136,24 +142,28 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
             jnp.where(stage == pp - 1, v, jnp.zeros([], v.dtype)), pp_axis)
             for k, v in out_bufs.items()}
 
-    manual = {pp_axis} | ({"ep"} if manual_ep else set())
+    manual = {pp_axis} | ({"ep"} if manual_ep else set()) \
+        | ({"cp"} if manual_cp else set())
     param_specs = param_manual_specs if param_manual_specs is not None \
         else jax.tree.map(lambda _: P(pp_axis), stacked_params)
-    if manual_ep:
-        # microbatch dim (axis 1 of every payload array) splits over the
-        # manual ep axis; aux is replicated (MoE pmeans it per layer)
-        payload_specs = {
-            k: (P() if k in ("aux", "dropout_rng")   # rng: per-microbatch,
-                                                     # not per-row — replicate
-                else P(None, "ep", *([None] * (v.ndim - 2))))
-            for k, v in payload.items()
-        }
-        out_specs = {k: (P() if k == "aux"
-                         else P(None, "ep", None, None))
-                     for k in collect}
-    else:
-        payload_specs = jax.tree.map(lambda _: P(), payload)
-        out_specs = {k: P() for k in collect}
+
+    # payload partitioning over the manual axes: microbatch dim (axis 1)
+    # splits over ep, seq dim (axis 2) over cp; aux and the per-microbatch
+    # dropout key data stay replicated (rng: per-microbatch, not per-row —
+    # the device_fn decorrelates by folding in the axis indices)
+    def payload_spec(k, v):
+        if k in ("aux", "dropout_rng"):
+            return P()
+        parts = [None] * v.ndim
+        if manual_ep:
+            parts[1] = "ep"
+        if manual_cp and v.ndim >= 3:
+            parts[2] = "cp"     # x (nm,mb,s,E) and positions/segment_ids
+                                # (nm,mb,s) all carry seq at axis 2
+        return P(*parts)
+
+    payload_specs = {k: payload_spec(k, v) for k, v in payload.items()}
+    out_specs = {k: payload_spec(k, payload[k]) for k in collect}
 
     fn = shard_map(
         device_fn, mesh=mesh,
@@ -162,9 +172,10 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
         axis_names=manual, check_vma=False)
     # activation-sharding constraints don't apply inside the manual region
     # (and ring attention must not nest another shard_map) — trace with the
-    # context suppressed; ManualAxes tells nested layers (MoE) which axes
-    # are bound so they use direct collectives
-    with no_act_sharding(), ManualAxes(mesh, frozenset(manual)):
+    # context suppressed; ManualAxes tells nested layers (MoE, ring
+    # attention) which axes are bound so they use direct collectives
+    with no_act_sharding(), ManualAxes(mesh, frozenset(manual),
+                                       cp_layout=cp_layout):
         out = fn(stacked_params, payload)
     if block_returns_aux:
         return out["x"], out["aux"]
@@ -188,6 +199,9 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
     # EP x PP: the pipeline region goes manual over {pp, ep} and MoE
     # layers run their all_to_all dispatch on the bound ep axis
     manual_ep = strategy.ep > 1 and model.blocks.returns_aux
+    # CP x PP: bind cp too and run the ring per stage (zigzag honored);
+    # ulysses falls back to GSPMD-contiguous inside the region
+    manual_cp = strategy.cp > 1 and strategy.cp_impl == "ring"
     param_manual_specs = None
     if manual_ep:
         from hetu_tpu.parallel.sharding import param_partition_specs
@@ -244,7 +258,8 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
                 block_fn, params["blocks"], payload, mesh=mesh,
                 num_microbatches=nm, remat=remat,
                 block_returns_aux=block.returns_aux,
-                manual_ep=manual_ep,
+                manual_ep=manual_ep, manual_cp=manual_cp,
+                cp_layout=strategy.effective_cp_layout,
                 param_manual_specs=param_manual_specs)
             aux = jnp.zeros([], jnp.float32)
             if block.returns_aux:

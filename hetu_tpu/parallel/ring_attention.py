@@ -359,6 +359,49 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
     return ring
 
 
+def _select_impl(impl: str, d: int, s_local: int, causal: bool, cp: int,
+                 layout: str) -> bool:
+    """Shared impl-auto rule + zigzag divisibility validation for both
+    ring entry points (standalone GSPMD wrapper and the manual-region
+    path) — one copy so they can never pick different kernels for the
+    same config."""
+    zig = layout == "zigzag" and causal and cp > 1
+    if zig and s_local % 2 != 0:
+        raise ValueError(
+            f"zigzag layout needs even local seq, got {s_local} "
+            f"(global seq must divide by 2*cp)")
+    # zigzag hops run flash on half-chunks, so the pallas tile constraint
+    # applies to s_local // 2
+    s_tile = s_local // 2 if zig else s_local
+    if impl == "auto":
+        return (jax.default_backend() == "tpu"
+                and d in (64, 128, 256) and s_tile % 128 == 0)
+    return impl == "pallas"
+
+
+def ring_attention_manual(q, k, v, *, axis_name: str, cp: int,
+                          causal: bool = True,
+                          segment_ids: Optional[jnp.ndarray] = None,
+                          scale: Optional[float] = None,
+                          impl: str = "auto",
+                          layout: str = "contiguous"):
+    """Ring attention over an ALREADY-BOUND manual mesh axis.
+
+    For call sites inside an enclosing ``shard_map`` (the pipeline
+    executor, manual over {pp, cp, ...}) where nesting another shard_map
+    is illegal: ``q/k/v`` are the per-device LOCAL chunks
+    (b, s_local, h, d) and ``segment_ids`` the local (b, s_local) chunk.
+    Composes CP with PP the way the reference runs ``AttnCommRing``
+    inside any pipeline (``ParallelAttention.h:391-470``).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    use_pallas = _select_impl(impl, d, q.shape[1], causal, cp, layout)
+    ring = _make_ring_core(axis_name, cp, causal, scale, use_pallas,
+                           layout=layout)
+    return ring(q, k, v, segment_ids, segment_ids)
+
+
 def ring_attention(q, k, v, *, ctx, causal: bool = True,
                    segment_ids: Optional[jnp.ndarray] = None,
                    scale: Optional[float] = None, impl: str = "auto",
@@ -380,21 +423,11 @@ def ring_attention(q, k, v, *, ctx, causal: bool = True,
     if layout is None:
         layout = getattr(ctx, "cp_layout", "contiguous")
 
-    s_local = q.shape[1] // cp
-    if layout == "zigzag" and causal and cp > 1 \
-            and q.shape[1] % (2 * cp) != 0:
+    if q.shape[1] % cp != 0:
         raise ValueError(
-            f"zigzag layout needs seq {q.shape[1]} divisible by 2*cp="
-            f"{2 * cp} (equal-size global chunks)")
-    # zigzag hops run flash on half-chunks, so the pallas tile constraint
-    # applies to s_local // 2
-    s_tile = s_local // 2 if (layout == "zigzag" and causal and cp > 1) \
-        else s_local
-    if impl == "auto":
-        use_pallas = (jax.default_backend() == "tpu"
-                      and d in (64, 128, 256) and s_tile % 128 == 0)
-    else:
-        use_pallas = impl == "pallas"
+            f"seq {q.shape[1]} must divide by cp={cp}")
+    use_pallas = _select_impl(impl, d, q.shape[1] // cp, causal, cp,
+                              layout)
 
     ring = _make_ring_core(ctx.seq, cp, causal, scale, use_pallas,
                            layout=layout)

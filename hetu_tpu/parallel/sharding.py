@@ -163,11 +163,16 @@ class ManualAxes:
     """Marks that tracing happens inside a ``shard_map`` manual over
     ``axes`` of ``mesh`` (the pipeline region). Layers that would
     otherwise open their own ``shard_map`` (MoE all_to_all, vocab-parallel
-    CE) consult this to use bound-axis collectives directly instead —
-    nested shard_maps are not allowed."""
+    CE, ring attention) consult this to use bound-axis collectives
+    directly instead — nested shard_maps are not allowed.
+
+    ``cp_layout`` describes how the global sequence was laid out when
+    "cp" is one of the bound axes (ring attention needs it to pick the
+    per-hop masks)."""
 
     mesh: Mesh
     axes: frozenset
+    cp_layout: str = "contiguous"
 
     def __enter__(self):
         _MANUAL_CTX.append(self)

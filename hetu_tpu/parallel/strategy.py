@@ -62,13 +62,16 @@ class Strategy:
 
     @property
     def effective_cp_layout(self) -> str:
-        """The layout actually in force: pp>1 runs attention under GSPMD
-        inside the pipeline region (no ring), which assumes the plain
-        contiguous causal mask — zigzag only applies to the ring path.
-        Both ``shard_batch`` and ``make_plan`` consult this single source
-        of truth."""
-        if self.pp > 1 or self.cp == 1 or self.cp_impl == "ulysses":
-            return "contiguous"   # ulysses reassembles global order
+        """The layout actually in force. The ring path (cp_impl="ring")
+        honors ``cp_layout`` both standalone and inside the pipeline
+        region (pp>1 binds cp as a manual shard_map axis and runs the
+        ring core per stage — reference composes AttnCommRing with any
+        pipeline, ``ParallelAttention.h:391-470`` +
+        ``generate_llama_4d_config.py:11-51``). Ulysses reassembles
+        global order, so it is always contiguous. Both ``shard_batch``
+        and ``make_plan`` consult this single source of truth."""
+        if self.cp == 1 or self.cp_impl == "ulysses":
+            return "contiguous"
         return self.cp_layout
 
     def mesh_shape(self) -> dict[str, int]:

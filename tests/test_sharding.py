@@ -88,19 +88,22 @@ def test_data_spec():
 
 
 def test_effective_cp_layout():
-    """pp>1 runs attention under GSPMD (no ring) — zigzag must switch off
-    everywhere (shard_batch AND the activation ctx the eval path uses)."""
+    """The ring honors zigzag both standalone AND inside the pipeline
+    region (pp binds cp as a manual axis since r4); ulysses always
+    reassembles global order, so it is contiguous everywhere."""
     from hetu_tpu.engine import make_plan
     from hetu_tpu import optim
     from hetu_tpu.models import GPTConfig, GPTLMHeadModel
 
     assert Strategy(cp=2).effective_cp_layout == "zigzag"
     assert Strategy(cp=2, pp=2, num_microbatches=2).effective_cp_layout \
-        == "contiguous"
+        == "zigzag"
     assert Strategy(cp=1).effective_cp_layout == "contiguous"
+    assert Strategy(cp=2, cp_impl="ulysses").effective_cp_layout \
+        == "contiguous"
     plan = make_plan(GPTLMHeadModel(GPTConfig.tiny()), optim.adam(1e-3),
                      Strategy(cp=2, pp=2, dp=2, num_microbatches=2))
-    assert plan.act.cp_layout == "contiguous"
+    assert plan.act.cp_layout == "zigzag"
 
 
 def test_hybrid_mesh_single_slice_falls_back():

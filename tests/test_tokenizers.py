@@ -86,6 +86,22 @@ def test_encode_emits_special_ids(tok):
     assert tok.encode("<|endoftext|>") == [eot]
 
 
+def test_cache_eviction_mid_encode_regression(tok):
+    """Eviction must not strand placeholder words recorded before the
+    clear: encode() caches 'hello', then a call whose NEW words push the
+    cache over the limit must still resolve the already-cached 'hello'
+    (old code cleared inside _encode_words and KeyError'd)."""
+    old = tok._cache_limit
+    try:
+        tok._id_cache.clear()
+        tok.encode("hello world")          # seeds the cache
+        tok._cache_limit = 1               # next encode triggers eviction
+        ids = tok.encode("hello fox dog quick brown")
+        assert tok.decode(ids) == "hello fox dog quick brown"
+    finally:
+        tok._cache_limit = old
+
+
 def test_save_load_preserves_specials(tok, tmp_path):
     tok.save(str(tmp_path))
     tok2 = ByteLevelBPETokenizer.from_files(
@@ -102,6 +118,11 @@ def test_native_bpe_parity_and_speed():
     import time
 
     from hetu_tpu.data.tokenizers import _bpe_lib
+
+    def _timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
 
     random.seed(0)
     roots = ["inter", "nation", "token", "transform", "comput",
@@ -134,13 +155,14 @@ def test_native_bpe_parity_and_speed():
     blob = " ".join(random.choice(roots) + random.choice(sufs)
                     + str(random.randint(0, 10 ** 6))
                     for _ in range(8000))
-    t0 = time.perf_counter(); tok._id_cache.clear(); tok.encode(blob)
-    t_native = time.perf_counter() - t0
-    t0 = time.perf_counter(); tok_py._id_cache.clear(); tok_py._cache.clear()
-    tok_py.encode(blob)
-    t_py = time.perf_counter() - t0
+    # min over repeats: a single run flakes under CI contention; the
+    # claim defended is "native is not meaningfully slower" (typical
+    # measured: ~1.4x faster). The authoritative timing comparison lives
+    # in workloads/, not here.
+    t_native = min(_timed(lambda: (tok._id_cache.clear(), tok.encode(blob)))
+                   for _ in range(3))
+    t_py = min(_timed(lambda: (tok_py._id_cache.clear(),
+                               tok_py._cache.clear(), tok_py.encode(blob)))
+               for _ in range(3))
     assert tok.encode(blob) is not None
-    # generous margin: single-run wall clock flakes under CI contention;
-    # the claim defended is "native is not meaningfully slower" (typical
-    # measured: ~1.4x faster)
     assert t_native < 1.5 * t_py, (t_native, t_py)

@@ -21,6 +21,53 @@ from hetu_tpu.data.bucket import SeqLenBuckets
 from hetu_tpu.parallel.strategy import Strategy
 
 
+def preferred_cp_impl(seq_len: int, cp: int, num_heads: int,
+                      table_path: Optional[str] = None) -> str:
+    """Pick ring vs Ulysses for one (seq, cp) bucket.
+
+    Measured-profile-first: when ``workloads/out/cp_compare.json`` exists
+    (written by ``workloads/cp_compare.py``), the nearest measured
+    (cp, seq) winner decides. Fallback heuristic: Ulysses when it is
+    legal (heads divide by cp) and the sequence is short enough that its
+    two dense all_to_alls beat cp-1 ring hops (moderate cp, seq below
+    ~8k); ring otherwise — ring's per-hop overlap wins at long context.
+    """
+    if num_heads % cp != 0:
+        return "ring"                    # ulysses illegal
+    import os as _os
+    path = table_path or _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__)))), "workloads", "out",
+        "cp_compare.json")
+    table = _load_cp_table(path)
+    if table:
+        best = min(table, key=lambda r: (abs(r["cp"] - cp),
+                                         abs(r["seq"] - seq_len)))
+        return best["winner"]
+    return "ulysses" if (cp <= 4 and seq_len < 8192) else "ring"
+
+
+_CP_TABLE_CACHE: dict = {}
+
+
+def _load_cp_table(path: str) -> Optional[list]:
+    """The winners table, memoized on (path, mtime) — plan_buckets calls
+    preferred_cp_impl per (bucket × cp candidate) and the table is
+    immutable between measurement runs."""
+    import json as _json
+    import os as _os
+    try:
+        mtime = _os.path.getmtime(path)
+        key = (path, mtime)
+        if key not in _CP_TABLE_CACHE:
+            with open(path) as f:
+                _CP_TABLE_CACHE.clear()     # old mtimes are dead weight
+                _CP_TABLE_CACHE[key] = _json.load(f)["results"]
+        return _CP_TABLE_CACHE[key]
+    except (OSError, ValueError, KeyError):
+        return None
+
+
 @dataclasses.dataclass(frozen=True)
 class BucketPlan:
     """Dispatch recipe for one bucket length."""
@@ -71,9 +118,11 @@ def plan_buckets(lengths: Iterable[int], *,
                 cps.append(cp)
                 cp *= 2
             for cp in cps:
+                impl = base.cp_impl if cp == 1 else preferred_cp_impl(
+                    L, cp, dims_base.num_heads)
                 for remat in ("none", "full"):
                     cand = dataclasses.replace(
-                        base, cp=cp, remat=remat,
+                        base, cp=cp, remat=remat, cp_impl=impl,
                         dp=max(1, topo.num_devices // (cp * base.tp
                                                        * base.pp)))
                     dims = dataclasses.replace(

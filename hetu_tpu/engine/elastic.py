@@ -96,3 +96,51 @@ class ElasticController:
         t.start()
         t.stop_event = stop  # type: ignore[attr-defined]
         return t
+
+
+def elastic_resume(model, opt, new_strategy, *, state=None, devices=None,
+                   checkpoint_dir: Optional[str] = None):
+    """Resume training after a failure, preferring LIVE state.
+
+    The reference's elastic server restarts survivors from the latest
+    checkpoint (``heturpc_elastic_server.py:497-559`` → load_by_training).
+    The TPU-native controller can do better: when the controller process
+    survived (its train state is still resident), the state is resharded
+    in memory onto the recovery plan via the hot-switch path
+    (``parallel.switch.switch_strategy`` → ``cross_topology_switch``) —
+    NO checkpoint read, no disk round trip. Disk is the fallback only
+    when the controller itself died (``state=None``).
+
+    ``devices``: the surviving device list for the new plan's mesh
+    (defaults to all visible devices). Returns ``(new_plan, new_state)``.
+    """
+    from hetu_tpu.engine.train_step import make_plan
+
+    new_plan = make_plan(model, opt, new_strategy, devices=devices)
+    if state is not None:
+        from hetu_tpu.parallel.switch import switch_strategy
+        try:
+            new_state = switch_strategy(state, new_plan)
+        except Exception as e:
+            # live reshard can be impossible: e.g. tp-sharded state whose
+            # only copy of some shards lived on the dead devices — fall
+            # back to disk when we can
+            if checkpoint_dir is None:
+                raise
+            get_logger().warning(
+                f"elastic_resume: in-memory reshard failed ({e!r}) — "
+                f"falling back to the sharded checkpoint")
+        else:
+            get_logger().info(
+                "elastic_resume: live state present — in-memory reshard "
+                "(no checkpoint read)")
+            return new_plan, new_state
+    if checkpoint_dir is None:
+        raise ValueError(
+            "elastic_resume: no live state and no checkpoint_dir — "
+            "nothing to resume from")
+    get_logger().info(
+        "elastic_resume: controller died — loading sharded checkpoint")
+    from hetu_tpu.utils.dist_checkpoint import load_checkpoint_distributed
+    return new_plan, load_checkpoint_distributed(
+        checkpoint_dir, model, opt, plan=new_plan)

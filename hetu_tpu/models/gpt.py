@@ -46,6 +46,12 @@ class GPTConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    # gate variant (reference gate zoo ``hetu/v1/python/hetu/layers/``):
+    # "topk" | "ktop1" | "sam" | "balance"
+    moe_gate: str = "topk"
+    # SAM gate: expert groups (should equal the ep degree so group-local
+    # routing maps to device-local dispatch); 0 = gate default
+    moe_num_groups: int = 0
 
     @classmethod
     def small(cls):
@@ -79,10 +85,13 @@ class GPTBlock(Module):
         self.resid_pdrop = cfg.resid_pdrop
         if cfg.num_experts > 0:
             from hetu_tpu.nn.moe import MoEMLP
+            gkw = {"num_groups": cfg.moe_num_groups} \
+                if cfg.moe_gate == "sam" and cfg.moe_num_groups else None
             self.mlp = MoEMLP(cfg.hidden_size,
                               cfg.mlp_ratio * cfg.hidden_size,
                               cfg.num_experts, k=cfg.moe_top_k,
-                              capacity_factor=cfg.moe_capacity_factor)
+                              capacity_factor=cfg.moe_capacity_factor,
+                              gate_type=cfg.moe_gate, gate_kwargs=gkw)
             self.returns_aux = True
         else:
             self.mlp = ParallelMLP(cfg.hidden_size,

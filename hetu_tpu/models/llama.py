@@ -48,6 +48,8 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    moe_gate: str = "topk"   # "topk" | "ktop1" | "sam" | "balance"
+    moe_num_groups: int = 0  # SAM expert groups (0 = gate default)
 
     @classmethod
     def llama_7b(cls):
@@ -79,10 +81,13 @@ class LlamaBlock(Module):
         self.post_attn_norm = RMSNorm(cfg.hidden_size, eps=cfg.rms_norm_eps)
         if cfg.num_experts > 0:
             from hetu_tpu.nn.moe import MoEMLP
+            gkw = {"num_groups": cfg.moe_num_groups} \
+                if cfg.moe_gate == "sam" and cfg.moe_num_groups else None
             self.mlp = MoEMLP(cfg.hidden_size, cfg.intermediate_size,
                               cfg.num_experts, k=cfg.moe_top_k,
                               capacity_factor=cfg.moe_capacity_factor,
-                              gated=True)
+                              gated=True, gate_type=cfg.moe_gate,
+                              gate_kwargs=gkw)
             self.returns_aux = True
         else:
             self.mlp = ParallelMLP(cfg.hidden_size, cfg.intermediate_size,

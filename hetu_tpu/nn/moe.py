@@ -70,6 +70,189 @@ class TopKGate(Module):
         return top_idx.astype(jnp.int32), top_w, aux
 
 
+class KTop1Gate(Module):
+    """k independent top-1 routers over disjoint expert groups.
+
+    Reference: ``KTop1Gate`` (``hetu/v1/python/hetu/layers/KTop1Gate.py``,
+    ``ktop1gating``): the E logits split into k prototype groups of E/k
+    experts; each group runs its own softmax + top-1, so a token gets
+    exactly one expert PER GROUP (cheaper top-1 selection, top-k-like
+    capacity). Gate weight = the group softmax prob of the selected
+    expert (raw, not renormalized across groups — reference ``gates_s``);
+    aux = sum of per-group balance losses."""
+
+    def __init__(self, features: int, num_experts: int, k: int = 2,
+                 init=None):
+        super().__init__()
+        if num_experts % k != 0:
+            raise ValueError(f"num_experts {num_experts} must divide by "
+                             f"k {k} prototype groups")
+        self.num_experts = num_experts
+        self.k = k
+        self.param("weight", (features, num_experts),
+                   init or normal_init(0.02), axes=("embed", None))
+
+    def __call__(self, params, x):
+        T = x.shape[0]
+        Eg = self.num_experts // self.k
+        logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                            params["weight"].astype(jnp.float32))
+        # (T, k, E/k): group g owns experts [g*Eg, (g+1)*Eg)
+        probs = jax.nn.softmax(logits.reshape(T, self.k, Eg), axis=-1)
+        local = jnp.argmax(probs, axis=-1)              # (T, k)
+        w = jnp.take_along_axis(probs, local[..., None],
+                                axis=-1)[..., 0]        # (T, k)
+        offs = jnp.arange(self.k, dtype=jnp.int32) * Eg
+        idx = local.astype(jnp.int32) + offs[None, :]
+        first = jax.nn.one_hot(local, Eg, dtype=jnp.float32)  # (T,k,Eg)
+        f_e = jnp.mean(first, axis=0)                   # (k, Eg)
+        p_e = jnp.mean(probs, axis=0)
+        aux = Eg * jnp.sum(f_e * p_e)                   # summed over groups
+        return idx, w, aux
+
+
+class SAMGate(Module):
+    """Locality-aware gate: pick ONE expert group (device), then top-k
+    within it.
+
+    Reference: ``SAMGate`` (``hetu/v1/python/hetu/layers/SAMGate.py``,
+    ``samgating``): softmax over all E experts; experts are grouped by
+    owning device (``num_local_gpus`` groups); the group with the largest
+    total gate mass wins (``sam_group_sum_op`` + top-1), then the top-k
+    experts INSIDE that group are used — so all k experts of a token live
+    on one device and dispatch needs no cross-group traffic. Aux combines
+    the balance loss with an alignment term (``sam_max_op``) pushing gate
+    mass into the chosen group; here alignment = mean out-of-group mass
+    (a TPU-friendly closed form with the same gradient direction)."""
+
+    def __init__(self, features: int, num_experts: int, k: int = 2,
+                 num_groups: int = 2, alignment_coef: float = 1.0,
+                 init=None):
+        super().__init__()
+        if num_experts % num_groups != 0:
+            raise ValueError(f"num_experts {num_experts} must divide by "
+                             f"num_groups {num_groups}")
+        if k > num_experts // num_groups:
+            raise ValueError("k cannot exceed experts per group")
+        self.num_experts = num_experts
+        self.k = k
+        self.num_groups = num_groups
+        self.alignment_coef = alignment_coef
+        self.param("weight", (features, num_experts),
+                   init or normal_init(0.02), axes=("embed", None))
+
+    def __call__(self, params, x):
+        T = x.shape[0]
+        G, Eg = self.num_groups, self.num_experts // self.num_groups
+        logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                            params["weight"].astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)         # (T, E)
+        pg = probs.reshape(T, G, Eg)
+        group_mass = jnp.sum(pg, axis=-1)               # (T, G)
+        g_star = jnp.argmax(group_mass, axis=-1)        # (T,)
+        in_group = jnp.take_along_axis(
+            pg, g_star[:, None, None], axis=1)[:, 0]    # (T, Eg)
+        w, local = jax.lax.top_k(in_group, self.k)      # raw probs
+        idx = (local + (g_star[:, None] * Eg)).astype(jnp.int32)
+        first = jax.nn.one_hot(idx[:, 0], self.num_experts,
+                               dtype=jnp.float32)
+        aux = self.num_experts * jnp.sum(
+            jnp.mean(first, axis=0) * jnp.mean(probs, axis=0))
+        out_of_group = 1.0 - jnp.take_along_axis(
+            group_mass, g_star[:, None], axis=1)[:, 0]
+        aux = aux + self.alignment_coef * jnp.mean(out_of_group)
+        return idx, w, aux
+
+
+class BalanceGate(Module):
+    """Balanced-assignment routing (BASE-layers style), Sinkhorn form.
+
+    Reference: ``BalanceAssignmentGate``
+    (``hetu/v1/python/hetu/layers/BalanceGate.py``): token-expert affinity
+    ``x @ centroids^T`` solved to a BALANCED assignment (every expert gets
+    T/E tokens) by a native auction solver (``balance_assignment_op``).
+    The TPU-native re-design replaces the sequential auction with fixed
+    Sinkhorn iterations (row/col renormalization — pure matmul/softmax,
+    jit- and MXU-friendly), then takes the per-token argmax of the
+    transport plan; weight = sigmoid(affinity) as in BASE. k = 1, aux = 0
+    (balance is enforced by construction, approximately under Sinkhorn)."""
+
+    def __init__(self, features: int, num_experts: int, *,
+                 n_iters: int = 24, temperature: float = 0.02, init=None):
+        # defaults measured (CPU sweep, r4): τ=0.02/24 iters → ~0.8%
+        # capacity drop at factor 1.0 and load imbalance 1.03, vs 10%/1.31
+        # for plain argmax — cold Sinkhorn ≈ the exact auction assignment
+        super().__init__()
+        self.num_experts = num_experts
+        self.k = 1
+        self.n_iters = n_iters
+        self.temperature = temperature
+        self.param("centroids", (num_experts, features),
+                   init or normal_init(0.02), axes=(None, "embed"))
+
+    def __call__(self, params, x):
+        T = x.shape[0]
+        scores = jnp.einsum("td,ed->te", x.astype(jnp.float32),
+                            params["centroids"].astype(jnp.float32))
+        # Sinkhorn to (approx) uniform marginals: rows sum to 1 (each
+        # token routed once), cols to T/E (balanced expert load)
+        logp = scores / self.temperature
+
+        def body(logp, _):
+            logp = jax.nn.log_softmax(logp, axis=1)       # row normalize
+            logp = logp - jax.nn.logsumexp(logp, axis=0,
+                                           keepdims=True) \
+                + jnp.log(T / self.num_experts)            # col marginal
+            return logp, None
+
+        logp, _ = jax.lax.scan(body, logp, None, length=self.n_iters)
+        idx = jnp.argmax(logp, axis=-1).astype(jnp.int32)[:, None]
+        aff = jnp.take_along_axis(scores, idx, axis=-1)
+        w = jax.nn.sigmoid(aff)
+        return idx, w, jnp.zeros([], jnp.float32)
+
+
+GATE_TYPES = {"topk": TopKGate, "ktop1": KTop1Gate, "sam": SAMGate,
+              "balance": BalanceGate}
+
+
+def make_gate(gate_type: str, features: int, num_experts: int,
+              k: int = 2, **kw) -> Module:
+    """Gate factory for config-driven model construction."""
+    if gate_type not in GATE_TYPES:
+        raise ValueError(f"unknown gate {gate_type!r}; "
+                         f"have {sorted(GATE_TYPES)}")
+    if gate_type == "balance":
+        if k not in (1, 2):     # 2 = the config default, silently fine
+            raise ValueError(
+                f"balance gate is top-1 by construction (BASE layers); "
+                f"got k={k} — use a different gate for k-way routing")
+        return BalanceGate(features, num_experts, **kw)
+    return GATE_TYPES[gate_type](features, num_experts, k=k, **kw)
+
+
+def gate_drop_stats(idx, num_experts: int, k: int,
+                    capacity_factor: float) -> dict:
+    """Capacity-drop statistics for a gate decision (surfaced in metrics
+    / the EP workload): fraction of (token, choice) slots dropped by the
+    capacity limit, plus the per-expert load histogram. Mirrors the
+    position computation of ``_ep_dispatch`` exactly."""
+    T = idx.shape[0]
+    E = num_experts
+    C = max(1, math.ceil(capacity_factor * T * k / E))
+    idx_f = idx.reshape(T * k)
+    oh = jax.nn.one_hot(idx_f, E, dtype=jnp.int32)
+    pos = (jnp.cumsum(oh, axis=0) - oh)[jnp.arange(T * k), idx_f]
+    dropped = (pos >= C)
+    load = jnp.sum(oh, axis=0)
+    return {
+        "drop_frac": jnp.mean(dropped.astype(jnp.float32)),
+        "expert_load": load,
+        "load_imbalance": load.max() / jnp.maximum(1, load.mean()),
+        "capacity": C,
+    }
+
+
 class HashGate(Module):
     """Deterministic hash routing (reference ``HashGate``): expert =
     token_id mod E. Needs token ids, so it routes on provided ids rather
@@ -94,15 +277,17 @@ class MoEMLP(Module):
 
     def __init__(self, features: int, hidden: int, num_experts: int, *,
                  k: int = 2, capacity_factor: float = 1.25,
-                 gated: bool = False, init=None):
+                 gated: bool = False, gate_type: str = "topk",
+                 gate_kwargs: Optional[dict] = None, init=None):
         super().__init__()
         self.num_experts = num_experts
-        self.k = k
         self.capacity_factor = capacity_factor
         self.gated = gated
         self.activation = act_ops.swiglu if gated else jax.nn.gelu
         init = init or normal_init(0.02)
-        self.gate = TopKGate(features, num_experts, k=k)
+        self.gate = make_gate(gate_type, features, num_experts, k=k,
+                              **(gate_kwargs or {}))
+        self.k = self.gate.k      # balance gate forces k=1
         self.param("wi", (num_experts, features, hidden), init,
                    axes=("expert", "embed", "mlp"))
         if gated:
@@ -129,6 +314,25 @@ class MoEMLP(Module):
         return {n: params[n] for n in
                 (("wi", "wg", "wo") if self.gated else ("wi", "wo"))}
 
+    @staticmethod
+    def _ep_axes_of(mesh) -> tuple:
+        """("ep",) for the flat axis, ("ep_out", "ep_in") when the mesh
+        factors expert parallelism for the hierarchical a2a (multi-slice:
+        ep_out across DCN, ep_in within a slice), () when absent."""
+        if mesh.shape.get("ep", 1) > 1:
+            return ("ep",)
+        if "ep_out" in mesh.shape and "ep_in" in mesh.shape \
+                and mesh.shape["ep_out"] * mesh.shape["ep_in"] > 1:
+            return ("ep_out", "ep_in")
+        return ()
+
+    @staticmethod
+    def _ep_degree(mesh, axes) -> int:
+        n = 1
+        for a in axes:
+            n *= mesh.shape.get(a, 1)
+        return n
+
     def __call__(self, params, x):
         b, s, d = x.shape
         xf = x.reshape(b * s, d)
@@ -138,25 +342,29 @@ class MoEMLP(Module):
         # axis: run the dispatch body directly on the bound axis — the
         # EP x PP composition (no nested shard_map allowed)
         man = current_manual_axes()
-        if man is not None and "ep" in man.axes \
-                and man.mesh.shape.get("ep", 1) > 1 \
-                and self.num_experts % man.mesh.shape["ep"] == 0:
-            out = _ep_dispatch(
-                xf, idx, wgt, self._expert_params(params),
-                ep=man.mesh.shape["ep"], num_experts=self.num_experts,
-                k=self.k, capacity_factor=self.capacity_factor,
-                apply_experts=self._apply_experts)
-            aux = jax.lax.pmean(aux, "ep")
-            return out.reshape(b, s, d).astype(x.dtype), aux
+        if man is not None:
+            axes = self._ep_axes_of(man.mesh)
+            ep = self._ep_degree(man.mesh, axes)
+            if axes and set(axes) <= man.axes and ep > 1 \
+                    and self.num_experts % ep == 0:
+                out = _ep_dispatch(
+                    xf, idx, wgt, self._expert_params(params),
+                    ep=ep, num_experts=self.num_experts,
+                    k=self.k, capacity_factor=self.capacity_factor,
+                    apply_experts=self._apply_experts, ep_axes=axes)
+                aux = jax.lax.pmean(aux, axes)
+                return out.reshape(b, s, d).astype(x.dtype), aux
 
         ctx = current_act_sharding()
         ep_deg = 0
-        if ctx is not None and ctx.mesh.shape.get("ep", 1) > 1 \
-                and self.num_experts % ctx.mesh.shape["ep"] == 0:
-            ep_deg = ctx.mesh.shape["ep"]
+        if ctx is not None:
+            axes = self._ep_axes_of(ctx.mesh)
+            ep_deg = self._ep_degree(ctx.mesh, axes) if axes else 0
+            if ep_deg > 1 and self.num_experts % ep_deg != 0:
+                ep_deg = 0
 
         if ep_deg > 1:
-            out = self._ep_forward(params, xf, idx, wgt, ctx)
+            out = self._ep_forward(params, xf, idx, wgt, ctx, axes, ep_deg)
         else:
             out = self._dense_forward(params, xf, idx, wgt)
         out = act_constrain(out.reshape(b, s, d).astype(x.dtype), "tokens")
@@ -174,29 +382,63 @@ class MoEMLP(Module):
         return jnp.einsum("te,etd->td", combine, ye.astype(jnp.float32))
 
     # -- expert-parallel path: capacity buffers + all_to_all ----------------
-    def _ep_forward(self, params, xf, idx, wgt, ctx):
+    def _ep_forward(self, params, xf, idx, wgt, ctx, ep_axes, ep_deg):
         expert_params = self._expert_params(params)
-        tok_spec = P(("dp", "ep"))
-        exp_spec = jax.tree.map(lambda _: P("ep"), expert_params)
+        tok_spec = P(("dp",) + tuple(ep_axes))
+        exp_spec = jax.tree.map(lambda _: P(tuple(ep_axes)),
+                                expert_params)
         body = functools.partial(
-            _ep_dispatch, ep=ctx.mesh.shape["ep"],
+            _ep_dispatch, ep=ep_deg,
             num_experts=self.num_experts, k=self.k,
             capacity_factor=self.capacity_factor,
-            apply_experts=self._apply_experts)
+            apply_experts=self._apply_experts, ep_axes=ep_axes)
 
         fn = shard_map(
             body, mesh=ctx.mesh,
             in_specs=(tok_spec, tok_spec, tok_spec, exp_spec),
-            out_specs=tok_spec, axis_names={"dp", "ep"}, check_vma=False)
+            out_specs=tok_spec, axis_names={"dp", *ep_axes},
+            check_vma=False)
         return fn(xf, idx, wgt, expert_params)
 
 
+def hierarchical_all_to_all(buf, outer_axis: str, inner_axis: str):
+    """Two-stage all_to_all over a FACTORED expert axis (ep = outer ×
+    inner): exchange over the inner (intra-slice, ICI) axis first, then
+    the outer (cross-slice, DCN) axis — so the DCN stage moves one large
+    contiguous block per destination slice instead of ep small ones.
+
+    Reference capability: the hierarchical a2a of HetuMoE
+    (``hetu/v1/python/hetu/gpu_ops/AllToAll.py`` over grouped NCCL comms).
+    ``buf``: (ep, ...) per-rank blocks, destination-major with rank
+    r = outer * inner_size + inner. Returns the same shape with the
+    leading dim indexing sources."""
+    ep = buf.shape[0]
+    O = jax.lax.axis_size(outer_axis)
+    I = jax.lax.axis_size(inner_axis)
+    assert O * I == ep, (O, I, ep)
+    b = buf.reshape((O, I) + buf.shape[1:])
+    # inner exchange delivers each (outer-dest, inner-dest) block to the
+    # right inner rank within the source slice...
+    b = jax.lax.all_to_all(b, inner_axis, split_axis=1, concat_axis=1)
+    # ...then one aggregated block per destination slice rides DCN
+    b = jax.lax.all_to_all(b, outer_axis, split_axis=0, concat_axis=0)
+    return b.reshape((ep,) + buf.shape[1:])
+
+
 def _ep_dispatch(x, idx, wgt, eparams, *, ep, num_experts, k,
-                 capacity_factor, apply_experts):
+                 capacity_factor, apply_experts, ep_axes=("ep",)):
     """Per-rank EP dispatch body: capacity scatter → all_to_all → local
     experts → all_to_all → weighted combine. Requires a bound manual
     ``"ep"`` axis (from ``_ep_forward``'s shard_map or the pipeline's
-    manual region)."""
+    manual region). ``ep_axes``: one axis name, or (outer, inner) for the
+    hierarchical two-stage exchange on multi-slice meshes."""
+
+    def a2a(buf):
+        if len(ep_axes) == 2:
+            return hierarchical_all_to_all(buf, ep_axes[0], ep_axes[1])
+        return jax.lax.all_to_all(buf, ep_axes[0], split_axis=0,
+                                  concat_axis=0)
+
     E, El = num_experts, num_experts // ep
     T = x.shape[0]                       # local tokens
     C = max(1, math.ceil(capacity_factor * T * k / E))
@@ -213,13 +455,11 @@ def _ep_dispatch(x, idx, wgt, eparams, *, ep, num_experts, k,
                      xk.astype(jnp.float32))   # (E*C, d)
     buf = buf.reshape(ep, El, C, -1)
     # send each expert block to its owner rank
-    buf = jax.lax.all_to_all(buf, "ep", split_axis=0,
-                             concat_axis=0)    # (ep, El, C, d)
+    buf = a2a(buf)                             # (ep, El, C, d)
     xe = jnp.swapaxes(buf, 0, 1).reshape(El, ep * C, -1)
     ye = apply_experts(eparams, xe)            # (El, ep*C, d)
     ye = jnp.swapaxes(ye.reshape(El, ep, C, -1), 0, 1)
-    ye = jax.lax.all_to_all(ye, "ep", split_axis=0,
-                            concat_axis=0)     # (ep, El, C, d)
+    ye = a2a(ye)                               # (ep, El, C, d)
     ye = ye.reshape(E * C, -1)
     outk = jnp.einsum("ts,sd->td", disp,
                       ye.astype(jnp.float32))  # (Tk, d)

@@ -10,7 +10,7 @@ from hetu_tpu.parallel.sharding import (
 
 from hetu_tpu.parallel.hetero import (
     HeteroStrategy, StageSpec, build_hetero_train_step,
-    init_hetero_state, make_hetero_plan,
+    homogeneous_1f1b, init_hetero_state, make_hetero_plan,
 )
 from hetu_tpu.parallel.hetero_dp import DPGroupSpec, HeteroDPTrainStep
 from hetu_tpu.parallel.ulysses import ulysses_attention
@@ -20,6 +20,6 @@ __all__ = [
     "AxisRules", "param_partition_specs", "named_shardings",
     "shard_params", "constrain", "sharded_init",
     "HeteroStrategy", "StageSpec", "build_hetero_train_step",
-    "init_hetero_state", "make_hetero_plan",
+    "homogeneous_1f1b", "init_hetero_state", "make_hetero_plan",
     "DPGroupSpec", "HeteroDPTrainStep", "ulysses_attention",
 ]

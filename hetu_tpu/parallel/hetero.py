@@ -630,3 +630,33 @@ def build_hetero_train_step(model: Module, opt: Transform,
                          "build_train_step otherwise")
     return HeteroTrainStep(model, opt, plan, attn_impl=attn_impl,
                            schedule=schedule)
+
+
+def homogeneous_1f1b(num_layers: int, *, pp: int,
+                     tp: int = 1, dp: int = 1, num_microbatches: int = 2,
+                     remat: str = "none") -> HeteroStrategy:
+    """A HOMOGENEOUS pipeline as a HeteroStrategy — the 1F1B option for
+    uniform stage splits.
+
+    The single-jit scan executor (``parallel.pipeline``) bounds memory by
+    per-block remat; when true 1F1B liveness (≤ pp in-flight microbatches
+    by SCHEDULE, ``executable_graph.cc:836``) is required instead, split
+    the layers into ``pp`` equal stages and run the host-scheduled
+    executor with ``schedule="1f1b"``:
+
+        strategy = homogeneous_1f1b(cfg.num_layers, pp=4, tp=2,
+                                    num_microbatches=8)
+        plan  = make_hetero_plan(model, strategy)
+        state = init_hetero_state(model, opt, plan, key)   # or
+        state = state_to_hetero(homo_state, plan)          # hot switch
+        step  = build_hetero_train_step(model, opt, plan, schedule="1f1b")
+    """
+    if num_layers % pp != 0:
+        raise ValueError(f"num_layers {num_layers} must divide by pp {pp} "
+                         f"for equal stages (unequal: build a "
+                         f"HeteroStrategy directly)")
+    per = num_layers // pp
+    return HeteroStrategy(
+        stages=tuple(StageSpec(layers=per, tp=tp, dp=dp)
+                     for _ in range(pp)),
+        num_microbatches=num_microbatches, remat=remat).validate()

@@ -15,7 +15,10 @@ Elastic model (same as the reference's): individual processes cannot be
 re-admitted into a running JAX job, so on any worker death the pool kills
 the generation and relaunches all workers; workers resume from the latest
 (sharded) checkpoint. Generations are namespaced in worker names and KV
-keys.
+keys. Single-controller flows do better: when the controller process
+survives the failure, ``engine.elastic.elastic_resume`` reshards its LIVE
+train state onto the recovery plan in memory (cross_topology_switch) and
+no checkpoint is read — disk is only the dead-controller fallback.
 """
 
 from __future__ import annotations

@@ -20,9 +20,18 @@ model follows the standard scaling-book accounting:
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 from typing import Optional
 
 from hetu_tpu.parallel.strategy import Strategy
+
+# Default location of the measured calibration written by
+# workloads/calibrate_run.py during a TPU window.
+CALIBRATION_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))), "workloads", "out",
+    "calibration.json")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,6 +45,27 @@ class TPUTopology:
     hbm_bytes: float = 95e9
     mxu_efficiency: float = 0.5       # achievable fraction of peak
     dp_overlap: float = 0.7           # grad-allreduce overlap with bwd
+
+    @classmethod
+    def calibrated(cls, num_devices: int,
+                   path: Optional[str] = None, **overrides
+                   ) -> "TPUTopology":
+        """Topology seeded from the MEASURED calibration when one exists
+        (profile-first, like the reference's ``profile_hardware`` flow —
+        ``tools/Galvatron/galvatron/profile_hardware/``); spec-sheet
+        defaults otherwise. Explicit ``overrides`` always win."""
+        fields = {}
+        try:
+            with open(path or CALIBRATION_PATH) as f:
+                cal = json.load(f)
+            for k in ("peak_flops", "ici_bw", "dcn_bw", "hbm_bytes",
+                      "mxu_efficiency", "dp_overlap"):
+                if k in cal:
+                    fields[k] = float(cal[k])
+        except (OSError, ValueError, TypeError, KeyError):
+            fields = {}     # torn/hand-edited file → spec defaults whole
+        fields.update(overrides)
+        return cls(num_devices=num_devices, **fields)
 
 
 @dataclasses.dataclass(frozen=True)

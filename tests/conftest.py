@@ -17,6 +17,23 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# Persistent XLA compilation cache — OPT-IN via JAX_COMPILATION_CACHE_DIR.
+# Measured (r4): single-file reruns get 5x faster (test_trainer.py 60s→11s)
+# but the FULL suite against a shared cache hard-aborts ("Fatal Python
+# error: Aborted" loading a cached executable in
+# test_trainer_distributed_checkpoint_roundtrip, reproducible at any
+# min-compile-time threshold) — an XLA:CPU executable-deserialization bug,
+# so it must not be on by default. Safe per-file: set the env var when
+# iterating on one test file.
+_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+if _cache_dir:
+    try:
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 3.0)
+    except Exception:   # cache support is an optimization, never a failure
+        pass
+
 import pytest  # noqa: E402
 
 # Tests measured >~7s on the 8-CPU mesh (mostly multi-strategy parity runs
@@ -112,6 +129,32 @@ SLOW_TESTS = {
     "test_attention_tp_parity",
     "test_gpt_tp_loss_parity",
     "test_gate_topk_and_aux",
+    # round 4 additions
+    "test_gpt_pp_cp_ring_parity",
+    "test_hetero_dropout_threads_and_reproduces",
+    "test_gate_zoo_ep_matches_dense",
+    "test_gpt_moe_gate_zoo_trains",
+    "test_hierarchical_all_to_all_matches_flat",
+    "test_elastic_resume_prefers_live_state",
+    "test_homogeneous_1f1b_matches_scan_executor",
+    # measured >5s in the r4 durations pass — out of the inner loop
+    "test_hf_llama_converter_logit_parity",
+    "test_chunked_lm_loss_matches_dense",
+    "test_dropout_training",
+    "test_ulysses_grads_match_oracle",
+    "test_calibration_pipeline_cpu",
+    "test_topp_sampling_restricts_support",
+    "test_unroll_parity",
+    "test_profile_modules_table",
+    "test_flash_grads_segment_ids",
+    "test_quantized_sharded_checkpoint",
+    "test_split_phase_grad_accumulation",
+    "test_ring_packed_segments",
+    "test_fp16_grad_scaler_loop",
+    "test_vocab_parallel_lm_loss_grads_match_dense",
+    "test_bf16_compute_tracks_fp32",
+    "test_mlp_tp_parity",
+    "test_vocab_parallel_lm_loss_matches_dense",
 }
 
 

@@ -180,3 +180,56 @@ def test_metrics_logger_plot(tmp_path):
     out = m.plot(str(tmp_path / "loss.png"), keys=("loss", "grad_norm"))
     import os
     assert os.path.getsize(out) > 1000
+
+
+def test_elastic_resume_prefers_live_state(monkeypatch, tmp_path):
+    """Survivor-path recovery reshards LIVE state in memory — NO
+    checkpoint read (VERDICT r3 item 6; reference restarts from disk,
+    ``heturpc_elastic_server.py:497-559``)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from hetu_tpu import optim
+    from hetu_tpu.engine import make_plan, init_state, build_train_step
+    from hetu_tpu.engine.elastic import elastic_resume
+    from hetu_tpu.models import GPTLMHeadModel
+    from hetu_tpu.parallel.strategy import Strategy
+    from hetu_tpu.utils import dist_checkpoint
+
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    opt = optim.adamw(1e-3)
+    plan8 = make_plan(model, opt, Strategy(dp=2, tp=4))
+    state = init_state(model, opt, plan8, jax.random.key(0),
+                       dtype=jnp.float32)
+    step8 = build_train_step(model, opt, plan8)
+    ids = jax.random.randint(jax.random.key(1), (8, 17), 0, cfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    for _ in range(2):
+        state, m = step8(state, plan8.shard_batch(batch))
+
+    # persist a checkpoint the live path must NOT touch
+    ckpt = str(tmp_path / "ck")
+    dist_checkpoint.save_checkpoint_distributed(ckpt, state)
+    oracle_plan, oracle_state = elastic_resume(
+        model, opt, Strategy(dp=2, tp=2), devices=jax.devices()[:4],
+        state=None, checkpoint_dir=ckpt)
+
+    def _no_disk(*a, **kw):
+        raise AssertionError("live-state resume read the checkpoint")
+    monkeypatch.setattr(dist_checkpoint, "load_checkpoint_distributed",
+                        _no_disk)
+
+    # "lose" devices 4..7: recovery plan on the surviving half
+    new_plan, new_state = elastic_resume(
+        model, opt, Strategy(dp=2, tp=2), devices=jax.devices()[:4],
+        state=state, checkpoint_dir=ckpt)
+    assert {d.id for leaf in jax.tree.leaves(new_state.params)
+            for d in leaf.sharding.device_set} == {0, 1, 2, 3}
+
+    # continuation must be numerically identical to the disk path
+    step4 = build_train_step(model, opt, new_plan)
+    _, m_live = step4(new_state, new_plan.shard_batch(batch))
+    _, m_disk = step4(oracle_state, new_plan.shard_batch(batch))
+    np.testing.assert_allclose(float(m_live["loss"]),
+                               float(m_disk["loss"]), rtol=1e-6)

@@ -100,3 +100,27 @@ def test_plan_single_device_engages_remat():
     p = plans[4096]
     assert p.strategy.remat != "none"
     assert p.est_step_ms > 0
+
+
+def test_preferred_cp_impl_uses_measured_table(tmp_path):
+    """Per-bucket ring/ulysses defaults come from the measured table when
+    present (VERDICT r3 item 9), heuristic otherwise, ring when ulysses
+    is illegal (heads % cp != 0)."""
+    import json
+    from hetu_tpu.data.hydraulis import preferred_cp_impl
+
+    assert preferred_cp_impl(4096, 3, num_heads=8) == "ring"  # illegal
+    # heuristic fallback (point at a missing table)
+    missing = str(tmp_path / "none.json")
+    assert preferred_cp_impl(2048, 2, 8, table_path=missing) == "ulysses"
+    assert preferred_cp_impl(32768, 4, 8, table_path=missing) == "ring"
+    # measured table wins over the heuristic
+    table = {"results": [
+        {"cp": 2, "seq": 2048, "winner": "ring"},
+        {"cp": 4, "seq": 32768, "winner": "ulysses"},
+    ]}
+    p = str(tmp_path / "cp_compare.json")
+    with open(p, "w") as f:
+        json.dump(table, f)
+    assert preferred_cp_impl(2048, 2, 8, table_path=p) == "ring"
+    assert preferred_cp_impl(32768, 4, 8, table_path=p) == "ulysses"

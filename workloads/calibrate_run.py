@@ -67,7 +67,26 @@ def main():
     for st, m, p in zip(strategies, measured, predicted):
         tag = f"remat={st.remat},nm={st.num_microbatches}"
         print(f"{tag:<34}{m * 1e3:>10.1f}{p * 1e3:>12.1f}")
-    print(json.dumps(validate_ranking(measured, predicted)))
+    ranking = validate_ranking(measured, predicted)
+    print(json.dumps(ranking))
+
+    # persist: TPUTopology.calibrated() loads this by default, making
+    # every later search (galvatron/malleus/hydraulis) profile-first
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "out",
+                       "calibration.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump({
+            "device_kind": getattr(dev, "device_kind", "tpu"),
+            "peak_flops": PEAK_V5E,
+            "hbm_bytes": 16e9,
+            "mxu_efficiency": cal.mxu_efficiency,
+            "measured_ms": [m * 1e3 for m in measured],
+            "predicted_ms": [p * 1e3 for p in predicted],
+            "strategies": [s.to_json() for s in strategies],
+            "ranking": ranking,
+        }, f, indent=1)
+    print(f"wrote {out}")
 
 
 if __name__ == "__main__":

@@ -29,4 +29,8 @@ run bench_suite 1800 python workloads/bench_suite.py
 run calibrate 1500 python workloads/calibrate_run.py
 # 6. ICI collectives (single chip: dispatch overhead reference)
 run collectives 600 python workloads/collectives.py
+# 7. ring vs ulysses winners table (refreshes the CPU-measured one)
+run cp_compare 900 python workloads/cp_compare.py
+# 8. EP gate zoo
+run moe_bench 600 python workloads/moe_bench.py
 echo "=== done ($(date +%H:%M:%S)) ==="

@@ -39,21 +39,32 @@ def preferred_cp_impl(seq_len: int, cp: int, num_heads: int,
         _os.path.dirname(_os.path.dirname(_os.path.dirname(
             _os.path.abspath(__file__)))), "workloads", "out",
         "cp_compare.json")
-    table = _load_cp_table(path)
-    if table:
-        best = min(table, key=lambda r: (abs(r["cp"] - cp),
-                                         abs(r["seq"] - seq_len)))
-        return best["winner"]
+    loaded = _load_cp_table(path)
+    if loaded is not None:
+        backend, table = loaded
+        import jax
+        # a table measured on another fabric must not decide (the
+        # committed CPU-mesh table would otherwise silently steer TPU
+        # bucket planning)
+        if backend == jax.default_backend():
+            rows = [r for r in table if r["cp"] == cp]
+            if rows:
+                best = min(rows, key=lambda r: abs(r["seq"] - seq_len))
+                # measured point must be within 4x in seq — beyond that
+                # the winner is extrapolation, not measurement
+                if max(best["seq"], seq_len) <= 4 * min(best["seq"],
+                                                        seq_len):
+                    return best["winner"]
     return "ulysses" if (cp <= 4 and seq_len < 8192) else "ring"
 
 
 _CP_TABLE_CACHE: dict = {}
 
 
-def _load_cp_table(path: str) -> Optional[list]:
-    """The winners table, memoized on (path, mtime) — plan_buckets calls
-    preferred_cp_impl per (bucket × cp candidate) and the table is
-    immutable between measurement runs."""
+def _load_cp_table(path: str):
+    """(backend, results) from the winners table, memoized on
+    (path, mtime) — plan_buckets calls preferred_cp_impl per (bucket ×
+    cp candidate) and the table is immutable between measurement runs."""
     import json as _json
     import os as _os
     try:
@@ -61,8 +72,10 @@ def _load_cp_table(path: str) -> Optional[list]:
         key = (path, mtime)
         if key not in _CP_TABLE_CACHE:
             with open(path) as f:
-                _CP_TABLE_CACHE.clear()     # old mtimes are dead weight
-                _CP_TABLE_CACHE[key] = _json.load(f)["results"]
+                data = _json.load(f)
+            _CP_TABLE_CACHE.clear()     # old mtimes are dead weight
+            _CP_TABLE_CACHE[key] = (data.get("backend", "unknown"),
+                                    data["results"])
         return _CP_TABLE_CACHE[key]
     except (OSError, ValueError, KeyError):
         return None
@@ -88,7 +101,8 @@ def plan_buckets(lengths: Iterable[int], *,
                  dims_base=None, topo=None,
                  max_cp: int = 1,
                  base_strategy: Optional[Strategy] = None,
-                 row_multiple: int = 1
+                 row_multiple: int = 1,
+                 pin_cp_impl: bool = False
                  ) -> dict[int, BucketPlan]:
     """Choose per-bucket rows + strategy for a roughly constant token
     budget per dispatch.
@@ -97,7 +111,9 @@ def plan_buckets(lengths: Iterable[int], *,
     enable cost-model-guided cp/remat per bucket; without them the plan is
     token-budget only. Only buckets that appear in ``lengths`` get plans.
     ``row_multiple``: round rows up to this multiple (the consumer's dp
-    degree — batch dims must divide over the mesh).
+    degree — batch dims must divide over the mesh). ``pin_cp_impl``:
+    keep ``base_strategy.cp_impl`` for every candidate instead of the
+    per-bucket measured/heuristic selection.
     """
     lengths = list(lengths)
     present = sorted(buckets.group(lengths))
@@ -118,8 +134,12 @@ def plan_buckets(lengths: Iterable[int], *,
                 cps.append(cp)
                 cp *= 2
             for cp in cps:
-                impl = base.cp_impl if cp == 1 else preferred_cp_impl(
-                    L, cp, dims_base.num_heads)
+                # auto-select ring/ulysses only when the caller left the
+                # dataclass default; an explicit base cp_impl is pinned
+                impl = base.cp_impl
+                if cp > 1 and base.cp_impl == Strategy().cp_impl \
+                        and not pin_cp_impl:
+                    impl = preferred_cp_impl(L, cp, dims_base.num_heads)
                 for remat in ("none", "full"):
                     cand = dataclasses.replace(
                         base, cp=cp, remat=remat, cp_impl=impl,

@@ -140,7 +140,8 @@ def elastic_resume(model, opt, new_strategy, *, state=None, devices=None,
             "elastic_resume: no live state and no checkpoint_dir — "
             "nothing to resume from")
     get_logger().info(
-        "elastic_resume: controller died — loading sharded checkpoint")
+        "elastic_resume: loading sharded checkpoint"
+        + ("" if state is not None else " (controller died)"))
     from hetu_tpu.utils.dist_checkpoint import load_checkpoint_distributed
     return new_plan, load_checkpoint_distributed(
         checkpoint_dir, model, opt, plan=new_plan)

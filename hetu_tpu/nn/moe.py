@@ -223,10 +223,15 @@ def make_gate(gate_type: str, features: int, num_experts: int,
         raise ValueError(f"unknown gate {gate_type!r}; "
                          f"have {sorted(GATE_TYPES)}")
     if gate_type == "balance":
-        if k not in (1, 2):     # 2 = the config default, silently fine
-            raise ValueError(
+        if k != 1:
+            # not an error: k=2 is the untouched config default, so a
+            # hard reject would break moe_gate="balance" out of the box —
+            # but the downgrade must be visible
+            import warnings
+            warnings.warn(
                 f"balance gate is top-1 by construction (BASE layers); "
-                f"got k={k} — use a different gate for k-way routing")
+                f"requested k={k} is downgraded to 1 (capacity and "
+                f"per-token compute follow)", stacklevel=2)
         return BalanceGate(features, num_experts, **kw)
     return GATE_TYPES[gate_type](features, num_experts, k=k, **kw)
 

@@ -250,14 +250,22 @@ class ParallelAttention(Module):
         if ctx is None and mctx is not None and "cp" in mctx.axes \
                 and mctx.mesh.shape["cp"] > 1:
             # inside a manual region (pipeline executor) with cp bound:
-            # run the ring core directly on the bound axis — x/q/k/v here
-            # are the per-device local seq chunks
-            from hetu_tpu.parallel.ring_attention import \
-                ring_attention_manual
-            out = ring_attention_manual(
-                q, k, v, axis_name="cp", cp=mctx.mesh.shape["cp"],
-                causal=self.causal, segment_ids=segment_ids,
-                impl=attn_impl, layout=mctx.cp_layout)
+            # run the cp attention core directly on the bound axis —
+            # x/q/k/v here are the per-device local seq chunks
+            if mctx.cp_impl == "ulysses":
+                from hetu_tpu.parallel.ulysses import \
+                    ulysses_attention_manual
+                out = ulysses_attention_manual(
+                    q, k, v, axis_name="cp", cp=mctx.mesh.shape["cp"],
+                    tp=mctx.mesh.shape.get("tp", 1), causal=self.causal,
+                    segment_ids=segment_ids, impl=attn_impl)
+            else:
+                from hetu_tpu.parallel.ring_attention import \
+                    ring_attention_manual
+                out = ring_attention_manual(
+                    q, k, v, axis_name="cp", cp=mctx.mesh.shape["cp"],
+                    causal=self.causal, segment_ids=segment_ids,
+                    impl=attn_impl, layout=mctx.cp_layout)
         elif ctx is not None and isinstance(ctx.seq, str) \
                 and ctx.mesh.shape[ctx.seq] > 1:
             # context parallelism: seq dim is sharded — KV ring

@@ -243,10 +243,22 @@ class HeteroTrainStep:
     """
 
     def __init__(self, model: Module, opt: Transform, plan: HeteroPlan, *,
-                 attn_impl: str = "auto", schedule: str = "gpipe"):
+                 attn_impl: str = "auto", schedule: str = "gpipe",
+                 backward: str = "recompute"):
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"schedule must be gpipe|1f1b, got "
                              f"{schedule!r}")
+        if backward not in ("recompute", "residuals"):
+            raise ValueError(f"backward must be recompute|residuals, got "
+                             f"{backward!r}")
+        # "recompute": every stage re-runs its forward under vjp in the
+        # backward jit — minimal residency, 2x forward compute (the r3
+        # state; ADVICE weak-4). "residuals": the forward jits RETURN
+        # their vjp closures (a jax pytree of residual arrays) and the
+        # backward applies them — forward runs once, residency = the
+        # schedule's in-flight microbatch bound (1F1B: <= pp), and the
+        # per-block remat policy still shapes what the residuals keep.
+        self.backward = backward
         self.schedule = schedule
         self.model, self.opt, self.plan = model, opt, plan
         st = plan.strategy
@@ -345,6 +357,29 @@ class HeteroTrainStep:
         self._bwd_first = jax.jit(bwd_first)
         self._bwd_mid = [b for _, b in mids]
         self._bwd_last = jax.jit(bwd_last)
+
+        if backward == "residuals":
+            # forward jits that RETURN the vjp closure; per-stage
+            # factories for the same lowering-cache reason as make_mid
+            def make_fwd_res(i):
+                if i == 0:
+                    def fwd(outer, chunk, ids, positions, extras):
+                        return jax.vjp(
+                            lambda o, c: fwd_first(o, c, ids, positions,
+                                                   extras), outer, chunk)
+                else:
+                    fmid = self._fwd_mid[i]
+
+                    def fwd(chunk, h, extras):
+                        return jax.vjp(
+                            lambda c, x: fmid(c, x, extras), chunk, h)
+                return jax.jit(fwd)
+
+            self._fwd_res = [make_fwd_res(i) if i < S - 1 else None
+                             for i in range(S)]
+            # generic appliers (one per stage: distinct lowering caches)
+            self._bwd_apply = [jax.jit(lambda vjp, g: vjp(g))
+                               for _ in range(S)]
         self._acc = jax.jit(
             lambda acc, g: jax.tree.map(
                 lambda a, b: a + b.astype(a.dtype), acc, g))
@@ -372,9 +407,10 @@ class HeteroTrainStep:
             })
         return out
 
-    def _forward_mb(self, state, mb, stage_in, extras_of):
+    def _forward_mb(self, state, mb, stage_in, extras_of, vjps=None):
         """Run one microbatch's forward through stages 0..S-2, recording
-        each stage's input for the recompute backward."""
+        each stage's input (recompute backward) or its vjp closure
+        (residual backward)."""
         plan = self.plan
         S = len(plan.meshes)
         ids = jax.device_put(mb["input_ids"], plan.batch_shardings[0])
@@ -398,19 +434,31 @@ class HeteroTrainStep:
             extras["dropout_seed"] = np.uint32(
                 (int(state.step) * self.nm + j) & 0xFFFFFFFF)
         extras_of.append(extras)
-        h = self._fwd_first(state.outer, state.blocks[0], ids,
-                            positions, extras)
+        if vjps is not None:
+            h, vjp0 = self._fwd_res[0](state.outer, state.blocks[0], ids,
+                                       positions, extras)
+            vjps[0].append(vjp0)
+        else:
+            h = self._fwd_first(state.outer, state.blocks[0], ids,
+                                positions, extras)
         stage_in[0].append((ids, positions, labels))
         for i in range(1, S):
             h = jax.device_put(h, plan.act_shardings[i])
-            stage_in[i].append(h)
+            # mids keep no input copy in residual mode (the vjp holds
+            # everything); the last stage's h feeds bwd_last either way
+            stage_in[i].append(h if (vjps is None or i == S - 1)
+                               else None)
             if i < S - 1:
-                h = self._fwd_mid[i](state.blocks[i], h, extras)
-        # the last stage's forward is fused into bwd_last (the vjp
-        # recomputes it)
+                if vjps is not None:
+                    h, vjp = self._fwd_res[i](state.blocks[i], h, extras)
+                    vjps[i].append(vjp)
+                else:
+                    h = self._fwd_mid[i](state.blocks[i], h, extras)
+        # the last stage's forward is fused into bwd_last (one forward
+        # in both modes)
 
     def _backward_mb(self, state, j, head_outer, stage_in, extras_of,
-                     gscale, acc):
+                     gscale, acc, vjps=None):
         """Backward for microbatch ``j``; frees its stored inputs."""
         plan = self.plan
         S = len(plan.meshes)
@@ -424,18 +472,27 @@ class HeteroTrainStep:
         acc["blocks"][S - 1] = self._acc(acc["blocks"][S - 1], dchunk)
         for i in range(S - 2, 0, -1):
             g = jax.device_put(dh, plan.act_shardings[i])
-            dchunk, dh = self._bwd_mid[i](state.blocks[i],
-                                          stage_in[i][j], extras, g)
+            if vjps is not None:
+                dchunk, dh = self._bwd_apply[i](vjps[i][j], g)
+            else:
+                dchunk, dh = self._bwd_mid[i](state.blocks[i],
+                                              stage_in[i][j], extras, g)
             acc["blocks"][i] = self._acc(acc["blocks"][i], dchunk)
         g = jax.device_put(dh, plan.act_shardings[0])
-        ids, positions, _ = stage_in[0][j]
-        douter, dchunk = self._bwd_first(
-            state.outer, state.blocks[0], ids, positions, extras, g)
+        if vjps is not None:
+            douter, dchunk = self._bwd_apply[0](vjps[0][j], g)
+        else:
+            ids, positions, _ = stage_in[0][j]
+            douter, dchunk = self._bwd_first(
+                state.outer, state.blocks[0], ids, positions, extras, g)
         acc["outer"] = self._acc(acc["outer"], douter)
         acc["blocks"][0] = self._acc(acc["blocks"][0], dchunk)
         # 1F1B memory bound: drop this microbatch's stored activations
+        # and residuals
         for i in range(S):
             stage_in[i][j] = None
+            if vjps is not None and i < S - 1:
+                vjps[i][j] = None
         return loss
 
     def __call__(self, state: HeteroState, batch: dict):
@@ -450,6 +507,8 @@ class HeteroTrainStep:
 
         stage_in: list[list] = [[] for _ in range(S)]   # per stage, per mb
         extras_of: list[dict] = []
+        vjps: Optional[list[list]] = \
+            [[] for _ in range(S)] if self.backward == "residuals" else None
         losses: list = [None] * nm
         acc = {"outer": self._zeros_f32(state.outer),
                "head_outer": self._zeros_f32(head_outer),
@@ -460,23 +519,23 @@ class HeteroTrainStep:
             # forward with one backward — at most S microbatches of
             # activations live at any time (1F1B's memory bound)
             for j, mb in enumerate(mbs):
-                self._forward_mb(state, mb, stage_in, extras_of)
+                self._forward_mb(state, mb, stage_in, extras_of, vjps)
                 if j >= S - 1:
                     k = j - (S - 1)
                     losses[k] = self._backward_mb(
                         state, k, head_outer, stage_in, extras_of,
-                        gscale, acc)
+                        gscale, acc, vjps)
             for k in range(max(0, nm - (S - 1)), nm):
                 losses[k] = self._backward_mb(
                     state, k, head_outer, stage_in, extras_of, gscale,
-                    acc)
+                    acc, vjps)
         else:  # gpipe: all forwards, then all backwards (newest first)
             for mb in mbs:
-                self._forward_mb(state, mb, stage_in, extras_of)
+                self._forward_mb(state, mb, stage_in, extras_of, vjps)
             for j in reversed(range(nm)):
                 losses[j] = self._backward_mb(
                     state, j, head_outer, stage_in, extras_of, gscale,
-                    acc)
+                    acc, vjps)
         gouter, ghead_outer = acc["outer"], acc["head_outer"]
         gblocks = acc["blocks"]
 
@@ -624,12 +683,13 @@ def state_from_hetero(hstate: HeteroState, plan: HeteroPlan,
 
 def build_hetero_train_step(model: Module, opt: Transform,
                             plan: HeteroPlan, *, attn_impl: str = "auto",
-                            schedule: str = "gpipe"):
+                            schedule: str = "gpipe",
+                            backward: str = "recompute"):
     if plan.pp < 2:
         raise ValueError("hetero executor needs >= 2 stages; use "
                          "build_train_step otherwise")
     return HeteroTrainStep(model, opt, plan, attn_impl=attn_impl,
-                           schedule=schedule)
+                           schedule=schedule, backward=backward)
 
 
 def homogeneous_1f1b(num_layers: int, *, pp: int,

@@ -43,6 +43,7 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
                     manual_ep: bool = False,
                     manual_cp: bool = False,
                     cp_layout: str = "contiguous",
+                    cp_impl: str = "ring",
                     param_manual_specs: Any = None):
     """Run ``payload`` microbatches through pp pipeline stages.
 
@@ -175,7 +176,8 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
     # context suppressed; ManualAxes tells nested layers (MoE, ring
     # attention) which axes are bound so they use direct collectives
     with no_act_sharding(), ManualAxes(mesh, frozenset(manual),
-                                       cp_layout=cp_layout):
+                                       cp_layout=cp_layout,
+                                       cp_impl=cp_impl):
         out = fn(stacked_params, payload)
     if block_returns_aux:
         return out["x"], out["aux"]
@@ -199,9 +201,9 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
     # EP x PP: the pipeline region goes manual over {pp, ep} and MoE
     # layers run their all_to_all dispatch on the bound ep axis
     manual_ep = strategy.ep > 1 and model.blocks.returns_aux
-    # CP x PP: bind cp too and run the ring per stage (zigzag honored);
-    # ulysses falls back to GSPMD-contiguous inside the region
-    manual_cp = strategy.cp > 1 and strategy.cp_impl == "ring"
+    # CP x PP: bind cp too and run ring (zigzag honored) or ulysses
+    # per stage on the bound axis
+    manual_cp = strategy.cp > 1
     param_manual_specs = None
     if manual_ep:
         from hetu_tpu.parallel.sharding import param_partition_specs
@@ -260,6 +262,7 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
                 block_returns_aux=block.returns_aux,
                 manual_ep=manual_ep, manual_cp=manual_cp,
                 cp_layout=strategy.effective_cp_layout,
+                cp_impl=strategy.cp_impl,
                 param_manual_specs=param_manual_specs)
             aux = jnp.zeros([], jnp.float32)
             if block.returns_aux:

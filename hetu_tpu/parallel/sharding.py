@@ -168,11 +168,13 @@ class ManualAxes:
 
     ``cp_layout`` describes how the global sequence was laid out when
     "cp" is one of the bound axes (ring attention needs it to pick the
-    per-hop masks)."""
+    per-hop masks); ``cp_impl`` selects ring vs ulysses for attention
+    inside the region."""
 
     mesh: Mesh
     axes: frozenset
     cp_layout: str = "contiguous"
+    cp_impl: str = "ring"
 
     def __enter__(self):
         _MANUAL_CTX.append(self)

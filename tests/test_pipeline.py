@@ -105,3 +105,15 @@ def test_gpt_pp_cp_ring_parity(strategy):
     _, ref = _run(GPTLMHeadModel, CFG, Strategy())
     _, got = _run(GPTLMHeadModel, CFG, strategy)
     np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_pp_cp_ulysses_parity():
+    """Ulysses inside the pipeline region: cp bound as a manual axis,
+    head-scatter a2a per stage (contiguous layout) — same trajectory as
+    single device."""
+    strategy = Strategy(pp=2, cp=2, num_microbatches=2,
+                        cp_impl="ulysses")
+    assert strategy.effective_cp_layout == "contiguous"
+    _, ref = _run(GPTLMHeadModel, CFG, Strategy())
+    _, got = _run(GPTLMHeadModel, CFG, strategy)
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)

@@ -33,4 +33,6 @@ run collectives 600 python workloads/collectives.py
 run cp_compare 900 python workloads/cp_compare.py
 # 8. EP gate zoo
 run moe_bench 600 python workloads/moe_bench.py
+# 9. flash kernel block-size tuning (feeds ops/flash_pallas defaults)
+run flash_tune 900 python workloads/flash_tune.py
 echo "=== done ($(date +%H:%M:%S)) ==="

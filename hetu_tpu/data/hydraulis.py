@@ -46,7 +46,9 @@ def preferred_cp_impl(seq_len: int, cp: int, num_heads: int,
         # a table measured on another fabric must not decide (the
         # committed CPU-mesh table would otherwise silently steer TPU
         # bucket planning)
-        if backend == jax.default_backend():
+        if backend != jax.default_backend():
+            _warn_stale_table(path, backend, jax.default_backend())
+        else:
             rows = [r for r in table if r["cp"] == cp]
             if rows:
                 best = min(rows, key=lambda r: abs(r["seq"] - seq_len))
@@ -59,6 +61,23 @@ def preferred_cp_impl(seq_len: int, cp: int, num_heads: int,
 
 
 _CP_TABLE_CACHE: dict = {}
+_WARNED_TABLES: set = set()
+
+
+def _warn_stale_table(path: str, table_backend: str, here: str) -> None:
+    """One-time notice that a winners table is being IGNORED — e.g. a
+    pre-backend-field table (backend "unknown") or one measured on a
+    different fabric. Silent discard would leave real measurements dead
+    with no hint to re-run cp_compare.py."""
+    if path in _WARNED_TABLES:
+        return
+    _WARNED_TABLES.add(path)
+    import warnings
+    warnings.warn(
+        f"cp winners table {path} was measured on backend "
+        f"{table_backend!r} but this process runs {here!r} — ignoring it "
+        f"(re-run workloads/cp_compare.py here to refresh)",
+        stacklevel=3)
 
 
 def _load_cp_table(path: str):
@@ -102,7 +121,7 @@ def plan_buckets(lengths: Iterable[int], *,
                  max_cp: int = 1,
                  base_strategy: Optional[Strategy] = None,
                  row_multiple: int = 1,
-                 pin_cp_impl: bool = False
+                 cp_impl: Optional[str] = None
                  ) -> dict[int, BucketPlan]:
     """Choose per-bucket rows + strategy for a roughly constant token
     budget per dispatch.
@@ -111,9 +130,11 @@ def plan_buckets(lengths: Iterable[int], *,
     enable cost-model-guided cp/remat per bucket; without them the plan is
     token-budget only. Only buckets that appear in ``lengths`` get plans.
     ``row_multiple``: round rows up to this multiple (the consumer's dp
-    degree — batch dims must divide over the mesh). ``pin_cp_impl``:
-    keep ``base_strategy.cp_impl`` for every candidate instead of the
-    per-bucket measured/heuristic selection.
+    degree — batch dims must divide over the mesh). ``cp_impl``:
+    "ring"/"ulysses" pins the implementation for every cp>1 candidate;
+    None (default) selects per bucket via :func:`preferred_cp_impl`
+    (an explicit pin is the only way to express intent — the dataclass
+    default on ``base_strategy`` is indistinguishable from unset).
     """
     lengths = list(lengths)
     present = sorted(buckets.group(lengths))
@@ -134,12 +155,10 @@ def plan_buckets(lengths: Iterable[int], *,
                 cps.append(cp)
                 cp *= 2
             for cp in cps:
-                # auto-select ring/ulysses only when the caller left the
-                # dataclass default; an explicit base cp_impl is pinned
                 impl = base.cp_impl
-                if cp > 1 and base.cp_impl == Strategy().cp_impl \
-                        and not pin_cp_impl:
-                    impl = preferred_cp_impl(L, cp, dims_base.num_heads)
+                if cp > 1:
+                    impl = cp_impl if cp_impl is not None else \
+                        preferred_cp_impl(L, cp, dims_base.num_heads)
                 for remat in ("none", "full"):
                     cand = dataclasses.replace(
                         base, cp=cp, remat=remat, cp_impl=impl,

@@ -134,6 +134,21 @@ class Trainer:
         self._eval_fn = eval_fn
         return plan
 
+    def shrink_to(self, devices, strategy: Optional[Strategy] = None):
+        """Elastic recovery on the live controller: rebuild plans over
+        the SURVIVING ``devices`` and reshard the live state onto them —
+        no checkpoint read (``parallel.switch`` cross-topology path; see
+        also ``engine.elastic.elastic_resume`` for the non-Trainer form).
+
+        ``strategy``: the recovery strategy (e.g. from
+        ``ElasticController.recovery_plan``); defaults to the current one,
+        which must fit the surviving device count.
+        """
+        self.devices = list(devices)
+        self._plan_cache.clear()      # cached plans pin dead devices
+        return self.set_strategy(strategy if strategy is not None
+                                 else self.strategy)
+
     @property
     def strategy(self) -> Strategy:
         return self.plan.strategy

@@ -380,9 +380,13 @@ class HeteroTrainStep:
             # generic appliers (one per stage: distinct lowering caches)
             self._bwd_apply = [jax.jit(lambda vjp, g: vjp(g))
                                for _ in range(S)]
+        # donate the accumulator: it is dead after every accumulate call
+        # (reassigned), so XLA updates it in place — one fewer fp32 grad
+        # buffer alive per stage during the backward drain
         self._acc = jax.jit(
             lambda acc, g: jax.tree.map(
-                lambda a, b: a + b.astype(a.dtype), acc, g))
+                lambda a, b: a + b.astype(a.dtype), acc, g),
+            donate_argnums=(0,))
         self._zeros_f32 = jax.jit(
             lambda t: jax.tree.map(
                 lambda x: jnp.zeros(x.shape, jnp.float32), t))
@@ -394,6 +398,10 @@ class HeteroTrainStep:
             updates, new_opt = opt.update(grads, opt_state, params)
             return apply_updates(params, updates), new_opt
 
+        # NOT donated: the executor is host-scheduled and the incoming
+        # HeteroState is caller-owned — donation would invalidate a state
+        # a caller legitimately reuses (e.g. re-running a step for
+        # reproducibility checks)
         self._update = jax.jit(update)
 
     # -- helpers -----------------------------------------------------------

@@ -235,3 +235,32 @@ def test_periodic_eval_during_train():
     evals = [h for h in hist if "eval_loss" in h]
     assert [h["step"] for h in evals] == [3, 6]
     assert all(np.isfinite(h["eval_loss"]) for h in evals)
+
+
+def test_trainer_shrink_to_survivors_no_checkpoint(monkeypatch):
+    """Live elastic recovery through the Trainer: half the mesh 'dies',
+    shrink_to reshards the live state onto the survivors and training
+    continues — no checkpoint is read (r3 VERDICT item 6 at the Trainer
+    surface)."""
+    from hetu_tpu.utils import checkpoint as ckpt_mod
+    from hetu_tpu.utils import dist_checkpoint as dckpt_mod
+
+    def _no_disk(*a, **kw):
+        raise AssertionError("shrink_to touched a checkpoint")
+    monkeypatch.setattr(ckpt_mod, "load_checkpoint", _no_disk)
+    monkeypatch.setattr(dckpt_mod, "load_checkpoint_distributed", _no_disk)
+
+    t = Trainer(GPTLMHeadModel(CFG), optim.adamw(3e-3),
+                Strategy(dp=2, tp=4), _cfg(total_steps=2))
+    t.train(_batches(2))
+    step_before = int(jax.device_get(t.state.step))
+
+    survivors = jax.devices()[:4]
+    t.shrink_to(survivors, Strategy(dp=2, tp=2))
+    assert {d.id for leaf in jax.tree.leaves(t.state.params)
+            for d in leaf.sharding.device_set} == {0, 1, 2, 3}
+    assert int(jax.device_get(t.state.step)) == step_before
+
+    t.config.total_steps = 4
+    t.train(_batches(2))
+    assert int(jax.device_get(t.state.step)) == step_before + 2

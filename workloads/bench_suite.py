@@ -69,6 +69,10 @@ def _lm_bench(model, cfg, strategy, batch, seq, *, steps=10, warmup=2,
     out = {"step_ms": round(dt * 1e3, 2),
            "tokens_per_sec": round(batch * seq / dt, 1),
            "params": n, "loss": round(loss, 3)}
+    from hetu_tpu.utils.profiler import device_memory_stats
+    mem = device_memory_stats()
+    if mem.get("peak_bytes_in_use"):
+        out["hbm_peak_gb"] = round(mem["peak_bytes_in_use"] / 1e9, 2)
     from bench import model_flops_per_token, peak_flops
     peak = peak_flops(jax.devices()[0])
     if peak:

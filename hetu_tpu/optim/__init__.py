@@ -2,7 +2,10 @@ from hetu_tpu.optim.base import (
     Transform, chain, apply_updates, identity, scale, scale_by_schedule,
     add_decayed_weights, masked,
 )
-from hetu_tpu.optim.optimizers import sgd, adam, adamw, scale_by_adam, trace
+from hetu_tpu.optim.optimizers import (
+    adafactor, adagrad, adam, adamw, scale_by_adafactor, scale_by_adagrad,
+    scale_by_adam, sgd, trace,
+)
 from hetu_tpu.optim.schedules import (
     constant, linear_warmup, cosine_decay, linear_decay,
 )
@@ -14,7 +17,8 @@ from hetu_tpu.optim.scaler import (
 __all__ = [
     "Transform", "chain", "apply_updates", "identity", "scale",
     "scale_by_schedule", "add_decayed_weights", "masked",
-    "sgd", "adam", "adamw", "scale_by_adam", "trace",
+    "sgd", "adam", "adamw", "adagrad", "adafactor", "scale_by_adam",
+    "scale_by_adagrad", "scale_by_adafactor", "trace",
     "constant", "linear_warmup", "cosine_decay", "linear_decay",
     "clip_by_global_norm", "global_norm",
     "ScalerState", "init_scaler", "scale_loss", "unscale_and_check",

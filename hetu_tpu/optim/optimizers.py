@@ -1,8 +1,13 @@
-"""Concrete optimizers: SGD / Momentum / Adam / AdamW.
+"""Concrete optimizers: SGD / Momentum / Adam / AdamW / AdaGrad /
+Adafactor.
 
 Parity targets: the reference's fused update ops
 (``hetu/graph/ops/optimizer_update.h``: SGDUpdate, MomentumUpdate,
-AdamUpdate with step-count state) and Python wrappers (``python/hetu/optim``).
+AdamUpdate with step-count state), Python wrappers (``python/hetu/optim``),
+and the v1 zoo (``hetu/v1/python/hetu/optimizer.py``: SGD/Momentum/
+AdaGrad/Adam). Adafactor is beyond-reference: the TPU-native
+memory-efficient choice (factored second moments — O(n+m) instead of
+O(n·m) state per matrix) for models whose Adam moments don't fit HBM.
 State lives in fp32 regardless of param dtype (master weights pattern).
 """
 
@@ -79,6 +84,105 @@ def scale_by_adam(b1: float = 0.9, b2: float = 0.999,
     return Transform(init, update)
 
 
+class AdaGradState(NamedTuple):
+    accum: jnp.ndarray   # pytree of squared-grad accumulators
+
+
+def scale_by_adagrad(eps: float = 1e-10,
+                     initial_accumulator: float = 0.0) -> Transform:
+    """v1 ``AdaGradOptimizer`` semantics (``optimizer.py:335,371``):
+    accumulate squared grads, scale by 1/(sqrt(accum) + eps) — the same
+    form torch.optim.Adagrad uses (the oracle test relies on this)."""
+    def init(params):
+        return AdaGradState(jax.tree.map(
+            lambda p: jnp.full(p.shape, initial_accumulator, jnp.float32),
+            params))
+
+    def update(grads, state, params=None):
+        accum = jax.tree.map(
+            lambda g, a: a + jnp.square(g.astype(jnp.float32)),
+            grads, state.accum)
+        updates = jax.tree.map(
+            lambda g, a: g.astype(jnp.float32) / (jnp.sqrt(a) + eps),
+            grads, accum)
+        return updates, AdaGradState(accum)
+
+    return Transform(init, update)
+
+
+class AdafactorState(NamedTuple):
+    count: jnp.ndarray
+    v_row: jnp.ndarray   # pytree: factored row moments ((..., n) shapes)
+    v_col: jnp.ndarray   # pytree: factored col moments
+    v: jnp.ndarray       # pytree: full moments for <2D params
+
+
+def scale_by_adafactor(*, min_dim_size_to_factor: int = 128,
+                       decay_rate: float = 0.8,
+                       eps: float = 1e-30,
+                       clip_threshold: float = 1.0) -> Transform:
+    """Adafactor (Shazeer & Stern 2018) second-moment scaling.
+
+    Matrices with both trailing dims >= ``min_dim_size_to_factor`` keep
+    ROW and COLUMN moment vectors instead of the full moment matrix; the
+    per-step decay is t^-decay_rate; the update is RMS-clipped at
+    ``clip_threshold``. Momentum-free (the memory-efficient form).
+    """
+    def factored(p) -> bool:
+        return p.ndim >= 2 and p.shape[-1] >= min_dim_size_to_factor \
+            and p.shape[-2] >= min_dim_size_to_factor
+
+    def init(params):
+        zr = lambda p: jnp.zeros(p.shape[:-1], jnp.float32) \
+            if factored(p) else jnp.zeros((1,), jnp.float32)
+        zc = lambda p: jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32) \
+            if factored(p) else jnp.zeros((1,), jnp.float32)
+        zf = lambda p: jnp.zeros((1,), jnp.float32) if factored(p) \
+            else jnp.zeros(p.shape, jnp.float32)
+        return AdafactorState(jnp.zeros([], jnp.int32),
+                              jax.tree.map(zr, params),
+                              jax.tree.map(zc, params),
+                              jax.tree.map(zf, params))
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        # t^-0.8 decay (the paper's beta2_t schedule)
+        beta2 = 1.0 - count.astype(jnp.float32) ** (-decay_rate)
+
+        def upd(g, vr, vc, vf):
+            g = g.astype(jnp.float32)
+            g2 = jnp.square(g) + eps
+            if factored(g):
+                vr = beta2 * vr + (1 - beta2) * jnp.mean(g2, axis=-1)
+                vc = beta2 * vc + (1 - beta2) * jnp.mean(g2, axis=-2)
+                # rank-1 reconstruction: vr ⊗ vc / mean(vr)
+                denom = jnp.mean(vr, axis=-1, keepdims=True)
+                r = (vr / jnp.maximum(denom, eps))[..., None]
+                u = g * jax.lax.rsqrt(r * vc[..., None, :])
+            else:
+                vf = beta2 * vf + (1 - beta2) * g2
+                u = g * jax.lax.rsqrt(vf)
+            # RMS update clipping (paper eq. 12)
+            rms = jnp.sqrt(jnp.mean(jnp.square(u)))
+            u = u / jnp.maximum(1.0, rms / clip_threshold)
+            return u, vr, vc, vf
+
+        flat_g, tdef = jax.tree.flatten(grads)
+        flat_vr = tdef.flatten_up_to(state.v_row)
+        flat_vc = tdef.flatten_up_to(state.v_col)
+        flat_vf = tdef.flatten_up_to(state.v)
+        outs = [upd(g, vr, vc, vf) for g, vr, vc, vf in
+                zip(flat_g, flat_vr, flat_vc, flat_vf)]
+        updates = tdef.unflatten([o[0] for o in outs])
+        return updates, AdafactorState(
+            count,
+            tdef.unflatten([o[1] for o in outs]),
+            tdef.unflatten([o[2] for o in outs]),
+            tdef.unflatten([o[3] for o in outs]))
+
+    return Transform(init, update)
+
+
 def sgd(lr: ScalarOrSchedule, momentum: float = 0.0,
         nesterov: bool = False) -> Transform:
     if momentum:
@@ -97,3 +201,24 @@ def adamw(lr: ScalarOrSchedule, b1: float = 0.9, b2: float = 0.999,
     return chain(scale_by_adam(b1, b2, eps),
                  add_decayed_weights(weight_decay, mask),
                  _lr_transform(lr))
+
+
+def adagrad(lr: ScalarOrSchedule, eps: float = 1e-10,
+            initial_accumulator: float = 0.0) -> Transform:
+    return chain(scale_by_adagrad(eps, initial_accumulator),
+                 _lr_transform(lr))
+
+
+def adafactor(lr: ScalarOrSchedule, *,
+              min_dim_size_to_factor: int = 128,
+              decay_rate: float = 0.8,
+              clip_threshold: float = 1.0,
+              weight_decay: float = 0.0,
+              mask: Optional[Callable[[str], bool]] = None) -> Transform:
+    parts = [scale_by_adafactor(
+        min_dim_size_to_factor=min_dim_size_to_factor,
+        decay_rate=decay_rate, clip_threshold=clip_threshold)]
+    if weight_decay:
+        parts.append(add_decayed_weights(weight_decay, mask))
+    parts.append(_lr_transform(lr))
+    return chain(*parts)

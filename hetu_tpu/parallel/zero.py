@@ -46,11 +46,15 @@ def opt_state_partition_specs(state_struct: Any, params_struct: Any,
     Subtrees structurally matching the param pytree (Adam mu/nu, momentum
     velocity, fp32 master copies) inherit the param specs — plus a
     ``zero_axis`` ("dp") shard when ZeRO-1 is on. Scalar leaves (step counts)
-    replicate.
+    replicate. Moment leaves whose SHAPE differs from their param's
+    (Adafactor's factored row/col vectors) replicate — they are O(n+m)
+    per matrix, so replication costs nothing.
     """
     params_treedef = jax.tree.structure(params_struct)
 
-    def leaf_spec(leaf_struct, spec: P) -> P:
+    def leaf_spec(leaf_struct, param_struct, spec: P) -> P:
+        if tuple(leaf_struct.shape) != tuple(param_struct.shape):
+            return P()
         if zero_axis is None:
             return spec
         return add_axis_to_spec(spec, leaf_struct.shape, mesh, zero_axis)
@@ -60,7 +64,8 @@ def opt_state_partition_specs(state_struct: Any, params_struct: Any,
             return None
         try:
             if jax.tree.structure(node) == params_treedef:
-                return jax.tree.map(leaf_spec, node, param_specs)
+                return jax.tree.map(leaf_spec, node, params_struct,
+                                    param_specs)
         except Exception:
             pass
         if isinstance(node, tuple):

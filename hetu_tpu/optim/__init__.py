@@ -1,13 +1,14 @@
 from hetu_tpu.optim.base import (
     Transform, chain, apply_updates, identity, scale, scale_by_schedule,
-    add_decayed_weights, masked,
+    add_decayed_weights, add_scheduled_weight_decay, masked,
 )
 from hetu_tpu.optim.optimizers import (
     adafactor, adagrad, adam, adamw, scale_by_adafactor, scale_by_adagrad,
     scale_by_adam, sgd, trace,
 )
 from hetu_tpu.optim.schedules import (
-    constant, linear_warmup, cosine_decay, linear_decay,
+    constant, cosine_decay, inverse_sqrt, linear_decay, linear_warmup,
+    wd_increment,
 )
 from hetu_tpu.optim.clipping import clip_by_global_norm, global_norm
 from hetu_tpu.optim.scaler import (
@@ -16,10 +17,12 @@ from hetu_tpu.optim.scaler import (
 
 __all__ = [
     "Transform", "chain", "apply_updates", "identity", "scale",
-    "scale_by_schedule", "add_decayed_weights", "masked",
+    "scale_by_schedule", "add_decayed_weights",
+    "add_scheduled_weight_decay", "masked",
     "sgd", "adam", "adamw", "adagrad", "adafactor", "scale_by_adam",
     "scale_by_adagrad", "scale_by_adafactor", "trace",
     "constant", "linear_warmup", "cosine_decay", "linear_decay",
+    "inverse_sqrt", "wd_increment",
     "clip_by_global_norm", "global_norm",
     "ScalerState", "init_scaler", "scale_loss", "unscale_and_check",
     "update_scaler",

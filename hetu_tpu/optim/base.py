@@ -65,24 +65,41 @@ def scale_by_schedule(schedule: Callable[[jnp.ndarray], jnp.ndarray]) -> Transfo
     return Transform(init, update)
 
 
-def add_decayed_weights(weight_decay: float,
+def add_decayed_weights(weight_decay,
                         mask: Optional[Callable[[str], bool]] = None) -> Transform:
     """Decoupled weight decay (AdamW). ``mask(path)`` selects decayed params
-    (default: every param with ndim >= 2, i.e. skip norms/bias)."""
+    (default: every param with ndim >= 2, i.e. skip norms/bias).
+
+    ``weight_decay`` may be a SCHEDULE (callable of the step count — see
+    ``schedules.wd_increment``, the reference's wd-increment scheduler,
+    ``optim/optimizerParamScheduler.h:49-64``); the transform then keeps
+    its own step count. One implementation for both forms so the decay
+    mask/cast rules can never drift apart."""
     from hetu_tpu.core.tree import map_with_path
+    scheduled = callable(weight_decay)
+
+    def init(params):
+        return jnp.zeros([], jnp.int32) if scheduled else ()
 
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("weight decay needs params")
+        wd = weight_decay(state) if scheduled else weight_decay
 
         def leaf(path, g):
             p = _get_path(params, path)
             use = mask(path) if mask is not None else (p.ndim >= 2)
-            return g + weight_decay * p.astype(g.dtype) if use else g
+            return g + wd * p.astype(g.dtype) if use else g
 
-        return map_with_path(leaf, grads), state
+        return map_with_path(leaf, grads), (state + 1 if scheduled
+                                            else state)
 
-    return Transform(lambda p: (), update)
+    return Transform(init, update)
+
+
+#: back-compat alias — the scheduled form is just add_decayed_weights
+#: with a callable coefficient
+add_scheduled_weight_decay = add_decayed_weights
 
 
 def _get_path(tree, path: str):

@@ -58,10 +58,25 @@ class AdamState(NamedTuple):
     nu: jnp.ndarray      # pytree
 
 
+class AMSGradState(NamedTuple):
+    count: jnp.ndarray
+    mu: jnp.ndarray
+    nu: jnp.ndarray
+    max_nu: jnp.ndarray  # running max of bias-corrected nu (v1 adam_maxv)
+
+
 def scale_by_adam(b1: float = 0.9, b2: float = 0.999,
-                  eps: float = 1e-8) -> Transform:
+                  eps: float = 1e-8, amsgrad: bool = False) -> Transform:
+    """Adam; ``amsgrad=True`` adds the v1 ``AdamOptimizer(amsgrad=...)``
+    variant (``v1/python/hetu/optimizer.py:470-481``): the denominator
+    uses the running MAX of the second moment."""
     def init(params):
         z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        if amsgrad:
+            return AMSGradState(jnp.zeros([], jnp.int32),
+                                jax.tree.map(z, params),
+                                jax.tree.map(z, params),
+                                jax.tree.map(z, params))
         return AdamState(jnp.zeros([], jnp.int32),
                          jax.tree.map(z, params), jax.tree.map(z, params))
 
@@ -76,6 +91,14 @@ def scale_by_adam(b1: float = 0.9, b2: float = 0.999,
             grads, state.nu)
         mu_hat_scale = 1.0 / (1 - b1 ** cf)
         nu_hat_scale = 1.0 / (1 - b2 ** cf)
+        if amsgrad:
+            max_nu = jax.tree.map(
+                lambda n, mx: jnp.maximum(mx, n * nu_hat_scale),
+                nu, state.max_nu)
+            updates = jax.tree.map(
+                lambda m, mx: (m * mu_hat_scale) / (jnp.sqrt(mx) + eps),
+                mu, max_nu)
+            return updates, AMSGradState(count, mu, nu, max_nu)
         updates = jax.tree.map(
             lambda m, n: (m * mu_hat_scale) / (jnp.sqrt(n * nu_hat_scale) + eps),
             mu, nu)
@@ -191,13 +214,16 @@ def sgd(lr: ScalarOrSchedule, momentum: float = 0.0,
 
 
 def adam(lr: ScalarOrSchedule, b1: float = 0.9, b2: float = 0.999,
-         eps: float = 1e-8) -> Transform:
-    return chain(scale_by_adam(b1, b2, eps), _lr_transform(lr))
+         eps: float = 1e-8, amsgrad: bool = False) -> Transform:
+    return chain(scale_by_adam(b1, b2, eps, amsgrad), _lr_transform(lr))
 
 
 def adamw(lr: ScalarOrSchedule, b1: float = 0.9, b2: float = 0.999,
-          eps: float = 1e-8, weight_decay: float = 0.01,
+          eps: float = 1e-8,
+          weight_decay: ScalarOrSchedule = 0.01,
           mask: Optional[Callable[[str], bool]] = None) -> Transform:
+    """``weight_decay`` may be a schedule (``schedules.wd_increment``) —
+    the reference's wd-increment scheduler."""
     return chain(scale_by_adam(b1, b2, eps),
                  add_decayed_weights(weight_decay, mask),
                  _lr_transform(lr))

@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -28,6 +29,46 @@ def cosine_decay(lr: float, decay_steps: int, warmup_steps: int = 0,
                         0.0, 1.0)
         cos = 0.5 * (1 + jnp.cos(jnp.pi * prog))
         return (min_lr + (lr - min_lr) * cos) * warm
+    return f
+
+
+def inverse_sqrt(lr: float, warmup_steps: int = 0, min_lr: float = 0.0):
+    """Reference "inverse-square-root" style
+    (``optimizerParamScheduler.h:96-100``): linear warmup to ``lr``, then
+    ``lr·sqrt(warmup)/sqrt(step)`` floored at ``min_lr`` — continuous at
+    the warmup boundary (lr(warmup) == lr), the T5/Adafactor shape."""
+    def f(step):
+        s = step.astype(jnp.float32) + 1
+        w = float(max(warmup_steps, 1))
+        warm = lr * jnp.minimum(1.0, s / w)
+        decayed = jnp.maximum(
+            min_lr, lr * jnp.sqrt(w) * jax.lax.rsqrt(jnp.maximum(s, w)))
+        return jnp.where(s <= w, warm, decayed)
+    return f
+
+
+def wd_increment(start_wd: float, end_wd: float, incr_steps: int,
+                 style: str = "linear"):
+    """Weight-decay increment schedule (reference
+    ``optimizerParamScheduler.h:49-64``): constant holds ``end_wd``,
+    linear/cosine move start→end over ``incr_steps`` then hold."""
+    if style not in ("constant", "linear", "cosine"):
+        raise ValueError(f"unknown wd increment style {style!r}")
+    if style == "constant" and start_wd != end_wd:
+        # the reference asserts this (get_wd) — silently training with
+        # end_wd would hide a mis-edited config
+        raise ValueError(
+            f"constant wd style needs start_wd == end_wd, got "
+            f"{start_wd} != {end_wd}")
+
+    def f(step):
+        if style == "constant":
+            return jnp.asarray(end_wd, jnp.float32)
+        s = step.astype(jnp.float32)
+        frac = jnp.clip(s / max(incr_steps, 1), 0.0, 1.0)
+        if style == "cosine":
+            frac = 0.5 * (1.0 - jnp.cos(jnp.pi * frac))
+        return start_wd + (end_wd - start_wd) * frac
     return f
 
 

@@ -165,3 +165,74 @@ def test_adafactor_trains_gpt_tiny():
         state, m = step(state, batch)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_scheduled_weight_decay_matches_reference_styles():
+    """wd-increment scheduler parity (``optimizerParamScheduler.h:49-64``):
+    constant holds end_wd; linear/cosine interpolate then hold; the
+    transform applies the CURRENT coefficient each step."""
+    f_lin = optim.wd_increment(0.0, 0.1, 10, style="linear")
+    f_cos = optim.wd_increment(0.0, 0.1, 10, style="cosine")
+    f_con = optim.wd_increment(0.1, 0.1, 10, style="constant")
+    import pytest
+    with pytest.raises(ValueError):   # reference asserts start == end
+        optim.wd_increment(0.0, 0.1, 10, style="constant")
+    s = jnp.asarray(5)
+    np.testing.assert_allclose(float(f_lin(s)), 0.05, rtol=1e-6)
+    np.testing.assert_allclose(float(f_cos(s)), 0.05, rtol=1e-6)  # cos mid
+    np.testing.assert_allclose(float(f_con(s)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(f_lin(jnp.asarray(50))), 0.1)
+
+    # transform: step 0 decays by wd(0)=0, step 1 by wd(1)=0.01
+    opt = optim.chain(
+        optim.add_scheduled_weight_decay(f_lin), optim.scale(1.0))
+    params = {"w": jnp.ones((4, 4))}
+    state = opt.init(params)
+    g0 = {"w": jnp.zeros((4, 4))}
+    up0, state = opt.update(g0, state, params)
+    np.testing.assert_allclose(np.asarray(up0["w"]), 0.0)
+    up1, state = opt.update(g0, state, params)
+    np.testing.assert_allclose(np.asarray(up1["w"]), 0.01, rtol=1e-5)
+
+
+def test_amsgrad_matches_v1_reference_formula():
+    """v1 ``AdamOptimizer(amsgrad=True)`` parity (``optimizer.py:470,520``):
+    the reference maxes the BIAS-CORRECTED second moment (vc) — unlike
+    torch, which maxes raw v — so the oracle is the v1 numpy formula on
+    a noisy trajectory where max-nu actually diverges from vanilla adam."""
+    b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.1
+    w = np.asarray([1.0, -2.0, 3.0], np.float32)
+    m = np.zeros_like(w); v = np.zeros_like(w); maxv = np.zeros_like(w)
+    jp = {"w": jnp.asarray(w)}
+    jopt = optim.adam(lr, amsgrad=True)
+    jstate = jopt.init(jp)
+    scales = [1.0, 10.0, 0.1, 5.0, 0.01, 2.0]   # varying grad magnitude
+    for t, c in enumerate(scales, start=1):
+        g = 2.0 * c * w
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mc = m / (1 - b1 ** t)
+        vc = v / (1 - b2 ** t)
+        maxv = np.maximum(vc, maxv)
+        w = w - lr * mc / (np.sqrt(maxv) + eps)
+
+        gj = jax.grad(lambda p: c * jnp.sum(p["w"] ** 2))(jp)
+        up, jstate = jopt.update(gj, jstate, jp)
+        jp = optim.apply_updates(jp, up)
+    np.testing.assert_allclose(np.asarray(jp["w"]), w,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_inverse_sqrt_matches_reference_style():
+    """inverse-square-root parity (``optimizerParamScheduler.h:96-100``):
+    continuous at the warmup boundary (lr(warmup) == max_lr), decays as
+    sqrt(warmup)/sqrt(step), floored at min_lr."""
+    f = optim.inverse_sqrt(3e-4, warmup_steps=1000, min_lr=1e-5)
+    np.testing.assert_allclose(float(f(jnp.asarray(999))), 3e-4,
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(f(jnp.asarray(3999))),
+                               3e-4 * np.sqrt(1000 / 4000), rtol=1e-6)
+    np.testing.assert_allclose(float(f(jnp.asarray(499))),
+                               3e-4 * 0.5, rtol=1e-6)      # mid-warmup
+    np.testing.assert_allclose(float(f(jnp.asarray(10 ** 9))), 1e-5,
+                               rtol=1e-6)      # floor

@@ -32,18 +32,24 @@ def cosine_decay(lr: float, decay_steps: int, warmup_steps: int = 0,
     return f
 
 
-def inverse_sqrt(lr: float, warmup_steps: int = 0, min_lr: float = 0.0):
+def inverse_sqrt(lr: float, warmup_steps: int = 0, min_lr: float = 0.0,
+                 decay_steps: int = 0):
     """Reference "inverse-square-root" style
-    (``optimizerParamScheduler.h:96-100``): linear warmup to ``lr``, then
-    ``lr·sqrt(warmup)/sqrt(step)`` floored at ``min_lr`` — continuous at
-    the warmup boundary (lr(warmup) == lr), the T5/Adafactor shape."""
+    (``optimizerParamScheduler.h:82,96-100``): linear warmup to ``lr``,
+    then ``lr·sqrt(warmup)/sqrt(step)`` floored at ``min_lr`` —
+    continuous at the warmup boundary (lr(warmup) == lr), the
+    T5/Adafactor shape. ``decay_steps > 0`` adds the reference's hard
+    cutoff: past it the schedule returns ``min_lr`` outright."""
     def f(step):
         s = step.astype(jnp.float32) + 1
         w = float(max(warmup_steps, 1))
         warm = lr * jnp.minimum(1.0, s / w)
         decayed = jnp.maximum(
             min_lr, lr * jnp.sqrt(w) * jax.lax.rsqrt(jnp.maximum(s, w)))
-        return jnp.where(s <= w, warm, decayed)
+        out = jnp.where(s <= w, warm, decayed)
+        if decay_steps > 0:
+            out = jnp.where(s > decay_steps, min_lr, out)
+        return out
     return f
 
 
@@ -64,7 +70,10 @@ def wd_increment(start_wd: float, end_wd: float, incr_steps: int,
     def f(step):
         if style == "constant":
             return jnp.asarray(end_wd, jnp.float32)
-        s = step.astype(jnp.float32)
+        # +1: the reference's step tensor starts at ONES
+        # (optimizer.cc:170), so the FIRST update already moves off
+        # start_wd and end_wd is reached on update incr_steps
+        s = step.astype(jnp.float32) + 1
         frac = jnp.clip(s / max(incr_steps, 1), 0.0, 1.0)
         if style == "cosine":
             frac = 0.5 * (1.0 - jnp.cos(jnp.pi * frac))

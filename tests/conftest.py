@@ -102,6 +102,8 @@ SLOW_TESTS = {
     "test_elastic_train_example",
     "test_sft_example",
     "test_remaining_examples_run",
+    "test_r4_configs_compile_and_train",
+    "test_cnn_loss_curve_matches_torch",
     # multi-process (real OS processes + jax.distributed)
     "test_two_process_dp_training",
     "test_kill_restart_resumes_from_checkpoint",

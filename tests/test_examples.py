@@ -57,3 +57,14 @@ def test_sft_example():
                                     "lora_sft.py"])
 def test_remaining_examples_run(script):
     _run(script, timeout=600)
+
+
+@pytest.mark.parametrize("cfg", ["gpt_pp_cp_long.yaml",
+                                 "moe_sam_gate.yaml"])
+def test_r4_configs_compile_and_train(cfg):
+    """The round-4 example configs (pp×cp ring, SAM-gated MoE) drive the
+    standard pretrain flow."""
+    out = _run("pretrain.py", "--config",
+               os.path.join(_ROOT, "examples", "configs", cfg),
+               timeout=600)
+    assert "step" in out or out == "", (cfg, out[-300:])

@@ -192,3 +192,82 @@ def test_cnn_classifier_trains():
         params, opt_state, loss = step(params, opt_state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] / 3, losses[:3] + losses[-3:]
+
+
+def test_cnn_loss_curve_matches_torch():
+    """The reference's hallmark model test (``tests/test_cifar10.py``):
+    train the SAME CNN in both frameworks from identical weights/data
+    with plain SGD and compare the LOSS CURVES step by step."""
+    import numpy as np
+    import pytest
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    from hetu_tpu import optim
+    from hetu_tpu.models.vision import CNNConfig, SimpleCNN
+    from hetu_tpu.optim.base import apply_updates
+
+    cfg = CNNConfig(image_size=8, channels=(4, 8), hidden=16,
+                    num_classes=10)
+    model = SimpleCNN(cfg)
+    params = model.init(jax.random.key(0))
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8, 8, 3).astype(np.float32)
+    y = rng.randint(0, 10, size=(8,))
+
+    # torch mirror: NCHW convs with the SAME weights; the flatten goes
+    # through an NHWC permute so the fc weight ordering matches
+    class TorchCNN(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv0 = torch.nn.Conv2d(3, 4, 3, padding=1)
+            self.conv1 = torch.nn.Conv2d(4, 8, 3, padding=1)
+            self.fc = torch.nn.Linear(8 * 2 * 2, 16)
+            self.head = torch.nn.Linear(16, 10)
+
+        def forward(self, x):                  # x NCHW
+            x = F.max_pool2d(F.relu(self.conv0(x)), 2)
+            x = F.max_pool2d(F.relu(self.conv1(x)), 2)
+            x = x.permute(0, 2, 3, 1).reshape(x.shape[0], -1)
+            return self.head(F.relu(self.fc(x)))
+
+    tm = TorchCNN()
+    with torch.no_grad():
+        for i in (0, 1):
+            k = np.asarray(params[f"conv{i}"]["kernel"])   # (H,W,I,O)
+            getattr(tm, f"conv{i}").weight.copy_(
+                torch.from_numpy(k.transpose(3, 2, 0, 1)))
+            getattr(tm, f"conv{i}").bias.copy_(
+                torch.from_numpy(np.asarray(params[f"conv{i}"]["bias"])))
+        for name in ("fc", "head"):
+            w = np.asarray(params[name]["weight"])          # (in, out)
+            getattr(tm, name).weight.copy_(torch.from_numpy(w.T))
+            getattr(tm, name).bias.copy_(
+                torch.from_numpy(np.asarray(params[name]["bias"])))
+
+    topt = torch.optim.SGD(tm.parameters(), lr=0.05)
+    tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+    ty = torch.from_numpy(y)
+
+    opt = optim.sgd(0.05)
+    opt_state = opt.init(params)
+    jx, jy = jnp.asarray(x), jnp.asarray(y)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, g = jax.value_and_grad(model.loss)(params, jx, jy)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return apply_updates(params, updates), opt_state, loss
+
+    j_losses, t_losses = [], []
+    for _ in range(20):
+        params, opt_state, jl = step(params, opt_state)
+        j_losses.append(float(jl))
+        topt.zero_grad()
+        tl = F.cross_entropy(tm(tx), ty)
+        tl.backward()
+        topt.step()
+        t_losses.append(float(tl))
+
+    np.testing.assert_allclose(j_losses, t_losses, rtol=2e-4, atol=2e-4)
+    assert j_losses[-1] < j_losses[0]      # and it actually learns

@@ -177,22 +177,25 @@ def test_scheduled_weight_decay_matches_reference_styles():
     import pytest
     with pytest.raises(ValueError):   # reference asserts start == end
         optim.wd_increment(0.0, 0.1, 10, style="constant")
-    s = jnp.asarray(5)
+    # schedules are evaluated at step+1 (the reference's step tensor
+    # starts at ONES — optimizer.cc:170)
+    s = jnp.asarray(4)                      # 5th update
     np.testing.assert_allclose(float(f_lin(s)), 0.05, rtol=1e-6)
     np.testing.assert_allclose(float(f_cos(s)), 0.05, rtol=1e-6)  # cos mid
     np.testing.assert_allclose(float(f_con(s)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(f_lin(jnp.asarray(9))), 0.1)  # update 10
     np.testing.assert_allclose(float(f_lin(jnp.asarray(50))), 0.1)
 
-    # transform: step 0 decays by wd(0)=0, step 1 by wd(1)=0.01
+    # transform: FIRST update decays by wd(step 1)=0.01, second by 0.02
     opt = optim.chain(
         optim.add_scheduled_weight_decay(f_lin), optim.scale(1.0))
     params = {"w": jnp.ones((4, 4))}
     state = opt.init(params)
     g0 = {"w": jnp.zeros((4, 4))}
     up0, state = opt.update(g0, state, params)
-    np.testing.assert_allclose(np.asarray(up0["w"]), 0.0)
+    np.testing.assert_allclose(np.asarray(up0["w"]), 0.01, rtol=1e-5)
     up1, state = opt.update(g0, state, params)
-    np.testing.assert_allclose(np.asarray(up1["w"]), 0.01, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(up1["w"]), 0.02, rtol=1e-5)
 
 
 def test_amsgrad_matches_v1_reference_formula():

@@ -8,8 +8,10 @@ LlamaLMHeadModel :446).
 from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
 from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
 from hetu_tpu.models.bert import BertConfig, BertModel
-from hetu_tpu.models.vision import CNNConfig, MLPClassifier, SimpleCNN
+from hetu_tpu.models.vision import (
+    CNNConfig, MLPClassifier, RNNConfig, SimpleCNN, SimpleRNN,
+)
 from hetu_tpu.models.generation import generate, decode, init_kv_caches
 
-__all__ = ["GPTConfig", "GPTLMHeadModel", "LlamaConfig", "BertConfig", "BertModel", "CNNConfig", "SimpleCNN", "MLPClassifier", "LlamaLMHeadModel",
+__all__ = ["GPTConfig", "GPTLMHeadModel", "LlamaConfig", "BertConfig", "BertModel", "CNNConfig", "SimpleCNN", "MLPClassifier", "RNNConfig", "SimpleRNN", "LlamaLMHeadModel",
            "generate", "decode", "init_kv_caches"]

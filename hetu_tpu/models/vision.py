@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 
 from hetu_tpu.nn.layers import Conv2D, Linear, MLP, max_pool2d
@@ -48,6 +49,51 @@ class SimpleCNN(Module):
             x = max_pool2d(x)
         x = x.reshape(x.shape[0], -1)
         h = jnp.maximum(self.fc(params["fc"], x), 0.0)
+        return self.head(params["head"], h)
+
+    def loss(self, params, x, labels):
+        return cross_entropy_mean(self(params, x), labels)
+
+
+@dataclasses.dataclass(frozen=True)
+class RNNConfig:
+    in_dim: int = 28          # features per scan step (an MNIST row)
+    hidden: int = 128
+    num_classes: int = 10
+    seq_len: int = 28
+
+
+class SimpleRNN(Module):
+    """Elman-style row RNN (the reference's ``tests/test_rnn.py`` model):
+    ``h_t = relu(W2·[W1·x_t ; h_{t-1}])``, classify from the final
+    hidden state. TPU-native form: the time loop is a ``lax.scan`` (one
+    compiled step, no Python unroll)."""
+
+    def __init__(self, cfg: RNNConfig = RNNConfig()):
+        super().__init__()
+        self.cfg = cfg
+        self.linear1 = Linear(cfg.in_dim, cfg.hidden)
+        self.linear2 = Linear(cfg.hidden * 2, cfg.hidden)
+        self.head = Linear(cfg.hidden, cfg.num_classes)
+
+    def __call__(self, params, x):
+        """x (B, seq_len, in_dim) → logits (B, num_classes)."""
+        if x.shape[1] != self.cfg.seq_len:
+            raise ValueError(f"expected seq_len {self.cfg.seq_len}, "
+                             f"got input with {x.shape[1]} steps")
+
+        def cell(h, x_t):
+            z = self.linear1(params["linear1"], x_t)
+            h = jnp.maximum(self.linear2(
+                params["linear2"], jnp.concatenate([z, h], axis=-1)), 0.0)
+            return h, None
+
+        # carry dtype must equal the cell's OUTPUT dtype (the policy
+        # compute dtype under autocast) — scan requires identical carry
+        # avals in and out
+        h0 = jnp.zeros((x.shape[0], self.cfg.hidden),
+                       self.compute_dtype())
+        h, _ = jax.lax.scan(cell, h0, jnp.swapaxes(x, 0, 1))
         return self.head(params["head"], h)
 
     def loss(self, params, x, labels):

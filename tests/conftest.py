@@ -104,6 +104,7 @@ SLOW_TESTS = {
     "test_remaining_examples_run",
     "test_r4_configs_compile_and_train",
     "test_cnn_loss_curve_matches_torch",
+    "test_rnn_loss_curve_matches_torch",
     # multi-process (real OS processes + jax.distributed)
     "test_two_process_dp_training",
     "test_kill_restart_resumes_from_checkpoint",

@@ -271,3 +271,73 @@ def test_cnn_loss_curve_matches_torch():
 
     np.testing.assert_allclose(j_losses, t_losses, rtol=2e-4, atol=2e-4)
     assert j_losses[-1] < j_losses[0]      # and it actually learns
+
+
+def test_rnn_loss_curve_matches_torch():
+    """Row-RNN parity (reference ``tests/test_rnn.py``): identical
+    weights/data/SGD in both frameworks, loss curves match step for
+    step — the lax.scan time loop computes exactly the reference's
+    unrolled ``h_t = relu(W2[W1 x_t; h_{t-1}])``."""
+    import numpy as np
+    import pytest
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    from hetu_tpu import optim
+    from hetu_tpu.models.vision import RNNConfig, SimpleRNN
+    from hetu_tpu.optim.base import apply_updates
+
+    cfg = RNNConfig(in_dim=8, hidden=16, num_classes=10, seq_len=6)
+    model = SimpleRNN(cfg)
+    params = model.init(jax.random.key(0))
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 6, 8).astype(np.float32)
+    y = rng.randint(0, 10, size=(8,))
+
+    class TorchRNN(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.linear1 = torch.nn.Linear(8, 16)
+            self.linear2 = torch.nn.Linear(32, 16)
+            self.head = torch.nn.Linear(16, 10)
+
+        def forward(self, x):                    # (B, T, in)
+            h = torch.zeros(x.shape[0], 16)
+            for t in range(x.shape[1]):
+                z = self.linear1(x[:, t])
+                h = torch.relu(self.linear2(torch.cat([z, h], dim=1)))
+            return self.head(h)
+
+    tm = TorchRNN()
+    with torch.no_grad():
+        for name in ("linear1", "linear2", "head"):
+            w = np.asarray(params[name]["weight"])          # (in, out)
+            getattr(tm, name).weight.copy_(torch.from_numpy(w.T))
+            getattr(tm, name).bias.copy_(
+                torch.from_numpy(np.asarray(params[name]["bias"])))
+
+    topt = torch.optim.SGD(tm.parameters(), lr=0.05)
+    tx, ty = torch.from_numpy(x), torch.from_numpy(y)
+
+    opt = optim.sgd(0.05)
+    opt_state = opt.init(params)
+    jx, jy = jnp.asarray(x), jnp.asarray(y)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, g = jax.value_and_grad(model.loss)(params, jx, jy)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return apply_updates(params, updates), opt_state, loss
+
+    j_losses, t_losses = [], []
+    for _ in range(20):
+        params, opt_state, jl = step(params, opt_state)
+        j_losses.append(float(jl))
+        topt.zero_grad()
+        tl = F.cross_entropy(tm(tx), ty)
+        tl.backward()
+        topt.step()
+        t_losses.append(float(tl))
+
+    np.testing.assert_allclose(j_losses, t_losses, rtol=2e-4, atol=2e-4)
+    assert j_losses[-1] < j_losses[0]

@@ -194,6 +194,48 @@ def test_cnn_classifier_trains():
     assert losses[-1] < losses[0] / 3, losses[:3] + losses[-3:]
 
 
+def _torch_parity_loop(model, params, tm, jx, jy, tx, ty, *, steps=20,
+                       lr=0.05):
+    """Shared scaffolding for torch loss-curve parity tests: lockstep SGD
+    in both frameworks, returns (jax_losses, torch_losses)."""
+    import numpy as np
+    import torch
+    import torch.nn.functional as F
+    from hetu_tpu import optim
+    from hetu_tpu.optim.base import apply_updates
+
+    topt = torch.optim.SGD(tm.parameters(), lr=lr)
+    opt = optim.sgd(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, g = jax.value_and_grad(model.loss)(params, jx, jy)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return apply_updates(params, updates), opt_state, loss
+
+    j_losses, t_losses = [], []
+    for _ in range(steps):
+        params, opt_state, jl = step(params, opt_state)
+        j_losses.append(float(jl))
+        topt.zero_grad()
+        tl = F.cross_entropy(tm(tx), ty)
+        tl.backward()
+        topt.step()
+        t_losses.append(float(tl))
+    return j_losses, t_losses
+
+
+def _copy_linear(tmod, params, name):
+    """Copy a hetu_tpu Linear (in,out) into a torch.nn.Linear (out,in)."""
+    import numpy as np
+    import torch
+    w = np.asarray(params[name]["weight"])
+    getattr(tmod, name).weight.copy_(torch.from_numpy(w.T))
+    getattr(tmod, name).bias.copy_(
+        torch.from_numpy(np.asarray(params[name]["bias"])))
+
+
 def test_cnn_loss_curve_matches_torch():
     """The reference's hallmark model test (``tests/test_cifar10.py``):
     train the SAME CNN in both frameworks from identical weights/data
@@ -240,34 +282,11 @@ def test_cnn_loss_curve_matches_torch():
             getattr(tm, f"conv{i}").bias.copy_(
                 torch.from_numpy(np.asarray(params[f"conv{i}"]["bias"])))
         for name in ("fc", "head"):
-            w = np.asarray(params[name]["weight"])          # (in, out)
-            getattr(tm, name).weight.copy_(torch.from_numpy(w.T))
-            getattr(tm, name).bias.copy_(
-                torch.from_numpy(np.asarray(params[name]["bias"])))
+            _copy_linear(tm, params, name)
 
-    topt = torch.optim.SGD(tm.parameters(), lr=0.05)
-    tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
-    ty = torch.from_numpy(y)
-
-    opt = optim.sgd(0.05)
-    opt_state = opt.init(params)
-    jx, jy = jnp.asarray(x), jnp.asarray(y)
-
-    @jax.jit
-    def step(params, opt_state):
-        loss, g = jax.value_and_grad(model.loss)(params, jx, jy)
-        updates, opt_state = opt.update(g, opt_state, params)
-        return apply_updates(params, updates), opt_state, loss
-
-    j_losses, t_losses = [], []
-    for _ in range(20):
-        params, opt_state, jl = step(params, opt_state)
-        j_losses.append(float(jl))
-        topt.zero_grad()
-        tl = F.cross_entropy(tm(tx), ty)
-        tl.backward()
-        topt.step()
-        t_losses.append(float(tl))
+    j_losses, t_losses = _torch_parity_loop(
+        model, params, tm, jnp.asarray(x), jnp.asarray(y),
+        torch.from_numpy(x.transpose(0, 3, 1, 2)), torch.from_numpy(y))
 
     np.testing.assert_allclose(j_losses, t_losses, rtol=2e-4, atol=2e-4)
     assert j_losses[-1] < j_losses[0]      # and it actually learns
@@ -311,33 +330,11 @@ def test_rnn_loss_curve_matches_torch():
     tm = TorchRNN()
     with torch.no_grad():
         for name in ("linear1", "linear2", "head"):
-            w = np.asarray(params[name]["weight"])          # (in, out)
-            getattr(tm, name).weight.copy_(torch.from_numpy(w.T))
-            getattr(tm, name).bias.copy_(
-                torch.from_numpy(np.asarray(params[name]["bias"])))
+            _copy_linear(tm, params, name)
 
-    topt = torch.optim.SGD(tm.parameters(), lr=0.05)
-    tx, ty = torch.from_numpy(x), torch.from_numpy(y)
-
-    opt = optim.sgd(0.05)
-    opt_state = opt.init(params)
-    jx, jy = jnp.asarray(x), jnp.asarray(y)
-
-    @jax.jit
-    def step(params, opt_state):
-        loss, g = jax.value_and_grad(model.loss)(params, jx, jy)
-        updates, opt_state = opt.update(g, opt_state, params)
-        return apply_updates(params, updates), opt_state, loss
-
-    j_losses, t_losses = [], []
-    for _ in range(20):
-        params, opt_state, jl = step(params, opt_state)
-        j_losses.append(float(jl))
-        topt.zero_grad()
-        tl = F.cross_entropy(tm(tx), ty)
-        tl.backward()
-        topt.step()
-        t_losses.append(float(tl))
+    j_losses, t_losses = _torch_parity_loop(
+        model, params, tm, jnp.asarray(x), jnp.asarray(y),
+        torch.from_numpy(x), torch.from_numpy(y))
 
     np.testing.assert_allclose(j_losses, t_losses, rtol=2e-4, atol=2e-4)
     assert j_losses[-1] < j_losses[0]

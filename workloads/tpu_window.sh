@@ -37,4 +37,6 @@ run moe_bench 600 python workloads/moe_bench.py
 run flash_tune 900 python workloads/flash_tune.py
 # 10. bottleneck profile (per-module table + memory + xplane trace)
 run profile_step 900 python workloads/profile_step.py
+# 11. top-ops table from the trace (text, commit-able)
+run xplane_summary 300 python workloads/xplane_summary.py
 echo "=== done ($(date +%H:%M:%S)) ==="

@@ -1,0 +1,70 @@
+"""Summarize an xplane trace (from ``profile_step.py``) into a top-ops
+table — the actionable output of the window's bottleneck hunt, without
+needing TensorBoard.
+
+Usage: PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
+           python workloads/xplane_summary.py [trace_dir] [--top 25]
+(defaults to workloads/out/xplane; the env var works around the
+vendored TF protos predating protoc 3.19.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import os
+import sys
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def summarize(path: str, top: int) -> None:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2 as xp
+
+    files = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not files:
+        print(f"no .xplane.pb under {path}")
+        return
+    f = files[-1]           # newest capture
+    print(f"trace: {f}\n")
+    space = xp.XSpace()
+    with open(f, "rb") as fh:
+        space.ParseFromString(fh.read())
+
+    for plane in space.planes:
+        total_events = sum(len(l.events) for l in plane.lines)
+        if not total_events:
+            continue
+        meta = plane.event_metadata
+        agg = collections.defaultdict(lambda: [0.0, 0])   # ps, count
+        for line in plane.lines:
+            for ev in line.events:
+                name = meta[ev.metadata_id].name if ev.metadata_id in meta \
+                    else f"id{ev.metadata_id}"
+                a = agg[name]
+                a[0] += ev.duration_ps
+                a[1] += 1
+        total_ps = sum(a[0] for a in agg.values()) or 1.0
+        print(f"== plane {plane.name} ({total_events} events, "
+              f"{total_ps / 1e9:.2f} ms total) ==")
+        print(f"{'op':<58} {'ms':>9} {'%':>6} {'calls':>7}")
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
+        for name, (ps, n) in rows:
+            print(f"{name[:58]:<58} {ps / 1e9:>9.3f} "
+                  f"{100 * ps / total_ps:>5.1f}% {n:>7}")
+        print()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", nargs="?", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "out", "xplane"))
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args()
+    summarize(args.path, args.top)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,7 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
                     manual_cp: bool = False,
                     cp_layout: str = "contiguous",
                     cp_impl: str = "ring",
+                    unroll: bool = False,
                     param_manual_specs: Any = None):
     """Run ``payload`` microbatches through pp pipeline stages.
 
@@ -92,6 +93,12 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
 
         layer_ids = jnp.arange(n_local)
 
+        # unroll: straight-line the per-stage layer scan (XLA schedules
+        # across layer boundaries, drops the per-layer residual-stacking
+        # dynamic-update-slices — the single-chip win from the r3 sweep,
+        # now available inside the pipeline region too)
+        unroll_n = n_local if unroll else 1
+
         def stage_fn(cur):
             extras = {k: v for k, v in cur.items()
                       if k not in ("x", "aux")}
@@ -102,11 +109,12 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
                     h, a = one_block(h, lp, extras, li)
                     return (h, aux + a), None
                 (x, aux), _ = jax.lax.scan(
-                    body, (cur["x"], cur["aux"]), (params_local, layer_ids))
+                    body, (cur["x"], cur["aux"]), (params_local, layer_ids),
+                    unroll=unroll_n)
                 return {**cur, "x": x, "aux": aux}
             x, _ = jax.lax.scan(
                 lambda h, xs: (one_block(h, xs[0], extras, xs[1]), None),
-                cur["x"], (params_local, layer_ids))
+                cur["x"], (params_local, layer_ids), unroll=unroll_n)
             return {**cur, "x": x}
 
         zero = jax.tree.map(lambda v: jnp.zeros_like(v[0]), payload_all)
@@ -263,6 +271,7 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
                 manual_ep=manual_ep, manual_cp=manual_cp,
                 cp_layout=strategy.effective_cp_layout,
                 cp_impl=strategy.cp_impl,
+                unroll=strategy.unroll,
                 param_manual_specs=param_manual_specs)
             aux = jnp.zeros([], jnp.float32)
             if block.returns_aux:

@@ -52,8 +52,9 @@ class Strategy:
     remat_mask: Optional[tuple] = None   # per-layer recompute flags
                                  # (search_layerwise output; None = uniform)
     unroll: bool = False         # unroll the layer scan (straight-line
-                                 # code: faster single-stage, compile
-                                 # time grows with depth; pp>1 ignores)
+                                 # code: faster per stage, compile time
+                                 # grows with layers; under pp>1 the
+                                 # PER-STAGE scan unrolls)
 
     # -- derived -----------------------------------------------------------
     @property

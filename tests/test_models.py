@@ -198,9 +198,9 @@ def _torch_parity_loop(model, params, tm, jx, jy, tx, ty, *, steps=20,
                        lr=0.05):
     """Shared scaffolding for torch loss-curve parity tests: lockstep SGD
     in both frameworks, returns (jax_losses, torch_losses)."""
-    import numpy as np
     import torch
     import torch.nn.functional as F
+
     from hetu_tpu import optim
     from hetu_tpu.optim.base import apply_updates
 

@@ -117,3 +117,14 @@ def test_gpt_pp_cp_ulysses_parity():
     _, ref = _run(GPTLMHeadModel, CFG, Strategy())
     _, got = _run(GPTLMHeadModel, CFG, strategy)
     np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_pp_unroll_parity():
+    """Strategy.unroll under pp: the per-stage layer scan unrolls (r3
+    noted it was ignored) — trajectory identical to the scanned form."""
+    strategy = Strategy(pp=2, num_microbatches=2, unroll=True)
+    _, ref = _run(GPTLMHeadModel, CFG, Strategy(pp=2, num_microbatches=2))
+    _, got = _run(GPTLMHeadModel, CFG, strategy)
+    # same tolerance as the sibling parity tests: unrolling lets XLA
+    # refuse/reschedule across layers, which legally changes rounding
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)

@@ -64,7 +64,9 @@ def main():
         dims = ModelDims.from_config(
             cfg, seq_len=args.seq_len,
             global_batch=args.batch_rows)
-        cands = search_uniform(dims, TPUTopology(num_devices=n))
+        # profile-first: measured calibration (workloads/out/
+        # calibration.json) seeds the topology when present
+        cands = search_uniform(dims, TPUTopology.calibrated(n))
         strategy = cands[0].strategy
         print(f"auto-parallel picked: {strategy.to_json()}")
     elif args.strategy:

@@ -145,7 +145,7 @@ def config3_llama_autoparallel(on_tpu):
     )
     dims = ModelDims.from_config(LlamaConfig.llama_7b(), seq_len=2048,
                                  global_batch=64)
-    topo = TPUTopology(num_devices=8, peak_flops=197e12, hbm_bytes=16e9)
+    topo = TPUTopology.calibrated(8, peak_flops=197e12, hbm_bytes=16e9)
     cands = search_uniform(dims, topo)
     best = cands[0] if cands else None
 

@@ -38,11 +38,21 @@ def main():
     opt = optim.adamw(1e-4)
     B, S = 8, 1024
     dims = ModelDims.from_config(cfg, seq_len=S, global_batch=B)
-    topo = TPUTopology(num_devices=1, peak_flops=PEAK_V5E,
-                       hbm_bytes=16e9)
+    # hardware-true constants: peak from the actual device kind (the
+    # calibration file must not bake v5e specs onto a v5p slice), HBM
+    # from the allocator's own limit when it reports one
+    from bench import peak_flops
+    peak = peak_flops(dev) or PEAK_V5E
+    try:
+        hbm = float((dev.memory_stats() or {}).get("bytes_limit", 16e9))
+    except Exception:
+        hbm = 16e9
+    topo = TPUTopology(num_devices=1, peak_flops=peak, hbm_bytes=hbm)
 
+    print(f"== device {getattr(dev, 'device_kind', '?')}: peak "
+          f"{peak/1e12:.0f} TF/s, HBM {hbm/1e9:.0f} GB ==")
     print("== MXU efficiency curve ==")
-    for shape, eff in measure_matmul_efficiency(PEAK_V5E).items():
+    for shape, eff in measure_matmul_efficiency(peak).items():
         print(f"  {shape}: {eff:.3f}")
 
     params = model.init(jax.random.key(0), dtype=jnp.bfloat16)
@@ -78,8 +88,8 @@ def main():
     with open(out, "w") as f:
         json.dump({
             "device_kind": getattr(dev, "device_kind", "tpu"),
-            "peak_flops": PEAK_V5E,
-            "hbm_bytes": 16e9,
+            "peak_flops": peak,
+            "hbm_bytes": hbm,
             "mxu_efficiency": cal.mxu_efficiency,
             "measured_ms": [m * 1e3 for m in measured],
             "predicted_ms": [p * 1e3 for p in predicted],

@@ -12,7 +12,8 @@ from hetu_tpu.data.loader import (
     build_data_loader, sample_batches, token_batches,
 )
 from hetu_tpu.data.tokenizers import (
-    ByteLevelBPETokenizer, HFTokenizer, train_bpe,
+    ByteLevelBPETokenizer, HFTokenizer, SentencePieceTokenizer,
+    TiktokenTokenizer, train_bpe,
 )
 from hetu_tpu.data.hydraulis import (
     BucketPlan, DynamicDispatcher, plan_buckets,
@@ -22,6 +23,7 @@ __all__ = [
     "PackedBatch", "pack_sequences", "SeqLenBuckets",
     "JsonDataset", "SyntheticLMDataset",
     "build_data_loader", "sample_batches", "token_batches",
-    "ByteLevelBPETokenizer", "HFTokenizer", "train_bpe",
+    "ByteLevelBPETokenizer", "HFTokenizer", "SentencePieceTokenizer",
+    "TiktokenTokenizer", "train_bpe",
     "BucketPlan", "DynamicDispatcher", "plan_buckets",
 ]

@@ -379,3 +379,64 @@ class HFTokenizer:
 
     def __call__(self, text: str) -> list[int]:
         return self.encode(text)
+
+
+class TiktokenTokenizer:
+    """Wrapper for an OpenAI tiktoken encoding (reference:
+    ``python/hetu/data`` tiktoken wrapper). ``allowed_special`` follows
+    tiktoken semantics; defaults to allowing every registered special
+    token (the pretraining-corpus case)."""
+
+    def __init__(self, encoding: str = "gpt2", *,
+                 allowed_special="all"):
+        try:
+            import tiktoken
+        except ImportError as e:
+            raise ImportError(
+                "TiktokenTokenizer needs the optional `tiktoken` "
+                "package") from e
+        self.tk = tiktoken.get_encoding(encoding)
+        self._allowed = allowed_special
+
+    @property
+    def vocab_size(self) -> int:
+        return self.tk.n_vocab
+
+    def encode(self, text: str) -> list[int]:
+        # tiktoken natively understands the literal "all"
+        return self.tk.encode(text,
+                              allowed_special=self._allowed or set())
+
+    def decode(self, ids) -> str:
+        return self.tk.decode(list(int(i) for i in ids))
+
+    def __call__(self, text: str) -> list[int]:
+        return self.encode(text)
+
+
+class SentencePieceTokenizer:
+    """Wrapper for a sentencepiece model file (reference:
+    ``python/hetu/data`` sentencepiece wrapper). Import-gated: raises a
+    clear error when the optional dependency is absent."""
+
+    def __init__(self, model_path: str):
+        try:
+            import sentencepiece as spm
+        except ImportError as e:
+            raise ImportError(
+                "SentencePieceTokenizer needs the optional `sentencepiece`"
+                " package") from e
+        self.tk = spm.SentencePieceProcessor(model_file=model_path)
+
+    @property
+    def vocab_size(self) -> int:
+        return self.tk.vocab_size()
+
+    def encode(self, text: str) -> list[int]:
+        return self.tk.encode(text)
+
+    def decode(self, ids) -> str:
+        return self.tk.decode(list(int(i) for i in ids))
+
+    def __call__(self, text: str) -> list[int]:
+        return self.encode(text)

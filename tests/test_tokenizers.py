@@ -166,3 +166,34 @@ def test_native_bpe_parity_and_speed():
                for _ in range(3))
     assert tok.encode(blob) is not None
     assert t_native < 1.5 * t_py, (t_native, t_py)
+
+
+def test_tiktoken_wrapper_roundtrip():
+    """tiktoken wrapper parity (reference wraps tiktoken in
+    ``python/hetu/data``): byte-exact roundtrip + the gpt2 encoding
+    agrees with our in-tree byte-level BPE id space size."""
+    pytest.importorskip("tiktoken")
+    from hetu_tpu.data.tokenizers import TiktokenTokenizer
+
+    try:
+        tok = TiktokenTokenizer("gpt2")
+    except Exception as e:   # encoding file fetch needs network/cache
+        pytest.skip(f"tiktoken gpt2 encoding unavailable offline "
+                    f"({type(e).__name__})")
+    text = "hello world — ragnarök 北京 <|endoftext|> tail"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    assert tok.vocab_size == 50257
+
+
+def test_sentencepiece_wrapper_gated():
+    """Absent optional dep raises a CLEAR ImportError (not a bare
+    ModuleNotFoundError deep in a call)."""
+    from hetu_tpu.data.tokenizers import SentencePieceTokenizer
+    try:
+        import sentencepiece  # noqa: F401
+        pytest.skip("sentencepiece installed — gating not exercisable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="sentencepiece"):
+        SentencePieceTokenizer("/nonexistent.model")

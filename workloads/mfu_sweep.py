@@ -4,18 +4,69 @@ Finds the highest-MFU configuration for ``bench.py`` (BASELINE config 2).
 MFU accounting counts model FLOPs only (PaLM appendix B), so remat must buy
 a bigger batch than its recompute overhead costs to win.
 
+Each config runs in its OWN subprocess with a per-config timeout: in the
+round-4 window, one compile hung when the relay died mid-request and ate
+22 minutes of scarce TPU time — a hang must cost one config's budget, not
+the whole sweep's. After any config failure the parent re-probes the
+tunnel and aborts if it is gone (exit 2, same contract as tpu_window.sh).
+
 Usage: python workloads/mfu_sweep.py [--steps 10]
 """
 
 import argparse
 import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_one(batch, remat, unroll, args):
+    """Measure a single config in THIS process; print one RESULT line."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import peak_flops, model_flops_per_token
+    from hetu_tpu.utils.profiler import sync_result
+    from hetu_tpu import optim
+    from hetu_tpu.core.dtypes import Policy, autocast
+    from hetu_tpu.engine import make_plan, init_state, build_train_step
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+    from hetu_tpu.parallel.strategy import Strategy
+
+    dev = jax.devices()[0]
+    peak = peak_flops(dev)
+    if not peak:
+        raise SystemExit(f"no TPU (device {dev.device_kind!r})")
+    cfg = GPTConfig.small()
+    model = GPTLMHeadModel(cfg)
+    opt = optim.adamw(1e-4, weight_decay=0.01)
+    param_dt = jnp.float32 if args.param_dtype == "fp32" else jnp.bfloat16
+    policy = Policy(param_dtype=param_dt, compute_dtype=jnp.bfloat16)
+    seq = args.seq
+    strategy = Strategy(remat=remat, unroll=unroll)
+    with autocast(policy):
+        plan = make_plan(model, opt, strategy)
+        state = init_state(model, opt, plan, jax.random.key(0))
+        step = build_train_step(model, opt, plan)
+        ids = jax.random.randint(jax.random.key(1),
+                                 (batch, seq + 1), 0, cfg.vocab_size)
+        b = plan.shard_batch({"input_ids": ids[:, :-1],
+                              "labels": ids[:, 1:]})
+        for _ in range(max(1, args.warmup)):
+            state, m = step(state, b)
+        sync_result(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, m = step(state, b)
+        sync_result(m["loss"])
+        dt = (time.perf_counter() - t0) / args.steps
+    n = sum(x.size for x in jax.tree.leaves(state.params))
+    tps = batch * seq / dt
+    mfu = model_flops_per_token(cfg, n, seq) * tps / peak
+    print(f"RESULT {mfu:.4f} {batch} {remat} {int(unroll)} "
+          f"{dt * 1e3:.1f} {tps:.0f} {dev.device_kind}")
 
 
 def main():
@@ -30,35 +81,23 @@ def main():
     ap.add_argument("--grid", default=None,
                     help="comma list of batch:remat:unroll triples, e.g. "
                          "32:selective:1,64:full:1 (default: built-in)")
+    ap.add_argument("--one", default=None, metavar="B:R:U",
+                    help="internal: measure a single config in-process")
+    ap.add_argument("--per-config-tmo", type=int, default=300,
+                    help="seconds each config subprocess may take "
+                         "(compile + measure)")
     args = ap.parse_args()
 
-    from bench import peak_flops, model_flops_per_token
-    from hetu_tpu.utils.profiler import sync_result
-    from hetu_tpu import optim
-    from hetu_tpu.core.dtypes import Policy, autocast
-    from hetu_tpu.engine import make_plan, init_state, build_train_step
-    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
-    from hetu_tpu.parallel.strategy import Strategy
+    if args.one:
+        b, r, u = args.one.split(":")
+        measure_one(int(b), r, bool(int(u)), args)
+        return
 
     # out-of-process probe first: on a dead tunnel the axon plugin hangs
     # in-process backend init (jax.devices()) indefinitely
     from bench import probe_tpu
     if not probe_tpu(timeout=120):
-        raise SystemExit("no live TPU — the sweep measures MFU on real "
-                         "hardware only; use bench.py for the CPU smoke "
-                         "path")
-    dev = jax.devices()[0]
-    peak = peak_flops(dev)
-    if not peak:
-        raise SystemExit(f"no TPU (device {dev.device_kind!r}) — the sweep "
-                         "measures MFU on real hardware only; use bench.py "
-                         "for the CPU smoke path")
-    cfg = GPTConfig.small()
-    model = GPTLMHeadModel(cfg)
-    opt = optim.adamw(1e-4, weight_decay=0.01)
-    param_dt = jnp.float32 if args.param_dtype == "fp32" else jnp.bfloat16
-    policy = Policy(param_dtype=param_dt, compute_dtype=jnp.bfloat16)
-    seq = args.seq
+        raise SystemExit(2)
 
     if args.grid:
         grid = []
@@ -72,46 +111,49 @@ def main():
             (32, "selective", True), (64, "selective", True),
             (32, "full", True),
         ]
-    print(f"device={dev.device_kind} peak={peak/1e12:.0f}TF/s seq={seq} "
-          f"params={args.param_dtype}")
+    print(f"seq={args.seq} params={args.param_dtype} "
+          f"per_config_tmo={args.per_config_tmo}s")
     print(f"{'batch':>5} {'remat':>10} {'unroll':>6} {'step_ms':>8} "
           f"{'tok/s':>9} {'mfu':>6}")
     results = []
     for batch, remat, unroll in grid:
-        strategy = Strategy(remat=remat, unroll=unroll)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--one", f"{batch}:{remat}:{int(unroll)}",
+               "--steps", str(args.steps), "--warmup", str(args.warmup),
+               "--seq", str(args.seq), "--param-dtype", args.param_dtype]
         try:
-            with autocast(policy):
-                plan = make_plan(model, opt, strategy)
-                state = init_state(model, opt, plan, jax.random.key(0))
-                step = build_train_step(model, opt, plan)
-                ids = jax.random.randint(jax.random.key(1),
-                                         (batch, seq + 1), 0, cfg.vocab_size)
-                b = plan.shard_batch({"input_ids": ids[:, :-1],
-                                      "labels": ids[:, 1:]})
-                for _ in range(max(1, args.warmup)):
-                    state, m = step(state, b)
-                sync_result(m["loss"])
-                t0 = time.perf_counter()
-                for _ in range(args.steps):
-                    state, m = step(state, b)
-                sync_result(m["loss"])
-                dt = (time.perf_counter() - t0) / args.steps
-            n = sum(x.size for x in jax.tree.leaves(state.params))
-            tps = batch * seq / dt
-            mfu = model_flops_per_token(cfg, n, seq) * tps / peak
-            print(f"{batch:>5} {remat:>10} {unroll!s:>6} {dt*1e3:>8.1f} "
-                  f"{tps:>9.0f} {mfu:>6.4f}")
-            results.append((mfu, batch, remat, unroll))
-        except Exception as e:
-            msg = str(e).splitlines()[0][:80] if str(e) else type(e).__name__
-            print(f"{batch:>5} {remat:>10} {unroll!s:>6}   FAIL {msg}")
-        finally:
-            # free HBM between configs (state/step hold the arrays)
-            state = step = plan = b = None
+            r = subprocess.run(cmd, timeout=args.per_config_tmo,
+                               capture_output=True, text=True)
+            line = next((l for l in r.stdout.splitlines()
+                         if l.startswith("RESULT ")), None)
+        except subprocess.TimeoutExpired:
+            r, line = None, None
+            print(f"{batch:>5} {remat:>10} {unroll!s:>6}   TIMEOUT "
+                  f"({args.per_config_tmo}s)", flush=True)
+        if line:
+            # maxsplit: device_kind has spaces ("TPU v5 lite")
+            _, mfu, b_, r_, u_, ms, tps, kind = line.split(maxsplit=7)
+            print(f"{batch:>5} {remat:>10} {unroll!s:>6} {float(ms):>8.1f} "
+                  f"{float(tps):>9.0f} {float(mfu):>6.4f}", flush=True)
+            results.append((float(mfu), batch, remat, unroll, kind))
+        else:
+            # r is None on TIMEOUT (hang ⇒ almost certainly tunnel death)
+            if r is not None:
+                msg = (r.stderr.strip().splitlines() or ["no output"])[-1][:80]
+                print(f"{batch:>5} {remat:>10} {unroll!s:>6}   FAIL {msg}",
+                      flush=True)
+            # config died — is the tunnel still there for the next one?
+            if not probe_tpu(timeout=90):
+                print("tunnel gone — aborting sweep", flush=True)
+                if results:
+                    best = max(results)
+                    print(f"best: batch={best[1]} remat={best[2]} "
+                          f"unroll={best[3]} mfu={best[0]:.4f}")
+                raise SystemExit(2)
     if results:
         best = max(results)
         print(f"best: batch={best[1]} remat={best[2]} unroll={best[3]} "
-              f"mfu={best[0]:.4f}")
+              f"mfu={best[0]:.4f} on {best[4]}")
 
 
 if __name__ == "__main__":

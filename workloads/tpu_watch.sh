@@ -19,11 +19,20 @@ while true; do
       > workloads/out/probe.txt 2>&1; then
     echo "[watch] TPU up at $(date -Is): $(cat workloads/out/probe.txt)"
     bash workloads/tpu_window.sh
-    echo "[watch] window batch finished at $(date -Is)"
+    rc=$?
+    echo "[watch] window batch finished rc=$rc at $(date -Is)"
     date -Is >> workloads/out/windows_seen.txt
-    # a full batch just ran; back off before re-probing so a long-lived
-    # tunnel doesn't re-burn the chip in a loop
-    sleep 3600
+    if [ "$rc" -eq 0 ]; then
+      # a full batch just ran; back off before re-probing so a long-lived
+      # tunnel doesn't re-burn the chip in a loop
+      sleep 3600
+    else
+      # rc=2: the tunnel died mid-batch — return to polling so the NEXT
+      # window picks up the missing measurements, but with a minimum
+      # sleep: a half-up relay (light probe passes, batch dies early)
+      # must not re-burn the headline bench in a tight restart loop
+      sleep "$POLL"
+    fi
   else
     sleep "$POLL"
   fi

@@ -2,24 +2,49 @@
 # Run the full TPU measurement batch in priority order — the tunnel to the
 # chip has limited availability windows, so when one opens, fire this once
 # and collect everything. Outputs land in workloads/out/.
+#
+# Exit codes: 0 = batch completed; 2 = aborted early (tunnel died mid-batch;
+# the watcher goes straight back to polling instead of backing off).
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p workloads/out
+
+probe() {
+  # out-of-process: on a dead tunnel the plugin hangs in-process init
+  timeout "${1:-90}" python -c \
+    "import jax; d=jax.devices()[0]; assert d.platform=='tpu'" \
+    >/dev/null 2>&1
+}
+
 run() {
   name=$1; shift; tmo=$1; shift
+  # the round-4 window lost 22 min to one post-death hang: items after the
+  # first casualty each burned their full timeout because nothing
+  # re-checked the tunnel. Probe before EVERY item; one retry, then abort
+  # the whole batch so the watcher resumes polling for the next window.
+  if ! probe 90; then
+    echo "=== $name: probe failed, retrying in 60s ==="
+    sleep 60
+    if ! probe 90; then
+      echo "=== BATCH ABORTED before $name: tunnel down ($(date +%H:%M:%S)) ==="
+      exit 2
+    fi
+  fi
   echo "=== $name ($(date +%H:%M:%S)) ==="
   timeout "$tmo" "$@" >"workloads/out/$name.txt" 2>"workloads/out/$name.err"
   echo "rc=$? (tail)"; tail -5 "workloads/out/$name.txt"
 }
 # 0. health probe (fail fast if the tunnel is down)
-timeout 120 python -c "import jax; x=jax.numpy.ones((512,512)); print((x@x).sum(), jax.devices()[0].device_kind)" || { echo "TPU DOWN"; exit 1; }
+timeout 120 python -c "import jax; x=jax.numpy.ones((512,512)); print((x@x).sum(), jax.devices()[0].device_kind)" || { echo "TPU DOWN"; exit 2; }
 # 1. the headline bench FIRST — a short window must capture the MFU
 # number before anything else
 run bench 900 python bench.py
-# 2. the config sweep (feeds bench.py defaults for next time)
-run mfu_sweep 1500 python workloads/mfu_sweep.py
+# 2. the config sweep (feeds bench.py defaults for next time); each config
+# runs in its own subprocess with a per-config timeout. Outer timeout must
+# cover the worst case: 7 configs x (300s config + 90s re-probe) = 2730s
+run mfu_sweep 2700 python workloads/mfu_sweep.py
 # 2b. bf16-param variant on the contenders (halves param/grad traffic)
-run mfu_sweep_bf16 900 python workloads/mfu_sweep.py --param-dtype bf16 \
+run mfu_sweep_bf16 1200 python workloads/mfu_sweep.py --param-dtype bf16 \
     --grid 32:selective:1,64:selective:1,16:none:1
 # 3. flash kernel vs XLA attention
 run attn_bench 900 python workloads/attn_bench.py

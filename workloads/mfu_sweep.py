@@ -22,7 +22,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def measure_one(batch, remat, unroll, args):
+def measure_one(batch, remat, unroll, args, attn="auto"):
     """Measure a single config in THIS process; print one RESULT line."""
     import jax
     import jax.numpy as jnp
@@ -49,7 +49,7 @@ def measure_one(batch, remat, unroll, args):
     with autocast(policy):
         plan = make_plan(model, opt, strategy)
         state = init_state(model, opt, plan, jax.random.key(0))
-        step = build_train_step(model, opt, plan)
+        step = build_train_step(model, opt, plan, attn_impl=attn)
         ids = jax.random.randint(jax.random.key(1),
                                  (batch, seq + 1), 0, cfg.vocab_size)
         b = plan.shard_batch({"input_ids": ids[:, :-1],
@@ -65,7 +65,7 @@ def measure_one(batch, remat, unroll, args):
     n = sum(x.size for x in jax.tree.leaves(state.params))
     tps = batch * seq / dt
     mfu = model_flops_per_token(cfg, n, seq) * tps / peak
-    print(f"RESULT {mfu:.4f} {batch} {remat} {int(unroll)} "
+    print(f"RESULT {mfu:.4f} {batch} {remat} {int(unroll)} {attn} "
           f"{dt * 1e3:.1f} {tps:.0f} {dev.device_kind}")
 
 
@@ -79,9 +79,10 @@ def main():
                     help="bf16 halves param/grad HBM traffic (Adam "
                          "moments stay fp32)")
     ap.add_argument("--grid", default=None,
-                    help="comma list of batch:remat:unroll triples, e.g. "
-                         "32:selective:1,64:full:1 (default: built-in)")
-    ap.add_argument("--one", default=None, metavar="B:R:U",
+                    help="comma list of batch:remat:unroll[:attn] tuples, "
+                         "e.g. 32:selective:1,32:selective:1:reference "
+                         "(attn: auto|pallas|reference; default built-in)")
+    ap.add_argument("--one", default=None, metavar="B:R:U[:A]",
                     help="internal: measure a single config in-process")
     ap.add_argument("--per-config-tmo", type=int, default=300,
                     help="seconds each config subprocess may take "
@@ -89,8 +90,10 @@ def main():
     args = ap.parse_args()
 
     if args.one:
-        b, r, u = args.one.split(":")
-        measure_one(int(b), r, bool(int(u)), args)
+        parts = args.one.split(":")
+        b, r, u = parts[:3]
+        attn = parts[3] if len(parts) > 3 else "auto"
+        measure_one(int(b), r, bool(int(u)), args, attn=attn)
         return
 
     # out-of-process probe first: on a dead tunnel the axon plugin hangs
@@ -102,23 +105,32 @@ def main():
     if args.grid:
         grid = []
         for item in args.grid.split(","):
-            b, r, u = item.split(":")
-            grid.append((int(b), r, bool(int(u))))
+            parts = item.split(":")
+            b, r, u = parts[:3]
+            attn = parts[3] if len(parts) > 3 else "auto"
+            grid.append((int(b), r, bool(int(u)), attn))
     else:
         grid = [
-            (8, "none", False), (8, "none", True),
-            (16, "selective", True), (32, "selective", False),
-            (32, "selective", True), (64, "selective", True),
-            (32, "full", True),
+            (8, "none", False, "auto"), (8, "none", True, "auto"),
+            (16, "selective", True, "auto"),
+            (32, "selective", False, "auto"),
+            (32, "selective", True, "auto"),
+            (48, "selective", True, "auto"),
+            (64, "selective", True, "auto"),
+            (32, "full", True, "auto"),
+            # whole-step pallas-vs-XLA attention at the winning shape: the
+            # per-op microbench over the tunnel is swamped by RPC dispatch
+            # latency, so the decision must come from amortized step time
+            (32, "selective", True, "reference"),
         ]
     print(f"seq={args.seq} params={args.param_dtype} "
           f"per_config_tmo={args.per_config_tmo}s")
-    print(f"{'batch':>5} {'remat':>10} {'unroll':>6} {'step_ms':>8} "
-          f"{'tok/s':>9} {'mfu':>6}")
+    print(f"{'batch':>5} {'remat':>10} {'unroll':>6} {'attn':>9} "
+          f"{'step_ms':>8} {'tok/s':>9} {'mfu':>6}")
     results = []
-    for batch, remat, unroll in grid:
+    for batch, remat, unroll, attn in grid:
         cmd = [sys.executable, os.path.abspath(__file__),
-               "--one", f"{batch}:{remat}:{int(unroll)}",
+               "--one", f"{batch}:{remat}:{int(unroll)}:{attn}",
                "--steps", str(args.steps), "--warmup", str(args.warmup),
                "--seq", str(args.seq), "--param-dtype", args.param_dtype]
         try:
@@ -128,32 +140,34 @@ def main():
                          if l.startswith("RESULT ")), None)
         except subprocess.TimeoutExpired:
             r, line = None, None
-            print(f"{batch:>5} {remat:>10} {unroll!s:>6}   TIMEOUT "
-                  f"({args.per_config_tmo}s)", flush=True)
+            print(f"{batch:>5} {remat:>10} {unroll!s:>6} {attn:>9}   "
+                  f"TIMEOUT ({args.per_config_tmo}s)", flush=True)
         if line:
             # maxsplit: device_kind has spaces ("TPU v5 lite")
-            _, mfu, b_, r_, u_, ms, tps, kind = line.split(maxsplit=7)
-            print(f"{batch:>5} {remat:>10} {unroll!s:>6} {float(ms):>8.1f} "
-                  f"{float(tps):>9.0f} {float(mfu):>6.4f}", flush=True)
-            results.append((float(mfu), batch, remat, unroll, kind))
+            _, mfu, b_, r_, u_, a_, ms, tps, kind = line.split(maxsplit=8)
+            print(f"{batch:>5} {remat:>10} {unroll!s:>6} {attn:>9} "
+                  f"{float(ms):>8.1f} {float(tps):>9.0f} "
+                  f"{float(mfu):>6.4f}", flush=True)
+            results.append((float(mfu), batch, remat, unroll, attn, kind))
         else:
             # r is None on TIMEOUT (hang ⇒ almost certainly tunnel death)
             if r is not None:
                 msg = (r.stderr.strip().splitlines() or ["no output"])[-1][:80]
-                print(f"{batch:>5} {remat:>10} {unroll!s:>6}   FAIL {msg}",
-                      flush=True)
+                print(f"{batch:>5} {remat:>10} {unroll!s:>6} {attn:>9}   "
+                      f"FAIL {msg}", flush=True)
             # config died — is the tunnel still there for the next one?
             if not probe_tpu(timeout=90):
                 print("tunnel gone — aborting sweep", flush=True)
                 if results:
                     best = max(results)
                     print(f"best: batch={best[1]} remat={best[2]} "
-                          f"unroll={best[3]} mfu={best[0]:.4f}")
+                          f"unroll={best[3]} attn={best[4]} "
+                          f"mfu={best[0]:.4f}")
                 raise SystemExit(2)
     if results:
         best = max(results)
         print(f"best: batch={best[1]} remat={best[2]} unroll={best[3]} "
-              f"mfu={best[0]:.4f} on {best[4]}")
+              f"attn={best[4]} mfu={best[0]:.4f} on {best[5]}")
 
 
 if __name__ == "__main__":

@@ -41,8 +41,8 @@ timeout 120 python -c "import jax; x=jax.numpy.ones((512,512)); print((x@x).sum(
 run bench 900 python bench.py
 # 2. the config sweep (feeds bench.py defaults for next time); each config
 # runs in its own subprocess with a per-config timeout. Outer timeout must
-# cover the worst case: 7 configs x (300s config + 90s re-probe) = 2730s
-run mfu_sweep 2700 python workloads/mfu_sweep.py
+# cover the worst case: 9 configs x (300s config + 90s re-probe) = 3510s
+run mfu_sweep 3600 python workloads/mfu_sweep.py
 # 2b. bf16-param variant on the contenders (halves param/grad traffic)
 run mfu_sweep_bf16 1200 python workloads/mfu_sweep.py --param-dtype bf16 \
     --grid 32:selective:1,64:selective:1,16:none:1

@@ -64,4 +64,8 @@ run flash_tune 900 python workloads/flash_tune.py
 run profile_step 900 python workloads/profile_step.py
 # 11. top-ops table from the trace (text, commit-able)
 run xplane_summary 300 python workloads/xplane_summary.py
+# 12. re-run the headline bench — it adopts the sweep winner recorded in
+# this window (out/sweep_best.json), refreshing last_tpu_bench.json with
+# the best configuration the window found
+run bench_refresh 900 python bench.py
 echo "=== done ($(date +%H:%M:%S)) ==="

@@ -47,6 +47,37 @@ def _pick_block(n: int, target: int = 512) -> int:
     return n
 
 
+@functools.cache
+def _tuned_entries() -> tuple:
+    """Block winners measured by ``workloads/flash_tune.py`` on this
+    machine's chip; () when absent or when not running on TPU."""
+    if jax.default_backend() != "tpu":
+        return ()
+    import json
+    import os
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "..", "workloads", "out", "flash_blocks.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return tuple(tuple(sorted(e.items())) for e in data["entries"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return ()
+
+
+def _default_blocks(sq: int, sk: int, kind: str) -> tuple:
+    """Tuned (block_q, block_k) for this q/kv length if measured (exact
+    q-seq match whose blocks divide both lengths), else the static
+    heuristic. ``kind``: "fwd" | "bwd"."""
+    for items in _tuned_entries():
+        e = dict(items)
+        if e.get("seq") == sq and kind in e:
+            bq, bk = e[kind]
+            if sq % bq == 0 and sk % bk == 0:
+                return bq, bk
+    return _pick_block(sq), _pick_block(sk)
+
+
 def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -168,8 +199,11 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, *, causal, scale,
     b, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     rep = hq // hkv
-    block_q = block_q or _pick_block(sq)
-    block_k = block_k or _pick_block(sk)
+    if block_q is None and block_k is None:
+        block_q, block_k = _default_blocks(sq, sk, "fwd")
+    else:
+        block_q = block_q or _pick_block(sq)
+        block_k = block_k or _pick_block(sk)
     kv_blocks = sk // block_k
     interpret = _interpret_default() if interpret is None else interpret
 
@@ -357,8 +391,11 @@ def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
     b, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     rep = hq // hkv
-    block_q = block_q or _pick_block(sq)
-    block_k = block_k or _pick_block(sk)
+    if block_q is None and block_k is None:
+        block_q, block_k = _default_blocks(sq, sk, "bwd")
+    else:
+        block_q = block_q or _pick_block(sq)
+        block_k = block_k or _pick_block(sk)
     interpret = _interpret_default() if interpret is None else interpret
 
     qf = (q.astype(jnp.float32) * scale).astype(q.dtype)

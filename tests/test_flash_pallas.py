@@ -91,3 +91,37 @@ def test_dispatch_pallas_importable(rng):
     out = flash_attention(q, k, v, causal=True, impl="pallas")
     ref = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_tuned_block_defaults_lookup():
+    """_default_blocks consults flash_tune winners (exact q-seq match
+    whose blocks divide both lengths) and falls back to _pick_block."""
+    from hetu_tpu.ops import flash_pallas as fp
+
+    entries = (
+        tuple(sorted({"seq": 1024, "fwd": [256, 512],
+                      "bwd": [512, 256]}.items())),
+        tuple(sorted({"seq": 4096, "fwd": [512, 1024],
+                      "bwd": [1024, 512]}.items())),
+    )
+    orig = fp._tuned_entries
+    fp._tuned_entries = lambda: entries
+    try:
+        assert fp._default_blocks(1024, 1024, "fwd") == (256, 512)
+        assert fp._default_blocks(1024, 1024, "bwd") == (512, 256)
+        assert fp._default_blocks(4096, 4096, "fwd") == (512, 1024)
+        # unmeasured seq -> static heuristic
+        assert fp._default_blocks(2048, 2048, "fwd") == \
+            (fp._pick_block(2048), fp._pick_block(2048))
+        # measured q-seq but kv length the tuned block doesn't divide
+        # (ring hop with ragged kv) -> fallback
+        assert fp._default_blocks(1024, 384, "fwd") == \
+            (fp._pick_block(1024), fp._pick_block(384))
+    finally:
+        fp._tuned_entries = orig
+
+
+def test_tuned_entries_absent_on_cpu():
+    from hetu_tpu.ops import flash_pallas as fp
+    fp._tuned_entries.cache_clear()
+    assert fp._tuned_entries() == ()

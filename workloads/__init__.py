@@ -1,0 +1,1 @@
+"""Benchmark workloads (run as scripts from the repo root)."""

@@ -25,6 +25,7 @@ import numpy as np
 
 from hetu_tpu.ops.attention import attention_reference
 from hetu_tpu.ops.flash_pallas import flash_attention_pallas
+from workloads._timing import scan_loop, scan_loop_grad, time_loop_ms
 
 
 def _rand_qkv(key, b, s, hq, hkv, d, dtype=jnp.bfloat16):
@@ -41,11 +42,7 @@ def _segments(b, s, n_seg=4):
     return jnp.asarray(np.broadcast_to(ids, (b, s)), jnp.int32)
 
 
-def _time(fn, *args, iters=20, warmup=3):
-    # relay-safe host-fetch sync (block_until_ready can be lazy through
-    # the remote PJRT relay)
-    from hetu_tpu.utils.profiler import time_fn_ms
-    return time_fn_ms(fn, *args, iters=iters, warmup=warmup) / 1e3
+N_ITERS = 32
 
 
 def attn_flops(b, s, hq, d, causal):
@@ -114,27 +111,24 @@ def main():
         hq, hkv, d = 16, 16, 64
         q, k, v = _rand_qkv(key, b, s, hq, hkv, d)
 
-        pallas_fwd = jax.jit(lambda q, k, v: flash_attention_pallas(
-            q, k, v, causal=True, interpret=False))
-        xla_fwd = jax.jit(lambda q, k, v: attention_reference(
-            q, k, v, causal=True))
+        # scan-looped inside one jit: per-call dispatch over the relay
+        # costs ~ms of host time and would swamp sub-ms kernels
+        pallas_fwd = scan_loop(lambda q, k, v: flash_attention_pallas(
+            q, k, v, causal=True, interpret=False), N_ITERS)
+        xla_fwd = scan_loop(lambda q, k, v: attention_reference(
+            q, k, v, causal=True), N_ITERS)
 
-        def make_train(fn):
-            def loss(q, k, v):
-                return fn(q, k, v).astype(jnp.float32).sum()
-            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-
-        pallas_bwd = make_train(lambda q, k, v: flash_attention_pallas(
-            q, k, v, causal=True, interpret=False))
-        xla_bwd = make_train(lambda q, k, v: attention_reference(
-            q, k, v, causal=True))
+        pallas_bwd = scan_loop_grad(lambda q, k, v: flash_attention_pallas(
+            q, k, v, causal=True, interpret=False), N_ITERS)
+        xla_bwd = scan_loop_grad(lambda q, k, v: attention_reference(
+            q, k, v, causal=True), N_ITERS)
 
         flops = attn_flops(b, s, hq, d, causal=True)
         for tag, fn, mult in (("fwd", pallas_fwd, 1.0),
                               ("fwd_xla", xla_fwd, 1.0),
                               ("bwd", pallas_bwd, 3.5),
                               ("bwd_xla", xla_bwd, 3.5)):
-            dt = _time(fn, q, k, v)
+            dt = time_loop_ms(fn, (q, k, v), N_ITERS) / 1e3
             rec = {"seq": s, "batch": b, "op": tag,
                    "ms": round(dt * 1e3, 3),
                    "tflops": round(flops * mult / dt / 1e12, 2)}

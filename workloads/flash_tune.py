@@ -1,10 +1,17 @@
 """Flash-kernel block-size autotune on the real chip.
 
-Sweeps (block_q, block_k) for fwd and fwd+bwd at representative shapes
-and prints the best tiling per shape — feed the winners back as
-``flash_attention_pallas(..., block_q=, block_k=)`` defaults.
+Sweeps (block_q, block_k) for fwd and fwd+bwd at representative shapes —
+including the bench shape (batch 32, heads 12, seq 1024) — and records the
+winners to ``workloads/out/flash_blocks.json``, which
+``ops.flash_pallas`` consults for its default tiling on TPU.
 
-Usage: python workloads/flash_tune.py [--seq 2048] [--heads 16]
+Timing runs the kernel inside ONE jit via ``lax.scan`` (iterations
+chained through a negligible 1e-30 feedback term so XLA cannot hoist or
+dead-code them): over the axon relay, per-call dispatch costs ~ms of
+host time, which would otherwise swamp sub-ms kernels and make every
+block choice look identical.
+
+Usage: python workloads/flash_tune.py [--iters 32]
 """
 
 from __future__ import annotations
@@ -20,57 +27,70 @@ import jax
 import jax.numpy as jnp
 
 from hetu_tpu.ops.flash_pallas import flash_attention_pallas
-from hetu_tpu.utils.profiler import time_fn_ms
+from workloads._timing import scan_loop, scan_loop_grad, time_loop_ms
+
+OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "out", "flash_blocks.json")
+
+# (batch, seq, heads, head_dim): bench shape first, then long-context
+SHAPES = [(32, 1024, 12, 64), (4, 2048, 16, 64), (2, 4096, 16, 64),
+          (1, 8192, 16, 64)]
+
+
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--seq", type=int, default=2048)
-    ap.add_argument("--heads", type=int, default=16)
-    ap.add_argument("--head-dim", type=int, default=64)
-    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=32)
     args = ap.parse_args()
 
     if jax.devices()[0].platform != "tpu":
         print(json.dumps({"error": "autotune needs the TPU chip"}))
         return
+    kind = jax.devices()[0].device_kind
 
-    b, s, h, d = args.batch, args.seq, args.heads, args.head_dim
-    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.bfloat16)
-    k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.bfloat16)
-    v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.bfloat16)
+    entries = []
+    for b, s, h, d in SHAPES:
+        q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.bfloat16)
+        v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.bfloat16)
+        blocks = [x for x in (128, 256, 512, 1024) if s % x == 0]
+        rows = []
+        for bq in blocks:
+            for bk in blocks:
+                def f(q, k, v, bq=bq, bk=bk):
+                    return flash_attention_pallas(
+                        q, k, v, causal=True, interpret=False,
+                        block_q=bq, block_k=bk)
+                try:
+                    f_ms = time_loop_ms(scan_loop(f, args.iters),
+                                        (q, k, v), args.iters)
+                    b_ms = time_loop_ms(scan_loop_grad(f, args.iters),
+                                        (q, k, v), args.iters)
+                except Exception as e:
+                    rows.append({"bq": bq, "bk": bk, "error": str(e)[:80]})
+                    continue
+                rec = {"bq": bq, "bk": bk, "fwd_ms": round(f_ms, 3),
+                       "bwd_ms": round(b_ms, 3)}
+                rows.append(rec)
+                print(json.dumps({"shape": [b, s, h, d], **rec}), flush=True)
+        ok = [r for r in rows if "fwd_ms" in r]
+        if ok:
+            best_f = min(ok, key=lambda r: r["fwd_ms"])
+            best_b = min(ok, key=lambda r: r["bwd_ms"])
+            entries.append({"seq": s, "batch": b, "heads": h, "head_dim": d,
+                            "fwd": [best_f["bq"], best_f["bk"]],
+                            "bwd": [best_b["bq"], best_b["bk"]],
+                            "fwd_ms": best_f["fwd_ms"],
+                            "bwd_ms": best_b["bwd_ms"]})
+            print(json.dumps({"seq": s, "best_fwd": best_f,
+                              "best_bwd": best_b}), flush=True)
 
-    blocks = [x for x in (128, 256, 512, 1024) if s % x == 0]
-    results = []
-    for bq in blocks:
-        for bk in blocks:
-            fwd = jax.jit(lambda q, k, v, bq=bq, bk=bk:
-                          flash_attention_pallas(
-                              q, k, v, causal=True, interpret=False,
-                              block_q=bq, block_k=bk))
-            bwd = jax.jit(jax.grad(
-                lambda q, k, v, bq=bq, bk=bk: flash_attention_pallas(
-                    q, k, v, causal=True, interpret=False, block_q=bq,
-                    block_k=bk).astype(jnp.float32).sum(),
-                argnums=(0, 1, 2)))
-            try:
-                f_ms = time_fn_ms(fwd, q, k, v)
-                b_ms = time_fn_ms(bwd, q, k, v)
-            except Exception as e:
-                results.append({"bq": bq, "bk": bk,
-                                "error": str(e)[:80]})
-                continue
-            rec = {"bq": bq, "bk": bk, "fwd_ms": round(f_ms, 3),
-                   "bwd_ms": round(b_ms, 3)}
-            results.append(rec)
-            print(json.dumps(rec))
-
-    ok = [r for r in results if "fwd_ms" in r]
-    if ok:
-        best_f = min(ok, key=lambda r: r["fwd_ms"])
-        best_b = min(ok, key=lambda r: r["bwd_ms"])
-        print(json.dumps({"seq": s, "best_fwd": best_f,
-                          "best_bwd": best_b}))
+    if entries:
+        os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+        with open(OUT_PATH, "w") as f:
+            json.dump({"device": kind, "entries": entries}, f, indent=1)
+        print(f"wrote {OUT_PATH}")
 
 
 if __name__ == "__main__":

@@ -10,6 +10,7 @@ with ``psum`` so no rank ever materializes the full-vocab logits.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -46,6 +47,28 @@ def cross_entropy_mean(logits, labels, ignore_index: int = -100):
 CHUNK_LOGITS_BYTES = 768 * 1024 * 1024
 
 
+def _chunk_logits_bytes() -> int:
+    """Measured budget from ``workloads/ce_tune.py`` when available on
+    TPU, else the static default."""
+    return _tuned_chunk_bytes() or CHUNK_LOGITS_BYTES
+
+
+@functools.cache
+def _tuned_chunk_bytes() -> int:
+    if jax.default_backend() != "tpu":
+        return 0
+    import json
+    import os
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "..", "workloads", "out", "ce_chunk.json")
+    try:
+        with open(path) as f:
+            v = int(json.load(f)["chunk_logits_bytes"])
+        return v if v > 0 else 0
+    except (OSError, ValueError, KeyError, TypeError):
+        return 0
+
+
 def chunked_lm_loss(hidden, vocab_weight, labels, *, mm_dt=None,
                     ignore_index: int = -100,
                     chunk_tokens: Optional[int] = None):
@@ -68,7 +91,7 @@ def chunked_lm_loss(hidden, vocab_weight, labels, *, mm_dt=None,
     B, S, E = hidden.shape
     if chunk_tokens is None:
         V = vocab_weight.shape[0]
-        chunk_tokens = max(512, CHUNK_LOGITS_BYTES // (4 * V))
+        chunk_tokens = max(512, _chunk_logits_bytes() // (4 * V))
     c = max(1, min(S, chunk_tokens // max(B, 1)))
     if S % c:
         pad = c - S % c

@@ -1,0 +1,102 @@
+"""Chunked-CE budget autotune on the real chip (bench shape).
+
+The LM-loss backward re-reads and re-writes the full (V, E) fp32 dW
+accumulator once per chunk, so the per-chunk fp32-logits budget
+(``ops.losses.CHUNK_LOGITS_BYTES``) trades peak logits memory against
+accumulator traffic. This sweeps the budget at the bench shape
+(batch 32 x seq 1024, GPT-2 vocab) with scan-looped fwd+bwd timing and
+records the winner to ``workloads/out/ce_chunk.json``, which
+``ops.losses`` consults on TPU.
+
+Usage: python workloads/ce_tune.py [--iters 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.ops.losses import chunked_lm_loss
+
+OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "out", "ce_chunk.json")
+
+BUDGETS_MB = [256, 512, 768, 1024, 1536]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--vocab", type=int, default=50257)
+    ap.add_argument("--embed", type=int, default=768)
+    args = ap.parse_args()
+
+    if jax.devices()[0].platform != "tpu":
+        print(json.dumps({"error": "autotune needs the TPU chip"}))
+        return
+    kind = jax.devices()[0].device_kind
+
+    b, s, v, e = args.batch, args.seq, args.vocab, args.embed
+    hidden = jax.random.normal(jax.random.key(0), (b, s, e), jnp.bfloat16)
+    w = jax.random.normal(jax.random.key(1), (v, e), jnp.float32) * 0.02
+    labels = jax.random.randint(jax.random.key(2), (b, s), 0, v)
+
+    results = []
+    for mb in BUDGETS_MB:
+        chunk_tokens = max(512, mb * 1024 * 1024 // (4 * v))
+
+        grad_fn = jax.grad(
+            lambda h, w: chunked_lm_loss(h, w, labels, mm_dt=jnp.bfloat16,
+                                         chunk_tokens=chunk_tokens),
+            argnums=(0, 1))
+
+        def run(h, w):
+            def body(carry, _):
+                dh, dw = grad_fn(h + 1e-30 * carry, w)
+                return dh, None
+            out, _ = jax.lax.scan(body, jnp.zeros_like(h), None,
+                                  length=args.iters)
+            return out
+
+        jitted = jax.jit(run)
+        try:
+            o = jitted(hidden, w)
+            jax.block_until_ready(o)
+            t0 = time.perf_counter()
+            o = jitted(hidden, w)
+            jax.block_until_ready(o)
+            ms = (time.perf_counter() - t0) / args.iters * 1e3
+        except Exception as ex:
+            results.append({"budget_mb": mb, "error": str(ex)[:80]})
+            print(json.dumps(results[-1]), flush=True)
+            continue
+        n_chunks = -(-s // max(1, min(s, chunk_tokens // b)))
+        rec = {"budget_mb": mb, "chunk_tokens": chunk_tokens,
+               "n_chunks": n_chunks, "ms": round(ms, 3)}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    ok = [r for r in results if "ms" in r]
+    if ok:
+        best = min(ok, key=lambda r: r["ms"])
+        os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+        with open(OUT_PATH, "w") as f:
+            json.dump({"device": kind,
+                       "chunk_logits_bytes": best["budget_mb"] * 1024 * 1024,
+                       "shape": [b, s, v, e], "ms": best["ms"]}, f)
+        print(json.dumps({"best": best}))
+        print(f"wrote {OUT_PATH}")
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,8 @@ run cp_compare 900 python workloads/cp_compare.py
 run moe_bench 600 python workloads/moe_bench.py
 # 9. flash kernel block-size tuning (feeds ops/flash_pallas defaults)
 run flash_tune 900 python workloads/flash_tune.py
+# 9b. chunked-CE budget tuning (feeds ops/losses defaults)
+run ce_tune 600 python workloads/ce_tune.py
 # 10. bottleneck profile (per-module table + memory + xplane trace)
 run profile_step 900 python workloads/profile_step.py
 # 11. top-ops table from the trace (text, commit-able)

@@ -38,7 +38,11 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = GPTConfig.small() if on_tpu else GPTConfig.tiny()
-    B, S = (8, 1024) if on_tpu else (4, 64)
+    # profile at the HEADLINE bench shape (the sweep winner's batch when
+    # recorded) so the bottleneck table reflects what bench.py measures
+    from bench import load_sweep_best
+    best = load_sweep_best() if on_tpu else None
+    B, S = ((best or {}).get("batch", 32), 1024) if on_tpu else (4, 64)
     model = GPTLMHeadModel(cfg)
     pol = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16) \
         if on_tpu else Policy()
@@ -53,8 +57,11 @@ def main():
         del params
 
         opt = optim.adamw(1e-4)
-        strategy = Strategy(remat="selective", unroll=True) if on_tpu \
-            else Strategy()
+        if on_tpu:
+            strategy = Strategy(remat=(best or {}).get("remat", "selective"),
+                                unroll=(best or {}).get("unroll", True))
+        else:
+            strategy = Strategy()
         plan = make_plan(model, opt, strategy)
         state = init_state(model, opt, plan, jax.random.key(0))
         step = build_train_step(model, opt, plan)

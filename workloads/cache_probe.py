@@ -1,0 +1,67 @@
+"""Probe whether the persistent XLA compile cache works on this backend.
+
+The cache is a large win for the TPU window (relay compiles cost
+30-80 s per config and sweep configs run in fresh subprocesses), but the
+CPU backend hard-aborts deserializing cached executables (see
+tests/conftest.py), so it must be proven safe per-backend before the
+window enables it. Two subprocesses compile the same function with the
+cache enabled; success = both produce the correct value and the second
+hits the cache. Prints OK or FAIL.
+
+Usage: python workloads/cache_probe.py <cache_dir>
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+CHILD = r"""
+import os, sys, time
+import jax, jax.numpy as jnp
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the axon TPU plugin overrides the env var; pin via config
+    jax.config.update("jax_platforms", "cpu")
+t0 = time.perf_counter()
+f = jax.jit(lambda x: (x @ x + 1.7).sum())
+out = float(f(jnp.ones((256, 256), jnp.float32)))
+dt = time.perf_counter() - t0
+expect = 256 * 256 * (256.0 + 1.7)
+assert abs(out - expect) < 1e-3 * expect, out
+print(f"CHILD_OK {dt:.2f}")
+"""
+
+
+def main():
+    if len(sys.argv) != 2:
+        raise SystemExit("usage: cache_probe.py <cache_dir>")
+    cache_dir = os.path.abspath(sys.argv[1])
+    os.makedirs(cache_dir, exist_ok=True)
+    env = dict(os.environ,
+               JAX_COMPILATION_CACHE_DIR=cache_dir,
+               JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+               JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="0")
+    times = []
+    for i in range(2):
+        try:
+            r = subprocess.run([sys.executable, "-c", CHILD], env=env,
+                               capture_output=True, text=True, timeout=240)
+        except subprocess.TimeoutExpired:
+            print(f"FAIL run{i}: timeout (backend hang)")
+            return 1
+        line = next((l for l in r.stdout.splitlines()
+                     if l.startswith("CHILD_OK")), None)
+        if r.returncode != 0 or line is None:
+            tail = (r.stderr.strip().splitlines() or ["?"])[-1][:120]
+            print(f"FAIL run{i}: rc={r.returncode} {tail}")
+            return 1
+        times.append(float(line.split()[1]))
+    # don't require a speedup (tiny probe; relay variance) — correctness
+    # of the cache-hit path is what the CPU bug breaks
+    print(f"OK cold={times[0]:.2f}s warm={times[1]:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -129,6 +129,8 @@ def main():
             if best.get("param_dtype") == "bf16":
                 dtype_policy = Policy(param_dtype=jnp.bfloat16,
                                       compute_dtype=jnp.bfloat16)
+            if best.get("ce") == "fused":
+                os.environ["HETU_LM_LOSS_IMPL"] = "fused"
     else:  # CPU smoke fallback so the bench always emits a number
         cfg = GPTConfig.tiny()
         batches, seq, steps, warmup = (4,), 64, 3, 1

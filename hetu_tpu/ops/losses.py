@@ -199,8 +199,16 @@ def vocab_parallel_lm_loss(hidden, vocab_weight, labels, *,
     tp_deg = ctx.mesh.shape[ctx.tp] \
         if (ctx and isinstance(ctx.tp, str)) else 1
     if ctx is None or tp_deg <= 1 or vocab_weight.shape[0] % tp_deg != 0:
-        # big vocab: chunk so the (N, V) fp32 logits never materialize
+        # big vocab: never materialize the (N, V) fp32 logits — either
+        # the fused Pallas streaming kernel (HETU_LM_LOSS_IMPL=fused; one
+        # VMEM tile live, no chunk barrier) or XLA chunking (default)
         if vocab_weight.shape[0] >= 8192:
+            import os
+            if os.environ.get("HETU_LM_LOSS_IMPL") == "fused" \
+                    and jax.default_backend() == "tpu":
+                from hetu_tpu.ops.fused_ce_pallas import fused_lm_ce
+                return fused_lm_ce(hidden.astype(mm_dt), vocab_weight,
+                                   labels, ignore_index=ignore_index)
             return chunked_lm_loss(hidden, vocab_weight, labels,
                                    mm_dt=mm_dt, ignore_index=ignore_index)
         logits = jnp.einsum(

@@ -1,0 +1,74 @@
+"""Fused streaming LM-head+CE Pallas kernel vs the XLA oracles.
+
+Runs in interpret mode on the CPU mesh (same approach as
+test_flash_pallas.py); the real-chip timing A/B lives in
+workloads/mfu_sweep.py --ce fused.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.ops.fused_ce_pallas import fused_lm_ce
+from hetu_tpu.ops.losses import chunked_lm_loss, cross_entropy_mean
+
+
+def _data(B=2, S=128, E=64, V=1000, dtype=jnp.float32, seed=0):
+    h = jax.random.normal(jax.random.key(seed), (B, S, E), dtype)
+    w = jax.random.normal(jax.random.key(seed + 1), (V, E), jnp.float32) * 0.05
+    labels = jax.random.randint(jax.random.key(seed + 2), (B, S), 0, V)
+    return h, w, labels
+
+
+def _oracle(h, w, labels, ignore_index=-100):
+    logits = jnp.einsum("bse,ve->bsv", h.astype(jnp.float32),
+                        w.astype(jnp.float32))
+    return cross_entropy_mean(logits, labels, ignore_index)
+
+
+def test_fused_ce_matches_oracle():
+    h, w, labels = _data()
+    # V=1000 not divisible by block_v=256 -> exercises vocab padding
+    got = fused_lm_ce(h, w, labels, block_n=128, block_v=256)
+    np.testing.assert_allclose(got, _oracle(h, w, labels), rtol=2e-5)
+
+
+def test_fused_ce_ignore_index():
+    h, w, labels = _data()
+    labels = labels.at[0, :17].set(-100)
+    got = fused_lm_ce(h, w, labels, block_n=128, block_v=256)
+    np.testing.assert_allclose(got, _oracle(h, w, labels), rtol=2e-5)
+
+
+def test_fused_ce_grads_match():
+    h, w, labels = _data()
+    labels = labels.at[1, 5:9].set(-100)
+    gr = jax.grad(lambda h, w: _oracle(h, w, labels), argnums=(0, 1))(h, w)
+    gf = jax.grad(lambda h, w: fused_lm_ce(h, w, labels, block_n=128,
+                                           block_v=256),
+                  argnums=(0, 1))(h, w)
+    for a, b, name in zip(gf, gr, ("dh", "dw")):
+        np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-4, err_msg=name)
+
+
+def test_fused_ce_token_padding():
+    """N not divisible by block_n -> token padding must not leak into
+    the mean or the grads."""
+    h, w, labels = _data(B=1, S=100, E=64, V=512)
+    got = fused_lm_ce(h, w, labels, block_n=128, block_v=256)
+    np.testing.assert_allclose(got, _oracle(h, w, labels), rtol=2e-5)
+    gf = jax.grad(lambda h: fused_lm_ce(h, w, labels, block_n=128,
+                                        block_v=256))(h)
+    gr = jax.grad(lambda h: _oracle(h, w, labels))(h)
+    np.testing.assert_allclose(gf, gr, atol=3e-5, rtol=3e-4)
+
+
+def test_fused_ce_bf16_hidden_matches_chunked():
+    """bf16 hidden (the autocast layout): parity with chunked_lm_loss at
+    the same matmul dtype."""
+    h, w, labels = _data(dtype=jnp.bfloat16)
+    got = fused_lm_ce(h, w, labels, block_n=128, block_v=256)
+    ref = chunked_lm_loss(h, w, labels, mm_dt=jnp.bfloat16,
+                          chunk_tokens=128)
+    np.testing.assert_allclose(got, ref, rtol=3e-3)

@@ -24,6 +24,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def measure_one(batch, remat, unroll, args, attn="auto"):
     """Measure a single config in THIS process; print one RESULT line."""
+    if args.ce == "fused":
+        os.environ["HETU_LM_LOSS_IMPL"] = "fused"
     import jax
     import jax.numpy as jnp
 
@@ -78,6 +80,9 @@ def main():
                     default="fp32",
                     help="bf16 halves param/grad HBM traffic (Adam "
                          "moments stay fp32)")
+    ap.add_argument("--ce", choices=("chunked", "fused"), default="chunked",
+                    help="LM-loss impl: XLA chunking or the fused "
+                         "streaming Pallas kernel (ops/fused_ce_pallas)")
     ap.add_argument("--grid", default=None,
                     help="comma list of batch:remat:unroll[:attn] tuples, "
                          "e.g. 32:selective:1,32:selective:1:reference "
@@ -132,7 +137,8 @@ def main():
         cmd = [sys.executable, os.path.abspath(__file__),
                "--one", f"{batch}:{remat}:{int(unroll)}:{attn}",
                "--steps", str(args.steps), "--warmup", str(args.warmup),
-               "--seq", str(args.seq), "--param-dtype", args.param_dtype]
+               "--seq", str(args.seq), "--param-dtype", args.param_dtype,
+               "--ce", args.ce]
         try:
             r = subprocess.run(cmd, timeout=args.per_config_tmo,
                                capture_output=True, text=True)
@@ -168,10 +174,10 @@ def main():
         best = max(results)
         print(f"best: batch={best[1]} remat={best[2]} unroll={best[3]} "
               f"attn={best[4]} mfu={best[0]:.4f} on {best[5]}")
-        _record_best(best, args.param_dtype)
+        _record_best(best, args.param_dtype, args.ce)
 
 
-def _record_best(best, param_dtype):
+def _record_best(best, param_dtype, ce_impl="chunked"):
     """Persist the sweep winner for bench.py to adopt (max-mfu wins
     across sweep variants — the bf16 sweep only overwrites the fp32
     entry when it actually measured higher)."""
@@ -181,7 +187,7 @@ def _record_best(best, param_dtype):
     mfu, batch, remat, unroll, attn, kind = best
     entry = {"mfu": mfu, "batch": batch, "remat": remat,
              "unroll": bool(unroll), "attn": attn,
-             "param_dtype": param_dtype, "device": kind,
+             "param_dtype": param_dtype, "ce": ce_impl, "device": kind,
              "seq": 1024}
     try:
         with open(path) as f:

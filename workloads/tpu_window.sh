@@ -61,6 +61,10 @@ run mfu_sweep 3600 python workloads/mfu_sweep.py
 # 3b. bf16-param variant on the contenders (halves param/grad traffic)
 run mfu_sweep_bf16 1200 python workloads/mfu_sweep.py --param-dtype bf16 \
     --grid 32:selective:1,64:selective:1,16:none:1
+# 3c. fused streaming CE kernel (no logits materialization, no chunk
+# barrier) at the contender shapes
+run mfu_sweep_fusedce 1200 python workloads/mfu_sweep.py --ce fused \
+    --grid 32:selective:1,64:selective:1
 # 4. flash kernel block-size tuning (feeds ops/flash_pallas defaults)
 run flash_tune 900 python workloads/flash_tune.py
 # 5. chunked-CE budget tuning (feeds ops/losses defaults)

@@ -52,7 +52,7 @@ def _col_ids(iv, block_n, block_v):
 # Forward: per-token (lse, target-logit) streamed over vocab blocks
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref,
+def _fwd_kernel(h_ref, w_ref, lab_ref, tgt_ref, lse_ref,
                 m_scr, l_scr, t_scr, *, block_n, block_v, v_blocks, vocab):
     iv = pl.program_id(1)
 
@@ -87,7 +87,7 @@ def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref,
     def _finalize():
         lse = m_scr[:, :1] + jnp.log(l_scr[:, :1])
         lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
-        loss_ref[...] = jnp.broadcast_to(lse - t_scr[:, :1], loss_ref.shape)
+        tgt_ref[...] = jnp.broadcast_to(t_scr[:, :1], tgt_ref.shape)
 
 
 # --------------------------------------------------------------------------
@@ -95,20 +95,24 @@ def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref,
 # blocks per vocab block (same two-kernel split as the flash backward)
 # --------------------------------------------------------------------------
 
-def _p_tile(h, w, lab, lse, g, iv, *, block_n, block_v, vocab):
-    """g * (softmax - onehot) for one tile, fp32 (block_n, block_v)."""
+def _p_tile(h, w, lab, lse, glse, gtgt, iv, *, block_n, block_v, vocab):
+    """dlogits tile ``glse * exp(s - lse) + gtgt * onehot``, fp32.
+
+    ``glse``/``gtgt`` are the cotangents of this shard's (lse, tgt) —
+    the dense loss ``lse - tgt`` gives (g, -g); the vocab-parallel
+    psum-combine gives (g * exp(lse_local - lse_global), -g), and the
+    chain rule through both lands on g * (softmax - onehot)."""
     s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     cols = _col_ids(iv, block_n, block_v)
     p = jnp.exp(s - lse)                            # padded cols: exp(-inf)=0
     if vocab % block_v:
         p = jnp.where(cols < vocab, p, 0.0)
-    p = p - jnp.where(cols == lab, 1.0, 0.0)
-    return p * g
+    return glse * p + gtgt * jnp.where(cols == lab, 1.0, 0.0)
 
 
-def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, acc_scr, *,
-               block_n, block_v, v_blocks, vocab):
+def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, glse_ref, gtgt_ref,
+               dh_ref, acc_scr, *, block_n, block_v, v_blocks, vocab):
     iv = pl.program_id(1)
 
     @pl.when(iv == 0)
@@ -117,7 +121,8 @@ def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, acc_scr, *,
 
     h = h_ref[...]
     w = w_ref[...].astype(h.dtype)
-    p = _p_tile(h, w, lab_ref[:, :1], lse_ref[:, :1], g_ref[:, :1], iv,
+    p = _p_tile(h, w, lab_ref[:, :1], lse_ref[:, :1], glse_ref[:, :1],
+                gtgt_ref[:, :1], iv,
                 block_n=block_n, block_v=block_v, vocab=vocab)
     acc_scr[...] += jax.lax.dot_general(
         p.astype(h.dtype), w, (((1,), (0,)), ((), ())),
@@ -128,8 +133,8 @@ def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, acc_scr, *,
         dh_ref[...] = acc_scr[...].astype(dh_ref.dtype)
 
 
-def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, acc_scr, *,
-               block_n, block_v, n_blocks, vocab):
+def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, glse_ref, gtgt_ref,
+               dw_ref, acc_scr, *, block_n, block_v, n_blocks, vocab):
     iv = pl.program_id(0)
     i_n = pl.program_id(1)
 
@@ -139,7 +144,8 @@ def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, acc_scr, *,
 
     h = h_ref[...]
     w = w_ref[...].astype(h.dtype)
-    p = _p_tile(h, w, lab_ref[:, :1], lse_ref[:, :1], g_ref[:, :1], iv,
+    p = _p_tile(h, w, lab_ref[:, :1], lse_ref[:, :1], glse_ref[:, :1],
+                gtgt_ref[:, :1], iv,
                 block_n=block_n, block_v=block_v, vocab=vocab)
     # (block_v, E) += p^T @ h
     acc_scr[...] += jax.lax.dot_general(
@@ -163,9 +169,15 @@ def _pick_block_n(n: int) -> int:
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _fused_core(h, w, labels, block_n, block_v, interpret):
-    loss, _ = _fused_fwd_impl(h, w, labels, block_n, block_v, interpret)
-    return loss
+def fused_lse_tgt(h, w, labels, block_n, block_v, interpret):
+    """Per-token ``(lse, target_logit)`` over THIS weight shard,
+    streamed — the differentiable primitive. ``labels`` are local vocab
+    ids; out-of-shard tokens should carry an impossible id (e.g. -1),
+    contributing 0 to ``tgt``. Composes with a psum logsumexp combine
+    for the vocab-parallel path (the custom VJP takes general (glse,
+    gtgt) cotangents, so AD through the combine lands on
+    ``g * (softmax - onehot)`` per shard)."""
+    return _fused_fwd_impl(h, w, labels, block_n, block_v, interpret)
 
 
 def _fused_fwd_impl(h, w, labels, block_n, block_v, interpret):
@@ -178,7 +190,7 @@ def _fused_fwd_impl(h, w, labels, block_n, block_v, interpret):
     lab_l = _expand_lanes(labels.astype(jnp.int32))
 
     grid = (n_blocks, v_blocks)
-    loss_l, lse_l = pl.pallas_call(
+    tgt_l, lse_l = pl.pallas_call(
         functools.partial(_fwd_kernel, block_n=block_n, block_v=block_v,
                           v_blocks=v_blocks, vocab=vocab),
         grid=grid,
@@ -202,16 +214,17 @@ def _fused_fwd_impl(h, w, labels, block_n, block_v, interpret):
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(h, wp, lab_l)
-    return loss_l[:, 0], lse_l[:, 0]
+    return lse_l[:, 0], tgt_l[:, 0]
 
 
 def _fused_core_fwd(h, w, labels, block_n, block_v, interpret):
-    loss, lse = _fused_fwd_impl(h, w, labels, block_n, block_v, interpret)
-    return loss, (h, w, labels, lse)
+    lse, tgt = _fused_fwd_impl(h, w, labels, block_n, block_v, interpret)
+    return (lse, tgt), (h, w, labels, lse)
 
 
-def _fused_core_bwd(block_n, block_v, interpret, res, g):
+def _fused_core_bwd(block_n, block_v, interpret, res, cots):
     h, w, labels, lse = res
+    glse, gtgt = cots
     n, e = h.shape
     vocab = w.shape[0]
     v_pad = -vocab % block_v
@@ -220,7 +233,9 @@ def _fused_core_bwd(block_n, block_v, interpret, res, g):
     n_blocks = n // block_n
     lab_l = _expand_lanes(labels.astype(jnp.int32))
     lse_l = _expand_lanes(lse)
-    g_l = _expand_lanes(g.astype(jnp.float32))
+    glse_l = _expand_lanes(glse.astype(jnp.float32))
+    gtgt_l = _expand_lanes(gtgt.astype(jnp.float32))
+    lane_spec = pl.BlockSpec((block_n, NUM_LANES), lambda i, j: (i, 0))
 
     dh = pl.pallas_call(
         functools.partial(_dh_kernel, block_n=block_n, block_v=block_v,
@@ -229,9 +244,7 @@ def _fused_core_bwd(block_n, block_v, interpret, res, g):
         in_specs=[
             pl.BlockSpec((block_n, e), lambda i, j: (i, 0)),
             pl.BlockSpec((block_v, e), lambda i, j: (j, 0)),
-            pl.BlockSpec((block_n, NUM_LANES), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_n, NUM_LANES), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_n, NUM_LANES), lambda i, j: (i, 0)),
+            lane_spec, lane_spec, lane_spec, lane_spec,
         ],
         out_specs=pl.BlockSpec((block_n, e), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, e), h.dtype),
@@ -239,8 +252,9 @@ def _fused_core_bwd(block_n, block_v, interpret, res, g):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(h, wp, lab_l, lse_l, g_l)
+    )(h, wp, lab_l, lse_l, glse_l, gtgt_l)
 
+    lane_spec_vn = pl.BlockSpec((block_n, NUM_LANES), lambda j, i: (i, 0))
     dwp = pl.pallas_call(
         functools.partial(_dw_kernel, block_n=block_n, block_v=block_v,
                           n_blocks=n_blocks, vocab=vocab),
@@ -248,9 +262,7 @@ def _fused_core_bwd(block_n, block_v, interpret, res, g):
         in_specs=[
             pl.BlockSpec((block_n, e), lambda j, i: (i, 0)),
             pl.BlockSpec((block_v, e), lambda j, i: (j, 0)),
-            pl.BlockSpec((block_n, NUM_LANES), lambda j, i: (i, 0)),
-            pl.BlockSpec((block_n, NUM_LANES), lambda j, i: (i, 0)),
-            pl.BlockSpec((block_n, NUM_LANES), lambda j, i: (i, 0)),
+            lane_spec_vn, lane_spec_vn, lane_spec_vn, lane_spec_vn,
         ],
         out_specs=pl.BlockSpec((block_v, e), lambda j, i: (j, 0)),
         out_shape=jax.ShapeDtypeStruct((vocab + v_pad, e), w.dtype),
@@ -258,12 +270,12 @@ def _fused_core_bwd(block_n, block_v, interpret, res, g):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(h, wp, lab_l, lse_l, g_l)
+    )(h, wp, lab_l, lse_l, glse_l, gtgt_l)
     dw = dwp[:vocab] if v_pad else dwp
     return dh, dw, None
 
 
-_fused_core.defvjp(_fused_core_fwd, _fused_core_bwd)
+fused_lse_tgt.defvjp(_fused_core_fwd, _fused_core_bwd)
 
 
 def fused_lm_ce(hidden, vocab_weight, labels, *,
@@ -292,6 +304,49 @@ def fused_lm_ce(hidden, vocab_weight, labels, *,
         safe = jnp.pad(safe, (0, pad))
         valid = jnp.pad(valid, (0, pad))
 
-    loss_tok = _fused_core(h, vocab_weight, safe, bn, block_v, interpret)
-    loss_tok = jnp.where(valid, loss_tok, 0.0)
+    lse, tgt = fused_lse_tgt(h, vocab_weight, safe, bn, block_v, interpret)
+    loss_tok = jnp.where(valid, lse - tgt, 0.0)
     return loss_tok.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def fused_vocab_parallel_ce(h, w_local, labels, *, axis_name: str,
+                            vocab_start, ignore_index: int = -100,
+                            block_n: int | None = None, block_v: int = 512,
+                            interpret: bool | None = None):
+    """Per-token CE with the vocab sharded over ``axis_name`` — the fused
+    analogue of :func:`hetu_tpu.ops.losses.vocab_parallel_cross_entropy`.
+    Must be called inside ``shard_map``. ``h``: (N, E) local tokens;
+    ``w_local``: (V_local, E); ``labels``: (N,) GLOBAL vocab ids.
+
+    Streams this shard's vocab through :func:`fused_lse_tgt`, then
+    combines across shards with a psum logsumexp — AD through the
+    combine delivers the correct per-shard (glse, gtgt) cotangents.
+    Returns (per-token loss with ignored zeroed, valid mask).
+    """
+    n, _ = h.shape
+    v_local = w_local.shape[0]
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    local_ids = safe - vocab_start
+    in_shard = (local_ids >= 0) & (local_ids < v_local)
+    # out-of-shard tokens carry an impossible id -> tgt contribution 0
+    local_lab = jnp.where(in_shard, local_ids, -1).astype(jnp.int32)
+    interpret = _interpret_default() if interpret is None else interpret
+
+    bn = block_n or _pick_block_n(n)
+    pad = -n % bn
+    if pad:
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+        local_lab = jnp.pad(local_lab, (0, pad), constant_values=-1)
+
+    lse_loc, tgt_loc = fused_lse_tgt(h, w_local, local_lab, bn, block_v,
+                                     interpret)
+    if pad:
+        lse_loc, tgt_loc = lse_loc[:n], tgt_loc[:n]
+
+    # global logsumexp across shards (max-shift for stability; the shift
+    # cancels in value and gradient, so stop_gradient keeps AD simple)
+    gmax = jax.lax.pmax(jax.lax.stop_gradient(lse_loc), axis_name)
+    lse = jnp.log(jax.lax.psum(jnp.exp(lse_loc - gmax), axis_name)) + gmax
+    tgt = jax.lax.psum(tgt_loc, axis_name)
+    return (lse - tgt) * valid, valid

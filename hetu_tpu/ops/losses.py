@@ -219,6 +219,9 @@ def vocab_parallel_lm_loss(hidden, vocab_weight, labels, *,
 
     tp = ctx.tp
     v_local = vocab_weight.shape[0] // tp_deg
+    import os
+    use_fused = os.environ.get("HETU_LM_LOSS_IMPL") == "fused" \
+        and jax.default_backend() == "tpu"
 
     @functools.partial(
         shard_map, mesh=ctx.mesh,
@@ -229,10 +232,18 @@ def vocab_parallel_lm_loss(hidden, vocab_weight, labels, *,
                    jax.sharding.PartitionSpec(ctx.batch, ctx.seq)),
         check_vma=False)
     def head(h, w, y):
+        vocab_start = jax.lax.axis_index(tp) * v_local
+        if use_fused:
+            from hetu_tpu.ops.fused_ce_pallas import fused_vocab_parallel_ce
+            b, s, e = h.shape
+            loss, valid = fused_vocab_parallel_ce(
+                h.reshape(b * s, e).astype(mm_dt), w,
+                y.reshape(b * s), axis_name=tp, vocab_start=vocab_start,
+                ignore_index=ignore_index)
+            return loss.reshape(b, s), valid.reshape(b, s)
         local_logits = jnp.einsum(
             "bse,ve->bsv", h.astype(mm_dt), w.astype(mm_dt),
             preferred_element_type=jnp.float32)
-        vocab_start = jax.lax.axis_index(tp) * v_local
         return vocab_parallel_cross_entropy(
             local_logits, y, axis_name=tp, vocab_start=vocab_start,
             ignore_index=ignore_index)

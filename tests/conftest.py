@@ -46,6 +46,7 @@ SLOW_TESTS = {
     "test_fused_ce_matches_oracle",
     "test_fused_ce_grads_match",
     "test_fused_ce_bf16_hidden_matches_chunked",
+    "test_fused_vocab_parallel_matches_dense",
     # trainer / hot switch
     "test_hot_switch_loss_curve_identical",
     "test_trainer_switch_to_pipeline",

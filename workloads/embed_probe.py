@@ -1,0 +1,72 @@
+"""Embedding gather vs one-hot-matmul fwd+bwd probe at the bench shape.
+
+The embedding backward is a scatter-add of N token-rows into the (V, E)
+table; XLA:TPU's scatter lowering is the wildcard — if it serializes,
+the one-hot matmul formulation (2·N·V·E extra FLOPs but pure MXU) wins.
+This measures both, scan-looped (relay-safe), so ``nn.layers.Embedding``
+can pick the right backward for TPU.
+
+Usage: python workloads/embed_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    if jax.devices()[0].platform != "tpu":
+        print(json.dumps({"error": "probe needs the TPU chip"}))
+        return
+
+    N, V, E = 32 * 1024, 50257, 768
+    ids = jax.random.randint(jax.random.key(0), (N,), 0, V)
+    w = jax.random.normal(jax.random.key(1), (V, E), jnp.float32) * 0.02
+    g = jax.random.normal(jax.random.key(2), (N, E), jnp.bfloat16)
+
+    def gather_loss(w):
+        h = jnp.take(w, ids, axis=0).astype(jnp.bfloat16)
+        return (h * g).astype(jnp.float32).sum()
+
+    def onehot_loss(w):
+        # bf16 one-hot matmul: fwd = onehot @ w; bwd dW = onehot^T @ g
+        oh = jax.nn.one_hot(ids, V, dtype=jnp.bfloat16)
+        h = oh @ w.astype(jnp.bfloat16)
+        return (h * g).astype(jnp.float32).sum()
+
+    iters = 16
+    for name, loss in (("gather", gather_loss), ("onehot", onehot_loss)):
+        grad = jax.grad(loss)
+
+        def run(w):
+            def body(carry, _):
+                return grad(w + 1e-30 * carry), None
+            out, _ = jax.lax.scan(body, jnp.zeros_like(w), None,
+                                  length=iters)
+            return out
+
+        try:
+            jitted = jax.jit(run)
+            o = jitted(w)
+            jax.block_until_ready(o)
+            t0 = time.perf_counter()
+            o = jitted(w)
+            jax.block_until_ready(o)
+            ms = (time.perf_counter() - t0) / iters * 1e3
+            print(json.dumps({"impl": name, "fwd_bwd_ms": round(ms, 3)}),
+                  flush=True)
+        except Exception as e:
+            print(json.dumps({"impl": name, "error": str(e)[:100]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

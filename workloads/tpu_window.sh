@@ -76,6 +76,8 @@ run bench_refresh 900 python bench.py
 # 7. bottleneck profile (per-module table + memory + xplane trace) —
 # this guides the NEXT round of optimization work
 run profile_step 900 python workloads/profile_step.py
+# 7b. embedding gather-vs-onehot backward probe (scatter lowering check)
+run embed_probe 600 python workloads/embed_probe.py
 run xplane_summary 300 python workloads/xplane_summary.py
 # 8. cost-model calibration against real step times (VERDICT item 4)
 run calibrate 1500 python workloads/calibrate_run.py

@@ -28,6 +28,22 @@ _SWEEP_BEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "workloads", "out", "sweep_best.json")
 
 
+def is_oom(e) -> bool:
+    """Out-of-memory heuristic shared by the OOM-fallback batch chains
+    (bench.py, workloads/profile_step.py)."""
+    s = f"{type(e).__name__}: {e}"
+    return any(t in s for t in (
+        "RESOURCE_EXHAUSTED", "Out of memory", "OOM",
+        "Attempting to allocate", "exceeds the limit",
+        # the axon compile relay reports HBM-exhausted compiles as an
+        # opaque INTERNAL/HTTP-500 ("tpu_compile_helper subprocess
+        # exit code 1") — the real "Ran out of memory in memory space
+        # hbm" text only reaches the helper's log. Retrying a smaller
+        # batch is correct for OOM and harmless for a genuine compile
+        # bug (every batch fails → the last error still surfaces).
+        "tpu_compile_helper", "remote_compile"))
+
+
 def load_sweep_best():
     """Sweep winner {batch, remat, unroll, attn, param_dtype} measured on
     a TPU, or None. Ignored unless it was measured on TPU hardware."""
@@ -169,19 +185,6 @@ def main():
 
     # largest batch that fits wins (chunked CE keeps logits memory flat,
     # so batch is bounded by activations; OOM → halve and retry)
-    def is_oom(e) -> bool:
-        s = f"{type(e).__name__}: {e}"
-        return any(t in s for t in (
-            "RESOURCE_EXHAUSTED", "Out of memory", "OOM",
-            "Attempting to allocate", "exceeds the limit",
-            # the axon compile relay reports HBM-exhausted compiles as an
-            # opaque INTERNAL/HTTP-500 ("tpu_compile_helper subprocess
-            # exit code 1") — the real "Ran out of memory in memory space
-            # hbm" text only reaches the helper's log. Retrying a smaller
-            # batch is correct for OOM and harmless for a genuine compile
-            # bug (every batch fails → the last error still surfaces).
-            "tpu_compile_helper", "remote_compile"))
-
     dt = n_params = batch = None
     last_err = None
     for b in batches:

@@ -29,12 +29,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from hetu_tpu.ops.flash_pallas import _interpret_default, _pick_block
+
 NEG_INF = -1e30
 NUM_LANES = 128
-
-
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _expand_lanes(x: jnp.ndarray) -> jnp.ndarray:
@@ -161,11 +159,7 @@ def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, glse_ref, gtgt_ref,
 # custom_vjp wrapper
 # --------------------------------------------------------------------------
 
-def _pick_block_n(n: int) -> int:
-    for b in (512, 256, 128):
-        if n % b == 0:
-            return b
-    return n
+
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -297,7 +291,7 @@ def fused_lm_ce(hidden, vocab_weight, labels, *,
     safe = jnp.where(valid, labels, 0).astype(jnp.int32)
     interpret = _interpret_default() if interpret is None else interpret
 
-    bn = block_n or _pick_block_n(n)
+    bn = block_n or _pick_block(n)
     pad = -n % bn
     if pad:
         h = jnp.pad(h, ((0, pad), (0, 0)))
@@ -333,7 +327,7 @@ def fused_vocab_parallel_ce(h, w_local, labels, *, axis_name: str,
     local_lab = jnp.where(in_shard, local_ids, -1).astype(jnp.int32)
     interpret = _interpret_default() if interpret is None else interpret
 
-    bn = block_n or _pick_block_n(n)
+    bn = block_n or _pick_block(n)
     pad = -n % bn
     if pad:
         h = jnp.pad(h, ((0, pad), (0, 0)))

@@ -44,6 +44,7 @@ SLOW_TESTS = {
     # fused CE kernel (interpret-mode pallas is slow on CPU)
     "test_fused_ce_token_padding",
     "test_fused_ce_matches_oracle",
+    "test_fused_ce_ignore_index",
     "test_fused_ce_grads_match",
     "test_fused_ce_bf16_hidden_matches_chunked",
     "test_fused_vocab_parallel_matches_dense",

@@ -17,19 +17,37 @@ import os
 import subprocess
 import sys
 
+# A representative program, not a toy: the documented CPU abort is
+# program-dependent (one specific cached executable dies while others
+# load fine — tests/conftest.py), so the probe compiles a small but
+# real train step (scan over blocks, custom_vjp flash path skipped on
+# purpose: keep runtime ~seconds) and checks the loss value both runs.
 CHILD = r"""
 import os, sys, time
 import jax, jax.numpy as jnp
 if os.environ.get("JAX_PLATFORMS") == "cpu":
     # the axon TPU plugin overrides the env var; pin via config
     jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["HETU_REPO_ROOT"])
+from hetu_tpu import optim
+from hetu_tpu.engine import make_plan, init_state, build_train_step
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.parallel.strategy import Strategy
+
 t0 = time.perf_counter()
-f = jax.jit(lambda x: (x @ x + 1.7).sum())
-out = float(f(jnp.ones((256, 256), jnp.float32)))
+cfg = GPTConfig.tiny()
+model = GPTLMHeadModel(cfg)
+opt = optim.adamw(1e-3)
+plan = make_plan(model, opt, Strategy())
+state = init_state(model, opt, plan, jax.random.key(0))
+step = build_train_step(model, opt, plan)
+ids = jax.random.randint(jax.random.key(1), (2, 33), 0, cfg.vocab_size)
+b = plan.shard_batch({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+state, m = step(state, b)
+loss = float(jax.device_get(m["loss"]))
 dt = time.perf_counter() - t0
-expect = 256 * 256 * (256.0 + 1.7)
-assert abs(out - expect) < 1e-3 * expect, out
-print(f"CHILD_OK {dt:.2f}")
+assert loss == loss and 0.0 < loss < 20.0, loss
+print(f"CHILD_OK {dt:.2f} {loss:.6f}")
 """
 
 
@@ -38,11 +56,16 @@ def main():
         raise SystemExit("usage: cache_probe.py <cache_dir>")
     cache_dir = os.path.abspath(sys.argv[1])
     os.makedirs(cache_dir, exist_ok=True)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # MIN_COMPILE_TIME=0 forces the probe program INTO the cache; the
+    # window then runs with its own threshold — entries written there
+    # still exercise the identical serialize/deserialize path
     env = dict(os.environ,
+               HETU_REPO_ROOT=repo_root,
                JAX_COMPILATION_CACHE_DIR=cache_dir,
                JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
                JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="0")
-    times = []
+    times, losses = [], []
     for i in range(2):
         try:
             r = subprocess.run([sys.executable, "-c", CHILD], env=env,
@@ -56,10 +79,16 @@ def main():
             tail = (r.stderr.strip().splitlines() or ["?"])[-1][:120]
             print(f"FAIL run{i}: rc={r.returncode} {tail}")
             return 1
-        times.append(float(line.split()[1]))
-    # don't require a speedup (tiny probe; relay variance) — correctness
-    # of the cache-hit path is what the CPU bug breaks
-    print(f"OK cold={times[0]:.2f}s warm={times[1]:.2f}s")
+        _, dt, loss = line.split()
+        times.append(float(dt))
+        losses.append(float(loss))
+    if losses[0] != losses[1]:
+        print(f"FAIL: cached executable changed the result "
+              f"({losses[0]} vs {losses[1]})")
+        return 1
+    # don't require a speedup (relay variance) — correctness of the
+    # cache-hit path is what the known CPU bug breaks
+    print(f"OK cold={times[0]:.2f}s warm={times[1]:.2f}s loss={losses[0]}")
     return 0
 
 

@@ -38,51 +38,73 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = GPTConfig.small() if on_tpu else GPTConfig.tiny()
-    # profile at the HEADLINE bench shape (the sweep winner's batch when
-    # recorded) so the bottleneck table reflects what bench.py measures
+    # profile the FULL headline bench config (sweep winner when recorded:
+    # batch, param dtype, CE impl) so the bottleneck table reflects what
+    # bench.py measures
     from bench import load_sweep_best
     best = load_sweep_best() if on_tpu else None
     B, S = ((best or {}).get("batch", 32), 1024) if on_tpu else (4, 64)
     model = GPTLMHeadModel(cfg)
-    pol = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16) \
-        if on_tpu else Policy()
+    if on_tpu:
+        param_dt = jnp.bfloat16 \
+            if (best or {}).get("param_dtype") == "bf16" else jnp.float32
+        pol = Policy(param_dtype=param_dt, compute_dtype=jnp.bfloat16)
+        if (best or {}).get("ce") == "fused":
+            os.environ["HETU_LM_LOSS_IMPL"] = "fused"
+    else:
+        pol = Policy()
 
-    with autocast(pol):
-        params = model.init(jax.random.key(0))
-        ids = jax.random.randint(jax.random.key(1), (B, S), 0,
-                                 cfg.vocab_size)
-        batch = {"input_ids": ids, "labels": ids}
-        print("== per-module fwd/bwd (ms) ==")
-        print(format_module_table(profile_modules(model, params, batch)))
-        del params
+    def run(B):
+        with autocast(pol):
+            params = model.init(jax.random.key(0))
+            ids = jax.random.randint(jax.random.key(1), (B, S), 0,
+                                     cfg.vocab_size)
+            batch = {"input_ids": ids, "labels": ids}
+            print(f"== per-module fwd/bwd (ms), batch {B} ==")
+            print(format_module_table(profile_modules(model, params, batch)))
+            del params
 
-        opt = optim.adamw(1e-4)
-        if on_tpu:
-            strategy = Strategy(remat=(best or {}).get("remat", "selective"),
-                                unroll=(best or {}).get("unroll", True))
-        else:
-            strategy = Strategy()
-        plan = make_plan(model, opt, strategy)
-        state = init_state(model, opt, plan, jax.random.key(0))
-        step = build_train_step(model, opt, plan)
-        sbatch = plan.shard_batch(batch)
-        state, m = step(state, sbatch)          # compile
-        float(jax.device_get(m["loss"]))
-
-        print("\n== device memory ==")
-        for k, v in device_memory_stats().items():
-            print(f"  {k}: {v}")
-        print("\n== state/batch bytes ==")
-        for k, v in memory_breakdown(state, batch=sbatch).items():
-            print(f"  {k}: {v / 1e6:.1f} MB")
-
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "out", "xplane")
-        with xla_trace(out):
-            for _ in range(5):
-                state, m = step(state, sbatch)
+            opt = optim.adamw(1e-4)
+            if on_tpu:
+                strategy = Strategy(
+                    remat=(best or {}).get("remat", "selective"),
+                    unroll=(best or {}).get("unroll", True))
+            else:
+                strategy = Strategy()
+            plan = make_plan(model, opt, strategy)
+            state = init_state(model, opt, plan, jax.random.key(0))
+            step = build_train_step(model, opt, plan)
+            sbatch = plan.shard_batch(batch)
+            state, m = step(state, sbatch)          # compile
             float(jax.device_get(m["loss"]))
-        print(f"\nxplane trace written under {out}")
+
+            print("\n== device memory ==")
+            for k, v in device_memory_stats().items():
+                print(f"  {k}: {v}")
+            print("\n== state/batch bytes ==")
+            for k, v in memory_breakdown(state, batch=sbatch).items():
+                print(f"  {k}: {v / 1e6:.1f} MB")
+
+            out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "out", "xplane")
+            with xla_trace(out):
+                for _ in range(5):
+                    state, m = step(state, sbatch)
+                float(jax.device_get(m["loss"]))
+            print(f"\nxplane trace written under {out}")
+
+    # OOM fallback chain like bench.py's: the sweep winner's batch is
+    # known to fit a train step, but profiling holds extra buffers
+    from bench import is_oom
+    while True:
+        try:
+            run(B)
+            break
+        except Exception as e:
+            if B <= 4 or not is_oom(e):
+                raise
+            print(f"batch {B} OOM during profiling — retrying at {B // 2}")
+            B //= 2
 
 
 if __name__ == "__main__":

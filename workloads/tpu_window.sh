@@ -71,8 +71,10 @@ run flash_tune 900 python workloads/flash_tune.py
 run ce_tune 600 python workloads/ce_tune.py
 # 6. re-run the headline bench: it adopts the sweep winner
 # (out/sweep_best.json) plus the tuned flash/CE defaults, refreshing
-# last_tpu_bench.json with the best configuration the window found
-run bench_refresh 900 python bench.py
+# last_tpu_bench.json with the best configuration the window found.
+# Cache-free: the headline must not be lost to a program-dependent
+# cache-deserialize abort (the probe only proves one program's path)
+run bench_refresh 900 env -u JAX_COMPILATION_CACHE_DIR python bench.py
 # 7. bottleneck profile (per-module table + memory + xplane trace) —
 # this guides the NEXT round of optimization work
 run profile_step 900 python workloads/profile_step.py

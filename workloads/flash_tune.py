@@ -32,9 +32,12 @@ from workloads._timing import scan_loop, scan_loop_grad, time_loop_ms
 OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "out", "flash_blocks.json")
 
-# (batch, seq, heads, head_dim): bench shape first, then long-context
-SHAPES = [(32, 1024, 12, 64), (4, 2048, 16, 64), (2, 4096, 16, 64),
-          (1, 8192, 16, 64)]
+# (batch, seq, heads, head_dim, iters): bench shape first, then
+# long-context — iters shrink as the quadratic cost grows (32k causal is
+# ~0.5 s/call; 4 chained iterations amortize dispatch well enough)
+SHAPES = [(32, 1024, 12, 64, 32), (4, 2048, 16, 64, 32),
+          (2, 4096, 16, 64, 16), (1, 8192, 16, 64, 8),
+          (1, 32768, 16, 64, 4)]
 
 
 
@@ -50,11 +53,15 @@ def main():
     kind = jax.devices()[0].device_kind
 
     entries = []
-    for b, s, h, d in SHAPES:
+    for b, s, h, d, iters in SHAPES:
         q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.bfloat16)
         k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.bfloat16)
         v = jax.random.normal(jax.random.key(2), (b, s, h, d), jnp.bfloat16)
         blocks = [x for x in (128, 256, 512, 1024) if s % x == 0]
+        if s >= 16384:
+            # long-context: each config costs seconds of device time plus
+            # a ~30-80s relay compile — only sweep the plausible tilings
+            blocks = [x for x in blocks if x >= 512]
         rows = []
         for bq in blocks:
             for bk in blocks:
@@ -63,10 +70,10 @@ def main():
                         q, k, v, causal=True, interpret=False,
                         block_q=bq, block_k=bk)
                 try:
-                    f_ms = time_loop_ms(scan_loop(f, args.iters),
-                                        (q, k, v), args.iters)
-                    b_ms = time_loop_ms(scan_loop_grad(f, args.iters),
-                                        (q, k, v), args.iters)
+                    f_ms = time_loop_ms(scan_loop(f, iters),
+                                        (q, k, v), iters)
+                    b_ms = time_loop_ms(scan_loop_grad(f, iters),
+                                        (q, k, v), iters)
                 except Exception as e:
                     rows.append({"bq": bq, "bk": bk, "error": str(e)[:80]})
                     continue

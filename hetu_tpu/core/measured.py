@@ -1,0 +1,42 @@
+"""Shared loader for measured-defaults tables.
+
+The perf workloads (``workloads/*.py``) record chip-measured winners —
+flash block sizes, CE chunk budgets, embedding backward formulation,
+ring-vs-ulysses — as small JSON files under ``workloads/out/``; ops
+consult them at trace time so defaults are profile-first (the same
+philosophy as the reference's Galvatron ``profile_hardware`` flow).
+This module is the one place that knows the path convention and the
+degrade-to-None-on-torn-file rule.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+_CACHE: dict = {}
+
+
+def out_path(name: str) -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "workloads", "out", name)
+
+
+def read_measured(name: str, *, path: Optional[str] = None) -> Optional[Any]:
+    """Parsed JSON of ``workloads/out/<name>``, memoized on (path, mtime)
+    — a refreshed measurement is picked up without a process restart.
+    None when the file is absent, torn, or unreadable."""
+    p = path or out_path(name)
+    try:
+        key = (p, os.path.getmtime(p))
+        if key not in _CACHE:
+            with open(p) as f:
+                data = json.load(f)
+            # drop stale mtimes for this path (old windows' tables)
+            for k in [k for k in _CACHE if k[0] == p]:
+                del _CACHE[k]
+            _CACHE[key] = data
+        return _CACHE[key]
+    except (OSError, ValueError):
+        return None

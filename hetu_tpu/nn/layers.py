@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from hetu_tpu.nn.module import (
     Module, normal_init, zeros_init, ones_init, kaiming_uniform_init,
 )
+from hetu_tpu.ops import embedding as embed_ops
 from hetu_tpu.ops import normalization as norm_ops
 
 
@@ -41,16 +42,23 @@ class Linear(Module):
 
 
 class Embedding(Module):
+    """``bwd`` selects the gradient formulation for the table update:
+    "auto" uses the scatter-vs-onehot winner measured on this chip by
+    ``workloads/embed_probe.py`` (see ``ops/embedding.py``)."""
+
     def __init__(self, num_embeddings: int, features: int, init=None,
-                 axes: Sequence[Optional[str]] = (None, None)):
+                 axes: Sequence[Optional[str]] = (None, None),
+                 bwd: str = "auto"):
         super().__init__()
         self.num_embeddings = num_embeddings
         self.features = features
+        self.bwd = bwd
         self.param("weight", (num_embeddings, features),
                    init or normal_init(0.02), axes=axes)
 
     def __call__(self, params, ids):
-        return jnp.take(params["weight"], ids, axis=0).astype(
+        return embed_ops.embedding_lookup(
+            params["weight"], ids, bwd=self.bwd).astype(
             self.compute_dtype())
 
 

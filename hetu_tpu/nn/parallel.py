@@ -31,6 +31,7 @@ from jax import shard_map
 
 from hetu_tpu.nn.module import Module, ParamSpec, normal_init, zeros_init
 from hetu_tpu.ops import activations as act_ops
+from hetu_tpu.ops import embedding as embed_ops
 from hetu_tpu.ops.attention import attention_reference, flash_attention
 from hetu_tpu.ops.rotary import rope_frequencies, apply_rotary
 from hetu_tpu.parallel.sharding import (
@@ -121,7 +122,7 @@ class VocabParallelEmbedding(Module):
                 and self.num_embeddings % ctx.mesh.shape[ctx.tp] == 0:
             out = _vocab_parallel_lookup(w, ids, ctx)
         else:
-            out = jnp.take(w, ids, axis=0)
+            out = embed_ops.embedding_lookup(w, ids)
         return act_constrain(out.astype(self.compute_dtype()), "tokens")
 
 
@@ -137,7 +138,10 @@ def _vocab_parallel_lookup(weight, ids, ctx):
         start = jax.lax.axis_index(tp) * v_local
         local = ids - start
         ok = (local >= 0) & (local < v_local)
-        emb = jnp.take(w, jnp.clip(local, 0, v_local - 1), axis=0)
+        # masked local take; bwd=auto lets the measured onehot-matmul
+        # formulation replace the scatter-add table grad on TPU
+        emb = embed_ops.embedding_lookup(
+            w, jnp.clip(local, 0, v_local - 1))
         emb = jnp.where(ok[..., None], emb, jnp.zeros([], emb.dtype))
         return jax.lax.psum(emb, tp)
 

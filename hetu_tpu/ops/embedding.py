@@ -1,0 +1,143 @@
+"""Embedding lookup with a selectable backward formulation.
+
+The forward is always a row gather (cheap everywhere). The backward is
+the interesting part: the cotangent is a scatter-add of N token-rows
+into the (V, E) table. The reference implements it as an atomic
+scatter-add CUDA kernel (``impl/kernel/Embedding.cu`` path); XLA:TPU
+lowers the same thing via its scatter expansion, which can serialize.
+The MXU-native alternative computes ``dW = one_hot(ids)^T @ g`` as a
+(chunked) matmul — extra FLOPs, but pure systolic-array work.
+
+Which one wins is a property of the chip and the shape, so it is
+MEASURED, not assumed: ``workloads/embed_probe.py`` times both on the
+real TPU and records the winner; :func:`preferred_embedding_bwd`
+consults that record (same measured-defaults pattern as the flash
+block table and the CE chunk budget). Off-TPU, scatter is always used.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.core.measured import read_measured
+
+__all__ = ["embedding_lookup", "preferred_embedding_bwd"]
+
+# one-hot chunk rows: bounds the materialized (chunk, V) one-hot tile
+# (8192 x 50k bf16 ~= 0.8 GB, transient within one scan iteration)
+_DEFAULT_CHUNK = 8192
+
+
+def preferred_embedding_bwd(vocab: Optional[int] = None) -> str:
+    """"scatter" | "onehot" — the backward formulation measured fastest
+    on THIS backend, falling back to scatter when nothing was measured,
+    the measurement came from a different backend, or the measured
+    vocab is more than 4x away from this table's (a 50k-vocab winner
+    must not steer a 2-row type-embedding — same extrapolation guard as
+    ``data.hydraulis.preferred_cp_impl``)."""
+    if jax.default_backend() != "tpu":
+        return "scatter"
+    rec = read_measured("embed_bwd.json")
+    if not isinstance(rec, dict) or rec.get("backend") != "tpu" \
+            or rec.get("winner") not in ("scatter", "onehot"):
+        return "scatter"
+    try:
+        mv = int(rec.get("shape", {}).get("vocab", 0))
+    except (TypeError, ValueError):
+        mv = 0
+    if vocab is not None and mv \
+            and max(vocab, mv) > 4 * min(vocab, mv):
+        return "scatter"
+    return rec["winner"]
+
+
+def _onehot_grad(ids: jnp.ndarray, g: jnp.ndarray, vocab: int,
+                 chunk: int, mm_dt) -> jnp.ndarray:
+    """dW = one_hot(ids)^T @ g as fp32-accumulated matmuls in ``mm_dt``,
+    chunked over tokens so the one-hot tile stays bounded.
+
+    ``mm_dt`` defaults to bf16 upstream regardless of the cotangent's
+    dtype: the incoming g has already been cast back to the table's
+    dtype by the transpose of the adopter's ``.astype(compute_dtype)``,
+    but its VALUES came out of a bf16 compute path, so downcasting for
+    the MXU (with fp32 accumulation via ``preferred_element_type``)
+    loses nothing that the scatter formulation kept. The one-hot
+    operand is exact in either dtype (0/1)."""
+    idsf = ids.reshape(-1)
+    gf = g.reshape(-1, g.shape[-1])
+    n = idsf.shape[0]
+
+    def dw_of(ids_c, g_c):
+        oh = jax.nn.one_hot(ids_c, vocab, dtype=mm_dt)       # (C, V)
+        return jax.lax.dot_general(
+            oh, g_c.astype(mm_dt), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (V, E)
+
+    if chunk is None or n <= chunk:
+        return dw_of(idsf, gf)
+    if n % chunk != 0:
+        # ragged tail: pad with (id 0, g 0) rows — zero cotangent rows
+        # contribute nothing to dW, and the one-hot tile stays bounded
+        pad = chunk - n % chunk
+        idsf = jnp.concatenate([idsf, jnp.zeros((pad,), idsf.dtype)])
+        gf = jnp.concatenate(
+            [gf, jnp.zeros((pad, gf.shape[-1]), gf.dtype)])
+        n = n + pad
+
+    def body(acc, xs):
+        ids_c, g_c = xs
+        return acc + dw_of(ids_c, g_c), None
+
+    acc0 = jnp.zeros((vocab, gf.shape[-1]), jnp.float32)
+    out, _ = jax.lax.scan(
+        body, acc0, (idsf.reshape(n // chunk, chunk),
+                     gf.reshape(n // chunk, chunk, gf.shape[-1])))
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _lookup_onehot(w, ids, chunk, vocab, mm_dtype):
+    return jnp.take(w, ids, axis=0)
+
+
+def _lookup_onehot_fwd(w, ids, chunk, vocab, mm_dtype):
+    # zero-size carrier: residuals must be JAX types, so w's dtype rides
+    # along as an empty array instead of a raw numpy dtype
+    return jnp.take(w, ids, axis=0), (ids, jnp.zeros((0,), w.dtype))
+
+
+def _lookup_onehot_bwd(chunk, vocab, mm_dtype, res, g):
+    ids, dt = res
+    dw = _onehot_grad(ids, g, vocab, chunk, mm_dtype).astype(dt.dtype)
+    return dw, np.zeros(ids.shape, jax.dtypes.float0)
+
+
+_lookup_onehot.defvjp(_lookup_onehot_fwd, _lookup_onehot_bwd)
+
+
+def embedding_lookup(w: jnp.ndarray, ids: jnp.ndarray, *,
+                     bwd: str = "auto",
+                     chunk: int = _DEFAULT_CHUNK,
+                     mm_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Row gather ``w[ids]`` whose backward formulation is selectable.
+
+    bwd: "scatter" (XLA's native take-VJP), "onehot" (MXU matmul
+    ``one_hot(ids)^T @ g`` in ``mm_dtype`` with fp32 accumulation,
+    chunked), or "auto" (the winner measured by
+    ``workloads/embed_probe.py`` on this chip; scatter off-TPU).
+    Pass ``mm_dtype=jnp.float32`` with bwd="onehot" for a full-precision
+    table grad in fp32-everything setups.
+    """
+    if bwd == "auto":
+        bwd = preferred_embedding_bwd(w.shape[0])
+    if bwd == "scatter":
+        return jnp.take(w, ids, axis=0)
+    if bwd == "onehot":
+        return _lookup_onehot(w, ids, chunk, w.shape[0],
+                              jnp.dtype(mm_dtype))
+    raise ValueError(f"unknown embedding bwd {bwd!r}")

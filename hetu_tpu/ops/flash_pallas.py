@@ -47,21 +47,16 @@ def _pick_block(n: int, target: int = 512) -> int:
     return n
 
 
-@functools.cache
 def _tuned_entries() -> tuple:
     """Block winners measured by ``workloads/flash_tune.py`` on this
     machine's chip; () when absent or when not running on TPU."""
     if jax.default_backend() != "tpu":
         return ()
-    import json
-    import os
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "..", "..", "workloads", "out", "flash_blocks.json")
+    from hetu_tpu.core.measured import read_measured
+    data = read_measured("flash_blocks.json")
     try:
-        with open(path) as f:
-            data = json.load(f)
         return tuple(tuple(sorted(e.items())) for e in data["entries"])
-    except (OSError, ValueError, KeyError, TypeError):
+    except (KeyError, TypeError):
         return ()
 
 

@@ -123,5 +123,4 @@ def test_tuned_block_defaults_lookup():
 
 def test_tuned_entries_absent_on_cpu():
     from hetu_tpu.ops import flash_pallas as fp
-    fp._tuned_entries.cache_clear()
     assert fp._tuned_entries() == ()

@@ -204,3 +204,88 @@ def test_aux_losses_match_torch_semantics():
         float(kl_div_loss(lpred, tprobs)),
         float(F.kl_div(torch.tensor(lpred), torch.tensor(tprobs),
                        reduction="batchmean")), rtol=1e-5, atol=1e-7)
+
+
+def test_embedding_onehot_bwd_matches_scatter():
+    """The one-hot-matmul table grad (ops/embedding.py) must match XLA's
+    native take-VJP scatter-add, including repeated ids and the chunked
+    scan path (chunk divides N and chunk does not)."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.ops.embedding import embedding_lookup
+
+    V, E, N = 97, 16, 64
+    key = jax.random.key(0)
+    w = jax.random.normal(jax.random.key(1), (V, E), jnp.float32)
+    # repeated ids: several tokens hit the same row (accumulation path)
+    ids = jax.random.randint(key, (4, N // 4), 0, V // 3)
+    g = jax.random.normal(jax.random.key(2), (4, N // 4, E), jnp.float32)
+
+    def loss(w, bwd, chunk=8192):
+        h = embedding_lookup(w, ids, bwd=bwd, chunk=chunk,
+                             mm_dtype=jnp.float32)
+        return (h * g).sum()
+
+    ref = jax.grad(lambda w: loss(w, "scatter"))(w)
+    got = jax.grad(lambda w: loss(w, "onehot"))(w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # chunked scan path (chunk=16 divides N=64)
+    got_c = jax.grad(lambda w: loss(w, "onehot", chunk=16))(w)
+    np.testing.assert_allclose(np.asarray(got_c), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # ragged tail (chunk=24 does not divide N=64): padded scan path,
+    # NOT a silent fall-back to one unbounded one-hot tile
+    got_r = jax.grad(lambda w: loss(w, "onehot", chunk=24))(w)
+    np.testing.assert_allclose(np.asarray(got_r), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # forwards identical (both are the same gather)
+    np.testing.assert_array_equal(
+        np.asarray(embedding_lookup(w, ids, bwd="onehot")),
+        np.asarray(embedding_lookup(w, ids, bwd="scatter")))
+    # bf16 cotangent (the bench path): fp32-accumulated matmul grad
+    gb = g.astype(jnp.bfloat16)
+
+    def loss_b(w, bwd):
+        h = embedding_lookup(w, ids, bwd=bwd).astype(jnp.bfloat16)
+        return (h * gb).astype(jnp.float32).sum()
+
+    ref_b = jax.grad(lambda w: loss_b(w, "scatter"))(w)
+    got_b = jax.grad(lambda w: loss_b(w, "onehot"))(w)
+    np.testing.assert_allclose(np.asarray(got_b), np.asarray(ref_b),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_embedding_preferred_bwd_guards(tmp_path, monkeypatch):
+    """A winner measured on TPU must not leak into CPU runs; on TPU the
+    winner applies only within 4x of the measured vocab; a torn or
+    missing file degrades to scatter."""
+    import json
+    import jax
+    from hetu_tpu.ops import embedding as emb
+    from hetu_tpu.core import measured
+
+    assert emb.preferred_embedding_bwd() == "scatter"  # cpu backend
+
+    p = tmp_path / "embed_bwd.json"
+    p.write_text(json.dumps({"winner": "onehot", "backend": "tpu",
+                             "shape": {"vocab": 50257}}))
+    monkeypatch.setattr(measured, "out_path",
+                        lambda name: str(tmp_path / name))
+    # still scatter: this process runs on cpu
+    assert emb.preferred_embedding_bwd() == "scatter"
+
+    # pretend we ARE on tpu: the file now decides, with the vocab guard
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert emb.preferred_embedding_bwd() == "onehot"        # no vocab
+    assert emb.preferred_embedding_bwd(50257) == "onehot"   # exact
+    assert emb.preferred_embedding_bwd(-(-50257 // 4)) == "onehot"  # 4x edge
+    assert emb.preferred_embedding_bwd(2048) == "scatter"   # >4x away
+    assert emb.preferred_embedding_bwd(2) == "scatter"      # tiny table
+
+    # torn file degrades to scatter
+    p.write_text("{not json")
+    assert emb.preferred_embedding_bwd() == "scatter"
+    # foreign-backend record is ignored even on tpu
+    p.write_text(json.dumps({"winner": "onehot", "backend": "cpu"}))
+    assert emb.preferred_embedding_bwd() == "scatter"

@@ -24,12 +24,15 @@ def out_path(name: str) -> str:
 
 
 def read_measured(name: str, *, path: Optional[str] = None) -> Optional[Any]:
-    """Parsed JSON of ``workloads/out/<name>``, memoized on (path, mtime)
-    — a refreshed measurement is picked up without a process restart.
-    None when the file is absent, torn, or unreadable."""
+    """Parsed JSON of ``workloads/out/<name>``, memoized on
+    (path, mtime_ns, size) — a refreshed measurement is picked up without
+    a process restart, including rewrites within one coarse mtime tick
+    (the size term catches those). None when the file is absent, torn,
+    or unreadable."""
     p = path or out_path(name)
     try:
-        key = (p, os.path.getmtime(p))
+        st = os.stat(p)
+        key = (p, st.st_mtime_ns, st.st_size)
         if key not in _CACHE:
             with open(p) as f:
                 data = json.load(f)

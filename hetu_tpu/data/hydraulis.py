@@ -60,7 +60,6 @@ def preferred_cp_impl(seq_len: int, cp: int, num_heads: int,
     return "ulysses" if (cp <= 4 and seq_len < 8192) else "ring"
 
 
-_CP_TABLE_CACHE: dict = {}
 _WARNED_TABLES: set = set()
 
 
@@ -81,23 +80,14 @@ def _warn_stale_table(path: str, table_backend: str, here: str) -> None:
 
 
 def _load_cp_table(path: str):
-    """(backend, results) from the winners table, memoized on
-    (path, mtime) — plan_buckets calls preferred_cp_impl per (bucket ×
-    cp candidate) and the table is immutable between measurement runs."""
-    import json as _json
-    import os as _os
-    try:
-        mtime = _os.path.getmtime(path)
-        key = (path, mtime)
-        if key not in _CP_TABLE_CACHE:
-            with open(path) as f:
-                data = _json.load(f)
-            _CP_TABLE_CACHE.clear()     # old mtimes are dead weight
-            _CP_TABLE_CACHE[key] = (data.get("backend", "unknown"),
-                                    data["results"])
-        return _CP_TABLE_CACHE[key]
-    except (OSError, ValueError, KeyError):
+    """(backend, results) from the winners table via the shared
+    measured-defaults loader (``core.measured`` memoizes on mtime+size —
+    plan_buckets calls preferred_cp_impl per bucket × cp candidate)."""
+    from hetu_tpu.core.measured import read_measured
+    data = read_measured("cp_compare.json", path=path)
+    if not isinstance(data, dict) or "results" not in data:
         return None
+    return (data.get("backend", "unknown"), data["results"])
 
 
 @dataclasses.dataclass(frozen=True)

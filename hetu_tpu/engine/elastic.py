@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from hetu_tpu.engine.straggler import StragglerReport
 from hetu_tpu.rpc.client import CoordinatorClient
 from hetu_tpu.utils.logging import get_logger
 
@@ -57,9 +58,25 @@ class ElasticController:
     def check(self) -> tuple[list[str], list[str]]:
         return self.client.status(self.timeout_ms)
 
-    def recovery_plan(self, dims, topo, n_alive_devices: int):
-        """New Strategy for the surviving device count (largest
-        power-of-two subset), via the auto-parallel search."""
+    def recovery_plan(self, dims, topo, n_alive_devices: int, *,
+                      num_layers: Optional[int] = None,
+                      num_microbatches: int = 8,
+                      allow_hetero: bool = True,
+                      alive_device_ids=None):
+        """New strategy for the surviving device count.
+
+        Power-of-two survivor counts get a uniform Strategy from the
+        auto-parallel search. A NON-power-of-two count normally strands
+        devices (7 alive → largest pow2 subset = 4); the Ampelos planner
+        in the reference instead plans heterogeneous pipelines around the
+        dead devices so every survivor keeps working
+        (``python/hetu/engine/strategy_ampelos.py:906``
+        ``enumerate_pp_pattern(..., num_dead_devices)``). Here: when
+        ``num_layers`` is known, build a hetero pipeline over ALL
+        survivors (pow2 stage sizes, layers ∝ stage width via the
+        Malleus planner) and adopt it when its bubble-discounted
+        throughput beats the stranded-uniform plan. Feed the result to
+        ``Trainer.shrink_to`` — both strategy kinds hot-switch."""
         from hetu_tpu.tools.galvatron import TPUTopology, search_uniform
 
         n = n_alive_devices
@@ -67,6 +84,25 @@ class ElasticController:
             n -= 1
         if n < 1:
             return None
+
+        if allow_hetero and num_layers is not None \
+                and n_alive_devices != n:
+            het = _hetero_recovery(n_alive_devices, num_layers,
+                                   num_microbatches,
+                                   alive_device_ids=alive_device_ids)
+            if het is not None:
+                # bubble-discounted device-seconds: hetero keeps all
+                # survivors busy but pays the pipeline bubble; the
+                # uniform fallback strands (n_alive - n) devices
+                eff_het = n_alive_devices * num_microbatches \
+                    / (num_microbatches + het.pp - 1)
+                if eff_het > n:
+                    get_logger().info(
+                        f"elastic replan: {n_alive_devices} alive → "
+                        f"hetero {het.to_json()} (uses all survivors; "
+                        f"eff {eff_het:.2f} vs {n} stranded-uniform)")
+                    return het
+
         new_topo = TPUTopology(
             num_devices=n, peak_flops=topo.peak_flops, ici_bw=topo.ici_bw,
             dcn_bw=topo.dcn_bw, hbm_bytes=topo.hbm_bytes,
@@ -96,6 +132,47 @@ class ElasticController:
         t.start()
         t.stop_event = stop  # type: ignore[attr-defined]
         return t
+
+
+def _hetero_recovery(n_alive: int, num_layers: int,
+                     num_microbatches: int,
+                     alive_device_ids=None):
+    """HeteroStrategy over ALL ``n_alive`` survivors: the fewest pipeline
+    stages whose power-of-two widths sum to exactly ``n_alive`` (fewest
+    stages = smallest bubble), layers ∝ stage width. Survivors are
+    equal-speed, so this reuses the Malleus planner with a uniform
+    straggler report. None when no composition exists (n_alive = 1) or
+    the model is too shallow for the stage count.
+
+    ``alive_device_ids``: the REAL surviving jax device ids — when
+    absent, the returned strategy carries ``device_ids=None`` so the
+    stage meshes bind to whatever survivor list the caller hands
+    ``shrink_to``/``make_hetero_plan`` (fabricated 0..n-1 ids would
+    point at dead devices whenever the dead one is not the highest id).
+    """
+    import dataclasses
+
+    from hetu_tpu.engine.malleus import plan_hetero
+
+    ids = list(alive_device_ids) if alive_device_ids is not None \
+        else list(range(n_alive))
+    if len(ids) != n_alive:
+        raise ValueError(
+            f"{len(ids)} alive_device_ids for n_alive={n_alive}")
+    report = StragglerReport(times_s={i: 1.0 for i in ids},
+                             ratios={i: 1.0 for i in ids})
+    for k in range(2, 7):
+        if k > num_layers:
+            return None
+        try:
+            strat = plan_hetero(report, num_layers, num_stages=k,
+                                num_microbatches=num_microbatches)
+        except ValueError:
+            continue
+        if alive_device_ids is None:
+            strat = dataclasses.replace(strat, device_ids=None)
+        return strat
+    return None
 
 
 def elastic_resume(model, opt, new_strategy, *, state=None, devices=None,

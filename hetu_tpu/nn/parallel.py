@@ -129,6 +129,11 @@ class VocabParallelEmbedding(Module):
 def _vocab_parallel_lookup(weight, ids, ctx):
     tp = ctx.tp
     v_local = weight.shape[0] // ctx.mesh.shape[tp]
+    # decide the table-grad formulation from the GLOBAL vocab (inside
+    # shard_map w is the V/tp local shard, which would trip the measured
+    # winner's vocab-distance guard at high tp even though per-shard
+    # token count — the quantity the probe measured — is unchanged)
+    bwd = embed_ops.preferred_embedding_bwd(weight.shape[0])
 
     @functools.partial(
         shard_map, mesh=ctx.mesh,
@@ -138,10 +143,10 @@ def _vocab_parallel_lookup(weight, ids, ctx):
         start = jax.lax.axis_index(tp) * v_local
         local = ids - start
         ok = (local >= 0) & (local < v_local)
-        # masked local take; bwd=auto lets the measured onehot-matmul
-        # formulation replace the scatter-add table grad on TPU
+        # masked local take; the measured onehot-matmul formulation can
+        # replace the scatter-add table grad on TPU
         emb = embed_ops.embedding_lookup(
-            w, jnp.clip(local, 0, v_local - 1))
+            w, jnp.clip(local, 0, v_local - 1), bwd=bwd)
         emb = jnp.where(ok[..., None], emb, jnp.zeros([], emb.dtype))
         return jax.lax.psum(emb, tp)
 

@@ -46,8 +46,9 @@ def preferred_embedding_bwd(vocab: Optional[int] = None) -> str:
     if not isinstance(rec, dict) or rec.get("backend") != "tpu" \
             or rec.get("winner") not in ("scatter", "onehot"):
         return "scatter"
+    shape = rec.get("shape")
     try:
-        mv = int(rec.get("shape", {}).get("vocab", 0))
+        mv = int(shape.get("vocab", 0)) if isinstance(shape, dict) else 0
     except (TypeError, ValueError):
         mv = 0
     if vocab is not None and mv \

@@ -126,6 +126,57 @@ def test_elastic_failure_detection_and_replan():
         hb_a.stop()
 
 
+def test_elastic_recovery_plan_hetero_uses_all_survivors():
+    """Ampelos parity (strategy_ampelos.py:906): a non-pow2 survivor
+    count with known depth plans a hetero pipeline over ALL survivors
+    instead of stranding devices on the largest pow2 subset."""
+    from hetu_tpu.parallel.hetero import HeteroStrategy
+    from hetu_tpu.parallel.strategy import Strategy
+
+    ctrl = ElasticController.__new__(ElasticController)  # no coordinator
+    dims = ModelDims.from_config(GPTConfig.tiny(), seq_len=128,
+                                 global_batch=8)
+    topo = TPUTopology(num_devices=8)
+
+    # 7 alive, 8 layers: hetero over 4+2+1 (all 7 devices busy) beats
+    # a stranded-uniform plan on 4
+    s = ctrl.recovery_plan(dims, topo, n_alive_devices=7, num_layers=8)
+    assert isinstance(s, HeteroStrategy)
+    assert sum(st.n_devices for st in s.stages) == 7
+    assert sum(st.layers for st in s.stages) == 8
+    # no real ids known → device_ids must stay unbound (fabricated
+    # 0..6 would target a dead device whenever a low id died)
+    assert s.device_ids is None
+
+    # real survivor ids (device 2 died): the plan binds exactly those
+    alive = [0, 1, 3, 4, 5, 6, 7]
+    s_ids = ctrl.recovery_plan(dims, topo, n_alive_devices=7,
+                               num_layers=8, alive_device_ids=alive)
+    assert isinstance(s_ids, HeteroStrategy)
+    assert sorted(s_ids.device_ids) == alive
+    # widest stage carries the most layers (layers ∝ throughput)
+    widths = [st.tp for st in s.stages]
+    layers = [st.layers for st in s.stages]
+    assert layers[widths.index(max(widths))] == max(layers)
+
+    # pow2 survivor count: uniform strategy as before, even with depth
+    s8 = ctrl.recovery_plan(dims, topo, n_alive_devices=8, num_layers=8)
+    assert isinstance(s8, Strategy)
+
+    # unknown depth: pow2 fallback (old behavior)
+    s7 = ctrl.recovery_plan(dims, topo, n_alive_devices=7)
+    assert isinstance(s7, Strategy) and s7.num_devices == 4
+
+    # hetero opt-out honored
+    s_no = ctrl.recovery_plan(dims, topo, n_alive_devices=7,
+                              num_layers=8, allow_hetero=False)
+    assert isinstance(s_no, Strategy) and s_no.num_devices == 4
+
+    # too-shallow model (1 layer < 2 stages): falls back to uniform
+    s1 = ctrl.recovery_plan(dims, topo, n_alive_devices=7, num_layers=1)
+    assert isinstance(s1, Strategy)
+
+
 def test_profile_modules_table():
     """Per-module fwd/bwd timing (subgraph.h:53-56 parity): all entries
     positive, block count = num_layers, table renders."""

@@ -264,3 +264,38 @@ def test_trainer_shrink_to_survivors_no_checkpoint(monkeypatch):
     t.config.total_steps = 4
     t.train(_batches(2))
     assert int(jax.device_get(t.state.step)) == step_before + 2
+
+
+def test_trainer_shrink_to_hetero_recovery(monkeypatch):
+    """Ampelos-style recovery at the Trainer surface: 8 → 6 survivors is
+    NOT a power of two, so the elastic planner emits a hetero pipeline
+    (stages 4+2) that keeps every survivor busy; shrink_to hot-switches
+    the live homo state onto it and training continues, no disk."""
+    from hetu_tpu.engine.elastic import _hetero_recovery
+    from hetu_tpu.parallel.hetero import HeteroState
+    from hetu_tpu.utils import checkpoint as ckpt_mod
+    from hetu_tpu.utils import dist_checkpoint as dckpt_mod
+
+    def _no_disk(*a, **kw):
+        raise AssertionError("shrink_to touched a checkpoint")
+    monkeypatch.setattr(ckpt_mod, "load_checkpoint", _no_disk)
+    monkeypatch.setattr(dckpt_mod, "load_checkpoint_distributed", _no_disk)
+
+    t = Trainer(GPTLMHeadModel(CFG), optim.adamw(3e-3),
+                Strategy(dp=2, tp=4), _cfg(total_steps=2))
+    t.train(_batches(2))
+    step_before = int(jax.device_get(t.state.step))
+
+    het = _hetero_recovery(6, CFG.num_layers, num_microbatches=2)
+    assert het is not None
+    assert sorted(st.n_devices for st in het.stages) == [2, 4]
+    survivors = jax.devices()[:6]
+    t.shrink_to(survivors, het)
+    assert isinstance(t.state, HeteroState)
+    used = {d.id for m in t.plan.meshes for d in m.devices.flat}
+    assert used == {0, 1, 2, 3, 4, 5}
+    assert int(jax.device_get(t.state.step)) == step_before
+
+    t.config.total_steps = 4
+    t.train(_batches(2))
+    assert int(jax.device_get(t.state.step)) == step_before + 2

@@ -120,46 +120,57 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    attn_impl = "auto"
+    # each attempt: (label, strategy, batches, dtype_policy, attn, ce)
+    # — the sweep winner leads, but the BUILT-IN config stays behind it
+    # so a winner-specific regression (fused-CE kernel, bf16 params)
+    # degrades the headline instead of destroying it at round end
+    attempts = []
     if on_tpu:
         cfg = GPTConfig.small()      # 124M params
-        batches, seq, steps, warmup = (32, 16, 8), 1024, 20, 3
-        dtype_policy = Policy(param_dtype=jnp.float32,
-                              compute_dtype=jnp.bfloat16)
+        seq, steps, warmup = 1024, 20, 3
         # selective remat + unrolled layers won the r3 sweep
         # (workloads/mfu_sweep.py): remat buys batch 32 (vs 8 without)
         # and the pinned flash residuals keep its recompute to
-        # elementwise ops. A recorded sweep winner overrides these
-        # built-ins (its batch leads the OOM-fallback chain).
-        strategy = Strategy(remat="selective", unroll=True)
+        # elementwise ops.
+        attempts.append((
+            "builtin", Strategy(remat="selective", unroll=True),
+            (32, 16, 8),
+            Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16),
+            "auto", "chunked"))
         best = load_sweep_best()
         if best:
-            strategy = Strategy(remat=best["remat"],
-                                unroll=bool(best["unroll"]))
-            attn_impl = best.get("attn", "auto")
-            if best["batch"] not in batches:
-                batches = (best["batch"],) + batches
-            else:
+            winner_cfg = (best["remat"], bool(best["unroll"]),
+                          best["batch"], best.get("param_dtype", "fp32"),
+                          best.get("attn", "auto"),
+                          best.get("ce", "chunked"))
+            if winner_cfg != ("selective", True, 32, "fp32", "auto",
+                              "chunked"):   # != builtin: no double run
                 batches = (best["batch"],) + tuple(
-                    b for b in batches if b != best["batch"])
-            if best.get("param_dtype") == "bf16":
-                dtype_policy = Policy(param_dtype=jnp.bfloat16,
-                                      compute_dtype=jnp.bfloat16)
-            if best.get("ce") == "fused":
-                os.environ["HETU_LM_LOSS_IMPL"] = "fused"
+                    b for b in (32, 16, 8) if b != best["batch"])
+                pol = Policy(param_dtype=jnp.bfloat16,
+                             compute_dtype=jnp.bfloat16) \
+                    if best.get("param_dtype") == "bf16" \
+                    else Policy(param_dtype=jnp.float32,
+                                compute_dtype=jnp.bfloat16)
+                attempts.insert(0, (
+                    "winner", Strategy(remat=best["remat"],
+                                       unroll=bool(best["unroll"])),
+                    batches, pol, best.get("attn", "auto"),
+                    best.get("ce", "chunked")))
     else:  # CPU smoke fallback so the bench always emits a number
         cfg = GPTConfig.tiny()
-        batches, seq, steps, warmup = (4,), 64, 3, 1
-        dtype_policy = Policy(param_dtype=jnp.float32,
-                              compute_dtype=jnp.float32)
-        strategy = Strategy()
+        seq, steps, warmup = 64, 3, 1
+        attempts.append((
+            "builtin", Strategy(), (4,),
+            Policy(param_dtype=jnp.float32, compute_dtype=jnp.float32),
+            "auto", "chunked"))
 
     seq = min(seq, cfg.max_positions)
     model = GPTLMHeadModel(cfg)
     opt = optim.adamw(1e-4, weight_decay=0.01)
     # single chip (the driver validates multi-chip via dryrun_multichip)
 
-    def run(batch):
+    def run(batch, dtype_policy, strategy, attn_impl):
         with autocast(dtype_policy):
             plan = make_plan(model, opt, strategy)
             state = init_state(model, opt, plan, jax.random.key(0))
@@ -183,21 +194,45 @@ def main():
         n = sum(x.size for x in jax.tree.leaves(state.params))
         return dt, n
 
-    # largest batch that fits wins (chunked CE keeps logits memory flat,
-    # so batch is bounded by activations; OOM → halve and retry)
+    # attempt order: sweep winner, then built-in defaults. Within one
+    # attempt the largest batch that fits wins (chunked CE keeps logits
+    # memory flat, so batch is bounded by activations; OOM → halve and
+    # retry). A NON-OOM failure abandons the attempt: for the winner
+    # that means degrading to the built-ins (recorded in the output);
+    # for the final attempt it raises — regressions in the defaults
+    # must not be masked.
     dt = n_params = batch = None
-    last_err = None
-    for b in batches:
-        try:
-            dt, n_params = run(b)
-            batch = b
+    degraded = None
+    # an explicitly exported HETU_LM_LOSS_IMPL is the documented manual
+    # A/B switch (ops/fused_ce_pallas.py) — it outranks the sweep record
+    user_ce = os.environ.get("HETU_LM_LOSS_IMPL")
+    for ai, (label, strategy, batches, pol, attn_impl, ce) in \
+            enumerate(attempts):
+        last_attempt = ai == len(attempts) - 1
+        if user_ce is None:
+            if ce == "fused":
+                os.environ["HETU_LM_LOSS_IMPL"] = "fused"
+            else:
+                os.environ.pop("HETU_LM_LOSS_IMPL", None)
+        last_err = None
+        for b in batches:
+            try:
+                dt, n_params = run(b, pol, strategy, attn_impl)
+                batch = b
+                break
+            except Exception as e:
+                if not is_oom(e):
+                    if last_attempt:
+                        raise
+                    last_err = e
+                    break          # non-OOM: abandon this attempt
+                last_err = e
+        if dt is not None:
             break
-        except Exception as e:
-            if not is_oom(e):
-                raise    # NaN/compile regressions must not be masked
-            last_err = e
-    if dt is None:
-        raise last_err
+        if last_attempt and last_err is not None:
+            raise last_err
+        if label == "winner":
+            degraded = str(last_err or "winner config failed")[:200]
     tokens_per_sec = batch * seq / dt
     flops = model_flops_per_token(cfg, n_params, seq) * tokens_per_sec
     peak = peak_flops(dev)
@@ -213,6 +248,10 @@ def main():
         "n_params": n_params,
         "device": getattr(dev, "device_kind", dev.platform),
     }
+    if degraded is not None:
+        # the sweep winner config failed and the built-ins carried the
+        # number — visible so a winner-specific regression gets fixed
+        result["degraded_from_winner"] = degraded
     if on_tpu:
         try:
             os.makedirs(os.path.dirname(_LAST_TPU_PATH), exist_ok=True)

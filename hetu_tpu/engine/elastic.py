@@ -58,7 +58,8 @@ class ElasticController:
     def check(self) -> tuple[list[str], list[str]]:
         return self.client.status(self.timeout_ms)
 
-    def recovery_plan(self, dims, topo, n_alive_devices: int, *,
+    @staticmethod
+    def recovery_plan(dims, topo, n_alive_devices: int, *,
                       num_layers: Optional[int] = None,
                       num_microbatches: int = 8,
                       allow_hetero: bool = True,

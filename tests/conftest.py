@@ -107,6 +107,7 @@ SLOW_TESTS = {
     "test_hetero_malleus_example",
     "test_hydraulis_example",
     "test_elastic_train_example",
+    "test_elastic_hetero_recovery_example",
     "test_sft_example",
     "test_remaining_examples_run",
     "test_r4_configs_compile_and_train",

@@ -133,7 +133,7 @@ def test_elastic_recovery_plan_hetero_uses_all_survivors():
     from hetu_tpu.parallel.hetero import HeteroStrategy
     from hetu_tpu.parallel.strategy import Strategy
 
-    ctrl = ElasticController.__new__(ElasticController)  # no coordinator
+    ctrl = ElasticController  # recovery_plan is static: no coordinator
     dims = ModelDims.from_config(GPTConfig.tiny(), seq_len=128,
                                  global_batch=8)
     topo = TPUTopology(num_devices=8)

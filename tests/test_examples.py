@@ -59,6 +59,12 @@ def test_remaining_examples_run(script):
     _run(script, timeout=600)
 
 
+def test_elastic_hetero_recovery_example():
+    out = _run("elastic_hetero_recovery.py", timeout=600)
+    assert "recovery strategy:" in out
+    assert "recovery complete" in out
+
+
 @pytest.mark.parametrize("cfg", ["gpt_pp_cp_long.yaml",
                                  "moe_sam_gate.yaml"])
 def test_r4_configs_compile_and_train(cfg):

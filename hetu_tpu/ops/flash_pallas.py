@@ -28,6 +28,7 @@ matching ``attention_reference``.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -74,6 +75,19 @@ def _default_blocks(sq: int, sk: int, kind: str) -> tuple:
 
 
 def _interpret_default() -> bool:
+    """Interpret-mode default for the Pallas kernels (flash + fused CE).
+
+    ``HETU_PALLAS_INTERPRET=0|1`` overrides: AOT topology compilation
+    (``workloads/aot_check.py``) targets real TPU from a CPU-backend
+    process, where the backend heuristic would silently swap in the
+    interpret lowering and validate nothing."""
+    env = os.environ.get("HETU_PALLAS_INTERPRET")
+    if env is not None:
+        if env not in ("0", "1"):
+            raise ValueError(
+                f"HETU_PALLAS_INTERPRET={env!r}: use '0' (real Mosaic "
+                "lowering) or '1' (interpret mode)")
+        return env == "1"
     return jax.default_backend() != "tpu"
 
 

@@ -150,6 +150,7 @@ SLOW_TESTS = {
     "test_trainer_shrink_to_survivors_no_checkpoint",
     "test_trainer_shrink_to_hetero_recovery",
     "test_pp_memory_aot_analysis_on_tpu_target",
+    "test_mosaic_kernels_aot_compile_for_v5e",
     "test_homogeneous_1f1b_matches_scan_executor",
     "test_hetero_residual_backward_matches_recompute",
     "test_gpt_pp_cp_ulysses_parity",

@@ -124,3 +124,23 @@ def test_tuned_block_defaults_lookup():
 def test_tuned_entries_absent_on_cpu():
     from hetu_tpu.ops import flash_pallas as fp
     assert fp._tuned_entries() == ()
+
+
+def test_mosaic_kernels_aot_compile_for_v5e():
+    """The REAL Mosaic lowerings of the flash-attention and fused-CE
+    kernels (not interpret mode) must compile for a v5e target — libtpu
+    is local, so a lowering regression is caught here instead of
+    mid-TPU-window (workloads/aot_check.py is the full matrix)."""
+    import pytest
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    except Exception as e:
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+
+    from workloads.aot_check import check_flash, check_fused_ce
+    devs = list(topo.devices)
+    assert "compile_s" in check_flash(devs, shape=(2, 512, 8, 64))
+    assert "compile_s" in check_flash(devs, shape=(2, 512, 8, 64),
+                                      kv_heads=2, seg=True)
+    assert "compile_s" in check_fused_ce(devs, n=1024, e=256, v=2048)

@@ -1,0 +1,213 @@
+"""Mosaic/XLA AOT compile checks for real TPU targets — no tunnel needed.
+
+The Pallas kernels (flash attention, fused CE) normally only compile
+for TPU inside a live window; everywhere else they run in interpret
+mode, so a Mosaic-lowering regression (bad block shape, unsupported op,
+VMEM overflow) stays invisible until scarce chip time is burned on it.
+libtpu is local, so this workload AOT-compiles the REAL kernels — and
+whole sharded train steps using them — for v5e topologies via
+``jax.experimental.topologies`` with ``HETU_PALLAS_INTERPRET=0``:
+
+- flash attention fwd+bwd: causal bench shape, GQA, packed segment
+  ids, head_dim 128, and every tuned block entry recorded by
+  ``flash_tune.py`` (a tuned config that stops compiling is caught
+  HERE, not mid-window);
+- fused streaming LM-head+CE fwd+bwd at the bench vocab;
+- the dp2×tp2×cp2 ring-attention train step on a v5e:2x4 target
+  (collectives + Pallas inside shard_map);
+- the single-chip bench-winner step with per-device memory analysis
+  (HBM headroom for the batch chain).
+
+Usage: python workloads/aot_check.py [--quick]
+Writes workloads/out/aot_check.json; one row per check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+# axon sitecustomize overrides JAX_PLATFORMS; stay on the CPU backend —
+# nothing executes, only the AOT target is TPU (see pp_memory.py)
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _one_dev_mesh(devs):
+    return Mesh(np.array(devs[:1]).reshape(1, 1), ("dp", "tp"))
+
+
+def _sds(shape, dtype, mesh, spec=P()):
+    return jax.ShapeDtypeStruct(shape, dtype,
+                                sharding=NamedSharding(mesh, spec))
+
+
+def check_flash(devs, *, shape=(4, 1024, 12, 64), kv_heads=None,
+                seg=False, block_q=None, block_k=None):
+    from hetu_tpu.ops.flash_pallas import flash_attention_pallas as fa
+    mesh = _one_dev_mesh(devs)
+    b, s, h, d = shape
+    q = _sds((b, s, h, d), jnp.bfloat16, mesh)
+    kv = _sds((b, s, kv_heads or h, d), jnp.bfloat16, mesh)
+    segs = _sds((b, s), jnp.int32, mesh) if seg else None
+
+    def loss(q, k, v, *s_):
+        out = fa(q, k, v, causal=True, interpret=False,
+                 segment_ids=s_[0] if s_ else None,
+                 block_q=block_q, block_k=block_k)
+        return out.astype(jnp.float32).sum()
+
+    args = (q, kv, kv) + ((segs,) if seg else ())
+    f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    t0 = time.perf_counter()
+    # "default" precision: Mosaic rejects bf16 dots under the HIGHEST
+    # matmul precision some test harnesses set globally ("Bad lhs type")
+    with jax.default_matmul_precision("default"):
+        f.lower(*args).compile()
+    return {"compile_s": round(time.perf_counter() - t0, 1)}
+
+
+def check_fused_ce(devs, *, n=4096, e=768, v=50257):
+    from hetu_tpu.ops.fused_ce_pallas import fused_lm_ce
+    mesh = _one_dev_mesh(devs)
+    h = _sds((1, n, e), jnp.bfloat16, mesh)
+    w = _sds((v, e), jnp.float32, mesh)
+    lab = _sds((1, n), jnp.int32, mesh)
+
+    def loss(h, w, lab):
+        return fused_lm_ce(h, w, lab, interpret=False)
+
+    f = jax.jit(jax.grad(loss, argnums=(0, 1)))
+    t0 = time.perf_counter()
+    with jax.default_matmul_precision("default"):
+        f.lower(h, w, lab).compile()
+    return {"compile_s": round(time.perf_counter() - t0, 1)}
+
+
+def check_step(devs, strategy, *, batch, seq, cfgkw=None,
+               attn_impl="pallas"):
+    """AOT-compile a full train step for the topology; memory rows.
+
+    Sets (and restores) ``HETU_PALLAS_INTERPRET=0`` around the compile:
+    inside the step the kernels take the interpret DEFAULT, which on
+    this CPU-backend process would silently swap in the interpret
+    lowering and validate nothing. Scoped here — a module-level set
+    would leak into any process importing this file (e.g. the test
+    suite, poisoning later interpret-mode kernel tests)."""
+    from workloads.pp_memory import analyze
+    from hetu_tpu.core.dtypes import Policy
+    from hetu_tpu.models import GPTConfig
+
+    cfg = GPTConfig(vocab_size=50257, max_positions=seq, hidden_size=768,
+                    num_layers=12, num_heads=12, **(cfgkw or {}))
+    pol = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)
+    prev = os.environ.get("HETU_PALLAS_INTERPRET")
+    os.environ["HETU_PALLAS_INTERPRET"] = "0"
+    try:
+        with jax.default_matmul_precision("default"):
+            return analyze(cfg, strategy, devs, batch=batch, seq=seq,
+                           policy=pol, attn_impl=attn_impl)
+    finally:
+        if prev is None:
+            os.environ.pop("HETU_PALLAS_INTERPRET", None)
+        else:
+            os.environ["HETU_PALLAS_INTERPRET"] = prev
+
+
+def tuned_block_checks():
+    """One flash check per tuned entry in flash_blocks.json (both fwd
+    and bwd blocks) at that entry's seq — a tuned config that stops
+    Mosaic-compiling must fail here, not mid-window."""
+    from hetu_tpu.core.measured import read_measured
+    data = read_measured("flash_blocks.json")
+    out = []
+    for e in (data or {}).get("entries", []):
+        # a malformed entry must cost only itself, not the whole gate
+        try:
+            seq = int(e["seq"])
+            for kind in ("fwd", "bwd"):
+                if kind in e:
+                    bq, bk = (int(x) for x in e[kind])
+                    out.append((f"flash_tuned_{kind}_s{seq}_q{bq}k{bk}",
+                                dict(shape=(1, seq, 8, 64), block_q=bq,
+                                     block_k=bk)))
+        except (KeyError, TypeError, ValueError) as err:
+            print(f"skipping malformed flash_blocks entry {e!r}: {err}",
+                  flush=True)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="kernel checks only (skip whole-step compiles)")
+    args = ap.parse_args()
+
+    from jax.experimental import topologies
+
+    from hetu_tpu.parallel.strategy import Strategy
+
+    topo1 = topologies.get_topology_desc("v5e:2x2", "tpu")
+    topo8 = topologies.get_topology_desc("v5e:2x4", "tpu")
+    d1 = list(topo1.devices)
+    d8 = list(topo8.devices)
+
+    checks = [
+        ("flash_causal_bench", lambda: check_flash(d1)),
+        ("flash_gqa4", lambda: check_flash(d1, shape=(2, 1024, 8, 64),
+                                           kv_heads=2)),
+        ("flash_packed_segids", lambda: check_flash(d1, seg=True)),
+        ("flash_d128", lambda: check_flash(d1, shape=(2, 1024, 8, 128))),
+        ("fused_ce_bench_vocab", lambda: check_fused_ce(d1)),
+    ]
+    checks += [(name, lambda kw=kw: check_flash(d1, **kw))
+               for name, kw in tuned_block_checks()]
+    if not args.quick:
+        checks += [
+            ("step_dp2tp2cp2_ring_v5e8",
+             lambda: check_step(d8, Strategy(dp=2, tp=2, cp=2,
+                                             remat="selective"),
+                                batch=8, seq=1024)),
+            ("step_bench_winner_b32",
+             lambda: check_step(d1[:1], Strategy(remat="selective",
+                                                 unroll=True),
+                                batch=32, seq=1024)),
+        ]
+
+    rows = []
+    for name, fn in checks:
+        try:
+            r = fn()
+        except Exception as e:
+            r = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+        rows.append({"check": name, **r})
+        status = r.get("error", f"ok {r.get('compile_s', '?')}s")
+        extra = ""
+        if "peak_bytes_est" in r:
+            extra = f"  peak {r['peak_bytes_est'] / 1024**3:.2f} GiB"
+        print(f"{name:>32}: {status}{extra}", flush=True)
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "out", "aot_check.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"rows": rows}, f, indent=1)
+    n_err = sum("error" in r for r in rows)
+    print(f"{len(rows) - n_err}/{len(rows)} checks compiled; wrote {path}")
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -50,8 +50,13 @@ import jax.numpy as jnp
 HBM_V5E = int(15.75 * 1024 ** 3)
 
 
-def analyze(cfg, strategy, topo_devices, *, batch, seq, policy):
-    """AOT-compile the train step for the topology; return memory rows."""
+def analyze(cfg, strategy, topo_devices, *, batch, seq, policy,
+            attn_impl: str = "reference"):
+    """AOT-compile the train step for the topology; return memory rows.
+
+    ``attn_impl="pallas"`` compiles the real Mosaic kernels (pair with
+    ``HETU_PALLAS_INTERPRET=0`` — see ``aot_check.py``); the default
+    reference path measures HBM without kernel lowering in the loop."""
     from hetu_tpu import optim
     from hetu_tpu.core.dtypes import autocast
     from hetu_tpu.engine.state import new_train_state
@@ -66,7 +71,7 @@ def analyze(cfg, strategy, topo_devices, *, batch, seq, policy):
     # would compile (and be measured) at fp32 compute
     with autocast(policy):
         plan = make_plan(model, opt, strategy, devices=topo_devices)
-        step = build_train_step(model, opt, plan, attn_impl="reference")
+        step = build_train_step(model, opt, plan, attn_impl=attn_impl)
 
         shapes = jax.eval_shape(
             lambda k: new_train_state(model.init(k), opt),

@@ -40,3 +40,22 @@ def test_dryrun_multichip_smoke():
     sys.path.insert(0, _ROOT)
     import __graft_entry__ as g
     g.dryrun_multichip(8)
+
+
+def test_sweep_infeasible_table_guards(tmp_path):
+    """mfu_sweep's AOT-feasibility skip: only 'fits: false' rows at the
+    SAME seq are trusted; anything else (other seq, torn file, fits
+    null) must not suppress a measurement."""
+    import json
+    from workloads.mfu_sweep import _load_infeasible
+
+    p = tmp_path / "sweep_feasible.json"
+    p.write_text(json.dumps({"seq": 1024, "rows": {
+        "64:selective:1:fp32": {"fits": False},
+        "32:selective:1:fp32": {"fits": True},
+        "48:selective:1:fp32": {"fits": None, "error": "x"}}}))
+    assert _load_infeasible(1024, str(p)) == {"64:selective:1:fp32"}
+    assert _load_infeasible(2048, str(p)) == set()      # other seq
+    p.write_text("{torn")
+    assert _load_infeasible(1024, str(p)) == set()      # torn file
+    assert _load_infeasible(1024, str(tmp_path / "no.json")) == set()

@@ -32,17 +32,31 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import contextlib
 
 import jax
-
-# axon sitecustomize overrides JAX_PLATFORMS; stay on the CPU backend —
-# nothing executes, only the AOT target is TPU (see pp_memory.py)
-jax.config.update("jax_platforms", "cpu")
-
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@contextlib.contextmanager
+def _mosaic_aot_env():
+    """Compile the REAL kernels from a CPU-backend process: force the
+    interpret default off (restored on exit — a process-wide set would
+    leak into importers, e.g. the test suite's interpret-mode kernel
+    tests) and scope matmul precision to "default" (Mosaic rejects bf16
+    dots under the global HIGHEST some harnesses set)."""
+    prev = os.environ.get("HETU_PALLAS_INTERPRET")
+    os.environ["HETU_PALLAS_INTERPRET"] = "0"
+    try:
+        with jax.default_matmul_precision("default"):
+            yield
+    finally:
+        if prev is None:
+            os.environ.pop("HETU_PALLAS_INTERPRET", None)
+        else:
+            os.environ["HETU_PALLAS_INTERPRET"] = prev
 
 
 def _one_dev_mesh(devs):
@@ -72,9 +86,7 @@ def check_flash(devs, *, shape=(4, 1024, 12, 64), kv_heads=None,
     args = (q, kv, kv) + ((segs,) if seg else ())
     f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
     t0 = time.perf_counter()
-    # "default" precision: Mosaic rejects bf16 dots under the HIGHEST
-    # matmul precision some test harnesses set globally ("Bad lhs type")
-    with jax.default_matmul_precision("default"):
+    with _mosaic_aot_env():
         f.lower(*args).compile()
     return {"compile_s": round(time.perf_counter() - t0, 1)}
 
@@ -91,7 +103,7 @@ def check_fused_ce(devs, *, n=4096, e=768, v=50257):
 
     f = jax.jit(jax.grad(loss, argnums=(0, 1)))
     t0 = time.perf_counter()
-    with jax.default_matmul_precision("default"):
+    with _mosaic_aot_env():
         f.lower(h, w, lab).compile()
     return {"compile_s": round(time.perf_counter() - t0, 1)}
 
@@ -113,17 +125,32 @@ def check_step(devs, strategy, *, batch, seq, cfgkw=None,
     cfg = GPTConfig(vocab_size=50257, max_positions=seq, hidden_size=768,
                     num_layers=12, num_heads=12, **(cfgkw or {}))
     pol = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)
-    prev = os.environ.get("HETU_PALLAS_INTERPRET")
-    os.environ["HETU_PALLAS_INTERPRET"] = "0"
-    try:
-        with jax.default_matmul_precision("default"):
-            return analyze(cfg, strategy, devs, batch=batch, seq=seq,
-                           policy=pol, attn_impl=attn_impl)
-    finally:
-        if prev is None:
-            os.environ.pop("HETU_PALLAS_INTERPRET", None)
-        else:
-            os.environ["HETU_PALLAS_INTERPRET"] = prev
+    with _mosaic_aot_env():
+        return analyze(cfg, strategy, devs, batch=batch, seq=seq,
+                       policy=pol, attn_impl=attn_impl)
+
+
+def check_ctx32k(devs, batch: int = 2):
+    """AOT HBM precheck of bench_suite config 5 (32k-context Llama,
+    flash + full remat, bf16) — mirrors config5_long_context's model at
+    the batch it attempts FIRST (2; measured b1 = 7.0 GiB of 15.75)."""
+    import dataclasses
+
+    from workloads.pp_memory import analyze
+    from hetu_tpu.core.dtypes import Policy
+    from hetu_tpu.models import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel.strategy import Strategy
+
+    seq = 32768
+    cfg = dataclasses.replace(LlamaConfig.tiny(), hidden_size=1024,
+                              num_heads=8, num_kv_heads=8,
+                              intermediate_size=2816, num_layers=4,
+                              max_positions=seq, vocab_size=32000)
+    pol = Policy(param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16)
+    with _mosaic_aot_env():
+        return analyze(cfg, Strategy(remat="full", unroll=True),
+                       devs, batch=batch, seq=seq, policy=pol,
+                       attn_impl="pallas", model_cls=LlamaLMHeadModel)
 
 
 def tuned_block_checks():
@@ -149,11 +176,80 @@ def tuned_block_checks():
     return out
 
 
+def sweep_feasibility(devs, *, seq=1024):
+    """Per-device HBM feasibility of the MFU sweep's contender configs,
+    compiled OFFLINE so the window never burns minutes compiling a
+    config the chip must then refuse. Writes
+    ``out/sweep_feasible.json``; ``mfu_sweep.py`` consults it and skips
+    configs recorded as not fitting."""
+    from hetu_tpu.core.dtypes import Policy
+    from hetu_tpu.models import GPTConfig
+    from hetu_tpu.parallel.strategy import Strategy
+
+    cfg = GPTConfig.small()
+    grid = [
+        (32, "selective", True, "fp32"),
+        (48, "selective", True, "fp32"),
+        (64, "selective", True, "fp32"),
+        (32, "selective", True, "bf16"),
+        (48, "selective", True, "bf16"),
+        (64, "selective", True, "bf16"),
+    ]
+    rows = {}
+    for batch, remat, unroll, pdt in grid:
+        pol = Policy(param_dtype=jnp.bfloat16 if pdt == "bf16"
+                     else jnp.float32, compute_dtype=jnp.bfloat16)
+        key = f"{batch}:{remat}:{int(unroll)}:{pdt}"
+        try:
+            from workloads.pp_memory import analyze
+            with _mosaic_aot_env():
+                r = analyze(cfg, Strategy(remat=remat, unroll=unroll),
+                            devs[:1], batch=batch, seq=seq,
+                            policy=pol, attn_impl="pallas")
+            if "error" in r:
+                # a compile-time HBM refusal IS the feasibility answer
+                oom = "RESOURCE_EXHAUSTED" in r["error"]
+                rows[key] = {"fits": False if oom else None, **r}
+            else:
+                rows[key] = {"fits": r["fits_hbm"], **r}
+        except Exception as e:
+            # a compile-time HBM refusal IS the feasibility answer even
+            # when it surfaces as an exception from the lowering
+            oom = "RESOURCE_EXHAUSTED" in str(e)
+            rows[key] = {"fits": False if oom else None,
+                         "error": f"{type(e).__name__}: {str(e)[:150]}"}
+        rec = rows[key]
+        peak = rec.get("peak_bytes_est")
+        print(f"{key:>24}: fits={rec['fits']}"
+              + (f" peak {peak / 1024**3:.2f} GiB" if peak else "")
+              + (f" ({rec['error'][:60]})" if "error" in rec else ""),
+              flush=True)
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "out", "sweep_feasible.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"seq": seq, "attn": "pallas", "rows": rows}, f,
+                  indent=1)
+    print(f"wrote {path}")
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="kernel checks only (skip whole-step compiles)")
+    ap.add_argument("--sweep-feasibility", action="store_true",
+                    help="compile the sweep contender grid for HBM "
+                         "feasibility (writes out/sweep_feasible.json)")
     args = ap.parse_args()
+
+    # script-entry only (a module-level set would flip the backend of
+    # any process importing this file, e.g. the test suite): axon's
+    # sitecustomize overrides JAX_PLATFORMS, so pin via the config API —
+    # nothing here executes on device, only the AOT target is TPU
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
     from jax.experimental import topologies
 
@@ -163,6 +259,11 @@ def main():
     topo8 = topologies.get_topology_desc("v5e:2x4", "tpu")
     d1 = list(topo1.devices)
     d8 = list(topo8.devices)
+
+    if args.sweep_feasibility:
+        rows = sweep_feasibility(d1)
+        return 1 if any(r["fits"] is None and "error" in r
+                        for r in rows.values()) else 0
 
     checks = [
         ("flash_causal_bench", lambda: check_flash(d1)),
@@ -184,6 +285,9 @@ def main():
              lambda: check_step(d1[:1], Strategy(remat="selective",
                                                  unroll=True),
                                 batch=32, seq=1024)),
+            # BASELINE config 5 precheck: the 32k-context single-chip
+            # path must fit HBM before a window burns time finding out
+            ("step_ctx32k_feasible", lambda: check_ctx32k(d1[:1])),
         ]
 
     rows = []
@@ -199,8 +303,11 @@ def main():
             extra = f"  peak {r['peak_bytes_est'] / 1024**3:.2f} GiB"
         print(f"{name:>32}: {status}{extra}", flush=True)
 
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "out", "aot_check.json")
+    # --quick covers only the kernel rows: keep it out of the full
+    # matrix's artifact so docs citing aot_check.json stay reproducible
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "out",
+                        "aot_check_quick.json" if args.quick
+                        else "aot_check.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "w") as f:
         json.dump({"rows": rows}, f, indent=1)

@@ -195,13 +195,25 @@ def config5_long_context(on_tpu):
                               intermediate_size=2816, num_layers=4,
                               max_positions=seq, vocab_size=32000)
     model = LlamaLMHeadModel(cfg)
-    r = _lm_bench(model, cfg, Strategy(remat="full", unroll=True), 1, seq,
-                  steps=5, warmup=2,
-                  policy=Policy(param_dtype=jnp.bfloat16,
-                                compute_dtype=jnp.bfloat16))
-    return {"config": 5, "metric": "ctx32k_tokens_per_sec",
-            "value": r["tokens_per_sec"], "unit": "tokens/sec",
-            "seq_len": seq, **r}
+    # AOT analysis (workloads/aot_check.py check_ctx32k) measured batch 1
+    # at 7.0 GiB of 15.75 peak — batch 2 should fit and ~double tokens/s;
+    # chain down on OOM so the measurement is never lost to the attempt
+    from bench import is_oom
+    last = None
+    for b in ((2, 1) if on_tpu else (1,)):
+        try:
+            r = _lm_bench(model, cfg, Strategy(remat="full", unroll=True),
+                          b, seq, steps=5, warmup=2,
+                          policy=Policy(param_dtype=jnp.bfloat16,
+                                        compute_dtype=jnp.bfloat16))
+            return {"config": 5, "metric": "ctx32k_tokens_per_sec",
+                    "value": r["tokens_per_sec"], "unit": "tokens/sec",
+                    "seq_len": seq, "batch": b, **r}
+        except Exception as e:
+            if not is_oom(e):
+                raise
+            last = e
+    raise last
 
 
 def main():

@@ -133,7 +133,19 @@ def main():
     print(f"{'batch':>5} {'remat':>10} {'unroll':>6} {'attn':>9} "
           f"{'step_ms':>8} {'tok/s':>9} {'mfu':>6}")
     results = []
+    infeasible = _load_infeasible(args.seq)
     for batch, remat, unroll, attn in grid:
+        # offline AOT feasibility (aot_check.py --sweep-feasibility):
+        # a config the compiler already refused for HBM must not burn
+        # window minutes re-discovering that on the chip
+        # the feasibility grid compiled pallas attention + chunked CE;
+        # a fused-CE sweep uses LESS memory, so the skip would be wrong
+        if attn in ("auto", "pallas") and args.ce == "chunked" and \
+                f"{batch}:{remat}:{int(unroll)}:{args.param_dtype}" \
+                in infeasible:
+            print(f"{batch:>5} {remat:>10} {unroll!s:>6} {attn:>9}   "
+                  f"SKIP (AOT: does not fit HBM)", flush=True)
+            continue
         cmd = [sys.executable, os.path.abspath(__file__),
                "--one", f"{batch}:{remat}:{int(unroll)}:{attn}",
                "--steps", str(args.steps), "--warmup", str(args.warmup),
@@ -175,6 +187,25 @@ def main():
         print(f"best: batch={best[1]} remat={best[2]} unroll={best[3]} "
               f"attn={best[4]} mfu={best[0]:.4f} on {best[5]}")
         _record_best(best, args.param_dtype, args.ce)
+
+
+def _load_infeasible(seq: int, path: str = None) -> set:
+    """Config keys ("batch:remat:unroll:param_dtype") the offline AOT
+    pass recorded as NOT fitting HBM — only trusted at the same seq and
+    for the pallas attention path the feasibility grid compiled."""
+    import json
+    path = path or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "out",
+        "sweep_feasible.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if data.get("seq") != seq:
+            return set()
+        return {k for k, r in data.get("rows", {}).items()
+                if r.get("fits") is False}
+    except (OSError, ValueError, AttributeError):
+        return set()
 
 
 def _record_best(best, param_dtype, ce_impl="chunked"):

@@ -33,16 +33,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
 import jax
-
-# the axon sitecustomize overrides JAX_PLATFORMS; without this, any
-# jax.devices() call inside plan building initializes the relay backend
-# and HANGS when the tunnel is down. This workload never executes on
-# device — the process backend stays CPU, only the AOT target is TPU.
-jax.config.update("jax_platforms", "cpu")
-
 import jax.numpy as jnp
 
 # XLA's own per-chip budget for v5e ("Used ... of 15.75G hbm" in its
@@ -51,7 +42,7 @@ HBM_V5E = int(15.75 * 1024 ** 3)
 
 
 def analyze(cfg, strategy, topo_devices, *, batch, seq, policy,
-            attn_impl: str = "reference"):
+            attn_impl: str = "reference", model_cls=None):
     """AOT-compile the train step for the topology; return memory rows.
 
     ``attn_impl="pallas"`` compiles the real Mosaic kernels (pair with
@@ -63,7 +54,7 @@ def analyze(cfg, strategy, topo_devices, *, batch, seq, policy,
     from hetu_tpu.engine.train_step import build_train_step, make_plan
     from hetu_tpu.models import GPTLMHeadModel
 
-    model = GPTLMHeadModel(cfg)
+    model = (model_cls or GPTLMHeadModel)(cfg)
     opt = optim.adamw(1e-4)
     # the WHOLE lower+compile must stay inside the policy context: the
     # modules read the thread-local compute dtype at TRACE time, and
@@ -118,6 +109,14 @@ def main():
     ap.add_argument("--nm", type=int, default=8)
     ap.add_argument("--topology", default="v5e:2x4")
     args = ap.parse_args()
+
+    # script-entry only (a module-level set would flip the backend of any
+    # importer, e.g. the test suite): axon's sitecustomize overrides
+    # JAX_PLATFORMS, and without the config pin any jax.devices() call in
+    # plan building initializes the relay backend and HANGS when the
+    # tunnel is down. Nothing executes on device — the AOT target is TPU.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
     from jax.experimental import topologies
 

@@ -18,6 +18,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
 
@@ -85,11 +86,104 @@ def flash_attention(q, k, v, *, causal: bool = False,
         # than interpret-mode Pallas.
         impl = "pallas" if _on_tpu() and _pallas_supported(q, k) else "reference"
     if impl == "pallas":
+        out = _pallas_sharded_call(q, k, v, causal=causal,
+                                   segment_ids=segment_ids, scale=scale)
+        if out is not None:
+            return out
         from hetu_tpu.ops.flash_pallas import flash_attention_pallas
         return flash_attention_pallas(q, k, v, causal=causal,
                                       segment_ids=segment_ids, scale=scale)
     return attention_reference(q, k, v, causal=causal,
                                segment_ids=segment_ids, scale=scale)
+
+
+def _pallas_sharded_call(q, k, v, *, causal, segment_ids, scale):
+    """Run the Pallas kernel per-device under ``shard_map`` when the
+    batch/head dims are mesh-sharded.
+
+    XLA:TPU cannot auto-partition Mosaic kernels ("Mosaic kernels cannot
+    be automatically partitioned. Please wrap the call in a shard_map"),
+    so the plain GSPMD path — dp/tp sharding with cp=1, and the pipeline
+    executor's partial-manual region whose dp/tp stay auto — MUST wrap
+    the call; the CPU mesh never sees this because interpret-mode Pallas
+    lowers to partitionable jax ops (caught by the offline AOT matrix,
+    ``workloads/aot_check.py``). Returns None when no wrap is needed
+    (no sharding context, single-device axes, or non-divisible dims —
+    the plain call is then the status quo). The cp>1 seq-sharded cases
+    never reach here (ring/ulysses own them and bind the mesh manual
+    themselves)."""
+    from hetu_tpu.parallel.sharding import (
+        current_act_sharding, current_manual_axes,
+    )
+
+    ctx = current_act_sharding()
+    mctx = current_manual_axes()
+    if ctx is not None:
+        mesh = ctx.mesh
+        batch_ax = ctx.batch
+        head_ax = ctx.tp if isinstance(ctx.tp, str) else None
+        # seq sharded → the ring/ulysses paths own the kernel call
+        if isinstance(ctx.seq, str) and mesh.shape.get(ctx.seq, 1) > 1:
+            return None
+    elif mctx is not None:
+        # partial-manual pipeline region: pp/cp/ep are bound, dp/tp are
+        # auto — bind what remains so the kernel call is fully local
+        mesh = mctx.mesh
+        unbound = [a for a in mesh.shape if a not in mctx.axes]
+        batch_ax = tuple(a for a in unbound if a in ("dp", "ep")) or None
+        head_ax = "tp" if "tp" in unbound else None
+    else:
+        return None
+
+    def size_of(ax):
+        if ax is None:
+            return 1
+        names = ax if isinstance(ax, (tuple, list)) else (ax,)
+        n = 1
+        for a in names:
+            n *= mesh.shape.get(a, 1)
+        return n
+
+    nb, nh = size_of(batch_ax), size_of(head_ax)
+    if nb * nh == 1:
+        return None
+    b, _, hq, _ = q.shape
+    hkv = k.shape[2]
+    if b % nb or hq % nh or hkv % nh:
+        return None
+
+    from jax import shard_map
+
+    from hetu_tpu.ops.flash_pallas import flash_attention_pallas
+
+    # bind EVERY axis not already manual: a partial-manual region still
+    # counts as "auto" to the partitioner even over size-1 axes, and a
+    # pallas call inside one is rejected just the same
+    bound = mctx.axes if (ctx is None and mctx is not None) else frozenset()
+    axis_names = {a for a in mesh.shape if a not in bound}
+    if bound:
+        # nested shard_map (inside the pipeline's partial-manual region)
+        # must receive the CONTEXT mesh — the abstract mesh whose bound
+        # axes are already marked Manual — not the concrete Mesh
+        from jax.sharding import get_abstract_mesh
+        mesh = get_abstract_mesh()
+    qkv_spec = P(batch_ax, None, head_ax, None)
+
+    def local(q, k, v, *seg):
+        return flash_attention_pallas(
+            q, k, v, causal=causal, scale=scale,
+            segment_ids=seg[0] if seg else None)
+
+    if segment_ids is None:
+        fn = shard_map(local, mesh=mesh, in_specs=(qkv_spec,) * 3,
+                       out_specs=qkv_spec, axis_names=axis_names,
+                       check_vma=False)
+        return fn(q, k, v)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(qkv_spec,) * 3 + (P(batch_ax, None),),
+                   out_specs=qkv_spec, axis_names=axis_names,
+                   check_vma=False)
+    return fn(q, k, v, segment_ids)
 
 
 @functools.cache

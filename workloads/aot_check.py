@@ -131,26 +131,20 @@ def check_step(devs, strategy, *, batch, seq, cfgkw=None,
 
 
 def check_ctx32k(devs, batch: int = 2):
-    """AOT HBM precheck of bench_suite config 5 (32k-context Llama,
-    flash + full remat, bf16) — mirrors config5_long_context's model at
-    the batch it attempts FIRST (2; measured b1 = 7.0 GiB of 15.75)."""
-    import dataclasses
-
+    """AOT HBM precheck of bench_suite config 5 at the batch it
+    attempts FIRST — the model/strategy/policy come from the bench's
+    own ``config5_spec`` so the precheck can never validate a stale
+    config."""
+    from workloads.bench_suite import config5_spec
     from workloads.pp_memory import analyze
-    from hetu_tpu.core.dtypes import Policy
-    from hetu_tpu.models import LlamaConfig, LlamaLMHeadModel
-    from hetu_tpu.parallel.strategy import Strategy
+    from hetu_tpu.models import LlamaLMHeadModel
 
     seq = 32768
-    cfg = dataclasses.replace(LlamaConfig.tiny(), hidden_size=1024,
-                              num_heads=8, num_kv_heads=8,
-                              intermediate_size=2816, num_layers=4,
-                              max_positions=seq, vocab_size=32000)
-    pol = Policy(param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16)
+    cfg, strategy, pol = config5_spec(seq)
     with _mosaic_aot_env():
-        return analyze(cfg, Strategy(remat="full", unroll=True),
-                       devs, batch=batch, seq=seq, policy=pol,
-                       attn_impl="pallas", model_cls=LlamaLMHeadModel)
+        return analyze(cfg, strategy, devs, batch=batch, seq=seq,
+                       policy=pol, attn_impl="pallas",
+                       model_cls=LlamaLMHeadModel)
 
 
 def tuned_block_checks():
@@ -182,24 +176,19 @@ def sweep_feasibility(devs, *, seq=1024):
     config the chip must then refuse. Writes
     ``out/sweep_feasible.json``; ``mfu_sweep.py`` consults it and skips
     configs recorded as not fitting."""
+    from workloads.mfu_sweep import CONTENDER_GRID, feasibility_key
     from hetu_tpu.core.dtypes import Policy
     from hetu_tpu.models import GPTConfig
     from hetu_tpu.parallel.strategy import Strategy
 
     cfg = GPTConfig.small()
-    grid = [
-        (32, "selective", True, "fp32"),
-        (48, "selective", True, "fp32"),
-        (64, "selective", True, "fp32"),
-        (32, "selective", True, "bf16"),
-        (48, "selective", True, "bf16"),
-        (64, "selective", True, "bf16"),
-    ]
+    grid = [(b, r, u, pdt) for (b, r, u) in CONTENDER_GRID
+            for pdt in ("fp32", "bf16")]
     rows = {}
     for batch, remat, unroll, pdt in grid:
         pol = Policy(param_dtype=jnp.bfloat16 if pdt == "bf16"
                      else jnp.float32, compute_dtype=jnp.bfloat16)
-        key = f"{batch}:{remat}:{int(unroll)}:{pdt}"
+        key = feasibility_key(batch, remat, unroll, pdt)
         try:
             from workloads.pp_memory import analyze
             with _mosaic_aot_env():
@@ -214,9 +203,10 @@ def sweep_feasibility(devs, *, seq=1024):
                 rows[key] = {"fits": r["fits_hbm"], **r}
         except Exception as e:
             # a compile-time HBM refusal IS the feasibility answer even
-            # when it surfaces as an exception from the lowering
-            oom = "RESOURCE_EXHAUSTED" in str(e)
-            rows[key] = {"fits": False if oom else None,
+            # when it surfaces as an exception from the lowering;
+            # bench.is_oom also covers the relay's opaque OOM spellings
+            from bench import is_oom
+            rows[key] = {"fits": False if is_oom(e) else None,
                          "error": f"{type(e).__name__}: {str(e)[:150]}"}
         rec = rows[key]
         peak = rec.get("peak_bytes_est")
@@ -288,6 +278,20 @@ def main():
             # BASELINE config 5 precheck: the 32k-context single-chip
             # path must fit HBM before a window burns time finding out
             ("step_ctx32k_feasible", lambda: check_ctx32k(d1[:1])),
+            # the remaining dryrun strategy families, compiled for the
+            # REAL v5e-8 target (the driver's dryrun only proves the
+            # virtual CPU mesh): pipeline-in-manual-region and EP MoE
+            ("step_dp2pp2tp2_v5e8",
+             lambda: check_step(d8, Strategy(dp=2, pp=2, tp=2,
+                                             num_microbatches=2,
+                                             remat="selective"),
+                                batch=8, seq=1024)),
+            ("step_dp2pp2ep2_moe_v5e8",
+             lambda: check_step(d8, Strategy(dp=2, pp=2, ep=2,
+                                             num_microbatches=2,
+                                             remat="selective"),
+                                batch=8, seq=1024,
+                                cfgkw={"num_experts": 4})),
         ]
 
     rows = []

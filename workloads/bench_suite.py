@@ -184,28 +184,37 @@ def config4_moe(on_tpu):
             "value": r["tokens_per_sec"], "unit": "tokens/sec", **r}
 
 
-def config5_long_context(on_tpu):
-    """32k-context CP+remat regime (config 5): single-chip flash path at
-    the longest sequence that fits, remat full."""
-    from hetu_tpu.models import LlamaConfig, LlamaLMHeadModel
+def config5_spec(seq: int = 32768):
+    """(cfg, strategy, policy) of BASELINE config 5 — ONE definition
+    shared with the AOT precheck (``aot_check.check_ctx32k``), so the
+    feasibility number always describes the config the bench runs."""
     import dataclasses
-    seq = 32768 if on_tpu else 512
+
+    from hetu_tpu.models import LlamaConfig
     cfg = dataclasses.replace(LlamaConfig.tiny(), hidden_size=1024,
                               num_heads=8, num_kv_heads=8,
                               intermediate_size=2816, num_layers=4,
                               max_positions=seq, vocab_size=32000)
+    return (cfg, Strategy(remat="full", unroll=True),
+            Policy(param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16))
+
+
+def config5_long_context(on_tpu):
+    """32k-context CP+remat regime (config 5): single-chip flash path at
+    the longest sequence that fits, remat full."""
+    from hetu_tpu.models import LlamaLMHeadModel
+    seq = 32768 if on_tpu else 512
+    cfg, strategy, policy = config5_spec(seq)
     model = LlamaLMHeadModel(cfg)
-    # AOT analysis (workloads/aot_check.py check_ctx32k) measured batch 1
-    # at 7.0 GiB of 15.75 peak — batch 2 should fit and ~double tokens/s;
-    # chain down on OOM so the measurement is never lost to the attempt
+    # AOT analysis (workloads/aot_check.py check_ctx32k) measured batch 2
+    # at 10.76 GiB of 15.75 peak — try it first (~2x tokens/s); chain
+    # down on OOM so the measurement is never lost to the attempt
     from bench import is_oom
     last = None
     for b in ((2, 1) if on_tpu else (1,)):
         try:
-            r = _lm_bench(model, cfg, Strategy(remat="full", unroll=True),
-                          b, seq, steps=5, warmup=2,
-                          policy=Policy(param_dtype=jnp.bfloat16,
-                                        compute_dtype=jnp.bfloat16))
+            r = _lm_bench(model, cfg, strategy, b, seq, steps=5,
+                          warmup=2, policy=policy)
             return {"config": 5, "metric": "ctx32k_tokens_per_sec",
                     "value": r["tokens_per_sec"], "unit": "tokens/sec",
                     "seq_len": seq, "batch": b, **r}

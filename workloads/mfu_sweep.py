@@ -141,8 +141,8 @@ def main():
         # the feasibility grid compiled pallas attention + chunked CE;
         # a fused-CE sweep uses LESS memory, so the skip would be wrong
         if attn in ("auto", "pallas") and args.ce == "chunked" and \
-                f"{batch}:{remat}:{int(unroll)}:{args.param_dtype}" \
-                in infeasible:
+                feasibility_key(batch, remat, unroll,
+                                args.param_dtype) in infeasible:
             print(f"{batch:>5} {remat:>10} {unroll!s:>6} {attn:>9}   "
                   f"SKIP (AOT: does not fit HBM)", flush=True)
             continue
@@ -187,6 +187,17 @@ def main():
         print(f"best: batch={best[1]} remat={best[2]} unroll={best[3]} "
               f"attn={best[4]} mfu={best[0]:.4f} on {best[5]}")
         _record_best(best, args.param_dtype, args.ce)
+
+
+# sweep contenders at/above the current winner's batch — ONE definition
+# shared with aot_check.sweep_feasibility so the offline feasibility keys
+# always match what the sweep looks up
+CONTENDER_GRID = ((32, "selective", True), (48, "selective", True),
+                  (64, "selective", True))
+
+
+def feasibility_key(batch, remat, unroll, param_dtype) -> str:
+    return f"{batch}:{remat}:{int(unroll)}:{param_dtype}"
 
 
 def _load_infeasible(seq: int, path: str = None) -> set:

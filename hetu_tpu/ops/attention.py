@@ -113,60 +113,48 @@ def _pallas_sharded_call(q, k, v, *, causal, segment_ids, scale):
     never reach here (ring/ulysses own them and bind the mesh manual
     themselves)."""
     from hetu_tpu.parallel.sharding import (
-        current_act_sharding, current_manual_axes,
+        current_act_sharding,
     )
 
+    from hetu_tpu.parallel.sharding import _axis_size, manual_unbound_axes
+
+    b, _, hq, _ = q.shape
+    hkv = k.shape[2]
     ctx = current_act_sharding()
-    mctx = current_manual_axes()
     if ctx is not None:
         mesh = ctx.mesh
         batch_ax = ctx.batch
         head_ax = ctx.tp if isinstance(ctx.tp, str) else None
         # seq sharded → the ring/ulysses paths own the kernel call
-        if isinstance(ctx.seq, str) and mesh.shape.get(ctx.seq, 1) > 1:
+        if isinstance(ctx.seq, str) and _axis_size(mesh, ctx.seq) > 1:
             return None
-    elif mctx is not None:
-        # partial-manual pipeline region: pp/cp/ep are bound, dp/tp are
-        # auto — bind what remains so the kernel call is fully local
-        mesh = mctx.mesh
-        unbound = [a for a in mesh.shape if a not in mctx.axes]
-        batch_ax = tuple(a for a in unbound if a in ("dp", "ep")) or None
-        head_ax = "tp" if "tp" in unbound else None
+        # GSPMD with nothing to shard the call over: plain call is fine
+        if _axis_size(mesh, batch_ax) * _axis_size(mesh, head_ax) == 1:
+            return None
+        # a dim whose size doesn't divide its mesh axes is carried
+        # REPLICATED instead (shard_map gathers it; slower but correct —
+        # the raw call would not compile at all)
+        if _axis_size(mesh, batch_ax) > 1 and b % _axis_size(mesh,
+                                                            batch_ax):
+            batch_ax = None
+        nh = _axis_size(mesh, head_ax)
+        if nh > 1 and (hq % nh or hkv % nh):
+            head_ax = None
+        axis_names = set(mesh.shape)
     else:
-        return None
-
-    def size_of(ax):
-        if ax is None:
-            return 1
-        names = ax if isinstance(ax, (tuple, list)) else (ax,)
-        n = 1
-        for a in names:
-            n *= mesh.shape.get(a, 1)
-        return n
-
-    nb, nh = size_of(batch_ax), size_of(head_ax)
-    if nb * nh == 1:
-        return None
-    b, _, hq, _ = q.shape
-    hkv = k.shape[2]
-    if b % nb or hq % nh or hkv % nh:
-        return None
+        # partial-manual pipeline region: pp/cp/ep are bound, dp/tp are
+        # auto — the call must be wrapped even when the auto axes are
+        # all size 1 (a partial-manual region still counts as "auto" to
+        # the partitioner, which rejects raw Mosaic calls in it)
+        info = manual_unbound_axes(b, (hq, hkv))
+        if info is None:
+            return None
+        mesh, axis_names, batch_ax, head_ax = info
 
     from jax import shard_map
 
     from hetu_tpu.ops.flash_pallas import flash_attention_pallas
 
-    # bind EVERY axis not already manual: a partial-manual region still
-    # counts as "auto" to the partitioner even over size-1 axes, and a
-    # pallas call inside one is rejected just the same
-    bound = mctx.axes if (ctx is None and mctx is not None) else frozenset()
-    axis_names = {a for a in mesh.shape if a not in bound}
-    if bound:
-        # nested shard_map (inside the pipeline's partial-manual region)
-        # must receive the CONTEXT mesh — the abstract mesh whose bound
-        # axes are already marked Manual — not the concrete Mesh
-        from jax.sharding import get_abstract_mesh
-        mesh = get_abstract_mesh()
     qkv_spec = P(batch_ax, None, head_ax, None)
 
     def local(q, k, v, *seg):

@@ -172,6 +172,82 @@ def vocab_parallel_cross_entropy(local_logits, labels, *, axis_name: str,
     return loss, valid
 
 
+def _use_fused_ce() -> bool:
+    """Fused streaming CE is opt-in (``HETU_LM_LOSS_IMPL=fused``) and
+    needs the real Mosaic lowering: the TPU backend, or an AOT compile
+    for a TPU target signalled by ``HETU_PALLAS_INTERPRET=0``."""
+    import os
+    if os.environ.get("HETU_LM_LOSS_IMPL") != "fused":
+        return False
+    return jax.default_backend() == "tpu" \
+        or os.environ.get("HETU_PALLAS_INTERPRET") == "0"
+
+
+def _fused_token_axes(ctx):
+    """(batch_axes, seq_axes, flat_axis_list, mesh_factor) over which
+    the fused-CE tokens shard. tp is INCLUDED in the seq split even
+    though it plays no role in this unsharded-vocab branch: the head
+    weight rides in replicated, so tp ranks must compute DISJOINT token
+    slices — identical copies would make shard_map's transpose psum the
+    dW cotangent tp-fold."""
+    from hetu_tpu.parallel.sharding import _axis_size
+
+    mesh = ctx.mesh
+    b_ax = ctx.batch
+    seq_axes = []
+    for a in (ctx.seq if isinstance(ctx.seq, str) else None,
+              ctx.tp if isinstance(ctx.tp, str) else None):
+        if a is not None and _axis_size(mesh, a) > 1:
+            seq_axes.append(a)
+    s_ax = tuple(seq_axes) if seq_axes else None
+    flat = list(seq_axes)
+    if b_ax is not None:
+        flat += list(b_ax if isinstance(b_ax, (tuple, list)) else (b_ax,))
+    factor = _axis_size(mesh, b_ax) * _axis_size(mesh, s_ax)
+    return b_ax, s_ax, flat, factor
+
+
+def _fused_ce_sharded(h, w, labels, ctx, ignore_index):
+    """Per-device fused CE under ``shard_map`` (GSPMD cannot
+    auto-partition Mosaic kernels). The global mean is rebuilt from
+    per-shard (sum, count) via psum — identical numerics to the
+    unsharded mean. None when the token dims don't divide the mesh
+    axes (caller falls back to the XLA chunked path, which GSPMD
+    shards fine)."""
+    from jax import shard_map
+
+    from hetu_tpu.parallel.sharding import _axis_size
+    from hetu_tpu.ops.fused_ce_pallas import fused_lm_ce
+
+    b_ax, s_ax, axes, _factor = _fused_token_axes(ctx)
+    B, S = labels.shape
+    if B % _axis_size(ctx.mesh, b_ax) or S % _axis_size(ctx.mesh, s_ax):
+        return None
+    if _factor == 1:
+        # nothing shards the tokens (e.g. pp-only mesh): every device
+        # computes the full loss on replicated operands — the wrap
+        # exists purely to satisfy the partitioner
+        b_ax = s_ax = None
+        axes = []
+
+    def local(h, w, y):
+        mean = fused_lm_ce(h, w, y, ignore_index=ignore_index)
+        n = (y != ignore_index).sum().astype(jnp.float32)
+        num, den = mean * n, n
+        for a in axes:
+            num = jax.lax.psum(num, a)
+            den = jax.lax.psum(den, a)
+        return num / jnp.maximum(den, 1.0)
+
+    fn = shard_map(
+        local, mesh=ctx.mesh,
+        in_specs=(jax.sharding.PartitionSpec(b_ax, s_ax, None),
+                  jax.sharding.PartitionSpec(None, None),
+                  jax.sharding.PartitionSpec(b_ax, s_ax)),
+        out_specs=jax.sharding.PartitionSpec(), check_vma=False)
+    return fn(h, w, labels)
+
+
 def vocab_parallel_lm_loss(hidden, vocab_weight, labels, *,
                            ignore_index: int = -100):
     """Mean LM loss with the (V, E) head weight sharded on vocab over tp.
@@ -203,9 +279,25 @@ def vocab_parallel_lm_loss(hidden, vocab_weight, labels, *,
         # the fused Pallas streaming kernel (HETU_LM_LOSS_IMPL=fused; one
         # VMEM tile live, no chunk barrier) or XLA chunking (default)
         if vocab_weight.shape[0] >= 8192:
-            import os
-            if os.environ.get("HETU_LM_LOSS_IMPL") == "fused" \
-                    and jax.default_backend() == "tpu":
+            if _use_fused_ce():
+                if ctx is not None and ctx.mesh.size > 1:
+                    # multi-device GSPMD mesh: the Mosaic kernel cannot
+                    # be auto-partitioned — run it per-device (same P0
+                    # as ops.attention._pallas_sharded_call). This
+                    # includes token-replicated meshes (e.g. pp-only):
+                    # the raw call is rejected even with replicated
+                    # operands, so the wrap runs with all-None specs
+                    out = _fused_ce_sharded(
+                        hidden.astype(mm_dt), vocab_weight, labels, ctx,
+                        ignore_index)
+                    if out is not None:
+                        return out
+                    # non-divisible token dims: the raw Mosaic call
+                    # would not compile under GSPMD — XLA chunking
+                    # shards fine
+                    return chunked_lm_loss(hidden, vocab_weight, labels,
+                                           mm_dt=mm_dt,
+                                           ignore_index=ignore_index)
                 from hetu_tpu.ops.fused_ce_pallas import fused_lm_ce
                 return fused_lm_ce(hidden.astype(mm_dt), vocab_weight,
                                    labels, ignore_index=ignore_index)
@@ -219,9 +311,7 @@ def vocab_parallel_lm_loss(hidden, vocab_weight, labels, *,
 
     tp = ctx.tp
     v_local = vocab_weight.shape[0] // tp_deg
-    import os
-    use_fused = os.environ.get("HETU_LM_LOSS_IMPL") == "fused" \
-        and jax.default_backend() == "tpu"
+    use_fused = _use_fused_ce()
 
     @functools.partial(
         shard_map, mesh=ctx.mesh,

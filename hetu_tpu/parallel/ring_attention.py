@@ -107,26 +107,63 @@ def _hop_mask(sq, sk, causal, q_seg, kv_seg):
     return mask
 
 
-def _hop_fwd_pallas(q, k, v, q_seg, kv_seg, *, causal, scale):
+def _hop_fwd_pallas(q, k, v, q_seg, kv_seg, *, causal, scale,
+                    info=None):
     from hetu_tpu.ops.flash_pallas import _flash_fwd
-    out, lse = _flash_fwd(
-        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
-        q_seg, kv_seg, causal=causal, scale=scale)
-    return jnp.swapaxes(out, 1, 2).astype(jnp.float32), lse
+
+    def run(q, k, v, *segs):
+        out, lse = _flash_fwd(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2),
+            segs[0] if segs else None, segs[1] if segs else None,
+            causal=causal, scale=scale)
+        return jnp.swapaxes(out, 1, 2).astype(jnp.float32), lse
+
+    segs = () if q_seg is None else (q_seg, kv_seg)
+    if info is None:
+        return run(q, k, v, *segs)
+    mesh, names, b_ax, h_ax = info
+    from jax import shard_map
+    qspec = P(b_ax, None, h_ax, None)
+    fn = shard_map(
+        run, mesh=mesh,
+        in_specs=(qspec,) * 3 + (P(b_ax, None),) * len(segs),
+        out_specs=(qspec, P(b_ax, h_ax, None)),
+        axis_names=names, check_vma=False)
+    return fn(q, k, v, *segs)
 
 
 def _hop_bwd_pallas(q, k, v, q_seg, kv_seg, lse, delta, do, *,
-                    causal, scale):
+                    causal, scale, info=None):
     from hetu_tpu.ops.flash_pallas import _flash_bwd
-    qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
-    doh = jnp.swapaxes(do, 1, 2)
-    # out is only used by _flash_bwd to derive delta; we pass the combined
-    # delta explicitly, so a placeholder is fine.
-    dq, dk, dv = _flash_bwd(qh, kh, vh, q_seg, kv_seg, qh, lse, doh,
-                            causal=causal, scale=scale, delta=delta)
-    return (jnp.swapaxes(dq, 1, 2).astype(jnp.float32),
-            jnp.swapaxes(dk, 1, 2).astype(jnp.float32),
-            jnp.swapaxes(dv, 1, 2).astype(jnp.float32))
+
+    def run(q, k, v, lse, delta, do, *segs):
+        qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+        doh = jnp.swapaxes(do, 1, 2)
+        # out is only used by _flash_bwd to derive delta; we pass the
+        # combined delta explicitly, so a placeholder is fine.
+        dq, dk, dv = _flash_bwd(
+            qh, kh, vh, segs[0] if segs else None,
+            segs[1] if segs else None, qh, lse, doh,
+            causal=causal, scale=scale, delta=delta)
+        return (jnp.swapaxes(dq, 1, 2).astype(jnp.float32),
+                jnp.swapaxes(dk, 1, 2).astype(jnp.float32),
+                jnp.swapaxes(dv, 1, 2).astype(jnp.float32))
+
+    segs = () if q_seg is None else (q_seg, kv_seg)
+    if info is None:
+        return run(q, k, v, lse, delta, do, *segs)
+    mesh, names, b_ax, h_ax = info
+    from jax import shard_map
+    qspec = P(b_ax, None, h_ax, None)
+    hspec = P(b_ax, h_ax, None)
+    fn = shard_map(
+        run, mesh=mesh,
+        in_specs=(qspec,) * 3 + (hspec, hspec, qspec)
+        + (P(b_ax, None),) * len(segs),
+        out_specs=(qspec,) * 3,
+        axis_names=names, check_vma=False)
+    return fn(q, k, v, lse, delta, do, *segs)
 
 
 def _combine(out_acc, lse_acc, out_h, lse_h):
@@ -144,9 +181,14 @@ def _combine(out_acc, lse_acc, out_h, lse_h):
 
 
 def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
-                    use_pallas: bool, layout: str = "contiguous"):
-    hop_fwd = _hop_fwd_pallas if use_pallas else _hop_fwd_ref
-    hop_bwd = _hop_bwd_pallas if use_pallas else _hop_bwd_ref
+                    use_pallas: bool, layout: str = "contiguous",
+                    unbound_info=None):
+    import functools as _ft
+    if use_pallas:
+        hop_fwd = _ft.partial(_hop_fwd_pallas, info=unbound_info)
+        hop_bwd = _ft.partial(_hop_bwd_pallas, info=unbound_info)
+    else:
+        hop_fwd, hop_bwd = _hop_fwd_ref, _hop_bwd_ref
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     # zigzag only changes the *causal* structure; non-causal attention is
     # permutation-equivariant, so every hop is FULL either way.
@@ -397,8 +439,14 @@ def ring_attention_manual(q, k, v, *, axis_name: str, cp: int,
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     use_pallas = _select_impl(impl, d, q.shape[1], causal, cp, layout)
+    # captured NOW (forward trace, ManualAxes context active) and
+    # threaded into the hops — the hand-written hop-backward traces
+    # after the context exits and could not probe it itself
+    from hetu_tpu.parallel.sharding import manual_unbound_axes
+    info = manual_unbound_axes(
+        q.shape[0], (q.shape[2], k.shape[2])) if use_pallas else None
     ring = _make_ring_core(axis_name, cp, causal, scale, use_pallas,
-                           layout=layout)
+                           layout=layout, unbound_info=info)
     return ring(q, k, v, segment_ids, segment_ids)
 
 

@@ -54,12 +54,48 @@ class AxisRules:
 
 
 def _axis_size(mesh: Mesh, axis) -> int:
-    if isinstance(axis, tuple):
+    """Product of mesh-axis sizes for an axis name / tuple / None; axes
+    absent from the mesh count as 1 (shared by the rule table and the
+    Pallas shard_map wrappers in ops.attention / ops.losses)."""
+    if axis is None:
+        return 1
+    if isinstance(axis, (tuple, list)):
         n = 1
         for a in axis:
-            n *= mesh.shape[a]
+            n *= mesh.shape.get(a, 1)
         return n
-    return mesh.shape[axis]
+    return mesh.shape.get(axis, 1)
+
+
+def manual_unbound_axes(b: int, heads) -> Optional[tuple]:
+    """(abstract_mesh, axis_names, batch_ax, head_ax) when the trace is
+    inside a partial-manual region (the pipeline executor) that left
+    mesh axes auto — GSPMD rejects raw Mosaic kernels even over size-1
+    auto axes, so Pallas call sites nest their own fully-local
+    ``shard_map`` over the remaining axes (collectives stay OUTSIDE the
+    nested region). None when not in a manual region or nothing is
+    unbound. ``b``/``heads``: the batch size and every head count that
+    must divide their axes — a non-divisible dim rides replicated
+    (slower, still correct). Shared by ``ops.attention`` and
+    ``parallel.ring_attention``; call at FORWARD trace time and thread
+    the result (hand-written backwards trace after the context exits).
+    """
+    mctx = current_manual_axes()
+    if mctx is None:
+        return None
+    unbound = [a for a in mctx.mesh.shape if a not in mctx.axes]
+    if not unbound:
+        return None
+    batch_ax = tuple(a for a in unbound if a in ("dp", "ep")) or None
+    head_ax = "tp" if "tp" in unbound else None
+    nb = _axis_size(mctx.mesh, batch_ax)
+    nh = _axis_size(mctx.mesh, head_ax)
+    if nb > 1 and b % nb:
+        batch_ax = None
+    if nh > 1 and any(h % nh for h in heads):
+        head_ax = None
+    from jax.sharding import get_abstract_mesh
+    return get_abstract_mesh(), set(unbound), batch_ax, head_ax
 
 
 def param_partition_specs(module: Module, rules: AxisRules,

@@ -109,7 +109,7 @@ def check_fused_ce(devs, *, n=4096, e=768, v=50257):
 
 
 def check_step(devs, strategy, *, batch, seq, cfgkw=None,
-               attn_impl="pallas"):
+               attn_impl="pallas", ce="chunked"):
     """AOT-compile a full train step for the topology; memory rows.
 
     Sets (and restores) ``HETU_PALLAS_INTERPRET=0`` around the compile:
@@ -117,7 +117,9 @@ def check_step(devs, strategy, *, batch, seq, cfgkw=None,
     this CPU-backend process would silently swap in the interpret
     lowering and validate nothing. Scoped here — a module-level set
     would leak into any process importing this file (e.g. the test
-    suite, poisoning later interpret-mode kernel tests)."""
+    suite, poisoning later interpret-mode kernel tests).
+    ``ce="fused"`` compiles the streaming fused-CE Mosaic kernel the
+    sweep can adopt (its GSPMD wrap is a distinct P0 surface)."""
     from workloads.pp_memory import analyze
     from hetu_tpu.core.dtypes import Policy
     from hetu_tpu.models import GPTConfig
@@ -125,9 +127,19 @@ def check_step(devs, strategy, *, batch, seq, cfgkw=None,
     cfg = GPTConfig(vocab_size=50257, max_positions=seq, hidden_size=768,
                     num_layers=12, num_heads=12, **(cfgkw or {}))
     pol = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)
-    with _mosaic_aot_env():
-        return analyze(cfg, strategy, devs, batch=batch, seq=seq,
-                       policy=pol, attn_impl=attn_impl)
+    prev_ce = os.environ.get("HETU_LM_LOSS_IMPL")
+    if ce == "fused":
+        os.environ["HETU_LM_LOSS_IMPL"] = "fused"
+    try:
+        with _mosaic_aot_env():
+            return analyze(cfg, strategy, devs, batch=batch, seq=seq,
+                           policy=pol, attn_impl=attn_impl)
+    finally:
+        if ce == "fused":
+            if prev_ce is None:
+                os.environ.pop("HETU_LM_LOSS_IMPL", None)
+            else:
+                os.environ["HETU_LM_LOSS_IMPL"] = prev_ce
 
 
 def check_ctx32k(devs, batch: int = 2):
@@ -292,6 +304,25 @@ def main():
                                              remat="selective"),
                                 batch=8, seq=1024,
                                 cfgkw={"num_experts": 4})),
+            # ring attention per stage inside the pipeline region (the
+            # hop kernels carry their own nested shard_map; the wrap
+            # decision is captured at forward trace — see
+            # parallel.sharding.manual_unbound_axes)
+            ("step_dp2pp2cp2_ring_v5e8",
+             lambda: check_step(d8, Strategy(dp=2, pp=2, cp=2,
+                                             num_microbatches=2,
+                                             remat="selective"),
+                                batch=8, seq=1024)),
+            # the fused-CE Mosaic kernel's GSPMD wraps: token-sharded
+            # (dp) and token-REPLICATED multi-device (pp-only) meshes
+            ("step_dp4_fusedce_v5e",
+             lambda: check_step(d1, Strategy(dp=4, remat="selective"),
+                                batch=8, seq=1024, ce="fused")),
+            ("step_pp2_fusedce_v5e",
+             lambda: check_step(d1[:2], Strategy(pp=2,
+                                                 num_microbatches=2,
+                                                 remat="selective"),
+                                batch=8, seq=1024, ce="fused")),
         ]
 
     rows = []

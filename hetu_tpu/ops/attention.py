@@ -113,10 +113,8 @@ def _pallas_sharded_call(q, k, v, *, causal, segment_ids, scale):
     never reach here (ring/ulysses own them and bind the mesh manual
     themselves)."""
     from hetu_tpu.parallel.sharding import (
-        current_act_sharding,
+        _axis_size, current_act_sharding, manual_unbound_axes,
     )
-
-    from hetu_tpu.parallel.sharding import _axis_size, manual_unbound_axes
 
     b, _, hq, _ = q.shape
     hkv = k.shape[2]

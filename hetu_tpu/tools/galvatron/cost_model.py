@@ -32,6 +32,10 @@ CALIBRATION_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))), "workloads", "out",
     "calibration.json")
+# Memory-model correction measured against AOT compiler ground truth
+# (workloads/mem_calibrate.py — needs no TPU window: libtpu is local).
+MEM_CALIBRATION_PATH = os.path.join(
+    os.path.dirname(CALIBRATION_PATH), "mem_calibration.json")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +49,27 @@ class TPUTopology:
     hbm_bytes: float = 95e9
     mxu_efficiency: float = 0.5       # achievable fraction of peak
     dp_overlap: float = 0.7           # grad-allreduce overlap with bwd
+    # activation-memory correction vs the analytic model, measured by
+    # AOT-compiling real train steps and reading XLA's memory analysis
+    # (workloads/mem_calibrate.py → mem_calibration.json); 1.0 = trust
+    # the analytic act model. Applied multiplicatively to mem_act.
+    # ``mem_scale_remat``: per-remat refinements as (remat, scale)
+    # pairs — the analytic act_factor RATIOS between remat modes are
+    # also off, so one global scale cannot match all three.
+    mem_scale: float = 1.0
+    mem_scale_remat: tuple = ()
+
+    def act_scale(self, remat: str) -> float:
+        for r, s in self.mem_scale_remat:
+            if r == remat:
+                return s
+        if self.mem_scale_remat:
+            # a remat mode the calibration never measured (e.g.
+            # offload) must not inherit the global max — that would
+            # reject candidates on a correction with no measurement
+            # behind it; analytic (1.0) is the honest default there
+            return 1.0
+        return self.mem_scale
 
     @classmethod
     def calibrated(cls, num_devices: int,
@@ -64,6 +89,19 @@ class TPUTopology:
                     fields[k] = float(cal[k])
         except (OSError, ValueError, TypeError, KeyError):
             fields = {}     # torn/hand-edited file → spec defaults whole
+        try:
+            with open(MEM_CALIBRATION_PATH) as f:
+                mc = json.load(f)
+            # parse fully before assigning: a torn file must not apply
+            # half (global scale without its per-remat refinements)
+            mem_scale = float(mc["mem_scale"])
+            mem_scale_remat = tuple(
+                (str(r), float(s))
+                for r, s in mc.get("remat_scales", {}).items())
+            fields["mem_scale"] = mem_scale
+            fields["mem_scale_remat"] = mem_scale_remat
+        except (OSError, ValueError, TypeError, KeyError):
+            pass
         fields.update(overrides)
         return cls(num_devices=num_devices, **fields)
 
@@ -212,10 +250,14 @@ def estimate(dims: ModelDims, strategy: Strategy,
                   "offload": 1.0}.get(s.remat, 14.0)
     mem_act_mb = b_loc / nm * seq_loc * h * act_factor \
         * layers_per_stage * dims.bytes_per_el / s.tp
-    # the scan pipeline keeps activations for every in-flight tick;
-    # plain grad accumulation keeps one microbatch live at a time
-    live_mb = (nm + s.pp - 1) if (s.pp > 1 and s.remat == "none") else 1
-    mem_act = mem_act_mb * live_mb
+    # the scan-flush pipeline keeps every microbatch's residuals live
+    # until its backward, REGARDLESS of remat (remat shrinks the per-mb
+    # residual footprint — the act_factor above — not the schedule's
+    # liveness; validated against XLA memory_analysis, which REFUSES
+    # pp4-none at GPT-2-small scale while the old remat-gated formula
+    # predicted 1 GiB). Plain grad accumulation keeps one microbatch.
+    live_mb = (nm + s.pp - 1) if s.pp > 1 else 1
+    mem_act = mem_act_mb * live_mb * topo.act_scale(s.remat)
     mem = mem_params + mem_opt + mem_act
 
     return CostBreakdown(step, t_compute * bubble, t_tp * bubble,

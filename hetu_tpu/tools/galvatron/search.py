@@ -93,10 +93,31 @@ def enumerate_candidates(dims: ModelDims, topo: TPUTopology, *,
 def search_uniform(dims: ModelDims, topo: TPUTopology, *,
                    mem_budget: Optional[float] = None,
                    **kw) -> list[Candidate]:
-    """All feasible candidates, fastest first. ``[0]`` is the pick."""
+    """All feasible candidates, fastest first. ``[0]`` is the pick.
+
+    The memory constraint uses the AOT-measured activation scales when
+    a calibration is loaded (``mem_calibration.json`` — conservative:
+    fitted on a 124M model, so it can over-reject at much larger
+    scales). If NO candidate survives the calibrated constraint, the
+    search falls back to the uncalibrated analytic model with a warning
+    instead of starving the caller — a best-effort plan beats none, and
+    the warning tells the operator which regime they are in."""
     budget = mem_budget if mem_budget is not None else topo.hbm_bytes
     cands = [c for c in enumerate_candidates(dims, topo, **kw)
              if c.cost.mem_per_device <= budget]
+    if not cands and (topo.mem_scale != 1.0 or topo.mem_scale_remat):
+        import dataclasses
+        import warnings
+        relaxed = dataclasses.replace(topo, mem_scale=1.0,
+                                      mem_scale_remat=())
+        cands = [c for c in enumerate_candidates(dims, relaxed, **kw)
+                 if c.cost.mem_per_device <= budget]
+        if cands:
+            warnings.warn(
+                "no strategy fits under the CALIBRATED memory model; "
+                "falling back to the uncalibrated analytic model — the "
+                "picked strategy may OOM on real hardware (verify with "
+                "workloads/aot_check.py check_step)", stacklevel=2)
     cands.sort(key=lambda c: c.cost.step_time)
     return cands
 

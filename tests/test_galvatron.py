@@ -239,3 +239,61 @@ def test_search_uniform_rank_agrees_with_recorded_calibration():
         1 for i in range(len(est)) for j in range(len(est))
         if i != j and (est[i] < est[j]) == (measured[i] < measured[j]))
     assert agree >= 2, (est, measured)
+
+
+def test_memory_model_agrees_with_compiler_truth():
+    """The search's memory model, calibrated against XLA's own memory
+    analysis (workloads/mem_calibrate.py — AOT, no window needed): the
+    per-remat scales must load, the calibrated estimates must bracket
+    the measured AOT peaks (0.4x..4x — the raw analytic model was
+    5-17x OFF before calibration), and the scan-flush liveness must
+    order none > selective > full at fixed shape (the pre-r4 model
+    gated liveness on remat and inverted this)."""
+    import json
+    import os
+
+    from hetu_tpu.tools.galvatron.cost_model import (
+        MEM_CALIBRATION_PATH, ModelDims, TPUTopology, estimate,
+    )
+
+    if not os.path.exists(MEM_CALIBRATION_PATH):
+        pytest.skip("no mem calibration artifact (run mem_calibrate.py)")
+    with open(MEM_CALIBRATION_PATH) as f:
+        cal = json.load(f)
+    topo = TPUTopology.calibrated(
+        8, peak_flops=197e12, hbm_bytes=int(15.75 * 2 ** 30))
+    assert topo.mem_scale > 1.0       # the analytic model underestimates
+    assert dict(topo.mem_scale_remat)  # per-remat refinements loaded
+
+    cfg = GPTConfig(vocab_size=50257, max_positions=1024,
+                    hidden_size=768, num_layers=12, num_heads=12)
+    by_name = {
+        "dp2pp4_none_b8": Strategy(dp=2, pp=4, remat="none",
+                                   num_microbatches=8),
+        "dp2pp4_sel": Strategy(dp=2, pp=4, remat="selective",
+                               num_microbatches=8),
+        "dp2pp4_full": Strategy(dp=2, pp=4, remat="full",
+                                num_microbatches=8),
+        "dp8_sel": Strategy(dp=8, remat="selective"),
+        "dp2pp2tp2_sel": Strategy(dp=2, pp=2, tp=2, remat="selective",
+                                  num_microbatches=2),
+    }
+    checked = 0
+    for row in cal["rows"]:
+        if "error" in row or row["name"] not in by_name:
+            continue
+        dims = ModelDims.from_config(cfg, seq_len=1024,
+                                     global_batch=row["batch"])
+        est = estimate(dims, by_name[row["name"]], topo).mem_per_device
+        meas = row["aot_peak_bytes"]
+        assert 0.4 * meas <= est <= 4.0 * meas, (row["name"], est, meas)
+        checked += 1
+    assert checked >= 3
+
+    # scan-flush liveness is schedule-bound, not remat-gated
+    dims16 = ModelDims.from_config(cfg, seq_len=1024, global_batch=16)
+    mems = [estimate(dims16, Strategy(dp=2, pp=4, remat=r,
+                                      num_microbatches=8),
+                     topo).mem_per_device
+            for r in ("none", "selective", "full")]
+    assert mems[0] > mems[1] > mems[2]

@@ -127,19 +127,24 @@ def check_step(devs, strategy, *, batch, seq, cfgkw=None,
     cfg = GPTConfig(vocab_size=50257, max_positions=seq, hidden_size=768,
                     num_layers=12, num_heads=12, **(cfgkw or {}))
     pol = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)
+    # PIN the CE impl both ways: under _mosaic_aot_env the fused gate
+    # fires on HETU_PALLAS_INTERPRET=0 too, so an ambient fused export
+    # would silently flip rows labeled chunked (and the whole memory
+    # calibration) onto the fused kernel
     prev_ce = os.environ.get("HETU_LM_LOSS_IMPL")
     if ce == "fused":
         os.environ["HETU_LM_LOSS_IMPL"] = "fused"
+    else:
+        os.environ.pop("HETU_LM_LOSS_IMPL", None)
     try:
         with _mosaic_aot_env():
             return analyze(cfg, strategy, devs, batch=batch, seq=seq,
                            policy=pol, attn_impl=attn_impl)
     finally:
-        if ce == "fused":
-            if prev_ce is None:
-                os.environ.pop("HETU_LM_LOSS_IMPL", None)
-            else:
-                os.environ["HETU_LM_LOSS_IMPL"] = prev_ce
+        if prev_ce is None:
+            os.environ.pop("HETU_LM_LOSS_IMPL", None)
+        else:
+            os.environ["HETU_LM_LOSS_IMPL"] = prev_ce
 
 
 def check_ctx32k(devs, batch: int = 2):

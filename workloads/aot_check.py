@@ -164,6 +164,31 @@ def check_ctx32k(devs, batch: int = 2):
                        model_cls=LlamaLMHeadModel)
 
 
+def check_decode(devs, *, batch=4, prompt=32, new=16):
+    """AOT-compile the generation path (prefill + scan decode with a KV
+    cache) for the TPU target — the inference surface's compile check."""
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+    from hetu_tpu.models.generation import generate
+
+    mesh = _one_dev_mesh(devs)
+    cfg = GPTConfig.small()
+    model = GPTLMHeadModel(cfg)
+    params_abs = jax.eval_shape(lambda k: model.init(k),
+                                jax.random.key(0))
+    sh = NamedSharding(mesh, P())
+    p_abs = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        params_abs)
+    ids = jax.ShapeDtypeStruct((batch, prompt), jnp.int32, sharding=sh)
+    f = jax.jit(lambda p, i: generate(
+        model, p, i, max_new_tokens=new, max_len=prompt + 2 * new,
+        cache_dtype=jnp.bfloat16))
+    t0 = time.perf_counter()
+    with _mosaic_aot_env():
+        f.lower(p_abs, ids).compile()
+    return {"compile_s": round(time.perf_counter() - t0, 1)}
+
+
 def tuned_block_checks():
     """One flash check per tuned entry in flash_blocks.json (both fwd
     and bwd blocks) at that entry's seq — a tuned config that stops
@@ -328,6 +353,14 @@ def main():
                                                  num_microbatches=2,
                                                  remat="selective"),
                                 batch=8, seq=1024, ce="fused")),
+            # activation offload to pinned host memory (never
+            # TPU-compiled before r4 — 'degrades gracefully off-TPU'
+            # was the only evidence)
+            ("step_offload_v5e",
+             lambda: check_step(d1[:1], Strategy(remat="offload"),
+                                batch=8, seq=1024)),
+            # inference: prefill + lax.scan KV-cache decode
+            ("decode_kv_cache_v5e", lambda: check_decode(d1[:1])),
         ]
 
     rows = []

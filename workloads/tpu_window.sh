@@ -75,16 +75,16 @@ run bench_suite134 1200 python workloads/bench_suite.py --configs 1,3,4
 run mfu_sweep 3600 python workloads/mfu_sweep.py
 # 4b. bf16-param variant on the contenders (halves param/grad traffic)
 run mfu_sweep_bf16 1200 python workloads/mfu_sweep.py --param-dtype bf16 \
-    --grid 32:selective:1,64:selective:1,16:none:1
+    --grid 32:selective:1,48:selective:1,16:none:1
 # 4c. fused streaming CE kernel (no logits materialization, no chunk
 # barrier) at the contender shapes
 run mfu_sweep_fusedce 1200 python workloads/mfu_sweep.py --ce fused \
-    --grid 32:selective:1,64:selective:1
+    --grid 32:selective:1,48:selective:1
 # 4d. combined levers: bf16 params x fused CE — sweep_best.json keeps
 # the max across variants, so the combination must be measured directly
 # or it can never win adoption
 run mfu_sweep_combo 1200 python workloads/mfu_sweep.py --param-dtype bf16 \
-    --ce fused --grid 32:selective:1,64:selective:1
+    --ce fused --grid 32:selective:1,48:selective:1
 # 5. flash kernel block-size tuning (feeds ops/flash_pallas defaults)
 run flash_tune 900 python workloads/flash_tune.py
 # 5b. chunked-CE budget tuning (feeds ops/losses defaults)

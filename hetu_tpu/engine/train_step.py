@@ -144,7 +144,7 @@ def model_dropout_active(model: Module) -> bool:
     """True iff the model's config enables any dropout rate."""
     cfg = getattr(model, "cfg", None)
     return any(getattr(cfg, f, 0.0) > 0.0 for f in
-               ("embd_pdrop", "resid_pdrop", "hidden_pdrop"))
+               ("embd_pdrop", "resid_pdrop", "attn_pdrop", "hidden_pdrop"))
 
 
 def default_loss_fn(model: Module, strategy: Strategy,

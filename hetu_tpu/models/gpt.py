@@ -41,6 +41,10 @@ class GPTConfig:
     # by the train step; eval paths never drop.
     embd_pdrop: float = 0.0
     resid_pdrop: float = 0.0
+    # dropout on attention probabilities (reference flash wrapper's
+    # p_dropout, ``hetu/impl/kernel/FlashAttention.cu:1-50``); >0 forces
+    # the XLA attention path — the Pallas kernel has no PRNG
+    attn_pdrop: float = 0.0
     # MoE (0 experts = dense; parity: HetuMoE GPT, BASELINE config 4)
     num_experts: int = 0
     moe_top_k: int = 2
@@ -83,6 +87,7 @@ class GPTBlock(Module):
             use_rope=False, init=normal_init(cfg.init_std))
         self.ln_2 = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
         self.resid_pdrop = cfg.resid_pdrop
+        self.attn_pdrop = cfg.attn_pdrop
         if cfg.num_experts > 0:
             from hetu_tpu.nn.moe import MoEMLP
             gkw = {"num_groups": cfg.moe_num_groups} \
@@ -112,11 +117,16 @@ class GPTBlock(Module):
             return x + h, new_cache
         # positions only matter for decode (GPT's learned position
         # embedding is applied in embed(), not per block)
-        k1 = k2 = None
-        if dropout_key is not None and self.resid_pdrop > 0:
+        ka = k1 = k2 = None
+        if dropout_key is not None and self.attn_pdrop > 0:
+            ka, k1, k2 = jax.random.split(dropout_key, 3)
+        elif dropout_key is not None and self.resid_pdrop > 0:
+            # 2-way split kept for attn_pdrop=0: resid-only configs must
+            # reproduce their pre-attn-dropout mask streams across resume
             k1, k2 = jax.random.split(dropout_key)
         a = self.attn(params["attn"], self.ln_1(params["ln_1"], x),
-                      segment_ids=segment_ids, attn_impl=attn_impl)
+                      segment_ids=segment_ids, attn_impl=attn_impl,
+                      dropout_rate=self.attn_pdrop, dropout_key=ka)
         x = x + dropout(a, self.resid_pdrop, k1)
         h = self.mlp(params["mlp"], self.ln_2(params["ln_2"], x))
         if self.returns_aux:

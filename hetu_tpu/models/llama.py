@@ -43,6 +43,9 @@ class LlamaConfig:
     # residual dropout (0.0 = Llama-standard; nonzero is the common SFT
     # regularizer). Keys threaded by the train step; eval never drops.
     resid_pdrop: float = 0.0
+    # dropout on attention probs (reference flash p_dropout); >0 forces
+    # the XLA attention path — the Pallas kernel has no PRNG
+    attn_pdrop: float = 0.0
     # MoE (0 experts = dense; experts are SwiGLU like the dense MLP)
     num_experts: int = 0
     moe_top_k: int = 2
@@ -93,6 +96,7 @@ class LlamaBlock(Module):
             self.mlp = ParallelMLP(cfg.hidden_size, cfg.intermediate_size,
                                    bias=False, gated=True)
         self.resid_pdrop = cfg.resid_pdrop
+        self.attn_pdrop = cfg.attn_pdrop
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, dropout_key=None):
@@ -108,13 +112,18 @@ class LlamaBlock(Module):
             if self.returns_aux:
                 h = h[0]  # aux is train-only
             return x + h, new_cache
-        k1 = k2 = None
-        if dropout_key is not None and self.resid_pdrop > 0:
+        ka = k1 = k2 = None
+        if dropout_key is not None and self.attn_pdrop > 0:
+            ka, k1, k2 = jax.random.split(dropout_key, 3)
+        elif dropout_key is not None and self.resid_pdrop > 0:
+            # 2-way split kept for attn_pdrop=0: resid-only configs must
+            # reproduce their pre-attn-dropout mask streams across resume
             k1, k2 = jax.random.split(dropout_key)
         a = self.attn(params["attn"],
                       self.input_norm(params["input_norm"], x),
                       positions=positions, segment_ids=segment_ids,
-                      attn_impl=attn_impl)
+                      attn_impl=attn_impl,
+                      dropout_rate=self.attn_pdrop, dropout_key=ka)
         x = x + dropout(a, self.resid_pdrop, k1)
         h = self.mlp(params["mlp"],
                      self.post_attn_norm(params["post_attn_norm"], x))

@@ -237,9 +237,11 @@ class ParallelAttention(Module):
             self._rope = None
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
-                 attn_impl: str = "auto", kv_cache=None):
+                 attn_impl: str = "auto", kv_cache=None,
+                 dropout_rate: float = 0.0, dropout_key=None):
         if kv_cache is not None:
             return self._decode(params, x, kv_cache, positions=positions)
+        drop_active = dropout_rate > 0.0 and dropout_key is not None
         b, s, _ = x.shape
         q = self.q_proj(params["q_proj"], x).reshape(
             b, s, self.num_heads, self.head_dim)
@@ -256,8 +258,17 @@ class ParallelAttention(Module):
         v = act_constrain(v, "heads")
         ctx = current_act_sharding()
         mctx = current_manual_axes()
-        if ctx is None and mctx is not None and "cp" in mctx.axes \
-                and mctx.mesh.shape["cp"] > 1:
+        manual_cp = (ctx is None and mctx is not None
+                     and "cp" in mctx.axes and mctx.mesh.shape["cp"] > 1)
+        gspmd_cp = (ctx is not None and isinstance(ctx.seq, str)
+                    and ctx.mesh.shape[ctx.seq] > 1)
+        if drop_active and (manual_cp or gspmd_cp):
+            # ring/ulysses cores carry no dropout plumbing (per-hop prob
+            # masks would need hop-split keys); loud beats silently-off
+            raise ValueError(
+                "attention dropout under context parallelism (cp>1) is "
+                "not supported — set attn_pdrop=0 or cp=1")
+        if manual_cp:
             # inside a manual region (pipeline executor) with cp bound:
             # run the cp attention core directly on the bound axis —
             # x/q/k/v here are the per-device local seq chunks
@@ -275,8 +286,7 @@ class ParallelAttention(Module):
                     q, k, v, axis_name="cp", cp=mctx.mesh.shape["cp"],
                     causal=self.causal, segment_ids=segment_ids,
                     impl=attn_impl, layout=mctx.cp_layout)
-        elif ctx is not None and isinstance(ctx.seq, str) \
-                and ctx.mesh.shape[ctx.seq] > 1:
+        elif gspmd_cp:
             # context parallelism: seq dim is sharded — KV ring
             # (reference: ParallelAttentionOp → AttnCommRing) or the
             # beyond-reference Ulysses all_to_all head scatter
@@ -293,7 +303,9 @@ class ParallelAttention(Module):
                                      impl=attn_impl)
         else:
             out = flash_attention(q, k, v, causal=self.causal,
-                                  segment_ids=segment_ids, impl=attn_impl)
+                                  segment_ids=segment_ids, impl=attn_impl,
+                                  dropout_rate=dropout_rate,
+                                  dropout_key=dropout_key)
         out = act_constrain(out, "heads")
         out = out.reshape(b, s, self.num_heads * self.head_dim)
         return self.out_proj(params["out_proj"], out)

@@ -38,11 +38,19 @@ def attention_reference(q, k, v, *, causal: bool = False,
                         scale: Optional[float] = None,
                         return_lse: bool = False,
                         q_offset: int | jnp.ndarray = 0,
-                        kv_offset: int | jnp.ndarray = 0):
+                        kv_offset: int | jnp.ndarray = 0,
+                        dropout_rate: float = 0.0,
+                        dropout_key: Optional[jax.Array] = None):
     """Pure-jnp attention oracle, fp32 softmax.
 
     ``q_offset``/``kv_offset`` shift the absolute positions used by the causal
     mask — needed when q/kv are chunks of a longer sequence (ring attention).
+
+    ``dropout_rate``/``dropout_key``: inverted dropout on the softmax
+    probabilities (the reference flash wrapper's p_dropout,
+    ``hetu/impl/kernel/FlashAttention.cu:1-50``); a None key (eval) is
+    the identity. The LSE is computed on the UN-dropped distribution —
+    dropout perturbs the value mix, not the normalizer.
     """
     b, sq, hq, d = q.shape
     sk = k.shape[1]
@@ -66,6 +74,9 @@ def attention_reference(q, k, v, *, causal: bool = False,
     # rows that are fully masked (can happen in ring hops) produce 0 output
     probs = jnp.exp(logits - lse[..., None])
     probs = jnp.where(mask, probs, 0.0)
+    if dropout_rate > 0.0 and dropout_key is not None:
+        from hetu_tpu.ops.dropout import dropout
+        probs = dropout(probs, dropout_rate, dropout_key)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     out = out.astype(q.dtype)
     if return_lse:
@@ -76,15 +87,33 @@ def attention_reference(q, k, v, *, causal: bool = False,
 def flash_attention(q, k, v, *, causal: bool = False,
                     segment_ids: Optional[jnp.ndarray] = None,
                     scale: Optional[float] = None,
-                    impl: str = "auto"):
+                    impl: str = "auto",
+                    dropout_rate: float = 0.0,
+                    dropout_key: Optional[jax.Array] = None):
     """Dispatch: Pallas flash kernel on TPU, reference elsewhere.
 
     ``impl``: "auto" | "pallas" | "reference".
+
+    Attention dropout (``dropout_rate`` > 0 with a live ``dropout_key``)
+    forces the XLA reference path: the Pallas kernel carries no PRNG
+    state, and XLA fuses mask generation into the prob/value matmul well
+    enough that a bespoke kernel buys little at dropout's training-only
+    shapes. An EXPLICIT ``impl="pallas"`` with active dropout raises
+    rather than silently dropping the mask (parity note: the reference
+    wrapper's p_dropout rides the flash kernel's own RNG,
+    ``hetu/impl/kernel/FlashAttention.cu:1-50``).
     """
+    drop_active = dropout_rate > 0.0 and dropout_key is not None
+    if drop_active and impl == "pallas":
+        raise ValueError(
+            "attention dropout is not implemented in the Pallas flash "
+            "kernel — use impl='auto' (dropout forces the XLA reference "
+            "path) or attn_pdrop=0")
     if impl == "auto":
         # Pallas kernel on real TPU; on CPU the XLA-fused oracle is faster
         # than interpret-mode Pallas.
-        impl = "pallas" if _on_tpu() and _pallas_supported(q, k) else "reference"
+        impl = "pallas" if not drop_active and _on_tpu() \
+            and _pallas_supported(q, k) else "reference"
     if impl == "pallas":
         out = _pallas_sharded_call(q, k, v, causal=causal,
                                    segment_ids=segment_ids, scale=scale)
@@ -94,7 +123,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
         return flash_attention_pallas(q, k, v, causal=causal,
                                       segment_ids=segment_ids, scale=scale)
     return attention_reference(q, k, v, causal=causal,
-                               segment_ids=segment_ids, scale=scale)
+                               segment_ids=segment_ids, scale=scale,
+                               dropout_rate=dropout_rate,
+                               dropout_key=dropout_key)
 
 
 def _pallas_sharded_call(q, k, v, *, causal, segment_ids, scale):

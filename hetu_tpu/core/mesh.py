@@ -79,7 +79,15 @@ def make_hybrid_mesh(ici_shape: dict[str, int], dcn_axis: str = "dp",
         raise ValueError(
             f"{dcn_axis} degree {ici_shape[dcn_axis]} must be divisible "
             f"by num_slices {n_slices}")
-    if n_slices <= 1:
+    has_slice_meta = any(hasattr(d, "slice_index") for d in devices)
+    if n_slices <= 1 or not has_slice_meta:
+        # single slice, or no slice metadata at all (CPU virtual
+        # devices): contiguous device groups stand in for slices — the
+        # factored axis layout and its collectives are what is being
+        # validated. Real TPU devices always carry slice_index, so any
+        # layout/num_slices mismatch takes the strict path below and
+        # FAILS instead of silently flattening (a flat mesh would route
+        # ICI-assumed collectives over DCN).
         return make_mesh(ici_shape)
     from jax.experimental import mesh_utils
     per_slice = dict(ici_shape)

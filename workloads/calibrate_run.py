@@ -87,6 +87,9 @@ def main():
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump({
+            # "measured" marks on-chip numbers: the AOT fallback
+            # (workloads/aot_calibrate.py) refuses to overwrite them
+            "source": "measured",
             "device_kind": getattr(dev, "device_kind", "tpu"),
             "peak_flops": peak,
             "hbm_bytes": hbm,

@@ -74,6 +74,20 @@ def main():
     else:
         strategy = Strategy(dp=n)
 
+    if getattr(strategy, "pp", 1) > 1:
+        # pp executor decision (compiler-evidence rule — workloads/
+        # pp_memory.py --compare-1f1b): scan pipeline when its flush
+        # residency fits HBM, host-scheduled 1F1B otherwise
+        from hetu_tpu.parallel.pipeline import resolve_pipeline_strategy
+        resolved = resolve_pipeline_strategy(
+            cfg, strategy, seq_len=args.seq_len,
+            global_batch=args.batch_rows)
+        if resolved is not strategy:
+            print(f"pp executor: promoted to 1F1B "
+                  f"({resolved.to_json()}) — scan flush residency "
+                  f"exceeds HBM")
+            strategy = resolved
+
     trainer = Trainer(
         model, optim.adamw(3e-3, weight_decay=0.01), strategy,
         config=TrainerConfig(total_steps=args.steps, log_every=5,

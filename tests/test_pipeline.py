@@ -179,3 +179,54 @@ def test_pp_memory_aot_analysis_on_tpu_target():
         < real[(scan, "none")]["temp_bytes"]
     assert not real[(scan, "none")]["fits_hbm"]
     assert real[(scan, "selective")]["fits_hbm"]
+
+
+def test_resolve_pipeline_strategy_rule():
+    """The pp>1 executor decision (VERDICT r4 item 5): scan when the
+    flush residency fits, homogeneous 1F1B when only the schedule-bound
+    residency does, scan again when NOTHING fits (remat is then the
+    lever), and never a conversion for strategies the hetero executor
+    cannot express (cp/ep/zero) or pp==1."""
+    from hetu_tpu.models import GPTConfig
+    from hetu_tpu.parallel.hetero import HeteroStrategy
+    from hetu_tpu.parallel.pipeline import resolve_pipeline_strategy
+    from hetu_tpu.tools.galvatron.cost_model import (
+        ModelDims, TPUTopology, estimate,
+    )
+
+    cfg = GPTConfig(vocab_size=50257, max_positions=1024,
+                    hidden_size=768, num_layers=12, num_heads=12)
+    st = Strategy(dp=2, pp=4, remat="none", num_microbatches=8)
+    dims = ModelDims.from_config(cfg, seq_len=1024, global_batch=16)
+
+    def topo(hbm):
+        return TPUTopology.calibrated(8, hbm_bytes=float(hbm))
+
+    est = estimate(dims, st, topo(1))
+    live, flush = min(st.pp, st.num_microbatches), \
+        st.num_microbatches + st.pp - 1
+    act = est.mem_per_device - est.mem_params - est.mem_opt
+    peak_1f1b = est.mem_params + est.mem_opt + act * live / flush
+    assert peak_1f1b < est.mem_per_device
+
+    kw = dict(seq_len=1024, global_batch=16)
+    # plenty of memory: scan unchanged
+    big = topo(est.mem_per_device * 2)
+    assert resolve_pipeline_strategy(cfg, st, topo=big, **kw) is st
+    # between the two peaks: promoted to 1F1B, shape preserved
+    mid = topo((peak_1f1b + est.mem_per_device) / 2)
+    h = resolve_pipeline_strategy(cfg, st, topo=mid, **kw)
+    assert isinstance(h, HeteroStrategy)
+    assert h.pp == 4 and h.num_layers == 12
+    assert h.num_microbatches == 8 and h.remat == "none"
+    assert all(s.layers == 3 and s.dp == 2 for s in h.stages)
+    # below both: stays scan (caller must add remat)
+    small = topo(peak_1f1b / 2)
+    assert resolve_pipeline_strategy(cfg, st, topo=small, **kw) is st
+    # inexpressible dims stay scan even when not fitting
+    for bad in (Strategy(dp=2, pp=4, cp=2, num_microbatches=8),
+                Strategy(dp=2, pp=4, zero=True, num_microbatches=8)):
+        assert resolve_pipeline_strategy(cfg, bad, topo=mid, **kw) is bad
+    # pp == 1 is a no-op
+    flat = Strategy(dp=8)
+    assert resolve_pipeline_strategy(cfg, flat, topo=mid, **kw) is flat

@@ -35,6 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # XLA's own per-chip budget for v5e ("Used ... of 15.75G hbm" in its
 # RESOURCE_EXHAUSTED messages) — NOT the 16G marketing figure
@@ -116,6 +117,161 @@ def analyze(cfg, strategy, topo_devices, *, batch, seq, policy,
     return row
 
 
+def _bytes_of(tree) -> int:
+    """GLOBAL logical bytes of a ShapeDtypeStruct tree."""
+    return sum(int(np.prod(l.shape)) * l.dtype.itemsize
+               for l in jax.tree.leaves(tree)
+               if hasattr(l, "shape") and hasattr(l, "dtype"))
+
+
+def _bytes_dev(tree) -> int:
+    """PER-DEVICE bytes: leaves with a sharding contribute their shard
+    shape (what one device actually stores), unsharded leaves their full
+    shape."""
+    total = 0
+    for l in jax.tree.leaves(tree):
+        if not (hasattr(l, "shape") and hasattr(l, "dtype")):
+            continue
+        shape = l.shape
+        sh = getattr(l, "sharding", None)
+        if sh is not None and hasattr(sh, "shard_shape"):
+            shape = sh.shard_shape(l.shape)
+        total += int(np.prod(shape)) * l.dtype.itemsize
+    return total
+
+
+def analyze_1f1b(cfg, *, pp, dp, tp, nm, remat, topo_devices, batch, seq,
+                 policy):
+    """Compiler-derived per-device memory for the host-scheduled 1F1B
+    executor (``parallel.hetero.homogeneous_1f1b``), assembled from its
+    per-stage AOT programs + the schedule's liveness bound.
+
+    Unlike ``analyze`` (one program = one compiler peak), 1F1B memory is
+    a host-side composition: per-stage state + ≤pp in-flight
+    microbatches' residuals (the 1F1B bound, reference
+    ``executable_graph.cc:836``) + the largest stage program's temp
+    peak. Residual bytes per microbatch come from ``jax.eval_shape`` of
+    the residual-mode forward's vjp closure, minus the stage's param
+    bytes (the closure passes the param buffers through — shared across
+    microbatches, not per-mb cost)."""
+    from hetu_tpu import optim
+    from hetu_tpu.core.dtypes import autocast
+    from hetu_tpu.models import GPTLMHeadModel
+    from hetu_tpu.parallel.hetero import (
+        HeteroTrainStep, homogeneous_1f1b, make_hetero_plan,
+    )
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    model = GPTLMHeadModel(cfg)
+    opt = optim.adamw(1e-4)
+    strategy = homogeneous_1f1b(cfg.num_layers, pp=pp, tp=tp, dp=dp,
+                                num_microbatches=nm, remat=remat)
+    mb = batch // nm
+    with autocast(policy):
+        plan = make_hetero_plan(model, strategy, devices=topo_devices)
+        step = HeteroTrainStep(model, opt, plan, schedule="1f1b",
+                               backward="residuals")
+
+        pshapes = jax.eval_shape(
+            lambda k: model.init(k, dtype=policy.param_dtype),
+            jax.random.key(0))
+        ranges = strategy.layer_ranges()
+
+        def abs_tree(shapes, shardings):
+            return jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                                   sharding=sh),
+                shapes, shardings)
+
+        outer_s = {k: v for k, v in pshapes.items() if k != "blocks"}
+        outer_abs = abs_tree(outer_s, plan.outer_shardings)
+        houter_abs = abs_tree(outer_s, plan.head_outer_shardings)
+        # blocks params are layer-stacked; a stage chunk's aval is the
+        # same leaf with the leading (layer) dim cut to the stage range
+        # (hetero._slice_blocks does this on real arrays)
+        chunk_abs = [
+            jax.tree.map(
+                lambda s, sh, n=hi - lo: jax.ShapeDtypeStruct(
+                    (n,) + s.shape[1:], s.dtype, sharding=sh),
+                pshapes["blocks"], plan.block_shardings[i])
+            for i, (lo, hi) in enumerate(ranges)]
+
+        def rep(mesh, shape, dtype):
+            return jax.ShapeDtypeStruct(
+                shape, dtype, sharding=NamedSharding(mesh, P()))
+
+        ids_abs = jax.ShapeDtypeStruct(
+            (mb, seq), jnp.int32, sharding=plan.batch_shardings[0])
+        labels_abs = jax.ShapeDtypeStruct(
+            (mb, seq), jnp.int32, sharding=plan.batch_shardings[-1])
+        h_abs = [jax.ShapeDtypeStruct((mb, seq, cfg.hidden_size),
+                                      policy.compute_dtype,
+                                      sharding=plan.act_shardings[i])
+                 for i in range(pp)]
+        extras_of = [{"positions": rep(plan.meshes[i], (mb, seq),
+                                       jnp.int32)} for i in range(pp)]
+        gscale = rep(plan.meshes[-1], (), jnp.float32)
+
+        def mem(compiled):
+            ma = compiled.memory_analysis()
+            return {"temp": int(ma.temp_size_in_bytes),
+                    "arg": int(ma.argument_size_in_bytes),
+                    "out": int(ma.output_size_in_bytes)}
+
+        rows = {}
+        # residuals and inter-stage activations are batch-sharded over
+        # the stage's dp — eval_shape avals carry no shardings, so the
+        # GLOBAL byte counts divide by dp for the per-device cost (state
+        # trees DO carry shardings: _bytes_dev reads the shard shapes)
+        # -- stage 0: embed + first chunk, residual-mode forward --------
+        out0 = jax.eval_shape(step._fwd_res[0], outer_abs, chunk_abs[0],
+                              ids_abs, extras_of[0]["positions"],
+                              extras_of[0])
+        c0 = step._fwd_res[0].lower(outer_abs, chunk_abs[0], ids_abs,
+                                    extras_of[0]["positions"],
+                                    extras_of[0]).compile()
+        vjp0_abs = out0[1]
+        r0 = max(0, _bytes_of(vjp0_abs)
+                 - _bytes_of(chunk_abs[0]) - _bytes_of(outer_abs)) // dp
+        b0 = step._bwd_apply[0].lower(vjp0_abs, out0[0]).compile()
+        rows["first"] = {"fwd": mem(c0), "bwd": mem(b0),
+                         "residual_mb": r0,
+                         "state": _bytes_dev(chunk_abs[0]) * 4
+                         + _bytes_dev(outer_abs) * 4}
+        # -- mid stage (stage 1), the repeated shape --------------------
+        if pp > 2:
+            outm = jax.eval_shape(step._fwd_res[1], chunk_abs[1],
+                                  h_abs[1], extras_of[1])
+            cm = step._fwd_res[1].lower(chunk_abs[1], h_abs[1],
+                                        extras_of[1]).compile()
+            vjpm_abs = outm[1]
+            rm = max(0, _bytes_of(vjpm_abs)
+                     - _bytes_of(chunk_abs[1])) // dp
+            bm = step._bwd_apply[1].lower(vjpm_abs, outm[0]).compile()
+            rows["mid"] = {"fwd": mem(cm), "bwd": mem(bm),
+                           "residual_mb": rm,
+                           "state": _bytes_dev(chunk_abs[1]) * 4}
+        # -- last stage: fused fwd+loss+bwd, h stored per in-flight mb --
+        cl = step._bwd_last.lower(houter_abs, chunk_abs[-1], h_abs[-1],
+                                  labels_abs, extras_of[-1],
+                                  gscale).compile()
+        rows["last"] = {"bwd_last": mem(cl),
+                        "residual_mb": _bytes_of([h_abs[-1]]) // dp,
+                        "state": _bytes_dev(chunk_abs[-1]) * 4
+                        + _bytes_dev(houter_abs) * 4}
+
+    # schedule bound: <= pp microbatches in flight per stage (1F1B)
+    live = min(pp, nm)
+    for r in rows.values():
+        temps = max(p["temp"] for p in r.values()
+                    if isinstance(p, dict) and "temp" in p)
+        r["peak_bytes_est"] = r["state"] + live * r["residual_mb"] + temps
+    peak = max(r["peak_bytes_est"] for r in rows.values())
+    return {"stages": rows, "live_mb": live, "peak_bytes_est": peak,
+            "fits_hbm": peak < HBM_V5E}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=12)
@@ -124,6 +280,10 @@ def main():
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--nm", type=int, default=8)
     ap.add_argument("--topology", default="v5e:2x4")
+    ap.add_argument("--compare-1f1b", action="store_true",
+                    help="scan executor vs host-scheduled 1F1B peaks "
+                         "(VERDICT r4 item 5: decide the pp default "
+                         "with compiler evidence)")
     args = ap.parse_args()
 
     # script-entry only (a module-level set would flip the backend of any
@@ -153,6 +313,50 @@ def main():
                      "nm": args.nm},
            "rows": []}
     gib = 1024 ** 3
+
+    if args.compare_1f1b:
+        print(f"scan vs 1F1B, L={args.layers} h={args.hidden} "
+              f"b={args.batch} s={args.seq} nm={args.nm} dp2 x pp4")
+        cmp_out = {"model": out["model"], "rows": []}
+        for remat in ("none", "selective"):
+            try:
+                scan = analyze(cfg, Strategy(dp=2, pp=4, remat=remat,
+                                             num_microbatches=args.nm),
+                               devs, batch=args.batch, seq=args.seq,
+                               policy=policy)
+            except Exception as e:   # noqa: BLE001 — keep other rows
+                scan = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            try:
+                f1b = analyze_1f1b(cfg, pp=4, dp=2, tp=1, nm=args.nm,
+                                   remat=remat, topo_devices=devs,
+                                   batch=args.batch, seq=args.seq,
+                                   policy=policy)
+            except Exception as e:   # noqa: BLE001
+                f1b = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            row = {"remat": remat, "scan": scan, "1f1b": f1b}
+            cmp_out["rows"].append(row)
+            sp = scan.get("peak_bytes_est")
+            fp = f1b.get("peak_bytes_est")
+            print(f"  remat={remat:<10} scan "
+                  f"{scan.get('error') if sp is None else f'{sp/gib:.2f}G'}"
+                  f" | 1f1b "
+                  f"{f1b.get('error') if fp is None else f'{fp/gib:.2f}G'}",
+                  flush=True)
+            winner = None
+            if sp is not None and fp is not None:
+                winner = "scan" if sp <= fp else "1f1b"
+            elif fp is not None:
+                winner = "1f1b"
+            elif sp is not None:
+                winner = "scan"
+            row["winner"] = winner
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "out", f"pp_1f1b_compare_L{args.layers}.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(cmp_out, f, indent=1)
+        print(f"wrote {path}")
+        return
     print(f"topology={args.topology} ({len(devs)} devices) "
           f"L={args.layers} h={args.hidden} b={args.batch} s={args.seq}")
     print(f"{'strategy':>22} {'remat':>10} {'temp GiB':>9} "

@@ -146,6 +146,7 @@ class ElasticWorkerPool:
                  env: Optional[dict] = None,
                  platform_env: Optional[dict] = None,
                  ssh_hosts: Optional[Sequence[str]] = None,
+                 ssh_cmd: Sequence[str] = ("ssh", "-tt"),
                  coordinator_host: Optional[str] = None,
                  poll_s: float = 0.2):
         self.script = script
@@ -156,6 +157,13 @@ class ElasticWorkerPool:
         # command (the coordinator address must then be reachable —
         # bind-all is the operator's call, as in the reference)
         self.ssh_hosts = list(ssh_hosts) if ssh_hosts else None
+        # transport argv prefix: the hop command that receives
+        # ``host remote-shell-string...`` — ("ssh", "-tt") in production
+        # (reference: parallel-ssh, ``pssh_start.py:17``); tests and
+        # exotic fabrics substitute a shim with the same contract (the
+        # remote words are shell-quoted, so the hop must run them
+        # through a shell like sshd does)
+        self.ssh_cmd = list(ssh_cmd)
         # routable address of THIS machine for remote workers' coordinator
         # connections (required with ssh_hosts)
         self.coordinator_host = coordinator_host
@@ -223,9 +231,10 @@ class ElasticWorkerPool:
                             for k, v in env.items()
                             if k.startswith(("HETU_", "JAX_", "XLA_",
                                              "PYTHONPATH"))]
-                # -tt: killing the local ssh client drops the remote tty,
-                # so the remote worker gets SIGHUP on generation teardown
-                cmd = ["ssh", "-tt", host, "env", *hetu_env, "python3",
+                # -tt (in the default ssh_cmd): killing the local ssh
+                # client drops the remote tty, so the remote worker gets
+                # SIGHUP on generation teardown
+                cmd = [*self.ssh_cmd, host, "env", *hetu_env, "python3",
                        shlex.quote(self.script),
                        *map(shlex.quote, self.args)]
             self.procs.append(subprocess.Popen(

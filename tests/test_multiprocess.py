@@ -77,3 +77,42 @@ def test_restarts_exhausted_reports_failure(tmp_path):
         summary = pool.run(timeout_s=300)
     assert summary.get("failed") is True
     assert summary["restarts"] == 0
+
+
+def test_ssh_prefix_fanout(tmp_path):
+    """SSH multi-host fan-out (``pssh_start.py:17``) through a hop shim
+    with sshd's exact contract — argv = (host, remote words), remote
+    words shell-quoted and run through a shell. No sshd exists in CI, so
+    the shim stands in for the transport while everything the launcher
+    owns (env serialization, quoting, per-host scheduling, coordinator
+    reachability, per-worker logs) is exercised for real: a DP step
+    spans the two 'remote' workers and their losses match."""
+    shim = tmp_path / "fake-ssh"
+    hop_log = tmp_path / "hops.log"
+    shim.write_text(
+        "#!/bin/bash\n"
+        "host=$1; shift\n"
+        f"echo \"$host\" >> {hop_log}\n"
+        "exec bash -c \"$*\"\n")
+    shim.chmod(0o755)
+
+    env = {"HETU_OUT": str(tmp_path), "HETU_STEPS": "3",
+           "HETU_REPO": _REPO}
+    with ElasticWorkerPool(_WORKER, 2, env=env,
+                           ssh_hosts=["host-a", "host-b"],
+                           ssh_cmd=[str(shim)],
+                           coordinator_host="127.0.0.1",
+                           log_dir=str(tmp_path / "logs")) as pool:
+        summary = pool.run(timeout_s=300)
+    assert summary.get("failed") is None
+    assert summary["exit_codes"] == [0, 0]
+    # round-robin host placement, one hop per worker
+    assert sorted(hop_log.read_text().split()) == ["host-a", "host-b"]
+    # per-worker logs landed under the launcher's layout
+    assert sorted(os.listdir(tmp_path / "logs")) == ["g0-w0.log",
+                                                     "g0-w1.log"]
+    # the DP allreduce crossed the hop: identical decreasing losses
+    res = _read_results(tmp_path, 0, 2)
+    np.testing.assert_allclose(res[0]["losses"], res[1]["losses"],
+                               rtol=1e-6)
+    assert res[0]["losses"][-1] < res[0]["losses"][0]

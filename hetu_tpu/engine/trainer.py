@@ -288,26 +288,40 @@ class Trainer:
             self.save(wait=True)
         return history
 
-    def train_dynamic(self, dispatcher, seqs,
-                      epochs: int = 1) -> list[dict]:
+    def train_dynamic(self, dispatcher, seqs, epochs: int = 1, *,
+                      use_bucket_strategies: bool = False) -> list[dict]:
         """Hydraulis flow: train over a DynamicDispatcher's per-bucket
-        batches, one cached jitted step per bucket length (the strategy
-        stays this Trainer's; per-bucket cp/remat overrides go through
-        HeteroDPTrainStep instead)."""
+        batches, one cached jitted step per bucket length (jit cache
+        keyed on shape).
+
+        ``use_bucket_strategies=True`` is the COMPOSED Hydraulis planner
+        (reference ``examples/hydraulis/strategy/new_planning.py``): each
+        bucket trains under ITS OWN parallel strategy from
+        ``plan_buckets``'s cost-model search (short buckets dp-heavy,
+        long buckets cp+remat), hot-switching the live state between
+        plans at bucket boundaries. The dispatcher emits largest buckets
+        first, so switches happen once per bucket class per epoch, and
+        the plan pool makes A→B→A reuse free. False keeps this Trainer's
+        single strategy (per-bucket shapes only)."""
         if self.state is None:
             self.initialize()
         history = []
         host_step = int(jax.device_get(self.state.step))
         for _ in range(epochs):
             for batch, plan in dispatcher.batches(seqs):
+                if use_bucket_strategies \
+                        and plan.strategy != self.strategy:
+                    self.set_strategy(plan.strategy)
                 metrics = self.train_step(batch)
                 host_step += 1   # host-side: no per-step device sync
                 if self.config.log_every and \
                         host_step % self.config.log_every == 0:
+                    extra = {"strategy": plan.strategy.to_json()} \
+                        if use_bucket_strategies else {}
                     history.append(self.metrics.log(
                         host_step,
                         loss=float(jax.device_get(metrics["loss"])),
-                        bucket=plan.bucket_len))
+                        bucket=plan.bucket_len, **extra))
         return history
 
     def evaluate(self, batches: Iterable[dict]) -> float:

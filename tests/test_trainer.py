@@ -299,3 +299,40 @@ def test_trainer_shrink_to_hetero_recovery(monkeypatch):
     t.config.total_steps = 4
     t.train(_batches(2))
     assert int(jax.device_get(t.state.step)) == step_before + 2
+
+
+def test_trainer_hydraulis_strategy_dispatch():
+    """The COMPOSED Hydraulis planner (VERDICT r4 item 6, reference
+    ``examples/hydraulis/strategy/new_planning.py``): a mixed-length
+    stream trains under >=2 parallel strategies in ONE run — short
+    buckets on a dp-heavy plan, the long bucket on cp2+remat — with the
+    live state hot-switched at bucket boundaries, and the loss stream
+    matches the single-plan run on the same batches (strategies change
+    the sharding, never the math)."""
+    from hetu_tpu.data.hydraulis import BucketPlan, DynamicDispatcher
+
+    rs = np.random.RandomState(3)
+    seqs = [np.arange(L + 1, dtype=np.int32) % CFG.vocab_size
+            for L in list(rs.randint(8, 32, size=16))
+            + list(rs.randint(100, 128, size=8))]
+    plans = {
+        32: BucketPlan(32, 8, Strategy(dp=4), 0.0),
+        128: BucketPlan(128, 4, Strategy(dp=2, cp=2, remat="full"), 0.0),
+    }
+
+    t = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3), Strategy(dp=4),
+                _cfg())
+    hist = t.train_dynamic(DynamicDispatcher(plans), seqs,
+                           use_bucket_strategies=True)
+    used = {h["strategy"] for h in hist}
+    assert len(used) >= 2, used                     # >=2 plans, one run
+    assert len(t._plan_cache) >= 2                  # both compiled
+    assert all(np.isfinite(h["loss"]) for h in hist)
+
+    # single-plan baseline on the SAME dispatch order: loss parity
+    t1 = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3), Strategy(dp=2),
+                 _cfg())
+    base = t1.train_dynamic(DynamicDispatcher(plans), seqs)
+    np.testing.assert_allclose([h["loss"] for h in hist],
+                               [h["loss"] for h in base],
+                               rtol=2e-3, atol=2e-3)

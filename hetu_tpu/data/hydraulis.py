@@ -27,10 +27,13 @@ def preferred_cp_impl(seq_len: int, cp: int, num_heads: int,
 
     Measured-profile-first: when ``workloads/out/cp_compare.json`` exists
     (written by ``workloads/cp_compare.py``), the nearest measured
-    (cp, seq) winner decides. Fallback heuristic: Ulysses when it is
-    legal (heads divide by cp) and the sequence is short enough that its
-    two dense all_to_alls beat cp-1 ring hops (moderate cp, seq below
-    ~8k); ring otherwise — ring's per-hop overlap wins at long context.
+    (cp, seq) winner decides. Without a same-backend measurement the
+    default is RING, unconditionally: every measured cell to date
+    (CPU mesh, cp∈{2,4}, seq∈{512..32k}) has ring 2.3–3× faster, so
+    Ulysses is demoted to experimental — selected only where a
+    measurement on THIS backend shows it winning (high head count /
+    short seq is its theorized regime; `workloads/cp_compare.py` carries
+    those rows for the TPU window to decide).
     """
     if num_heads % cp != 0:
         return "ring"                    # ulysses illegal
@@ -49,7 +52,10 @@ def preferred_cp_impl(seq_len: int, cp: int, num_heads: int,
         if backend != jax.default_backend():
             _warn_stale_table(path, backend, jax.default_backend())
         else:
-            rows = [r for r in table if r["cp"] == cp]
+            # heads-tagged rows (the high-head TPU block) only decide
+            # for their own head count; untagged rows are generic
+            rows = [r for r in table if r["cp"] == cp
+                    and r.get("heads") in (None, num_heads)]
             if rows:
                 best = min(rows, key=lambda r: abs(r["seq"] - seq_len))
                 # measured point must be within 4x in seq — beyond that
@@ -57,7 +63,7 @@ def preferred_cp_impl(seq_len: int, cp: int, num_heads: int,
                 if max(best["seq"], seq_len) <= 4 * min(best["seq"],
                                                         seq_len):
                     return best["winner"]
-    return "ulysses" if (cp <= 4 and seq_len < 8192) else "ring"
+    return "ring"
 
 
 _WARNED_TABLES: set = set()

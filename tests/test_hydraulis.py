@@ -110,9 +110,11 @@ def test_preferred_cp_impl_uses_measured_table(tmp_path):
     from hetu_tpu.data.hydraulis import preferred_cp_impl
 
     assert preferred_cp_impl(4096, 3, num_heads=8) == "ring"  # illegal
-    # heuristic fallback (point at a missing table)
+    # no measurement → ring unconditionally (ulysses is experimental:
+    # it has never won a measured cell; only a same-backend measurement
+    # may select it)
     missing = str(tmp_path / "none.json")
-    assert preferred_cp_impl(2048, 2, 8, table_path=missing) == "ulysses"
+    assert preferred_cp_impl(2048, 2, 8, table_path=missing) == "ring"
     assert preferred_cp_impl(32768, 4, 8, table_path=missing) == "ring"
     # measured table wins over the heuristic (same backend)
     table = {"backend": "cpu", "results": [
@@ -124,21 +126,22 @@ def test_preferred_cp_impl_uses_measured_table(tmp_path):
         json.dump(table, f)
     assert preferred_cp_impl(2048, 2, 8, table_path=p) == "ring"
     assert preferred_cp_impl(32768, 4, 8, table_path=p) == "ulysses"
-    # range guard: >4x seq extrapolation falls back to the heuristic
-    # (cp=2 measured only at 2048; 32768 query → heuristic says ring,
-    # and a 4096 query is within 4x → measured "ring" also)
+    # range guard: >4x seq extrapolation falls back to the ring default
+    # (cp=2 measured only at 2048; a 4096 query is within 4x → measured)
     assert preferred_cp_impl(32768, 2, 8, table_path=p) == "ring"
     table2 = {"backend": "cpu", "results": [
-        {"cp": 2, "seq": 2048, "winner": "ring"}]}
+        {"cp": 2, "seq": 2048, "winner": "ulysses"}]}
     p2 = str(tmp_path / "cp2.json")
     with open(p2, "w") as f:
         json.dump(table2, f)
-    # cp=4 has no measured row → heuristic ("ulysses" at 2048)
-    assert preferred_cp_impl(2048, 4, 8, table_path=p2) == "ulysses"
-    # a table measured on ANOTHER backend must not decide
+    # a measured ulysses win DOES select it (same backend, in range)...
+    assert preferred_cp_impl(2048, 2, 8, table_path=p2) == "ulysses"
+    # ...but cp=4 has no measured row → ring default
+    assert preferred_cp_impl(2048, 4, 8, table_path=p2) == "ring"
+    # a table measured on ANOTHER backend must not decide → ring default
     table3 = {"backend": "tpu", "results": [
-        {"cp": 2, "seq": 2048, "winner": "ring"}]}
+        {"cp": 2, "seq": 2048, "winner": "ulysses"}]}
     p3 = str(tmp_path / "cp3.json")
     with open(p3, "w") as f:
         json.dump(table3, f)
-    assert preferred_cp_impl(2048, 2, 8, table_path=p3) == "ulysses"
+    assert preferred_cp_impl(2048, 2, 8, table_path=p3) == "ring"

@@ -78,8 +78,12 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     args = ap.parse_args()
     on_tpu = jax.default_backend() == "tpu"
+    # short seqs included on TPU: high-head/short-seq is ulysses's
+    # theorized best regime (two dense all_to_alls vs cp-1 ring hops) —
+    # the demote-or-promote call (VERDICT r4 item 7) needs those cells
     seqs = [int(s) for s in (args.seqs or
-                             ("4096,16384" if on_tpu else "1024,4096")
+                             ("512,2048,4096,16384" if on_tpu
+                              else "1024,4096")
                              ).split(",")]
     cps = [int(c) for c in args.cps.split(",")]
 
@@ -87,17 +91,36 @@ def main():
     print(f"backend={jax.default_backend()} devices={len(jax.devices())}")
     print(f"{'cp':>3} {'seq':>6} {'ring ms':>9} {'ulysses ms':>11} "
           f"{'ring/ulysses':>13} winner")
-    for cp in cps:
-        for seq in seqs:
-            if args.heads % cp:
-                continue                    # ulysses needs heads % cp == 0
-            r = measure(cp, seq, heads=args.heads, steps=args.steps,
-                        hidden=args.hidden, layers=args.layers)
-            ratio = r["ring"] / r["ulysses"]
-            winner = "ring" if ratio < 1 else "ulysses"
-            results.append({"cp": cp, "seq": seq, **r, "winner": winner})
-            print(f"{cp:>3} {seq:>6} {r['ring']:>9.1f} "
-                  f"{r['ulysses']:>11.1f} {ratio:>13.2f} {winner}")
+    # base grid rows are written UNTAGGED (generic: they decide for any
+    # model head count in preferred_cp_impl); only the dedicated
+    # high-head block carries a "heads" tag so it decides solely for its
+    # own head count
+    grid = [(cp, seq, args.heads, args.hidden, False)
+            for cp in cps for seq in seqs]
+    if on_tpu:
+        # high-head block (heads=16): per-head dim shrinks, ring's
+        # per-hop KV chunks get skinnier while ulysses's all_to_all
+        # volume is head-count-invariant. Skip cells the user's grid
+        # already measures (same cp/seq/heads — a second hidden size
+        # would write conflicting same-key rows).
+        base_keys = {(cp, seq, args.heads) for cp in cps for seq in seqs}
+        grid += [(cp, seq, 16, 512, True)
+                 for cp in cps for seq in (512, 2048)
+                 if (cp, seq, 16) not in base_keys]
+    for cp, seq, heads, hidden, tag in grid:
+        if heads % cp:
+            continue                        # ulysses needs heads % cp == 0
+        r = measure(cp, seq, heads=heads, steps=args.steps,
+                    hidden=hidden, layers=args.layers)
+        ratio = r["ring"] / r["ulysses"]
+        winner = "ring" if ratio < 1 else "ulysses"
+        row = {"cp": cp, "seq": seq, **r, "winner": winner}
+        if tag:
+            row["heads"] = heads
+        results.append(row)
+        print(f"{cp:>3} {seq:>6} h{heads:<3} {r['ring']:>9.1f} "
+              f"{r['ulysses']:>11.1f} {ratio:>13.2f} {winner}",
+              flush=True)
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "out",
                        "cp_compare.json")

@@ -14,6 +14,8 @@ import time
 import jax
 import jax.numpy as jnp
 
+_T0 = time.time()          # process start: soft budget for extra probes
+
 # Last-known TPU result, persisted on every TPU run and committed by the
 # window harvest — the CPU fallback attaches it as "stale_tpu" so the
 # driver artifact carries the real perf signal even when the tunnel is
@@ -108,6 +110,44 @@ def model_flops_per_token(cfg: GPTConfig, n_params: int, seq: int) -> float:
     # 6N matmul flops/token + causal attention 12*L*H*s/2 … standard MFU
     # accounting (PaLM appendix B)
     return 6.0 * n_params + 6.0 * cfg.num_layers * cfg.hidden_size * seq
+
+
+def _combo_probe(dt, batch, seq):
+    """Measure the never-measured combined levers (bf16 params x fused
+    streaming CE — VERDICT r4 weak #1) in a SUBPROCESS with a hard
+    timeout, reusing ``mfu_sweep.py --one``'s measurement path — an
+    in-process attempt could hang on a relay-death compile and cost the
+    secured headline (the exact failure mfu_sweep's per-config
+    subprocesses exist for). Returns a note string, or
+    ``(dt, batch, note)`` on a measured win. Every outcome leaves a
+    note — 'never ran' must be distinguishable from 'ran and lost'."""
+    sweep = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "workloads", "mfu_sweep.py")
+    secured_tps = batch * seq / dt
+    for b in (48, 32):
+        try:
+            r = subprocess.run(
+                [sys.executable, sweep, "--one", f"{b}:selective:1:auto",
+                 "--param-dtype", "bf16", "--ce", "fused"],
+                timeout=330, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            return f"combo b{b} timed out (relay hang?) — kept secured"
+        line = next((l for l in r.stdout.splitlines()
+                     if l.startswith("RESULT")), None)
+        if r.returncode != 0 or line is None:
+            tail = (r.stderr or r.stdout).strip().splitlines()[-1:]
+            if is_oom(RuntimeError(r.stderr + r.stdout)):
+                continue                     # smaller batch may fit
+            return f"combo b{b} failed: {(tail or ['?'])[0][:120]}"
+        # RESULT <mfu> <batch> <remat> <unroll> <attn> <ms> <tps> <kind>
+        dt_c = float(line.split()[5]) / 1e3
+        if b * seq / dt_c > secured_tps:
+            return (dt_c, b,
+                    f"combo adopted (bf16+fusedCE b{b}, "
+                    f"{b * seq / dt_c:.0f} vs {secured_tps:.0f} tok/s)")
+        return (f"combo measured slower ({b * seq / dt_c:.0f} vs "
+                f"{secured_tps:.0f} tok/s)")
+    return "combo: all batches OOM/compile-refused"
 
 
 def main():
@@ -233,6 +273,24 @@ def main():
             raise last_err
         if label == "winner":
             degraded = str(last_err or "winner config failed")[:200]
+
+    # -- opportunistic combo probe (round-5): the end-of-round bench is
+    # itself chip time, so with the headline SECURED above, spend a
+    # bounded slice of it measuring the never-measured combined levers
+    # (bf16 params x fused streaming CE — VERDICT r4 weak #1) and adopt
+    # only on a measured win. Guards: only when no sweep winner already
+    # encodes a measurement, only under a soft wall-clock budget, and
+    # any failure keeps the secured result.
+    combo_note = None
+    t_spent = time.time() - _T0
+    if on_tpu and dt is not None \
+            and not any(l == "winner" for l, *_ in attempts) \
+            and os.environ.get("HETU_BENCH_COMBO", "1") != "0" \
+            and user_ce is None and t_spent < 420:
+        combo_note = _combo_probe(dt, batch, seq)
+        if isinstance(combo_note, tuple):
+            dt, batch, combo_note = combo_note
+
     tokens_per_sec = batch * seq / dt
     flops = model_flops_per_token(cfg, n_params, seq) * tokens_per_sec
     peak = peak_flops(dev)
@@ -252,6 +310,8 @@ def main():
         # the sweep winner config failed and the built-ins carried the
         # number — visible so a winner-specific regression gets fixed
         result["degraded_from_winner"] = degraded
+    if combo_note is not None:
+        result["combo"] = combo_note
     if on_tpu:
         try:
             os.makedirs(os.path.dirname(_LAST_TPU_PATH), exist_ok=True)

@@ -20,6 +20,15 @@
 //   STATUS <timeout_ms>    -> ALIVE a,b,c DEAD d,e
 //   PING                   -> PONG
 //   SHUTDOWN               -> OK (server exits)
+//   AUTH <token>           -> OK | ERR bad token (connection closed)
+//
+// Auth: argv[3] (optional) is a shared secret. When set, a connection
+// must AUTH before any command other than PING (liveness probes stay
+// open); a wrong token or an unauthenticated command closes the
+// connection. The launcher generates a per-pool token and ships it to
+// workers via HETU_COORD_TOKEN (reference ships no auth on its gRPC
+// DeviceController; multi-host fleets bind 0.0.0.0, so a bearer token
+// is the minimum hardening).
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -30,6 +39,7 @@
 #include <algorithm>
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <set>
@@ -63,6 +73,10 @@ int main(int argc, char** argv) {
   // loopback by default; "0.0.0.0" (or another address) for multi-host
   // worker fleets (rpc/launcher.py ssh_hosts)
   const char* bind_addr = argc > 2 ? argv[2] : "127.0.0.1";
+  // token arrives via env, NOT argv: /proc/<pid>/cmdline is world-
+  // readable, so an argv token would leak to every local user
+  const char* tok_env = std::getenv("HETU_COORD_TOKEN");
+  const std::string token = tok_env ? tok_env : "";
 
   int srv = ::socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
@@ -88,6 +102,7 @@ int main(int argc, char** argv) {
   std::map<std::string, Barrier> barriers;
   std::map<std::string, int64_t> beats;
   std::map<int, std::string> bufs;
+  std::set<int> authed;
   bool running = true;
 
   std::vector<pollfd> fds{{srv, POLLIN, 0}};
@@ -105,6 +120,8 @@ int main(int argc, char** argv) {
       if (n <= 0) {
         ::close(fds[i].fd);
         bufs.erase(fds[i].fd);
+        authed.erase(fds[i].fd);  // OS reuses fd numbers: a later
+                                  // connection must not inherit auth
         fds[i].fd = -1;  // compacted below
         continue;
       }
@@ -118,7 +135,29 @@ int main(int argc, char** argv) {
         std::string cmd;
         ss >> cmd;
         int fd = fds[i].fd;
-        if (cmd == "RANK") {
+        if (!token.empty() && cmd != "PING" && !authed.count(fd)) {
+          if (cmd == "AUTH") {
+            std::string t;
+            ss >> t;
+            if (t == token) {
+              authed.insert(fd);
+              send_line(fd, "OK");
+              continue;
+            }
+            send_line(fd, "ERR bad token");
+          } else {
+            send_line(fd, "ERR auth required");
+          }
+          ::close(fd);
+          bufs.erase(fd);
+          fds[i].fd = -1;
+          break;  // drop the rest of this connection's buffered lines
+        }
+        if (cmd == "AUTH") {
+          // no-token server, or already authed: idempotent OK keeps
+          // clients config-agnostic
+          send_line(fd, "OK");
+        } else if (cmd == "RANK") {
           std::string name;
           ss >> name;
           auto it = ranks.find(name);

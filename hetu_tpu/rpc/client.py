@@ -8,6 +8,7 @@ KV/Barrier/HeartBeat) + the Python KV-store client
 from __future__ import annotations
 
 import json
+import os
 import socket
 import urllib.parse
 from typing import Any, Optional
@@ -15,10 +16,19 @@ from typing import Any, Optional
 
 class CoordinatorClient:
     def __init__(self, port: int, host: str = "127.0.0.1",
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 token: Optional[str] = None):
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
         self._buf = b""
+        # auth-enabled coordinators require AUTH first on every
+        # connection; workers inherit the pool's token via env
+        token = token if token is not None \
+            else os.environ.get("HETU_COORD_TOKEN")
+        if token:
+            resp = self._cmd(f"AUTH {token}")
+            if resp != "OK":
+                raise ConnectionError(f"coordinator auth failed: {resp}")
 
     def _cmd(self, line: str) -> str:
         self._sock.sendall(line.encode() + b"\n")

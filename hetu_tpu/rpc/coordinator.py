@@ -31,9 +31,14 @@ class Coordinator:
 
     def __init__(self, port: Optional[int] = None, *,
                  prefer_native: bool = True,
-                 bind: str = "127.0.0.1"):
+                 bind: str = "127.0.0.1",
+                 token: Optional[str] = None):
         self.port = port or _free_port()
         self.bind = bind
+        # shared-secret auth (optional): every client connection must
+        # AUTH <token> first; the launcher generates one per pool and
+        # ships it to workers as HETU_COORD_TOKEN
+        self.token = token or ""
         self._proc: Optional[subprocess.Popen] = None
         self._py_server = None
         if prefer_native and self._start_native():
@@ -49,8 +54,15 @@ class Coordinator:
             exe = build_native(_CSRC, "coordinator", shared=False)
             if exe is None:
                 return False
+            # token via env, not argv — /proc/<pid>/cmdline is world-
+            # readable on the coordinator host
+            env = dict(os.environ)
+            if self.token:
+                env["HETU_COORD_TOKEN"] = self.token
+            else:
+                env.pop("HETU_COORD_TOKEN", None)
             self._proc = subprocess.Popen(
-                [exe, str(self.port), self.bind],
+                [exe, str(self.port), self.bind], env=env,
                 stdout=subprocess.PIPE, text=True)
             line = self._proc.stdout.readline()
             return line.startswith("COORDINATOR READY")
@@ -63,14 +75,16 @@ class Coordinator:
     # -- python fallback ----------------------------------------------------
     def _start_python(self):
         from hetu_tpu.rpc.py_server import PyCoordinatorServer
-        self._py_server = PyCoordinatorServer(self.port, bind=self.bind)
+        self._py_server = PyCoordinatorServer(self.port, bind=self.bind,
+                                              token=self.token)
         self._py_server.start()
         self._py_server.wait_ready()
 
     def shutdown(self):
         try:
             from hetu_tpu.rpc.client import CoordinatorClient
-            CoordinatorClient(self.port).shutdown()
+            CoordinatorClient(self.port,
+                              token=self.token or None).shutdown()
         except Exception:
             pass
         if self._proc is not None:

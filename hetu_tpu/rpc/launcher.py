@@ -185,9 +185,14 @@ class ElasticWorkerPool:
 
     # -- lifecycle -----------------------------------------------------------
     def __enter__(self):
-        # multi-host fleets need a reachable coordinator
+        # multi-host fleets need a reachable coordinator; every pool
+        # gets a fresh bearer token (shipped to workers via
+        # HETU_COORD_TOKEN) — mandatory when binding beyond loopback
+        import secrets
+        self._token = secrets.token_hex(16)
         self.coordinator = Coordinator(
-            bind="0.0.0.0" if self.ssh_hosts else "127.0.0.1")
+            bind="0.0.0.0" if self.ssh_hosts else "127.0.0.1",
+            token=self._token)
         return self
 
     def __exit__(self, *exc):
@@ -203,6 +208,7 @@ class ElasticWorkerPool:
         # launcher-owned keys always win — they define the worker identity
         env.update({
             "HETU_COORD_PORT": str(self.coordinator.port),
+            "HETU_COORD_TOKEN": self._token,
             "HETU_NUM_PROCS": str(self.num_workers),
             "HETU_RANK": str(rank),
             "HETU_GENERATION": str(self.generation),
@@ -223,6 +229,7 @@ class ElasticWorkerPool:
             self._logs.append(log)
             env = self._worker_env(r)
             cmd = [sys.executable, self.script, *self.args]
+            stdin = None
             if self.ssh_hosts:
                 import shlex
                 host = self.ssh_hosts[r % len(self.ssh_hosts)]
@@ -230,15 +237,26 @@ class ElasticWorkerPool:
                 hetu_env = [shlex.quote(f"{k}={v}")
                             for k, v in env.items()
                             if k.startswith(("HETU_", "JAX_", "XLA_",
-                                             "PYTHONPATH"))]
+                                             "PYTHONPATH"))
+                            and k != "HETU_COORD_TOKEN"]
                 # -tt (in the default ssh_cmd): killing the local ssh
                 # client drops the remote tty, so the remote worker gets
-                # SIGHUP on generation teardown
-                cmd = [*self.ssh_cmd, host, "env", *hetu_env, "python3",
-                       shlex.quote(self.script),
+                # SIGHUP on generation teardown. The auth token travels
+                # over the ssh STDIN pipe, never on the remote command
+                # line — /proc/<pid>/cmdline is world-readable on every
+                # worker host.
+                cmd = [*self.ssh_cmd, host,
+                       "read -r HETU_COORD_TOKEN && export "
+                       "HETU_COORD_TOKEN && exec env", *hetu_env,
+                       "python3", shlex.quote(self.script),
                        *map(shlex.quote, self.args)]
-            self.procs.append(subprocess.Popen(
-                cmd, env=env, stdout=log, stderr=log))
+                stdin = subprocess.PIPE
+            p = subprocess.Popen(cmd, env=env, stdout=log, stderr=log,
+                                 stdin=stdin)
+            if stdin is not None:
+                p.stdin.write((self._token + "\n").encode())
+                p.stdin.flush()
+            self.procs.append(p)
         get_logger().info(
             f"pool: generation {self.generation} spawned "
             f"{self.num_workers} workers")

@@ -24,6 +24,8 @@ class _State:
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         st: _State = self.server.state  # type: ignore[attr-defined]
+        token: str = self.server.token  # type: ignore[attr-defined]
+        authed = False
         while True:
             line = self.rfile.readline()
             if not line:
@@ -32,7 +34,19 @@ class _Handler(socketserver.StreamRequestHandler):
             if not parts:
                 continue
             cmd, args = parts[0], parts[1:]
-            if cmd == "RANK":
+            # auth gate (same contract as coordinator.cpp): PING stays
+            # open for liveness probes, everything else needs the token
+            if token and cmd != "PING" and not authed:
+                if cmd == "AUTH" and args and args[0] == token:
+                    authed = True
+                    self._send("OK")
+                    continue
+                self._send("ERR bad token" if cmd == "AUTH"
+                           else "ERR auth required")
+                return                       # close the connection
+            if cmd == "AUTH":
+                self._send("OK")             # no-token / already authed
+            elif cmd == "RANK":
                 with st.lock:
                     r = st.ranks.setdefault(args[0], len(st.ranks))
                 self._send(f"RANK {r}")
@@ -88,9 +102,11 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class PyCoordinatorServer:
-    def __init__(self, port: int, bind: str = "127.0.0.1"):
+    def __init__(self, port: int, bind: str = "127.0.0.1",
+                 token: str = ""):
         self.bind = bind
         self.port = port
+        self.token = token
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._ready = threading.Event()
@@ -100,6 +116,7 @@ class PyCoordinatorServer:
         self._server = socketserver.ThreadingTCPServer(
             (self.bind, self.port), _Handler)
         self._server.state = _State()  # type: ignore[attr-defined]
+        self._server.token = self.token  # type: ignore[attr-defined]
         self._server.daemon_threads = True
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)

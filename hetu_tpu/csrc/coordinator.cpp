@@ -66,6 +66,15 @@ void send_line(int fd, const std::string& s) {
   ::send(fd, out.data(), out.size(), 0);
 }
 
+// constant-time equality (leaks only the length): AUTH on a bind-all
+// port must not hand out a byte-by-byte timing oracle
+bool token_eq(const std::string& a, const std::string& b) {
+  unsigned char diff = a.size() == b.size() ? 0 : 1;
+  for (size_t i = 0; i < a.size() && i < b.size(); ++i)
+    diff |= static_cast<unsigned char>(a[i] ^ b[i]);
+  return diff == 0;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -139,7 +148,7 @@ int main(int argc, char** argv) {
           if (cmd == "AUTH") {
             std::string t;
             ss >> t;
-            if (t == token) {
+            if (token_eq(t, token)) {
               authed.insert(fd);
               send_line(fd, "OK");
               continue;

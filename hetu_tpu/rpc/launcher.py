@@ -221,9 +221,13 @@ class ElasticWorkerPool:
         for r in range(self.num_workers):
             if self.log_dir:
                 os.makedirs(self.log_dir, exist_ok=True)
-                log = open(os.path.join(
-                    self.log_dir,
-                    f"g{self.generation}-w{r}.log"), "w")
+                path = os.path.join(self.log_dir,
+                                    f"g{self.generation}-w{r}.log")
+                # 0600: worker logs can carry secrets (e.g. a pty-echoed
+                # auth token line on ssh fleets)
+                log = os.fdopen(os.open(
+                    path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600),
+                    "w")
             else:
                 log = subprocess.DEVNULL
             self._logs.append(log)
@@ -244,18 +248,31 @@ class ElasticWorkerPool:
                 # SIGHUP on generation teardown. The auth token travels
                 # over the ssh STDIN pipe, never on the remote command
                 # line — /proc/<pid>/cmdline is world-readable on every
-                # worker host.
-                cmd = [*self.ssh_cmd, host,
-                       "read -r HETU_COORD_TOKEN && export "
-                       "HETU_COORD_TOKEN && exec env", *hetu_env,
-                       "python3", shlex.quote(self.script),
-                       *map(shlex.quote, self.args)]
+                # worker host. The remote bootstrap is wrapped in an
+                # explicit `sh -c` so csh/fish login shells work, and
+                # turns pty echo off (best-effort) before reading the
+                # token; the launcher-local log file is 0600 regardless,
+                # so even a raced echo never lands world-readable.
+                payload = (
+                    "stty -echo 2>/dev/null; read -r HETU_COORD_TOKEN; "
+                    "export HETU_COORD_TOKEN; exec env "
+                    + " ".join(hetu_env) + " python3 "
+                    + shlex.quote(self.script) + " "
+                    + " ".join(map(shlex.quote, self.args))).rstrip()
+                cmd = [*self.ssh_cmd, host, "sh", "-c",
+                       shlex.quote(payload)]
                 stdin = subprocess.PIPE
             p = subprocess.Popen(cmd, env=env, stdout=log, stderr=log,
                                  stdin=stdin)
             if stdin is not None:
-                p.stdin.write((self._token + "\n").encode())
-                p.stdin.flush()
+                try:
+                    p.stdin.write((self._token + "\n").encode())
+                    p.stdin.flush()
+                except OSError:
+                    # ssh died instantly (unreachable host): leave the
+                    # dead proc for the generation-restart loop, exactly
+                    # like any other worker death
+                    pass
             self.procs.append(p)
         get_logger().info(
             f"pool: generation {self.generation} spawned "

@@ -37,7 +37,9 @@ class _Handler(socketserver.StreamRequestHandler):
             # auth gate (same contract as coordinator.cpp): PING stays
             # open for liveness probes, everything else needs the token
             if token and cmd != "PING" and not authed:
-                if cmd == "AUTH" and args and args[0] == token:
+                import hmac
+                if cmd == "AUTH" and args \
+                        and hmac.compare_digest(args[0], token):
                     authed = True
                     self._send("OK")
                     continue

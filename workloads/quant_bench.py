@@ -20,7 +20,15 @@ Writes per-shape rows + the regime verdict to
 ``workloads/out/quant_bench.json`` (flushed per row — a relay death must
 not lose completed rows).
 
-Usage: python workloads/quant_bench.py
+Usage: python workloads/quant_bench.py          (on-chip timing)
+       python workloads/quant_bench.py --aot    (offline compiler check)
+
+``--aot`` needs NO chip: it compiles the same matmuls for the offline
+v5e target and reads XLA's cost analysis. The W8A16 claim stands or
+falls on whether the dequant is FUSED into the matmul's operand stream
+(weights stream from HBM as 1 byte each) or materialized (a full bf16
+copy is written+read, costing MORE than plain bf16): bytes-accessed
+tells which, per shape, straight from the compiler.
 """
 
 from __future__ import annotations
@@ -79,7 +87,58 @@ def time_ms(jitted, args):
     return (time.perf_counter() - t0) / ITERS * 1e3
 
 
+def aot_main():
+    """Offline fusion check: compile for the v5e topology, compare the
+    compiler's bytes-accessed against the fused/materialized bounds."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    jax.config.update("jax_platforms", "cpu")   # axon sitecustomize
+    import numpy as np
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    mesh = Mesh(np.array(list(topo.devices)[:1]), ("x",))
+    rep = NamedSharding(mesh, P())
+
+    def compiled_bytes(fn, *avals):
+        c = jax.jit(fn, out_shardings=rep).lower(*avals).compile()
+        ca = c.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+        return float(ca.get("bytes accessed", 0.0))
+
+    rows = []
+    for m, k, n in [(16, 4096, 4096), (256, 4096, 4096),
+                    (16, 768, 3072)]:
+        x = jax.ShapeDtypeStruct((m, k), jnp.bfloat16, sharding=rep)
+        wb = jax.ShapeDtypeStruct((k, n), jnp.bfloat16, sharding=rep)
+        q8 = jax.ShapeDtypeStruct((k, n), jnp.int8, sharding=rep)
+        s8 = jax.ShapeDtypeStruct((1, n), jnp.float32, sharding=rep)
+        b_bf16 = compiled_bytes(jnp.matmul, x, wb)
+        b_int8 = compiled_bytes(
+            lambda x, q, s: int8_matmul(x, q, s, dtype=jnp.bfloat16),
+            x, q8, s8)
+        io = 2 * (m * k + m * n)
+        fused = io + k * n + 4 * n        # int8 weights stream once
+        mat = io + 3 * k * n + 4 * n      # bf16 copy written + read
+        verdict = "fused" if abs(b_int8 - fused) < abs(b_int8 - mat) \
+            else "materialized"
+        rows.append({"m": m, "k": k, "n": n, "bf16_bytes": b_bf16,
+                     "int8_bytes": b_int8, "fused_bound": fused,
+                     "materialized_bound": mat, "verdict": verdict})
+        print(f"m={m:>4} k={k} n={n}  bf16 {b_bf16/2**20:7.1f}MiB  "
+              f"int8 {b_int8/2**20:7.1f}MiB  (fused bound "
+              f"{fused/2**20:.1f}, materialized {mat/2**20:.1f}) "
+              f"-> {verdict}", flush=True)
+    out = OUT.replace("quant_bench.json", "quant_aot.json")
+    with open(out, "w") as f:
+        json.dump({"target": "v5e (offline AOT)", "rows": rows}, f,
+                  indent=1)
+    print(f"wrote {out}")
+
+
 def main():
+    if "--aot" in sys.argv:
+        return aot_main()
     if jax.devices()[0].platform != "tpu":
         print(json.dumps({"error": "probe needs the TPU chip"}))
         return

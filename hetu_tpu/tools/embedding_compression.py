@@ -1,11 +1,12 @@
 """Embedding memory compression (recsys-scale embedding tables).
 
-TPU-native essential subset of the reference's
+TPU-native re-design of the reference's
 ``tools/EmbeddingMemoryCompression`` (~9.5k LoC of compression methods for
-HET/v1 recsys training — SURVEY §2.6 marks the full tool optional). The
-three methods that cover the tool's practical span, each a drop-in
-``nn.Module`` with the same ``(params, ids) -> (..., features)`` contract
-as :class:`~hetu_tpu.nn.layers.Embedding`:
+HET/v1 recsys training — SURVEY §2.6 marks the full tool optional). Seven
+method families spanning the reference zoo
+(``methods/layers/{hash,md?,quantize,dpq,mgqe,tensortrain,dhe,mde}.py``),
+each a drop-in ``nn.Module`` with the same ``(params, ids) ->
+(..., features)`` contract as :class:`~hetu_tpu.nn.layers.Embedding`:
 
 - :class:`HashEmbedding` — the hash trick with K independent hashes into a
   small table, combined by sum (compositional/"QR"-style collision
@@ -16,9 +17,19 @@ as :class:`~hetu_tpu.nn.layers.Embedding`:
   at lookup (storage 4× smaller than fp32; XLA fuses the dequant into the
   gather's consumer). Train-time: straight-through estimator — forward
   uses the quantized value, gradients flow to the latent fp table.
+- :class:`DPQEmbedding` — differentiable product quantization (VQ-STE)
+  with MGQE's frequency-tiered centroid prefixes; exports serving-side
+  (codes, codebooks).
+- :class:`TensorTrainEmbedding` — TT-Rec 3-core chain, pure batched
+  matmuls.
+- :class:`DeepHashEmbedding` — table-free: salted hash encoding → MLP.
+- :class:`MixedDimEmbedding` — frequency blocks at shrinking dims with
+  up-projections.
 """
 
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -132,3 +143,254 @@ class QuantizedEmbedding(Module):
 
     def quantized_state(self, params):
         return quantize_int8(params["weight"], axis=-1)
+
+
+class DPQEmbedding(Module):
+    """Differentiable product quantization (VQ variant) with MGQE's
+    frequency-tiered choice counts.
+
+    Parity: ``tools/EmbeddingMemoryCompression/methods/layers/dpq.py``
+    (latent query table + per-part key/value codebooks, straight-through
+    VQ) and ``layers/mgqe.py`` (low-frequency ids restricted to a
+    smaller centroid prefix). TPU-native shape: the part-wise nearest-
+    centroid search is one batched matmul-style distance computation
+    (MXU) instead of the reference's tile/argmax op chain.
+
+    Training keeps the fp latent table (like the reference); serving
+    memory is ``codes (V, D) uint8/16 + codebooks (D, K, E/D)`` —
+    ``compressed_state()`` exports both, ``compression_ratio`` reports
+    the serving-side factor.
+    """
+
+    def __init__(self, num_embeddings: int, features: int, *,
+                 num_parts: int = 4, num_choices: int = 256,
+                 low_num_choices: int = 0, init=None):
+        super().__init__()
+        if features % num_parts:
+            raise ValueError(f"features {features} % num_parts "
+                             f"{num_parts} != 0")
+        self.num_embeddings = num_embeddings
+        self.features = features
+        self.num_parts = num_parts
+        self.num_choices = num_choices
+        # MGQE: ids flagged low-frequency use only the first
+        # ``low_num_choices`` centroids (0 = plain DPQ)
+        self.low_num_choices = low_num_choices
+        self.param("weight", (num_embeddings, features),
+                   init or normal_init(0.02), axes=("vocab", "embed"))
+        self.param("codebooks",
+                   (num_parts, num_choices, features // num_parts),
+                   init or normal_init(0.02), axes=(None, None, None))
+
+    def _quantize(self, w, books, low_mask=None):
+        """(N, E) rows -> (N, E) nearest-centroid reconstruction.
+
+        Distances in the ||w||² − 2w·c + ||c||² matmul form: the cross
+        term is one (N,D,p)×(D,K,p) einsum on the MXU and the largest
+        intermediate is the (N, D, K) distance table itself — the naive
+        broadcast difference would materialize (N, D, K, p)."""
+        N = w.shape[0]
+        parts = w.reshape(N, self.num_parts, -1)
+        dots = jnp.einsum("ndp,dkp->ndk", parts, books)
+        w2 = jnp.sum(parts ** 2, axis=-1)[..., None]
+        c2 = jnp.sum(books ** 2, axis=-1)[None]
+        d2 = w2 - 2.0 * dots + c2                          # (N, D, K)
+        if self.low_num_choices and low_mask is not None:
+            k = jnp.arange(self.num_choices)
+            banned = (k[None, None, :] >= self.low_num_choices) \
+                & low_mask[:, None, None]
+            d2 = jnp.where(banned, jnp.inf, d2)
+        codes = jnp.argmin(d2, axis=-1)                    # (N, D)
+        sel = jnp.take_along_axis(
+            books[None], codes[..., None, None], axis=2)[:, :, 0]
+        return sel.reshape(N, self.features), codes
+
+    def __call__(self, params, ids, *, low_freq_mask=None):
+        dt = self.compute_dtype()
+        rows = jnp.take(params["weight"], ids.reshape(-1), axis=0)
+        mask = None if low_freq_mask is None else low_freq_mask.reshape(-1)
+        deq, _ = self._quantize(rows, params["codebooks"], mask)
+        # straight-through: forward sees the quantized value, gradients
+        # reach BOTH the latent rows (identity) and the codebooks (deq)
+        out = rows + (deq - jax.lax.stop_gradient(rows))
+        return out.reshape(*ids.shape, self.features).astype(dt)
+
+    def compressed_state(self, params, low_freq_mask=None):
+        """(codes (V, D), codebooks) — the serving-side artifact.
+
+        ``low_freq_mask`` (V,): pass the SAME frequency tiers training
+        used, or the exported codes for low-frequency ids can index
+        centroids the trained forward never emitted."""
+        _, codes = self._quantize(params["weight"], params["codebooks"],
+                                  low_freq_mask)
+        dtype = jnp.uint8 if self.num_choices <= 256 else jnp.uint16
+        return codes.astype(dtype), params["codebooks"]
+
+    @property
+    def compression_ratio(self) -> float:
+        dense = self.num_embeddings * self.features * 4
+        code_bytes = 1 if self.num_choices <= 256 else 2
+        comp = self.num_embeddings * self.num_parts * code_bytes \
+            + self.num_parts * self.num_choices \
+            * (self.features // self.num_parts) * 4
+        return dense / comp
+
+
+class TensorTrainEmbedding(Module):
+    """TT-Rec: the table as a 3-core tensor train.
+
+    Parity: ``tools/EmbeddingMemoryCompression/methods/layers/
+    tensortrain.py``. id factors into (i1, i2, i3) over voc_quants,
+    features into (e1, e2, e3); a row is the chained core contraction
+    ``G1[i1] (1,e1·r) @ G2[i2] (r, e2·r-ish) @ G3[i3] (r, e3)`` — pure
+    batched matmuls, MXU-shaped by construction.
+    """
+
+    def __init__(self, voc_quants, emb_quants, *, rank: int = 8,
+                 init=None):
+        super().__init__()
+        if len(voc_quants) != 3 or len(emb_quants) != 3:
+            raise ValueError("TT-Rec here uses exactly 3 cores")
+        self.voc_quants = tuple(voc_quants)
+        self.emb_quants = tuple(emb_quants)
+        self.rank = rank
+        self.num_embeddings = math.prod(voc_quants)
+        self.features = math.prod(emb_quants)
+        v1, v2, v3 = voc_quants
+        e1, e2, e3 = emb_quants
+        # per-core init std: the 3-product's std should come out ~0.02
+        std = 0.02 ** (1 / 3) / rank ** (1 / 3)
+        self.param("g1", (v1, e1, rank), init or normal_init(std),
+                   axes=(None, None, None))
+        self.param("g2", (v2, rank, e2, rank),
+                   init or normal_init(std), axes=(None, None, None, None))
+        self.param("g3", (v3, rank, e3), init or normal_init(std),
+                   axes=(None, None, None))
+
+    def __call__(self, params, ids):
+        dt = self.compute_dtype()
+        v1, v2, v3 = self.voc_quants
+        flat = ids.reshape(-1)
+        i3 = flat % v3
+        i2 = (flat // v3) % v2
+        i1 = flat // (v2 * v3)
+        g1 = jnp.take(params["g1"], i1, axis=0).astype(dt)  # (N,e1,r)
+        g2 = jnp.take(params["g2"], i2, axis=0).astype(dt)  # (N,r,e2,r)
+        g3 = jnp.take(params["g3"], i3, axis=0).astype(dt)  # (N,r,e3)
+        x = jnp.einsum("nar,nrbs->nabs", g1, g2)            # (N,e1,e2,r)
+        x = jnp.einsum("nabs,nsc->nabc", x, g3)             # (N,e1,e2,e3)
+        return x.reshape(*ids.shape, self.features)
+
+    @property
+    def compression_ratio(self) -> float:
+        v1, v2, v3 = self.voc_quants
+        e1, e2, e3 = self.emb_quants
+        r = self.rank
+        dense = self.num_embeddings * self.features
+        tt = v1 * e1 * r + v2 * r * e2 * r + v3 * r * e3
+        return dense / tt
+
+
+class DeepHashEmbedding(Module):
+    """DHE: no table at all — k salted hashes of the id form a dense
+    encoding that a small MLP decodes into the embedding.
+
+    Parity: ``tools/EmbeddingMemoryCompression/methods/layers/dhe.py``
+    (hash encoding + MLP decoder). Memory is O(MLP), independent of
+    vocabulary; the whole lookup is dense math (no gather at all), the
+    friendliest possible shape for the MXU.
+    """
+
+    def __init__(self, num_embeddings: int, features: int, *,
+                 num_hashes: int = 32, hidden: int = 64,
+                 num_layers: int = 2, init=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.features = features
+        self.num_hashes = num_hashes
+        self.num_layers = num_layers
+        dims = [num_hashes] + [hidden] * (num_layers - 1) + [features]
+        for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+            self.param(f"w{i}", (a, b),
+                       init or normal_init(a ** -0.5), axes=(None, None))
+            self.param(f"b{i}", (b,), normal_init(0.0), axes=(None,))
+
+    def _encode(self, ids):
+        # k salted avalanche hashes -> uniform(-1, 1) floats
+        u = ids.astype(jnp.uint32)[..., None]
+        salts = jnp.arange(1, self.num_hashes + 1,
+                           dtype=jnp.uint32) * jnp.uint32(0x9E3779B9)
+        h = _mix32(u ^ salts[None])
+        return h.astype(jnp.float32) / jnp.float32(2 ** 31) - 1.0
+
+    def __call__(self, params, ids):
+        dt = self.compute_dtype()
+        x = self._encode(ids.reshape(-1)).astype(dt)
+        for i in range(self.num_layers):
+            x = jnp.matmul(x, params[f"w{i}"].astype(dt)) \
+                + params[f"b{i}"].astype(dt)
+            if i < self.num_layers - 1:
+                x = jax.nn.gelu(x)
+        return x.reshape(*ids.shape, self.features)
+
+    @property
+    def compression_ratio(self) -> float:
+        n = sum(math.prod(s.shape)
+                for s in self._param_specs.values())
+        return self.num_embeddings * self.features / n
+
+
+class MixedDimEmbedding(Module):
+    """Mixed-dimension embedding: frequency-ordered vocab blocks get
+    shrinking dims, each projected up to ``features``.
+
+    Parity: ``tools/EmbeddingMemoryCompression/methods/layers/mde.py``
+    (the MD scheme: hot block full-dim, cold blocks d/2^k + projection).
+    Assumes ids are frequency-ordered (the recsys convention the
+    reference's frequency partitioner produces); block boundaries come
+    from ``block_sizes``.
+    """
+
+    def __init__(self, block_sizes, features: int, *,
+                 dim_decay: int = 4, init=None):
+        super().__init__()
+        self.block_sizes = tuple(block_sizes)
+        self.features = features
+        self.num_embeddings = int(sum(block_sizes))
+        self.dims = []
+        d = features
+        for i, v in enumerate(self.block_sizes):
+            self.dims.append(max(1, d))
+            self.param(f"table{i}", (v, max(1, d)),
+                       init or normal_init(0.02), axes=("vocab", None))
+            if max(1, d) != features:
+                self.param(f"proj{i}", (max(1, d), features),
+                           init or normal_init(max(1, d) ** -0.5),
+                           axes=(None, "embed"))
+            d //= dim_decay
+
+    def __call__(self, params, ids):
+        dt = self.compute_dtype()
+        flat = ids.reshape(-1)
+        out = jnp.zeros((flat.shape[0], self.features), dt)
+        lo = 0
+        for i, v in enumerate(self.block_sizes):
+            in_block = (flat >= lo) & (flat < lo + v)
+            local = jnp.clip(flat - lo, 0, v - 1)
+            rows = jnp.take(params[f"table{i}"].astype(dt), local,
+                            axis=0)
+            if self.dims[i] != self.features:
+                rows = jnp.matmul(rows, params[f"proj{i}"].astype(dt))
+            out = out + jnp.where(in_block[:, None], rows, 0)
+            lo += v
+        return out.reshape(*ids.shape, self.features)
+
+    @property
+    def compression_ratio(self) -> float:
+        dense = self.num_embeddings * self.features
+        comp = sum(v * d + (d * self.features if d != self.features
+                            else 0)
+                   for v, d in zip(self.block_sizes, self.dims))
+        return dense / comp
+
+

@@ -1,0 +1,21 @@
+"""Shared integer bit-mixing primitives.
+
+One home for the murmur3 fmix32 finalizer used by every counter-based
+RNG / hash family in the framework (flash-kernel dropout masks, hash
+embeddings, deep hash encodings) — a constant tweak must not silently
+diverge between copies.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fmix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 fmix32: full-avalanche 32-bit mixer (uint32 in/out)."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    return x

@@ -42,8 +42,9 @@ class GPTConfig:
     embd_pdrop: float = 0.0
     resid_pdrop: float = 0.0
     # dropout on attention probabilities (reference flash wrapper's
-    # p_dropout, ``hetu/impl/kernel/FlashAttention.cu:1-50``); >0 forces
-    # the XLA attention path — the Pallas kernel has no PRNG
+    # p_dropout, ``hetu/impl/kernel/FlashAttention.cu:1-50``); carried
+    # by both attention paths — in-kernel counter-RNG masks on Pallas
+    # (``ops/flash_pallas._dropout_keep``), jax.random on XLA
     attn_pdrop: float = 0.0
     # MoE (0 experts = dense; parity: HetuMoE GPT, BASELINE config 4)
     num_experts: int = 0

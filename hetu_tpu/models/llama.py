@@ -43,8 +43,8 @@ class LlamaConfig:
     # residual dropout (0.0 = Llama-standard; nonzero is the common SFT
     # regularizer). Keys threaded by the train step; eval never drops.
     resid_pdrop: float = 0.0
-    # dropout on attention probs (reference flash p_dropout); >0 forces
-    # the XLA attention path — the Pallas kernel has no PRNG
+    # dropout on attention probs (reference flash p_dropout); carried
+    # by both attention paths — in-kernel counter-RNG masks on Pallas
     attn_pdrop: float = 0.0
     # MoE (0 experts = dense; experts are SwiGLU like the dense MLP)
     num_experts: int = 0

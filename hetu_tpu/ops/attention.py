@@ -95,40 +95,39 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ``impl``: "auto" | "pallas" | "reference".
 
     Attention dropout (``dropout_rate`` > 0 with a live ``dropout_key``)
-    forces the XLA reference path: the Pallas kernel carries no PRNG
-    state, and XLA fuses mask generation into the prob/value matmul well
-    enough that a bespoke kernel buys little at dropout's training-only
-    shapes. An EXPLICIT ``impl="pallas"`` with active dropout raises
-    rather than silently dropping the mask (parity note: the reference
-    wrapper's p_dropout rides the flash kernel's own RNG,
-    ``hetu/impl/kernel/FlashAttention.cu:1-50``).
+    is carried by BOTH paths (parity: the reference wrapper's p_dropout
+    rides the flash kernel's RNG, ``hetu/impl/kernel/
+    FlashAttention.cu:1-50``): the Pallas kernels regenerate a
+    position-addressable counter-RNG mask in forward and backward
+    (``flash_pallas._dropout_keep``), the reference path drops the
+    softmax probs with ``jax.random``. The two paths draw DIFFERENT
+    masks (their RNGs differ) — same distribution, not bit-identical.
     """
-    drop_active = dropout_rate > 0.0 and dropout_key is not None
-    if drop_active and impl == "pallas":
-        raise ValueError(
-            "attention dropout is not implemented in the Pallas flash "
-            "kernel — use impl='auto' (dropout forces the XLA reference "
-            "path) or attn_pdrop=0")
     if impl == "auto":
         # Pallas kernel on real TPU; on CPU the XLA-fused oracle is faster
         # than interpret-mode Pallas.
-        impl = "pallas" if not drop_active and _on_tpu() \
-            and _pallas_supported(q, k) else "reference"
+        impl = "pallas" if _on_tpu() and _pallas_supported(q, k) \
+            else "reference"
     if impl == "pallas":
         out = _pallas_sharded_call(q, k, v, causal=causal,
-                                   segment_ids=segment_ids, scale=scale)
+                                   segment_ids=segment_ids, scale=scale,
+                                   dropout_rate=dropout_rate,
+                                   dropout_key=dropout_key)
         if out is not None:
             return out
         from hetu_tpu.ops.flash_pallas import flash_attention_pallas
         return flash_attention_pallas(q, k, v, causal=causal,
-                                      segment_ids=segment_ids, scale=scale)
+                                      segment_ids=segment_ids, scale=scale,
+                                      dropout_rate=dropout_rate,
+                                      dropout_key=dropout_key)
     return attention_reference(q, k, v, causal=causal,
                                segment_ids=segment_ids, scale=scale,
                                dropout_rate=dropout_rate,
                                dropout_key=dropout_key)
 
 
-def _pallas_sharded_call(q, k, v, *, causal, segment_ids, scale):
+def _pallas_sharded_call(q, k, v, *, causal, segment_ids, scale,
+                         dropout_rate=0.0, dropout_key=None):
     """Run the Pallas kernel per-device under ``shard_map`` when the
     batch/head dims are mesh-sharded.
 
@@ -185,11 +184,21 @@ def _pallas_sharded_call(q, k, v, *, causal, segment_ids, scale):
     from hetu_tpu.ops.flash_pallas import flash_attention_pallas
 
     qkv_spec = P(batch_ax, None, head_ax, None)
+    drop_active = dropout_rate > 0.0 and dropout_key is not None
 
     def local(q, k, v, *seg):
+        key = dropout_key
+        if drop_active:
+            # decorrelate shards: without the fold-in, every shard's
+            # local (batch, head) indices draw the same mask
+            for ax in (batch_ax, head_ax):
+                if ax is not None:
+                    key = jax.random.fold_in(key, jax.lax.axis_index(ax))
         return flash_attention_pallas(
             q, k, v, causal=causal, scale=scale,
-            segment_ids=seg[0] if seg else None)
+            segment_ids=seg[0] if seg else None,
+            dropout_rate=dropout_rate if drop_active else 0.0,
+            dropout_key=key)
 
     if segment_ids is None:
         fn = shard_map(local, mesh=mesh, in_specs=(qkv_spec,) * 3,

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from hetu_tpu.core.bits import fmix32
+
 NEG_INF = -1e30
 NUM_LANES = 128
 NUM_SUBLANES = 8
@@ -103,6 +105,30 @@ def _expand_kv_ids(seg: jnp.ndarray) -> jnp.ndarray:
         seg, (seg.shape[0], NUM_SUBLANES, seg.shape[1]), (0, 2))
 
 
+def _dropout_keep(seed, ib, ih, iq, ik, *, rate, block_q, block_k,
+                  q_offset, kv_offset):
+    """(block_q, block_k) bool keep-mask from a counter-based RNG.
+
+    Addressed by ABSOLUTE (q, k) position + (batch, head) + seed — not by
+    block indices — so the forward and both backward kernels regenerate
+    the IDENTICAL mask even when their tuned block sizes differ (the
+    same property the reference gets from flash-attn's philox offsets,
+    ``hetu/impl/kernel/FlashAttention.cu:1-50``). Pure uint32 jnp ops:
+    one code path for Mosaic and interpret modes.
+    """
+    qpos = jnp.uint32(iq * block_q + q_offset) + jax.lax.broadcasted_iota(
+        jnp.uint32, (block_q, block_k), 0)
+    kpos = jnp.uint32(ik * block_k + kv_offset) + jax.lax.broadcasted_iota(
+        jnp.uint32, (block_q, block_k), 1)
+    salt = fmix32(jnp.uint32(seed)
+                   ^ (jnp.uint32(ib) * jnp.uint32(0x27D4EB2F))
+                   ^ (jnp.uint32(ih) * jnp.uint32(0x165667B1)))
+    u = fmix32((qpos * jnp.uint32(0x9E3779B1))
+                ^ (kpos * jnp.uint32(0x85EBCA77)) ^ salt)
+    threshold = jnp.uint32(min(2 ** 32 - 1, int(rate * 2 ** 32)))
+    return u >= threshold
+
+
 def _block_live(iq, ik, *, causal, block_q, block_k, q_offset, kv_offset):
     """Scalar predicate: does this (q_block, kv_block) cell have any live
     causal entry? Cells entirely above the diagonal are skipped with
@@ -136,9 +162,12 @@ def _mask_for_block(iq, ik, *, block_q, block_k, causal,
 # Forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, seed_ref,
                 o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                causal, block_q, block_k, kv_blocks, q_offset, kv_offset):
+                causal, block_q, block_k, kv_blocks, q_offset, kv_offset,
+                dropout_rate=0.0):
+    ib = pl.program_id(0)
+    ih = pl.program_id(1)
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -175,6 +204,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
         alpha = jnp.exp(m_prev - m_next)
         m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(alpha * l_prev + l_cur, l_scr.shape)
+        if dropout_rate > 0.0:
+            # dropout on the (later-normalized) probs: mask only the
+            # VALUE accumulation — the denominator l stays un-dropped,
+            # so out = Σ mask∘softmax∘V / keep and LSE is unchanged
+            keep = _dropout_keep(seed_ref[0], ib, ih, iq, ik,
+                                 rate=dropout_rate, block_q=block_q,
+                                 block_k=block_k, q_offset=q_offset,
+                                 kv_offset=kv_offset)
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         pv = jax.lax.dot_general(p.astype(v.dtype), v,
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -199,7 +237,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
 
 def _flash_fwd(q, k, v, q_seg, kv_seg, *, causal, scale,
                q_offset=0, kv_offset=0, interpret=None,
-               block_q=None, block_k=None):
+               block_q=None, block_k=None,
+               dropout_rate=0.0, seed=None):
     """q (b,hq,sq,d); k/v (b,hkv,sk,d); seg ids (b,s) or None.
 
     Returns out (b,hq,sq,d) and lse (b,hq,sq) (natural-log-sum-exp of the
@@ -227,15 +266,19 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, *, causal, scale,
                      lambda ib, ih, iq, ik: (ib, ih // rep, ik, 0)),
     ]
     args = [qf, k, v]
-    if q_seg is not None:
+    has_seg = q_seg is not None
+    has_drop = dropout_rate > 0.0
+    if has_seg:
         in_specs.append(pl.BlockSpec(
             (1, block_q, NUM_LANES), lambda ib, ih, iq, ik: (ib, iq, 0)))
         in_specs.append(pl.BlockSpec(
             (1, NUM_SUBLANES, block_k), lambda ib, ih, iq, ik: (ib, 0, ik)))
         args += [_expand_q_ids(q_seg), _expand_kv_ids(kv_seg)]
-        kernel = _fwd_kernel
-    else:
-        kernel = functools.partial(_seg_none_wrapper, _fwd_kernel, 3)
+    if has_drop:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.asarray(seed, jnp.int32).reshape(1))
+    kernel = functools.partial(_opt_refs_wrapper, _fwd_kernel, 3,
+                               has_seg, has_drop)
 
     out_shape = [
         jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
@@ -249,7 +292,8 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, *, causal, scale,
     out, lse_l = pl.pallas_call(
         functools.partial(kernel, causal=causal, block_q=block_q,
                           block_k=block_k, kv_blocks=kv_blocks,
-                          q_offset=q_offset, kv_offset=kv_offset),
+                          q_offset=q_offset, kv_offset=kv_offset,
+                          dropout_rate=dropout_rate),
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
@@ -267,11 +311,22 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, *, causal, scale,
     return out, lse_l[..., 0]
 
 
-def _seg_none_wrapper(kernel, n_tensor_args, *refs, **kw):
-    """Adapts a kernel expecting (tensor refs..., qseg, kseg, outs...) to a
-    call with no segment refs."""
-    ins, outs = refs[:n_tensor_args], refs[n_tensor_args:]
-    kernel(*ins, None, None, *outs, **kw)
+def _opt_refs_wrapper(kernel, n_tensor, has_seg, has_seed, *refs, **kw):
+    """Adapts a kernel expecting (tensor refs..., qseg, kseg, seed,
+    outs/scratch...) to a call where the optional refs may be absent —
+    pallas passes only the refs that were given specs, in order."""
+    idx = n_tensor
+    if has_seg:
+        qseg, kseg = refs[idx], refs[idx + 1]
+        idx += 2
+    else:
+        qseg = kseg = None
+    if has_seed:
+        seed = refs[idx]
+        idx += 1
+    else:
+        seed = None
+    kernel(*refs[:n_tensor], qseg, kseg, seed, *refs[idx:], **kw)
 
 
 # --------------------------------------------------------------------------
@@ -279,8 +334,11 @@ def _seg_none_wrapper(kernel, n_tensor_args, *refs, **kw):
 # --------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   qseg_ref, kseg_ref, dq_ref, dq_scr, *,
-                   causal, block_q, block_k, kv_blocks, q_offset, kv_offset):
+                   qseg_ref, kseg_ref, seed_ref, dq_ref, dq_scr, *,
+                   causal, block_q, block_k, kv_blocks, q_offset,
+                   kv_offset, dropout_rate=0.0):
+    ib = pl.program_id(0)
+    ih = pl.program_id(1)
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -312,6 +370,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # dA = mask ∘ (dO Vᵀ) / keep; delta = Σ dO∘O is invariant
+            # under dropout (see _flash_bwd docnote), so ds keeps form
+            keep = _dropout_keep(seed_ref[0], ib, ih, iq, ik,
+                                 rate=dropout_rate, block_q=block_q,
+                                 block_k=block_k, q_offset=q_offset,
+                                 kv_offset=kv_offset)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta)    # (bq, bk), fp32
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -331,8 +397,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    causal, block_q, block_k, q_blocks, q_offset, kv_offset):
+                    qseg_ref, kseg_ref, seed_ref, dk_ref, dv_ref,
+                    dk_scr, dv_scr, *,
+                    causal, block_q, block_k, q_blocks, q_offset,
+                    kv_offset, dropout_rate=0.0):
+    ib = pl.program_id(0)
+    ih = pl.program_id(1)
     ik = pl.program_id(2)
     iq = pl.program_id(3)
 
@@ -363,13 +433,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
 
-        # dV += P^T @ dO
+        keep = None
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], ib, ih, iq, ik,
+                                 rate=dropout_rate, block_q=block_q,
+                                 block_k=block_k, q_offset=q_offset,
+                                 kv_offset=kv_offset)
+        # dV += Ad^T @ dO (Ad = dropped probs — what the forward output
+        # actually mixed)
+        p_v = p if keep is None else \
+            jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         dv_scr[...] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        # dS = P * (dO @ V^T - delta);  dK += dS^T @ Q
+        # dS = P * (mask∘(dO @ V^T)/keep - delta);  dK += dS^T @ Q
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if keep is not None:
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta)
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -391,12 +472,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
                q_offset=0, kv_offset=0, interpret=None, delta=None,
-               block_q=None, block_k=None):
+               block_q=None, block_k=None, dropout_rate=0.0, seed=None):
     """Returns (dq, dk, dv) in input dtypes/shapes ((b,h,s,d) layout).
 
     ``delta`` (b,hq,sq) fp32 may be precomputed by the caller (ring
     attention passes the globally-combined value); defaults to
-    sum(out*do, -1)."""
+    sum(out*do, -1). Dropout note: delta = Σ dO∘O equals
+    Σ dA∘A even with dropout (dAd∘Ad = M∘dAd∘A/keep = dA∘A since the
+    0/1 mask is idempotent), so the delta trick needs no correction —
+    the kernels regenerate the forward's position-hashed mask and apply
+    it to dO·Vᵀ (dq/dk) and to the dV-side probs."""
     b, hq, sq, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     rep = hq // hkv
@@ -418,6 +503,12 @@ def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
     lane_spec_q = pl.BlockSpec((1, 1, block_q, NUM_LANES),
                                lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     args = [qf, k, v, do, lse_l, delta_l]
+    has_seg = q_seg is not None
+    has_drop = dropout_rate > 0.0
+    seed_args, seed_specs = [], []
+    if has_drop:
+        seed_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        seed_args = [jnp.asarray(seed, jnp.int32).reshape(1)]
     seg_args, seg_specs_dq, seg_specs_dkv = [], [], []
     if q_seg is not None:
         seg_args = [_expand_q_ids(q_seg), _expand_kv_ids(kv_seg)]
@@ -444,13 +535,14 @@ def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
         pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         lane_spec_q,
         lane_spec_q,
-    ] + seg_specs_dq
-    dq_kernel = _bwd_dq_kernel if q_seg is not None else functools.partial(
-        _seg_none_wrapper, _bwd_dq_kernel, 6)
+    ] + seg_specs_dq + seed_specs
+    dq_kernel = functools.partial(_opt_refs_wrapper, _bwd_dq_kernel, 6,
+                                  has_seg, has_drop)
     dq = pl.pallas_call(
         functools.partial(dq_kernel, causal=causal, block_q=block_q,
                           block_k=block_k, kv_blocks=sk // block_k,
-                          q_offset=q_offset, kv_offset=kv_offset),
+                          q_offset=q_offset, kv_offset=kv_offset,
+                          dropout_rate=dropout_rate),
         grid=(b, hq, sq // block_q, sk // block_k),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d),
@@ -461,7 +553,7 @@ def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(*args, *seg_args)
+    )(*args, *seg_args, *seed_args)
     dq = (dq * scale).astype(q.dtype)  # undo the q-scale folding
 
     # ---- dK/dV: grid (b, hq, kv_blocks, q_blocks), accumulate over q ----
@@ -478,15 +570,16 @@ def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
                      lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
         pl.BlockSpec((1, 1, block_q, NUM_LANES),
                      lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
-    ] + seg_specs_dkv
-    dkv_kernel = _bwd_dkv_kernel if q_seg is not None else functools.partial(
-        _seg_none_wrapper, _bwd_dkv_kernel, 6)
+    ] + seg_specs_dkv + seed_specs
+    dkv_kernel = functools.partial(_opt_refs_wrapper, _bwd_dkv_kernel, 6,
+                                   has_seg, has_drop)
     kv_out_spec = pl.BlockSpec((1, 1, block_k, d),
                                lambda ib, ih, ik, iq: (ib, ih, ik, 0))
     dk, dv = pl.pallas_call(
         functools.partial(dkv_kernel, causal=causal, block_q=block_q,
                           block_k=block_k, q_blocks=sq // block_q,
-                          q_offset=q_offset, kv_offset=kv_offset),
+                          q_offset=q_offset, kv_offset=kv_offset,
+                          dropout_rate=dropout_rate),
         grid=(b, hq, sk // block_k, sq // block_q),
         in_specs=dkv_specs,
         out_specs=[kv_out_spec, kv_out_spec],
@@ -498,7 +591,7 @@ def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(*args, *seg_args)
+    )(*args, *seg_args, *seed_args)
     if rep > 1:
         dk = dk.reshape(b, hkv, rep, sk, d).sum(axis=2)
         dv = dv.reshape(b, hkv, rep, sk, d).sum(axis=2)
@@ -510,22 +603,25 @@ def _flash_bwd(q, k, v, q_seg, kv_seg, out, lse, do, *, causal, scale,
 # Public custom_vjp entry point — (b, s, h, d) layout like ops.attention
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash_core(q, k, v, q_seg, kv_seg, causal, scale, interpret, blocks):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash_core(q, k, v, q_seg, kv_seg, seed, causal, scale, interpret,
+                blocks, dropout_rate):
     out, _ = _flash_fwd(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                         jnp.swapaxes(v, 1, 2), q_seg, kv_seg,
                         causal=causal, scale=scale, interpret=interpret,
-                        block_q=blocks[0], block_k=blocks[1])
+                        block_q=blocks[0], block_k=blocks[1],
+                        dropout_rate=dropout_rate, seed=seed)
     return jnp.swapaxes(out, 1, 2)
 
 
-def _flash_core_fwd(q, k, v, q_seg, kv_seg, causal, scale, interpret,
-                    blocks):
+def _flash_core_fwd(q, k, v, q_seg, kv_seg, seed, causal, scale,
+                    interpret, blocks, dropout_rate):
     from jax.ad_checkpoint import checkpoint_name
     qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     out, lse = _flash_fwd(qh, kh, vh, q_seg, kv_seg, causal=causal,
                           scale=scale, interpret=interpret,
-                          block_q=blocks[0], block_k=blocks[1])
+                          block_q=blocks[0], block_k=blocks[1],
+                          dropout_rate=dropout_rate, seed=seed)
     # Name the kernel residuals so remat policies can pin them: without
     # these tags, ``remat="selective"`` recomputes the whole forward
     # kernel inside the backward (saving dots doesn't cover a Pallas
@@ -534,17 +630,20 @@ def _flash_core_fwd(q, k, v, q_seg, kv_seg, causal, scale, interpret,
     # layer.
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return jnp.swapaxes(out, 1, 2), (qh, kh, vh, q_seg, kv_seg, out, lse)
+    return jnp.swapaxes(out, 1, 2), (qh, kh, vh, q_seg, kv_seg, seed,
+                                     out, lse)
 
 
-def _flash_core_bwd(causal, scale, interpret, blocks, res, g):
-    qh, kh, vh, q_seg, kv_seg, out, lse = res
+def _flash_core_bwd(causal, scale, interpret, blocks, dropout_rate,
+                    res, g):
+    qh, kh, vh, q_seg, kv_seg, seed, out, lse = res
     dq, dk, dv = _flash_bwd(qh, kh, vh, q_seg, kv_seg, out, lse,
                             jnp.swapaxes(g, 1, 2), causal=causal,
                             scale=scale, interpret=interpret,
-                            block_q=blocks[0], block_k=blocks[1])
+                            block_q=blocks[0], block_k=blocks[1],
+                            dropout_rate=dropout_rate, seed=seed)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
-            jnp.swapaxes(dv, 1, 2), None, None)
+            jnp.swapaxes(dv, 1, 2), None, None, None)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -556,17 +655,29 @@ def flash_attention_pallas(q, k, v, *, causal: bool = False,
                            scale: Optional[float] = None,
                            interpret: Optional[bool] = None,
                            block_q: Optional[int] = None,
-                           block_k: Optional[int] = None):
+                           block_k: Optional[int] = None,
+                           dropout_rate: float = 0.0,
+                           dropout_key: Optional[jax.Array] = None):
     """Flash attention, (batch, seq, heads, head_dim) layout, GQA allowed.
 
     Differentiable via fused Pallas backward kernels. ``segment_ids`` enables
     packed/varlen batches (positions attend only within equal ids).
     ``block_q``/``block_k`` override the default tiling (must divide the
     seq lens) — see ``workloads/flash_tune.py`` for the autotune sweep.
+
+    ``dropout_rate``/``dropout_key``: in-kernel attention-prob dropout
+    (reference flash wrapper's p_dropout). The key collapses to a uint32
+    seed feeding a position-addressable counter RNG (``_dropout_keep``),
+    so the backward kernels regenerate the identical mask with no stored
+    mask tensor and independently tuned block sizes.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     if segment_ids is not None and kv_segment_ids is None:
         kv_segment_ids = segment_ids
-    return _flash_core(q, k, v, segment_ids, kv_segment_ids,
-                       causal, scale, interpret, (block_q, block_k))
+    drop_active = dropout_rate > 0.0 and dropout_key is not None
+    seed = jax.random.bits(dropout_key, (1,), jnp.uint32
+                           ).astype(jnp.int32) if drop_active else None
+    return _flash_core(q, k, v, segment_ids, kv_segment_ids, seed,
+                       causal, scale, interpret, (block_q, block_k),
+                       dropout_rate if drop_active else 0.0)

@@ -34,6 +34,7 @@ import math
 import jax
 import jax.numpy as jnp
 
+from hetu_tpu.core.bits import fmix32
 from hetu_tpu.nn.module import Module, normal_init
 from hetu_tpu.ops.quantization import dequantize_int8, quantize_int8
 
@@ -45,13 +46,8 @@ _HASH_SALTS = (0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F)
 
 
 def _mix32(x: jnp.ndarray) -> jnp.ndarray:
-    """murmur3 fmix32: full-avalanche 32-bit mixer."""
-    x = x ^ (x >> jnp.uint32(16))
-    x = x * jnp.uint32(0x85EBCA6B)
-    x = x ^ (x >> jnp.uint32(13))
-    x = x * jnp.uint32(0xC2B2AE35)
-    x = x ^ (x >> jnp.uint32(16))
-    return x
+    """murmur3 fmix32 (shared impl: ``hetu_tpu.core.bits.fmix32``)."""
+    return fmix32(x)
 
 
 class HashEmbedding(Module):
@@ -215,16 +211,26 @@ class DPQEmbedding(Module):
         out = rows + (deq - jax.lax.stop_gradient(rows))
         return out.reshape(*ids.shape, self.features).astype(dt)
 
-    def compressed_state(self, params, low_freq_mask=None):
+    def compressed_state(self, params, low_freq_mask=None,
+                         block_rows: int = 65536):
         """(codes (V, D), codebooks) — the serving-side artifact.
 
         ``low_freq_mask`` (V,): pass the SAME frequency tiers training
         used, or the exported codes for low-frequency ids can index
-        centroids the trained forward never emitted."""
-        _, codes = self._quantize(params["weight"], params["codebooks"],
-                                  low_freq_mask)
+        centroids the trained forward never emitted. Rows quantize in
+        ``block_rows`` chunks: one shot at recsys V would materialize a
+        (V, parts, K) fp32 distance table (~41 GB at V=10M, K=256)."""
+        w, books = params["weight"], params["codebooks"]
+        V = w.shape[0]
+        out = []
+        for lo in range(0, V, block_rows):
+            m = None if low_freq_mask is None \
+                else low_freq_mask[lo:lo + block_rows]
+            _, codes = self._quantize(w[lo:lo + block_rows], books, m)
+            out.append(codes)
+        codes = jnp.concatenate(out, axis=0)
         dtype = jnp.uint8 if self.num_choices <= 256 else jnp.uint16
-        return codes.astype(dtype), params["codebooks"]
+        return codes.astype(dtype), books
 
     @property
     def compression_ratio(self) -> float:

@@ -266,8 +266,10 @@ def test_dropout_training():
 def test_attention_dropout():
     """Attention-prob dropout (reference flash wrapper's p_dropout,
     ``hetu/impl/kernel/FlashAttention.cu:1-50``): masked fraction ≈ rate
-    at the op level, explicit pallas+dropout refuses loudly, the model
-    path changes the loss deterministically, and cp>1 rejects it."""
+    at the op level, both dispatch paths carry dropout (pallas via the
+    in-kernel counter RNG — its own parity suite lives in
+    test_flash_pallas.py), the model path changes the loss
+    deterministically, and cp>1 rejects it."""
     from hetu_tpu.ops.attention import attention_reference, flash_attention
 
     # -- op level: recover the prob matrix through a one-hot V ----------
@@ -292,15 +294,19 @@ def test_attention_dropout():
                                 dropout_key=jax.random.key(2))
     np.testing.assert_array_equal(dropped, again)
 
-    # -- dispatch: explicit pallas + active dropout is an error ---------
-    with pytest.raises(ValueError, match="Pallas"):
-        flash_attention(q, k, v, causal=True, impl="pallas",
-                        dropout_rate=0.1, dropout_key=jax.random.key(0))
-    # auto with dropout resolves to the reference path (numerics match)
+    # -- dispatch: auto on CPU resolves to the reference path (numerics
+    # match); explicit pallas carries dropout in-kernel with its own
+    # counter RNG (different masks, same distribution — the kernel-side
+    # parity suite lives in test_flash_pallas.py)
     np.testing.assert_array_equal(
         flash_attention(q, k, v, causal=True, impl="auto",
                         dropout_rate=0.4, dropout_key=jax.random.key(2)),
         dropped)
+    pl_out = flash_attention(q, k, v, causal=True, impl="pallas",
+                             dropout_rate=0.4,
+                             dropout_key=jax.random.key(2))
+    assert np.isfinite(np.asarray(pl_out)).all()
+    assert not np.allclose(np.asarray(pl_out), np.asarray(probs))
 
     # -- model level ----------------------------------------------------
     kw = dict(vocab_size=256, max_positions=128, hidden_size=64,

@@ -69,21 +69,31 @@ def _sds(shape, dtype, mesh, spec=P()):
 
 
 def check_flash(devs, *, shape=(4, 1024, 12, 64), kv_heads=None,
-                seg=False, block_q=None, block_k=None):
+                seg=False, block_q=None, block_k=None,
+                dropout_rate=0.0):
     from hetu_tpu.ops.flash_pallas import flash_attention_pallas as fa
     mesh = _one_dev_mesh(devs)
     b, s, h, d = shape
     q = _sds((b, s, h, d), jnp.bfloat16, mesh)
     kv = _sds((b, s, kv_heads or h, d), jnp.bfloat16, mesh)
     segs = _sds((b, s), jnp.int32, mesh) if seg else None
+    # dropout: the SMEM seed operand + uint32 counter-RNG must lower in
+    # Mosaic (interpret mode can never catch a Mosaic-only rejection)
+    key = _sds((), jnp.uint32, mesh) if dropout_rate > 0 else None
 
-    def loss(q, k, v, *s_):
+    def loss(q, k, v, *extra):
+        extra = list(extra)
+        dkey = jax.random.wrap_key_data(
+            jnp.broadcast_to(extra.pop().astype(jnp.uint32), (2,)),
+            impl="threefry2x32") if dropout_rate > 0 else None
         out = fa(q, k, v, causal=True, interpret=False,
-                 segment_ids=s_[0] if s_ else None,
-                 block_q=block_q, block_k=block_k)
+                 segment_ids=extra[0] if extra else None,
+                 block_q=block_q, block_k=block_k,
+                 dropout_rate=dropout_rate, dropout_key=dkey)
         return out.astype(jnp.float32).sum()
 
-    args = (q, kv, kv) + ((segs,) if seg else ())
+    args = (q, kv, kv) + ((segs,) if seg else ()) \
+        + ((key,) if dropout_rate > 0 else ())
     f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
     t0 = time.perf_counter()
     with _mosaic_aot_env():

@@ -241,7 +241,6 @@ class ParallelAttention(Module):
                  dropout_rate: float = 0.0, dropout_key=None):
         if kv_cache is not None:
             return self._decode(params, x, kv_cache, positions=positions)
-        drop_active = dropout_rate > 0.0 and dropout_key is not None
         b, s, _ = x.shape
         q = self.q_proj(params["q_proj"], x).reshape(
             b, s, self.num_heads, self.head_dim)
@@ -262,12 +261,6 @@ class ParallelAttention(Module):
                      and "cp" in mctx.axes and mctx.mesh.shape["cp"] > 1)
         gspmd_cp = (ctx is not None and isinstance(ctx.seq, str)
                     and ctx.mesh.shape[ctx.seq] > 1)
-        if drop_active and (manual_cp or gspmd_cp):
-            # ring/ulysses cores carry no dropout plumbing (per-hop prob
-            # masks would need hop-split keys); loud beats silently-off
-            raise ValueError(
-                "attention dropout under context parallelism (cp>1) is "
-                "not supported — set attn_pdrop=0 or cp=1")
         if manual_cp:
             # inside a manual region (pipeline executor) with cp bound:
             # run the cp attention core directly on the bound axis —
@@ -278,14 +271,16 @@ class ParallelAttention(Module):
                 out = ulysses_attention_manual(
                     q, k, v, axis_name="cp", cp=mctx.mesh.shape["cp"],
                     tp=mctx.mesh.shape.get("tp", 1), causal=self.causal,
-                    segment_ids=segment_ids, impl=attn_impl)
+                    segment_ids=segment_ids, impl=attn_impl,
+                    dropout_rate=dropout_rate, dropout_key=dropout_key)
             else:
                 from hetu_tpu.parallel.ring_attention import \
                     ring_attention_manual
                 out = ring_attention_manual(
                     q, k, v, axis_name="cp", cp=mctx.mesh.shape["cp"],
                     causal=self.causal, segment_ids=segment_ids,
-                    impl=attn_impl, layout=mctx.cp_layout)
+                    impl=attn_impl, layout=mctx.cp_layout,
+                    dropout_rate=dropout_rate, dropout_key=dropout_key)
         elif gspmd_cp:
             # context parallelism: seq dim is sharded — KV ring
             # (reference: ParallelAttentionOp → AttnCommRing) or the
@@ -295,12 +290,16 @@ class ParallelAttention(Module):
                 out = ulysses_attention(q, k, v, ctx=ctx,
                                         causal=self.causal,
                                         segment_ids=segment_ids,
-                                        impl=attn_impl)
+                                        impl=attn_impl,
+                                        dropout_rate=dropout_rate,
+                                        dropout_key=dropout_key)
             else:
                 from hetu_tpu.parallel.ring_attention import ring_attention
                 out = ring_attention(q, k, v, ctx=ctx, causal=self.causal,
                                      segment_ids=segment_ids,
-                                     impl=attn_impl)
+                                     impl=attn_impl,
+                                     dropout_rate=dropout_rate,
+                                     dropout_key=dropout_key)
         else:
             out = flash_attention(q, k, v, causal=self.causal,
                                   segment_ids=segment_ids, impl=attn_impl,

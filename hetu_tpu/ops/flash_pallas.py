@@ -129,6 +129,25 @@ def _dropout_keep(seed, ib, ih, iq, ik, *, rate, block_q, block_k,
     return u >= threshold
 
 
+def dropout_keep_bh(seed, nb, nh, sq, sk, *, rate):
+    """(nb, nh, sq, sk) keep mask — the full-array twin of
+    ``_dropout_keep`` drawing the SAME stream (batch/head indices become
+    iota dims; positions are the whole matrix at block origin 0). Used
+    by the ring-attention reference hops and by tests to predict the
+    kernel's masks."""
+    bi = jax.lax.broadcasted_iota(jnp.uint32, (nb, nh, 1, 1), 0)
+    hi = jax.lax.broadcasted_iota(jnp.uint32, (nb, nh, 1, 1), 1)
+    salt = fmix32(jnp.uint32(seed)
+                  ^ (bi * jnp.uint32(0x27D4EB2F))
+                  ^ (hi * jnp.uint32(0x165667B1)))
+    qpos = jax.lax.broadcasted_iota(jnp.uint32, (1, 1, sq, sk), 2)
+    kpos = jax.lax.broadcasted_iota(jnp.uint32, (1, 1, sq, sk), 3)
+    u = fmix32((qpos * jnp.uint32(0x9E3779B1))
+               ^ (kpos * jnp.uint32(0x85EBCA77)) ^ salt)
+    threshold = jnp.uint32(min(2 ** 32 - 1, int(rate * 2 ** 32)))
+    return u >= threshold
+
+
 def _block_live(iq, ik, *, causal, block_q, block_k, q_offset, kv_offset):
     """Scalar predicate: does this (q_block, kv_block) cell have any live
     causal entry? Cells entirely above the diagonal are skipped with

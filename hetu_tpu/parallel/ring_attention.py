@@ -45,7 +45,16 @@ from hetu_tpu.ops.attention import NEG_INF, _expand_kv
 # --------------------------------------------------------------------------
 
 
-def _hop_fwd_ref(q, k, v, q_seg, kv_seg, *, causal, scale):
+def _hop_keep(seed, b, h, sq, sk, rate):
+    """Per-hop keep mask (b, h, sq, sk) from the kernel's counter RNG —
+    the ref hops and the pallas hops must drop the SAME cells for a
+    given (seed, rate), so both draw from ``flash_pallas``'s stream."""
+    from hetu_tpu.ops.flash_pallas import dropout_keep_bh
+    return dropout_keep_bh(seed[0], b, h, sq, sk, rate=rate)
+
+
+def _hop_fwd_ref(q, k, v, q_seg, kv_seg, *, causal, scale,
+                 dropout_rate=0.0, seed=None):
     b, sq, hq, d = q.shape
     sk = k.shape[1]
     kf = _expand_kv(k, hq).astype(jnp.float32)
@@ -63,13 +72,19 @@ def _hop_fwd_ref(q, k, v, q_seg, kv_seg, *, causal, scale):
     l = jnp.sum(p, axis=-1, keepdims=True)
     lse = jnp.where(l[..., 0] == 0.0, NEG_INF, m[..., 0] + jnp.log(
         jnp.where(l[..., 0] == 0.0, 1.0, l[..., 0])))          # (b,h,q)
+    if dropout_rate > 0.0 and seed is not None:
+        # mask only the value mix; l and lse stay un-dropped (the
+        # LSE-combine across hops then reproduces global prob dropout)
+        keep = _hop_keep(seed, b, hq, sq, sk, dropout_rate)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
     o = o / jnp.where(l[..., 0] == 0.0, 1.0, l[..., 0]).transpose(
         0, 2, 1)[..., None]
     return o, lse
 
 
-def _hop_bwd_ref(q, k, v, q_seg, kv_seg, lse, delta, do, *, causal, scale):
+def _hop_bwd_ref(q, k, v, q_seg, kv_seg, lse, delta, do, *, causal, scale,
+                 dropout_rate=0.0, seed=None):
     """dq/dk/dv for one hop given combined lse and delta (fp32, (b,h,s))."""
     b, sq, hq, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
@@ -85,8 +100,17 @@ def _hop_bwd_ref(q, k, v, q_seg, kv_seg, lse, delta, do, *, causal, scale):
     p = jnp.exp(s - lse[..., None])          # (b,h,q,k)
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
     dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    p_v = p
+    if dropout_rate > 0.0 and seed is not None:
+        # regenerate the forward's mask: dV sees the dropped probs, dS
+        # gets the masked dO·Vᵀ; delta needs no correction (Σ dO∘O =
+        # Σ dA∘A — the 0/1 mask is idempotent)
+        keep = _hop_keep(seed, b, hq, sq, sk, dropout_rate)
+        inv = 1.0 / (1.0 - dropout_rate)
+        p_v = jnp.where(keep, p * inv, 0.0)
+        dp = jnp.where(keep, dp * inv, 0.0)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p_v, dof)
     ds = p * (dp - delta[..., None])         # (b,h,q,k)
     dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
     dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
@@ -107,63 +131,100 @@ def _hop_mask(sq, sk, causal, q_seg, kv_seg):
     return mask
 
 
+def _fold_axes_into_seed(seed, axes):
+    """Decorrelate shard_map shards: kernel masks hash LOCAL (b, h)
+    indices, so every auto-sharded axis folds its index into the seed."""
+    from hetu_tpu.core.bits import fmix32
+    for ax in axes:
+        if ax is not None:
+            seed = fmix32(
+                seed.astype(jnp.uint32)
+                ^ (jax.lax.axis_index(ax).astype(jnp.uint32)
+                   * jnp.uint32(0x9E3779B9))).astype(jnp.int32)
+    return seed
+
+
 def _hop_fwd_pallas(q, k, v, q_seg, kv_seg, *, causal, scale,
-                    info=None):
+                    info=None, dropout_rate=0.0, seed=None):
     from hetu_tpu.ops.flash_pallas import _flash_fwd
 
-    def run(q, k, v, *segs):
+    drop = dropout_rate > 0.0 and seed is not None
+
+    def run(q, k, v, *extras):
+        extras = list(extras)
+        sd = None
+        if drop:
+            sd = extras.pop(0)
+            if info is not None:
+                sd = _fold_axes_into_seed(sd, info[2:4])
         out, lse = _flash_fwd(
             jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
             jnp.swapaxes(v, 1, 2),
-            segs[0] if segs else None, segs[1] if segs else None,
-            causal=causal, scale=scale)
+            extras[0] if extras else None, extras[1] if extras else None,
+            causal=causal, scale=scale,
+            dropout_rate=dropout_rate if drop else 0.0, seed=sd)
         return jnp.swapaxes(out, 1, 2).astype(jnp.float32), lse
 
-    segs = () if q_seg is None else (q_seg, kv_seg)
+    extras = (() if not drop else (seed,)) \
+        + (() if q_seg is None else (q_seg, kv_seg))
     if info is None:
-        return run(q, k, v, *segs)
+        return run(q, k, v, *extras)
     mesh, names, b_ax, h_ax = info
     from jax import shard_map
     qspec = P(b_ax, None, h_ax, None)
+    extra_specs = (() if not drop else (P(None),)) \
+        + (() if q_seg is None else (P(b_ax, None),) * 2)
     fn = shard_map(
         run, mesh=mesh,
-        in_specs=(qspec,) * 3 + (P(b_ax, None),) * len(segs),
+        in_specs=(qspec,) * 3 + extra_specs,
         out_specs=(qspec, P(b_ax, h_ax, None)),
         axis_names=names, check_vma=False)
-    return fn(q, k, v, *segs)
+    return fn(q, k, v, *extras)
 
 
 def _hop_bwd_pallas(q, k, v, q_seg, kv_seg, lse, delta, do, *,
-                    causal, scale, info=None):
+                    causal, scale, info=None, dropout_rate=0.0,
+                    seed=None):
     from hetu_tpu.ops.flash_pallas import _flash_bwd
 
-    def run(q, k, v, lse, delta, do, *segs):
+    drop = dropout_rate > 0.0 and seed is not None
+
+    def run(q, k, v, lse, delta, do, *extras):
+        extras = list(extras)
+        sd = None
+        if drop:
+            sd = extras.pop(0)
+            if info is not None:
+                sd = _fold_axes_into_seed(sd, info[2:4])
         qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
         doh = jnp.swapaxes(do, 1, 2)
         # out is only used by _flash_bwd to derive delta; we pass the
         # combined delta explicitly, so a placeholder is fine.
         dq, dk, dv = _flash_bwd(
-            qh, kh, vh, segs[0] if segs else None,
-            segs[1] if segs else None, qh, lse, doh,
-            causal=causal, scale=scale, delta=delta)
+            qh, kh, vh, extras[0] if extras else None,
+            extras[1] if extras else None, qh, lse, doh,
+            causal=causal, scale=scale, delta=delta,
+            dropout_rate=dropout_rate if drop else 0.0, seed=sd)
         return (jnp.swapaxes(dq, 1, 2).astype(jnp.float32),
                 jnp.swapaxes(dk, 1, 2).astype(jnp.float32),
                 jnp.swapaxes(dv, 1, 2).astype(jnp.float32))
 
-    segs = () if q_seg is None else (q_seg, kv_seg)
+    extras = (() if not drop else (seed,)) \
+        + (() if q_seg is None else (q_seg, kv_seg))
     if info is None:
-        return run(q, k, v, lse, delta, do, *segs)
+        return run(q, k, v, lse, delta, do, *extras)
     mesh, names, b_ax, h_ax = info
     from jax import shard_map
     qspec = P(b_ax, None, h_ax, None)
     hspec = P(b_ax, h_ax, None)
+    extra_specs = (() if not drop else (P(None),)) \
+        + (() if q_seg is None else (P(b_ax, None),) * 2)
     fn = shard_map(
         run, mesh=mesh,
-        in_specs=(qspec,) * 3 + (hspec, hspec, qspec)
-        + (P(b_ax, None),) * len(segs),
+        in_specs=(qspec,) * 3 + (hspec, hspec, qspec) + extra_specs,
         out_specs=(qspec,) * 3,
         axis_names=names, check_vma=False)
-    return fn(q, k, v, lse, delta, do, *segs)
+    return fn(q, k, v, lse, delta, do, *extras)
 
 
 def _combine(out_acc, lse_acc, out_h, lse_h):
@@ -182,17 +243,36 @@ def _combine(out_acc, lse_acc, out_h, lse_h):
 
 def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
                     use_pallas: bool, layout: str = "contiguous",
-                    unbound_info=None):
+                    unbound_info=None, dropout_rate: float = 0.0):
     import functools as _ft
     if use_pallas:
-        hop_fwd = _ft.partial(_hop_fwd_pallas, info=unbound_info)
-        hop_bwd = _ft.partial(_hop_bwd_pallas, info=unbound_info)
+        hop_fwd = _ft.partial(_hop_fwd_pallas, info=unbound_info,
+                              dropout_rate=dropout_rate)
+        hop_bwd = _ft.partial(_hop_bwd_pallas, info=unbound_info,
+                              dropout_rate=dropout_rate)
     else:
-        hop_fwd, hop_bwd = _hop_fwd_ref, _hop_bwd_ref
+        hop_fwd = _ft.partial(_hop_fwd_ref, dropout_rate=dropout_rate)
+        hop_bwd = _ft.partial(_hop_bwd_ref, dropout_rate=dropout_rate)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     # zigzag only changes the *causal* structure; non-causal attention is
     # permutation-equivariant, so every hop is FULL either way.
     zig = layout == "zigzag" and causal and cp > 1
+
+    def _call_seed(seed, idx, hop, tag):
+        """Per-(rank, hop, call) seed: every kernel/ref call draws its
+        own RNG stream (positions inside a call are hop-LOCAL, so the
+        stream itself must distinguish rank, hop and the zigzag
+        sub-call); the backward recomputes the identical value — its
+        loop and branch structure mirror the forward's exactly."""
+        if seed is None:
+            return None
+        from hetu_tpu.core.bits import fmix32
+        return fmix32(
+            seed.astype(jnp.uint32)
+            ^ (jnp.uint32(hop) * jnp.uint32(0x9E3779B1))
+            ^ (jnp.uint32(tag) * jnp.uint32(0x85EBCA77))
+            ^ (jnp.asarray(idx).astype(jnp.uint32)
+               * jnp.uint32(0x27D4EB2F))).astype(jnp.int32)
 
     def _seg_lo(seg, c):
         return seg[:, :c] if seg is not None else None
@@ -200,38 +280,47 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
     def _seg_hi(seg, c):
         return seg[:, c:] if seg is not None else None
 
-    def _zig_diag_fwd(q, k, v, q_seg, kv_seg):
+    # zigzag sub-call tags (hop 0 diag: aa/bb/ba; off-diag: lo/hi)
+    T_AA, T_BB, T_BA, T_LO, T_HI, T_FULL = 1, 2, 3, 4, 5, 6
+
+    def _zig_diag_fwd(q, k, v, q_seg, kv_seg, seed, idx):
         """Hop 0 (src == rank): local q chunks (a, b), kv chunks (a, b)
         with a < b globally ⇒ blocks (a,a) causal, (b,b) causal, (b,a)
         FULL, (a,b) EMPTY."""
         c = q.shape[1] // 2
         o_aa, l_aa = hop_fwd(q[:, :c], k[:, :c], v[:, :c],
                              _seg_lo(q_seg, c), _seg_lo(kv_seg, c),
-                             causal=True, scale=scale)
+                             causal=True, scale=scale,
+                             seed=_call_seed(seed, idx, 0, T_AA))
         o_bb, l_bb = hop_fwd(q[:, c:], k[:, c:], v[:, c:],
                              _seg_hi(q_seg, c), _seg_hi(kv_seg, c),
-                             causal=True, scale=scale)
+                             causal=True, scale=scale,
+                             seed=_call_seed(seed, idx, 0, T_BB))
         o_ba, l_ba = hop_fwd(q[:, c:], k[:, :c], v[:, :c],
                              _seg_hi(q_seg, c), _seg_lo(kv_seg, c),
-                             causal=False, scale=scale)
+                             causal=False, scale=scale,
+                             seed=_call_seed(seed, idx, 0, T_BA))
         o_b, l_b = _combine(o_bb, l_bb, o_ba, l_ba)
         return (jnp.concatenate([o_aa, o_b], axis=1),
                 jnp.concatenate([l_aa, l_b], axis=2))
 
-    def _zig_diag_bwd(q, k, v, q_seg, kv_seg, lse, delta, do):
+    def _zig_diag_bwd(q, k, v, q_seg, kv_seg, lse, delta, do, seed, idx):
         c = q.shape[1] // 2
         dq_aa, dk_aa, dv_aa = hop_bwd(
             q[:, :c], k[:, :c], v[:, :c], _seg_lo(q_seg, c),
             _seg_lo(kv_seg, c), lse[:, :, :c], delta[:, :, :c], do[:, :c],
-            causal=True, scale=scale)
+            causal=True, scale=scale,
+            seed=_call_seed(seed, idx, 0, T_AA))
         dq_bb, dk_bb, dv_bb = hop_bwd(
             q[:, c:], k[:, c:], v[:, c:], _seg_hi(q_seg, c),
             _seg_hi(kv_seg, c), lse[:, :, c:], delta[:, :, c:], do[:, c:],
-            causal=True, scale=scale)
+            causal=True, scale=scale,
+            seed=_call_seed(seed, idx, 0, T_BB))
         dq_ba, dk_ba, dv_ba = hop_bwd(
             q[:, c:], k[:, :c], v[:, :c], _seg_hi(q_seg, c),
             _seg_lo(kv_seg, c), lse[:, :, c:], delta[:, :, c:], do[:, c:],
-            causal=False, scale=scale)
+            causal=False, scale=scale,
+            seed=_call_seed(seed, idx, 0, T_BA))
         return (jnp.concatenate([dq_aa, dq_bb + dq_ba], axis=1),
                 jnp.concatenate([dk_aa + dk_ba, dk_bb], axis=1),
                 jnp.concatenate([dv_aa + dv_ba, dv_bb], axis=1))
@@ -241,11 +330,11 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
             lambda x: jax.lax.ppermute(x, axis_name, perm), tree)
 
     @jax.custom_vjp
-    def ring(q, k, v, q_seg, kv_seg):
-        out, _ = _ring_fwd(q, k, v, q_seg, kv_seg)
+    def ring(q, k, v, q_seg, kv_seg, seed):
+        out, _ = _ring_fwd(q, k, v, q_seg, kv_seg, seed)
         return out
 
-    def _ring_fwd(q, k, v, q_seg, kv_seg):
+    def _ring_fwd(q, k, v, q_seg, kv_seg, seed):
         idx = jax.lax.axis_index(axis_name)
         b, sq, hq, d = q.shape
         out_acc = jnp.zeros(q.shape, jnp.float32)
@@ -257,11 +346,14 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
             if hop == 0:
                 if zig:
                     out_h, lse_h = _zig_diag_fwd(q, kv_cur[0], kv_cur[1],
-                                                 q_seg, kvseg_cur)
+                                                 q_seg, kvseg_cur, seed,
+                                                 idx)
                 else:
                     out_h, lse_h = hop_fwd(q, kv_cur[0], kv_cur[1], q_seg,
                                            kvseg_cur, causal=causal,
-                                           scale=scale)
+                                           scale=scale,
+                                           seed=_call_seed(seed, idx, 0,
+                                                           T_FULL))
             elif zig:
                 src = (idx - hop) % cp
 
@@ -271,18 +363,20 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
                 # nothing, local hi q chunk (global 2cp-1-idx) is after
                 # both of src's chunks ⇒ hi q rows attend all KV. Either
                 # branch costs sq*sk/2 — balanced hops.
-                def kv_lo(kv):
+                def kv_lo(kv, hop=hop):
                     o, l = hop_fwd(q, kv[0][:, :c], kv[1][:, :c], q_seg,
                                    _seg_lo(kv[2] if kv_seg is not None
                                            else None, c),
-                                   causal=False, scale=scale)
+                                   causal=False, scale=scale,
+                                   seed=_call_seed(seed, idx, hop, T_LO))
                     return o, l
 
-                def q_hi(kv):
+                def q_hi(kv, hop=hop):
                     o, l = hop_fwd(q[:, c:], kv[0], kv[1],
                                    _seg_hi(q_seg, c),
                                    kv[2] if kv_seg is not None else None,
-                                   causal=False, scale=scale)
+                                   causal=False, scale=scale,
+                                   seed=_call_seed(seed, idx, hop, T_HI))
                     return (jnp.concatenate(
                         [jnp.zeros((b, c, hq, d), jnp.float32), o], axis=1),
                         jnp.concatenate(
@@ -293,11 +387,13 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
             else:
                 src = (idx - hop) % cp
 
-                def live(kv):
+                def live(kv, hop=hop):
                     return hop_fwd(q, kv[0], kv[1],
                                    q_seg, kv[2] if kv_seg is not None
                                    else None,
-                                   causal=False, scale=scale)
+                                   causal=False, scale=scale,
+                                   seed=_call_seed(seed, idx, hop,
+                                                   T_FULL))
 
                 def dead(kv):
                     return (jnp.zeros(q.shape, jnp.float32),
@@ -315,12 +411,12 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
                 kv_cur = rotate(kv_cur)
         return out_acc.astype(q.dtype), lse_acc
 
-    def ring_fwd(q, k, v, q_seg, kv_seg):
-        out, lse = _ring_fwd(q, k, v, q_seg, kv_seg)
-        return out, (q, k, v, q_seg, kv_seg, out, lse)
+    def ring_fwd(q, k, v, q_seg, kv_seg, seed):
+        out, lse = _ring_fwd(q, k, v, q_seg, kv_seg, seed)
+        return out, (q, k, v, q_seg, kv_seg, seed, out, lse)
 
     def ring_bwd(res, g):
-        q, k, v, q_seg, kv_seg, out, lse = res
+        q, k, v, q_seg, kv_seg, seed, out, lse = res
         idx = jax.lax.axis_index(axis_name)
         do = g
         delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
@@ -336,32 +432,36 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
                 if zig:
                     dq_h, dk_h, dv_h = _zig_diag_bwd(
                         q, kv_cur[0], kv_cur[1], q_seg, kvseg_cur,
-                        lse, delta, do)
+                        lse, delta, do, seed, idx)
                 else:
                     dq_h, dk_h, dv_h = hop_bwd(q, kv_cur[0], kv_cur[1],
                                                q_seg, kvseg_cur, lse, delta,
                                                do, causal=causal,
-                                               scale=scale)
+                                               scale=scale,
+                                               seed=_call_seed(seed, idx,
+                                                               0, T_FULL))
             elif zig:
                 src = (idx - hop) % cp
                 hkv = k.shape[2]
 
-                def kv_lo(kv):
+                def kv_lo(kv, hop=hop):
                     dq, dk, dv = hop_bwd(
                         q, kv[0][:, :c], kv[1][:, :c], q_seg,
                         _seg_lo(kv[2] if kv_seg is not None else None, c),
-                        lse, delta, do, causal=False, scale=scale)
+                        lse, delta, do, causal=False, scale=scale,
+                        seed=_call_seed(seed, idx, hop, T_LO))
                     pad = jnp.zeros((q.shape[0], c, hkv, k.shape[3]),
                                     jnp.float32)
                     return (dq, jnp.concatenate([dk, pad], axis=1),
                             jnp.concatenate([dv, pad], axis=1))
 
-                def q_hi(kv):
+                def q_hi(kv, hop=hop):
                     dq, dk, dv = hop_bwd(
                         q[:, c:], kv[0], kv[1], _seg_hi(q_seg, c),
                         kv[2] if kv_seg is not None else None,
                         lse[:, :, c:], delta[:, :, c:], do[:, c:],
-                        causal=False, scale=scale)
+                        causal=False, scale=scale,
+                        seed=_call_seed(seed, idx, hop, T_HI))
                     pad = jnp.zeros((q.shape[0], c, q.shape[2], q.shape[3]),
                                     jnp.float32)
                     return jnp.concatenate([pad, dq], axis=1), dk, dv
@@ -371,11 +471,13 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
             else:
                 src = (idx - hop) % cp
 
-                def live(kv):
+                def live(kv, hop=hop):
                     return hop_bwd(q, kv[0], kv[1], q_seg,
                                    kv[2] if kv_seg is not None else None,
                                    lse, delta, do,
-                                   causal=False, scale=scale)
+                                   causal=False, scale=scale,
+                                   seed=_call_seed(seed, idx, hop,
+                                                   T_FULL))
 
                 def dead(kv):
                     return (jnp.zeros(q.shape, jnp.float32),
@@ -395,7 +497,7 @@ def _make_ring_core(axis_name: str, cp: int, causal: bool, scale: float,
             else:
                 dkv = rotate(dkv)
         return (dq_acc.astype(q.dtype), dkv[0].astype(k.dtype),
-                dkv[1].astype(v.dtype), None, None)
+                dkv[1].astype(v.dtype), None, None, None)
 
     ring.defvjp(ring_fwd, ring_bwd)
     return ring
@@ -426,7 +528,9 @@ def ring_attention_manual(q, k, v, *, axis_name: str, cp: int,
                           segment_ids: Optional[jnp.ndarray] = None,
                           scale: Optional[float] = None,
                           impl: str = "auto",
-                          layout: str = "contiguous"):
+                          layout: str = "contiguous",
+                          dropout_rate: float = 0.0,
+                          dropout_key=None):
     """Ring attention over an ALREADY-BOUND manual mesh axis.
 
     For call sites inside an enclosing ``shard_map`` (the pipeline
@@ -445,15 +549,20 @@ def ring_attention_manual(q, k, v, *, axis_name: str, cp: int,
     from hetu_tpu.parallel.sharding import manual_unbound_axes
     info = manual_unbound_axes(
         q.shape[0], (q.shape[2], k.shape[2])) if use_pallas else None
+    drop = dropout_rate > 0.0 and dropout_key is not None
+    seed = jax.random.bits(dropout_key, (1,), jnp.uint32
+                           ).astype(jnp.int32) if drop else None
     ring = _make_ring_core(axis_name, cp, causal, scale, use_pallas,
-                           layout=layout, unbound_info=info)
-    return ring(q, k, v, segment_ids, segment_ids)
+                           layout=layout, unbound_info=info,
+                           dropout_rate=dropout_rate if drop else 0.0)
+    return ring(q, k, v, segment_ids, segment_ids, seed)
 
 
 def ring_attention(q, k, v, *, ctx, causal: bool = True,
                    segment_ids: Optional[jnp.ndarray] = None,
                    scale: Optional[float] = None, impl: str = "auto",
-                   layout: Optional[str] = None):
+                   layout: Optional[str] = None,
+                   dropout_rate: float = 0.0, dropout_key=None):
     """Context-parallel attention over ``ctx.seq`` (global arrays in,
     global arrays out; seq dim sharded over the cp axis).
 
@@ -477,23 +586,42 @@ def ring_attention(q, k, v, *, ctx, causal: bool = True,
     use_pallas = _select_impl(impl, d, q.shape[1] // cp, causal, cp,
                               layout)
 
+    drop = dropout_rate > 0.0 and dropout_key is not None
+    base_seed = jax.random.bits(dropout_key, (1,), jnp.uint32
+                                ).astype(jnp.int32) if drop else None
     ring = _make_ring_core(ctx.seq, cp, causal, scale, use_pallas,
-                           layout=layout)
+                           layout=layout,
+                           dropout_rate=dropout_rate if drop else 0.0)
     tp_ax = ctx.tp if isinstance(ctx.tp, str) else None
     qkv_spec = P(ctx.batch, ctx.seq, tp_ax, None)
 
+    # mask streams hash LOCAL (b, h) indices inside the full-manual
+    # region: fold every non-cp mesh axis into the seed so shards
+    # decorrelate (cp itself is handled per-rank by the hop seeds)
+    other_axes = tuple(a for a in ctx.mesh.axis_names
+                       if a != ctx.seq and ctx.mesh.shape[a] > 1)
+
+    def ring_entry(q, k, v, q_seg, kv_seg, *seed_arg):
+        sd = None
+        if seed_arg:
+            sd = _fold_axes_into_seed(seed_arg[0], other_axes)
+        return ring(q, k, v, q_seg, kv_seg, sd)
+
+    seed_args = (base_seed,) if drop else ()
+    seed_specs = (P(None),) if drop else ()
     if segment_ids is None:
         # no packing: hops run the cheaper no-segment kernel variant and
         # the ring carries only (k, v)
         fn = shard_map(
-            lambda q, k, v: ring(q, k, v, None, None), mesh=ctx.mesh,
-            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            lambda q, k, v, *s: ring_entry(q, k, v, None, None, *s),
+            mesh=ctx.mesh,
+            in_specs=(qkv_spec,) * 3 + seed_specs,
             out_specs=qkv_spec, check_vma=False)
-        return fn(q, k, v)
+        return fn(q, k, v, *seed_args)
 
     seg_spec = P(ctx.batch, ctx.seq)
     fn = shard_map(
-        ring, mesh=ctx.mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec, seg_spec),
+        ring_entry, mesh=ctx.mesh,
+        in_specs=(qkv_spec,) * 3 + (seg_spec, seg_spec) + seed_specs,
         out_specs=qkv_spec, check_vma=False)
-    return fn(q, k, v, segment_ids, segment_ids)
+    return fn(q, k, v, segment_ids, segment_ids, *seed_args)

@@ -48,36 +48,53 @@ def _check_heads(hq: int, hkv: int, cp: int, tp: int) -> None:
             f"(hq={hq}, hkv={hkv}, tp={tp})")
 
 
-def _ulysses_body(q, k, v, seg, *, axis, causal, impl):
+def _ulysses_body(q, k, v, seg, *, axis, causal, impl,
+                  dropout_rate=0.0, dropout_key=None):
     """Per-device core: head-scatter a2a → full-seq attention → seq a2a.
-    Runs inside an already-bound manual cp axis."""
+    Runs inside an already-bound manual cp axis.
+
+    Attention dropout composes trivially here: after the head scatter
+    each device holds the FULL sequence for its head subset, so the
+    kernel-level dropout (or the XLA-path mask) applies as on a single
+    device; folding the cp rank into the key decorrelates the head
+    groups (local head index 0 is a different global head per rank)."""
     qg = _a2a_heads(q, axis)
     kg = _a2a_heads(k, axis)
     vg = _a2a_heads(v, axis)
     seg_g = None
     if seg is not None:
         seg_g = jax.lax.all_gather(seg, axis, axis=1, tiled=True)
+    if dropout_rate > 0.0 and dropout_key is not None:
+        dropout_key = jax.random.fold_in(dropout_key,
+                                         jax.lax.axis_index(axis))
     out = flash_attention(qg, kg, vg, causal=causal,
-                          segment_ids=seg_g, impl=impl)
+                          segment_ids=seg_g, impl=impl,
+                          dropout_rate=dropout_rate,
+                          dropout_key=dropout_key)
     return _a2a_seq(out, axis)
 
 
 def ulysses_attention_manual(q, k, v, *, axis_name: str, cp: int,
                              tp: int = 1, causal: bool = True,
                              segment_ids: Optional[jnp.ndarray] = None,
-                             impl: str = "auto"):
+                             impl: str = "auto",
+                             dropout_rate: float = 0.0,
+                             dropout_key=None):
     """Ulysses over an ALREADY-BOUND manual mesh axis (the pipeline
     executor's region, manual over {pp, cp, ...}): inputs are the local
     seq chunks; the head dim may still be GSPMD-auto over tp, so ``tp``
     is the degree used for the divisibility check."""
     _check_heads(q.shape[2], k.shape[2], cp, tp)
     return _ulysses_body(q, k, v, segment_ids, axis=axis_name,
-                         causal=causal, impl=impl)
+                         causal=causal, impl=impl,
+                         dropout_rate=dropout_rate,
+                         dropout_key=dropout_key)
 
 
 def ulysses_attention(q, k, v, *, ctx, causal: bool = True,
                       segment_ids: Optional[jnp.ndarray] = None,
-                      impl: str = "auto"):
+                      impl: str = "auto",
+                      dropout_rate: float = 0.0, dropout_key=None):
     """Attention over a cp-sharded sequence via head scatter.
 
     ``q`` (b, s_local, hq, d); ``k``/``v`` (b, s_local, hkv, d); all
@@ -88,7 +105,9 @@ def ulysses_attention(q, k, v, *, ctx, causal: bool = True,
     cp = ctx.mesh.shape[axis]
     if cp <= 1:
         return flash_attention(q, k, v, causal=causal,
-                               segment_ids=segment_ids, impl=impl)
+                               segment_ids=segment_ids, impl=impl,
+                               dropout_rate=dropout_rate,
+                               dropout_key=dropout_key)
     if ctx.cp_layout != "contiguous":
         raise ValueError(
             "ulysses needs the contiguous cp layout (global positions "
@@ -96,22 +115,42 @@ def ulysses_attention(q, k, v, *, ctx, causal: bool = True,
     tp = ctx.mesh.shape[ctx.tp] if isinstance(ctx.tp, str) else 1
     _check_heads(q.shape[2], k.shape[2], cp, tp)
 
-    def body(q, k, v, seg):
+    drop_active = dropout_rate > 0.0 and dropout_key is not None
+    # inside the fully-manual region every (b, h) index is LOCAL: fold
+    # every non-cp mesh axis into the key so dp/tp shards decorrelate
+    # (cp folds inside _ulysses_body; same reasoning as ring_attention's
+    # seed fold)
+    other_axes = tuple(a for a in ctx.mesh.axis_names
+                       if a != axis and ctx.mesh.shape[a] > 1)
+
+    def body(q, k, v, seg, *key):
+        # the key rides as an explicit replicated operand (a traced
+        # closure capture inside shard_map is not portable)
+        dk_local = key[0] if key else None
+        if dk_local is not None:
+            for ax in other_axes:
+                dk_local = jax.random.fold_in(dk_local,
+                                              jax.lax.axis_index(ax))
         return _ulysses_body(q, k, v, seg, axis=axis, causal=causal,
-                             impl=impl)
+                             impl=impl,
+                             dropout_rate=dropout_rate if drop_active
+                             else 0.0,
+                             dropout_key=dk_local)
 
     # fully-manual shard_map over the whole mesh (same pattern as the
     # ring): tp splits heads, dp/ep split batch, cp splits seq
     tp_ax = ctx.tp if isinstance(ctx.tp, str) else None
     specs_qkv = P(ctx.batch, axis, tp_ax, None)
+    key_args = (dropout_key,) if drop_active else ()
+    key_specs = (P(),) if drop_active else ()
     if segment_ids is None:
-        fn = shard_map(lambda q, k, v: body(q, k, v, None),
+        fn = shard_map(lambda q, k, v, *key: body(q, k, v, None, *key),
                        mesh=ctx.mesh,
-                       in_specs=(specs_qkv, specs_qkv, specs_qkv),
+                       in_specs=(specs_qkv,) * 3 + key_specs,
                        out_specs=specs_qkv, check_vma=False)
-        return fn(q, k, v)
+        return fn(q, k, v, *key_args)
     seg_spec = P(ctx.batch, axis)
     fn = shard_map(body, mesh=ctx.mesh,
-                   in_specs=(specs_qkv, specs_qkv, specs_qkv, seg_spec),
+                   in_specs=(specs_qkv,) * 3 + (seg_spec,) + key_specs,
                    out_specs=specs_qkv, check_vma=False)
-    return fn(q, k, v, segment_ids)
+    return fn(q, k, v, segment_ids, *key_args)

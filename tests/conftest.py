@@ -155,6 +155,7 @@ SLOW_TESTS = {
     "test_trainer_shrink_to_hetero_recovery",
     "test_pp_memory_aot_analysis_on_tpu_target",
     "test_mosaic_kernels_aot_compile_for_v5e",
+    "test_mosaic_cp_dropout_train_step_compiles_for_v5e",
     "test_homogeneous_1f1b_matches_scan_executor",
     "test_hetero_residual_backward_matches_recompute",
     "test_gpt_pp_cp_ulysses_parity",

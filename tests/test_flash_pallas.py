@@ -265,3 +265,22 @@ def test_flash_dropout_lse_and_determinism(rng):
     m = _dropout_keep(seed[0], 0, 0, 0, 0, rate=rate, block_q=256,
                       block_k=256, q_offset=0, kv_offset=0)
     assert abs(float(m.mean()) - (1 - rate)) < 0.02
+
+
+def test_mosaic_cp_dropout_train_step_compiles_for_v5e():
+    """A full train step with ring CP AND attention dropout must pass
+    the real Mosaic+GSPMD pipeline (the SMEM seed operand now rides
+    inside the ring's shard_map region — the exact class of surface
+    interpret-mode CPU tests can never validate)."""
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc("v5e:2x4", "tpu")
+    except Exception as e:
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+
+    from workloads.aot_check import check_step
+    from hetu_tpu.parallel.strategy import Strategy
+    devs = list(topo.devices)
+    r = check_step(devs, Strategy(dp=4, cp=2), batch=8, seq=1024,
+                   cfgkw={"attn_pdrop": 0.1})
+    assert "compile_s" in r and "error" not in r, r

@@ -382,3 +382,27 @@ def test_ring_dropout_zigzag_and_model(rng):
     step = build_train_step(model, opt, plan)
     _, m = step(state, plan.shard_batch(batch))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_ring_dropout_pallas_matches_ref_hops(rng):
+    """The pallas hop family and the ref hop family draw the SAME
+    counter-RNG stream (dropout_keep_bh == in-kernel _dropout_keep at
+    block origin), so ring dropout outputs must be equal across
+    families — interpret-mode kernels on the CPU mesh."""
+    ctx, mesh = _env(2)
+    q, k, v = _qkv(rng, b=1, s=256, hq=2, hkv=2, d=64)
+    key = jax.random.key(13)
+    import os
+    os.environ["HETU_PALLAS_INTERPRET"] = "1"
+    try:
+        with ctx:
+            ref = ring_attention(q, k, v, ctx=ctx, causal=True,
+                                 impl="reference", dropout_rate=0.3,
+                                 dropout_key=key)
+            pal = ring_attention(q, k, v, ctx=ctx, causal=True,
+                                 impl="pallas", dropout_rate=0.3,
+                                 dropout_key=key)
+    finally:
+        del os.environ["HETU_PALLAS_INTERPRET"]
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(pal),
+                               rtol=2e-5, atol=2e-5)

@@ -125,11 +125,20 @@ def _combo_probe(dt, batch, seq):
                          "workloads", "mfu_sweep.py")
     secured_tps = batch * seq / dt
     for b in (48, 32):
+        # re-check the wall budget before EVERY try: the b48 attempt can
+        # burn its full timeout before OOMing, and two full tries after
+        # a slow headline would overrun the caller's own window slot —
+        # the probe must never cost the secured number
+        remaining = 780 - (time.time() - _T0)
+        if remaining < 90:
+            return (f"combo stopped before b{b}: wall budget exhausted "
+                    f"({remaining:.0f}s left)")
         try:
             r = subprocess.run(
                 [sys.executable, sweep, "--one", f"{b}:selective:1:auto",
                  "--param-dtype", "bf16", "--ce", "fused"],
-                timeout=330, capture_output=True, text=True)
+                timeout=min(330, remaining), capture_output=True,
+                text=True)
         except subprocess.TimeoutExpired:
             return f"combo b{b} timed out (relay hang?) — kept secured"
         line = next((l for l in r.stdout.splitlines()
@@ -268,6 +277,16 @@ def main():
                     break          # non-OOM: abandon this attempt
                 last_err = e
         if dt is not None:
+            # record what actually produced the timing: consumers
+            # (workloads/aot_calibrate.py's roofline anchor) must match
+            # the measured program, not assume the builtin config
+            measured_cfg = {
+                "batch": batch, "remat": strategy.remat,
+                "unroll": bool(strategy.unroll),
+                "param_dtype": "bf16" if pol.param_dtype == jnp.bfloat16
+                else "fp32",
+                "attn": attn_impl, "ce": ce,
+            }
             break
         if last_attempt and last_err is not None:
             raise last_err
@@ -290,6 +309,9 @@ def main():
         combo_note = _combo_probe(dt, batch, seq)
         if isinstance(combo_note, tuple):
             dt, batch, combo_note = combo_note
+            measured_cfg = {"batch": batch, "remat": "selective",
+                            "unroll": True, "param_dtype": "bf16",
+                            "attn": "auto", "ce": "fused"}
 
     tokens_per_sec = batch * seq / dt
     flops = model_flops_per_token(cfg, n_params, seq) * tokens_per_sec
@@ -312,6 +334,8 @@ def main():
         result["degraded_from_winner"] = degraded
     if combo_note is not None:
         result["combo"] = combo_note
+    if on_tpu:
+        result["config"] = measured_cfg
     if on_tpu:
         try:
             os.makedirs(os.path.dirname(_LAST_TPU_PATH), exist_ok=True)

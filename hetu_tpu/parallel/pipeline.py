@@ -215,8 +215,7 @@ def resolve_pipeline_strategy(cfg, strategy, *, seq_len: int,
     from hetu_tpu.tools.galvatron.cost_model import (ModelDims,
                                                      TPUTopology, estimate)
 
-    n = strategy.dp * strategy.tp * strategy.pp * strategy.cp * strategy.ep
-    topo = topo or TPUTopology.calibrated(n)
+    topo = topo or TPUTopology.calibrated(strategy.num_devices)
     dims = ModelDims.from_config(cfg, seq_len=seq_len,
                                  global_batch=global_batch)
     est = estimate(dims, strategy, topo)

@@ -53,15 +53,27 @@ PEAK_V5E = 197e12
 ANCHOR_MS_FALLBACK = 367.86          # BENCH_r04 headline, TPU v5 lite
 
 
+_ANCHOR_CFG_FALLBACK = {"batch": 32, "remat": "selective", "unroll": True,
+                        "param_dtype": "fp32", "ce": "chunked"}
+
+
 def _anchor_measured_ms():
+    """(step_ms, device, config) of the last on-chip headline. The
+    CONFIG matters as much as the time: bench.py may have recorded a
+    sweep-winner or combo-adopted program (different batch/dtype/CE),
+    and anchoring another program's flops to this time would skew
+    f_eff — so the anchor compile below reproduces exactly the recorded
+    config (older records without one get the builtin default)."""
     p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "out",
                      "last_tpu_bench.json")
     try:
         with open(p) as f:
             rec = json.load(f)
-        return float(rec["step_time_ms"]), rec.get("device", "TPU v5 lite")
+        cfg = {**_ANCHOR_CFG_FALLBACK, **rec.get("config", {})}
+        return (float(rec["step_time_ms"]),
+                rec.get("device", "TPU v5 lite"), cfg)
     except (OSError, ValueError, KeyError):
-        return ANCHOR_MS_FALLBACK, "TPU v5 lite"
+        return ANCHOR_MS_FALLBACK, "TPU v5 lite", dict(_ANCHOR_CFG_FALLBACK)
 
 
 def main():
@@ -100,16 +112,18 @@ def main():
 
     topo1 = topologies.get_topology_desc("v5e:2x2", "tpu")
     d1 = list(topo1.devices)[:1]
-    anchor_ms, device_kind = _anchor_measured_ms()
+    anchor_ms, device_kind, acfg = _anchor_measured_ms()
     hbm = int(15.75 * 2 ** 30)
 
     BW_HBM_V5E = 819e9                   # bytes/s, v5e spec
 
-    # --- 1. anchor config: the exact program the headline bench runs ----
-    print("== compiling anchor (B32 selective unroll pallas) ==",
-          flush=True)
-    anchor = check_step(d1, Strategy(remat="selective", unroll=True),
-                        batch=32, seq=1024)
+    # --- 1. anchor: the exact program the recorded headline measured ---
+    print(f"== compiling anchor {acfg} ==", flush=True)
+    anchor = check_step(d1, Strategy(remat=acfg["remat"],
+                                     unroll=bool(acfg["unroll"])),
+                        batch=int(acfg["batch"]), seq=1024,
+                        ce=acfg.get("ce", "chunked"),
+                        param_dtype=acfg.get("param_dtype", "fp32"))
     if not anchor.get("flops"):
         raise SystemExit(f"anchor compile gave no cost analysis: {anchor}")
     f_eff = anchor["flops"] / (anchor_ms / 1e3)
@@ -159,9 +173,10 @@ def main():
     # --- 3. mxu_efficiency from the anchor -------------------------------
     # single chip: estimate() has no comm terms, so step ∝ 1/eff exactly
     dims32 = ModelDims.from_config(GPTConfig.small(), seq_len=1024,
-                                   global_batch=32)
+                                   global_batch=int(acfg["batch"]))
     eff0 = 0.5
-    t0 = estimate(dims32, Strategy(remat="selective", unroll=True),
+    t0 = estimate(dims32, Strategy(remat=acfg["remat"],
+                                   unroll=bool(acfg["unroll"])),
                   TPUTopology(1, peak_flops=PEAK_V5E, hbm_bytes=hbm,
                               mxu_efficiency=eff0)).step_time
     eff = float(np.clip(eff0 * t0 / (anchor_ms / 1e3), 0.05, 1.0))
@@ -228,6 +243,7 @@ def main():
             "source": "aot_anchored",
             "device_kind": device_kind,
             "anchor_step_ms": anchor_ms,
+            "anchor_config": acfg,
             "anchor_f_eff": f_eff,
             "peak_flops": PEAK_V5E,
             "hbm_bytes": hbm,

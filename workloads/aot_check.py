@@ -119,7 +119,7 @@ def check_fused_ce(devs, *, n=4096, e=768, v=50257):
 
 
 def check_step(devs, strategy, *, batch, seq, cfgkw=None,
-               attn_impl="pallas", ce="chunked"):
+               attn_impl="pallas", ce="chunked", param_dtype="fp32"):
     """AOT-compile a full train step for the topology; memory rows.
 
     Sets (and restores) ``HETU_PALLAS_INTERPRET=0`` around the compile:
@@ -136,7 +136,8 @@ def check_step(devs, strategy, *, batch, seq, cfgkw=None,
 
     cfg = GPTConfig(vocab_size=50257, max_positions=seq, hidden_size=768,
                     num_layers=12, num_heads=12, **(cfgkw or {}))
-    pol = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)
+    pol = Policy(param_dtype=jnp.bfloat16 if param_dtype == "bf16"
+                 else jnp.float32, compute_dtype=jnp.bfloat16)
     # PIN the CE impl both ways: under _mosaic_aot_env the fused gate
     # fires on HETU_PALLAS_INTERPRET=0 too, so an ambient fused export
     # would silently flip rows labeled chunked (and the whole memory

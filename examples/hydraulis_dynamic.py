@@ -1,7 +1,11 @@
 """Dynamic sequence-length training — the reference's
-``examples/hydraulis`` flow: train a BPE tokenizer in-tree, bucket the
-corpus by length, plan per-bucket batch composition + strategy, and train
-with one cached jit per (bucket, strategy).
+``examples/hydraulis`` flow (``examples/hydraulis/strategy/
+new_planning.py``): train a BPE tokenizer in-tree, bucket the corpus by
+length, plan per-bucket batch composition AND a per-bucket parallel
+strategy with the cost model (short buckets dp-heavy, long buckets
+cp+remat), then train the mixed stream in ONE run — the Trainer
+hot-switches the live state between plans at bucket boundaries through
+its plan pool.
 
 Run (CPU simulation):
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -22,7 +26,6 @@ from hetu_tpu import optim
 from hetu_tpu.data.bucket import SeqLenBuckets
 from hetu_tpu.data.hydraulis import DynamicDispatcher, plan_buckets
 from hetu_tpu.data.tokenizers import train_bpe
-from hetu_tpu.engine import build_train_step, init_state, make_plan
 from hetu_tpu.models import GPTConfig, GPTLMHeadModel
 
 
@@ -37,30 +40,54 @@ def main():
     seqs = [np.asarray(tok.encode(t), np.int32) for t in texts]
     print(f"tokenizer vocab={tok.vocab_size}, docs={len(seqs)}")
 
-    buckets = SeqLenBuckets(min_len=32, max_len=512)
-    plans = plan_buckets([len(s) - 1 for s in seqs], buckets=buckets,
-                         token_budget=512)
-    for L, p in sorted(plans.items()):
-        print(f"bucket {L}: rows={p.batch_rows} strategy={p.strategy.dp}dp")
-
     cfg = GPTConfig(vocab_size=512, max_positions=512, hidden_size=64,
                     num_layers=2, num_heads=4)
     model = GPTLMHeadModel(cfg)
     opt = optim.adamw(1e-3)
 
-    # one (plan, state-sharding, step) per bucket strategy; state is shared
-    base_plan = make_plan(model, opt, plans[min(plans)].strategy)
-    state = init_state(model, opt, base_plan, jax.random.key(0))
-    steps = {}
+    # per-bucket strategies from the cost model (profile-first: a
+    # measured/AOT calibration seeds the topology when present)
+    import dataclasses
+
+    from hetu_tpu.engine.trainer import Trainer, TrainerConfig
+    from hetu_tpu.parallel.strategy import Strategy
+    from hetu_tpu.tools.galvatron import ModelDims, TPUTopology
+    from hetu_tpu.tools.galvatron.cost_model import estimate
+    n_dev = len(jax.devices())
+    dims = ModelDims.from_config(cfg, seq_len=512, global_batch=8)
+    topo = TPUTopology.calibrated(n_dev)
+    # the toy model fits everything on a real chip, so simulate a
+    # memory-tight device: HBM set between "dp-only at the longest
+    # bucket" (too big) and "cp2 + full remat" (fits) — exactly the
+    # regime where Hydraulis' per-bucket strategy planning earns its keep
+    buckets = SeqLenBuckets(min_len=32, max_len=512)
+    lmax = max(buckets.group([len(s) - 1 for s in seqs]))
+    dmax = dataclasses.replace(dims, seq_len=lmax, global_batch=n_dev)
+    hi = estimate(dmax, Strategy(dp=n_dev), topo).mem_per_device
+    lo = estimate(dmax, Strategy(dp=n_dev // 2, cp=2, remat="full"),
+                  topo).mem_per_device
+    topo = dataclasses.replace(topo, hbm_bytes=(hi + lo) / 2)
+    plans = plan_buckets([len(s) - 1 for s in seqs], buckets=buckets,
+                         token_budget=512, dims_base=dims, topo=topo,
+                         max_cp=2, row_multiple=n_dev)
+    for L, p in sorted(plans.items()):
+        st = p.strategy
+        print(f"bucket {L:4d}: rows={p.batch_rows:3d} strategy="
+              f"dp{st.dp}xcp{st.cp} remat={st.remat} "
+              f"est={p.est_step_ms:.1f}ms")
+
+    # ONE run over the mixed stream: the Trainer routes each bucket to
+    # its own plan, hot-switching the live state at bucket boundaries
+    trainer = Trainer(model, opt, plans[min(plans)].strategy,
+                      TrainerConfig(log_every=1, precision="fp32"))
     disp = DynamicDispatcher(plans)
-    for batch, plan in disp.batches(seqs):
-        key = plan.bucket_len
-        if key not in steps:
-            steps[key] = build_train_step(model, opt, base_plan)
-        state, m = steps[key](state, base_plan.shard_batch(batch))
-        print(f"bucket {plan.bucket_len:4d} rows {plan.batch_rows:3d} "
-              f"loss {float(jax.device_get(m['loss'])):.4f}")
-    print(f"pad fraction: {disp.stats.pad_fraction:.2%}")
+    hist = trainer.train_dynamic(disp, seqs, use_bucket_strategies=True)
+    for h in hist:
+        print(f"step {int(h['step']):3d} bucket {int(h['bucket']):4d} "
+              f"loss {h['loss']:.4f} strategy {h['strategy']}")
+    used = {h["strategy"] for h in hist}
+    print(f"pad fraction: {disp.stats.pad_fraction:.2%}; "
+          f"{len(used)} distinct plans in one run")
 
 
 if __name__ == "__main__":

@@ -2,10 +2,11 @@
 ``examples/hydraulis`` flow (``examples/hydraulis/strategy/
 new_planning.py``): train a BPE tokenizer in-tree, bucket the corpus by
 length, plan per-bucket batch composition AND a per-bucket parallel
-strategy with the cost model (short buckets dp-heavy, long buckets
-cp+remat), then train the mixed stream in ONE run — the Trainer
-hot-switches the live state between plans at bucket boundaries through
-its plan pool.
+strategy with the cost model (short buckets dp-heavy + no remat, the
+long bucket remat'd; cp candidates compete too and win when sequences
+outgrow what remat can fix), then train the mixed stream in ONE run —
+the Trainer hot-switches the live state between plans at bucket
+boundaries through its plan pool.
 
 Run (CPU simulation):
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -19,6 +20,8 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     import jax
     jax.config.update("jax_platforms", "cpu")
 
+import dataclasses
+
 import jax
 import numpy as np
 
@@ -26,7 +29,11 @@ from hetu_tpu import optim
 from hetu_tpu.data.bucket import SeqLenBuckets
 from hetu_tpu.data.hydraulis import DynamicDispatcher, plan_buckets
 from hetu_tpu.data.tokenizers import train_bpe
+from hetu_tpu.engine.trainer import Trainer, TrainerConfig
 from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.parallel.strategy import Strategy
+from hetu_tpu.tools.galvatron import ModelDims, TPUTopology
+from hetu_tpu.tools.galvatron.cost_model import estimate
 
 
 def main():
@@ -47,19 +54,16 @@ def main():
 
     # per-bucket strategies from the cost model (profile-first: a
     # measured/AOT calibration seeds the topology when present)
-    import dataclasses
-
-    from hetu_tpu.engine.trainer import Trainer, TrainerConfig
-    from hetu_tpu.parallel.strategy import Strategy
-    from hetu_tpu.tools.galvatron import ModelDims, TPUTopology
-    from hetu_tpu.tools.galvatron.cost_model import estimate
     n_dev = len(jax.devices())
-    dims = ModelDims.from_config(cfg, seq_len=512, global_batch=8)
+    # global_batch is a placeholder: plan_buckets re-derives it per
+    # bucket (rows at that length) before every estimate
+    dims = ModelDims.from_config(cfg, seq_len=512, global_batch=n_dev)
     topo = TPUTopology.calibrated(n_dev)
     # the toy model fits everything on a real chip, so simulate a
-    # memory-tight device: HBM set between "dp-only at the longest
-    # bucket" (too big) and "cp2 + full remat" (fits) — exactly the
-    # regime where Hydraulis' per-bucket strategy planning earns its keep
+    # memory-tight device: HBM set between "no remat at the longest
+    # bucket" (too big) and "full remat" (fits), making the planner
+    # assign DIFFERENT strategies per bucket — the regime where
+    # Hydraulis' per-bucket planning earns its keep
     buckets = SeqLenBuckets(min_len=32, max_len=512)
     lmax = max(buckets.group([len(s) - 1 for s in seqs]))
     dmax = dataclasses.replace(dims, seq_len=lmax, global_batch=n_dev)

@@ -69,3 +69,33 @@ def test_sweep_infeasible_table_guards(tmp_path):
     p.write_text("{torn")
     assert _load_infeasible(1024, str(p)) == set()      # torn file
     assert _load_infeasible(1024, str(tmp_path / "no.json")) == set()
+
+
+def test_calibration_anchor_follows_recorded_config(tmp_path):
+    """aot_calibrate's roofline anchor must reproduce the exact config
+    the recorded headline measured (a combo-adopted b48/bf16/fused
+    record must not be anchored with b32/fp32 flops)."""
+    sys.path.insert(0, _ROOT)
+    from workloads.aot_calibrate import (_ANCHOR_CFG_FALLBACK,
+                                         _anchor_measured_ms)
+
+    # no record -> full fallback config
+    ms0, _, cfg0 = _anchor_measured_ms(str(tmp_path / "missing.json"))
+    assert cfg0 == _ANCHOR_CFG_FALLBACK and ms0 > 0
+    # a record WITH a config: every field must surface
+    rec = {"step_time_ms": 123.0, "device": "TPU v5 lite",
+           "config": {"batch": 48, "remat": "selective", "unroll": True,
+                      "param_dtype": "bf16", "ce": "fused",
+                      "attn": "auto"}}
+    p = tmp_path / "last_tpu_bench.json"
+    with open(p, "w") as f:
+        json.dump(rec, f)
+    ms2, _, cfg2 = _anchor_measured_ms(str(p))
+    assert ms2 == 123.0
+    assert cfg2["batch"] == 48 and cfg2["param_dtype"] == "bf16" \
+        and cfg2["ce"] == "fused"
+    # an OLD record without a config: builtin default, recorded time
+    with open(p, "w") as f:
+        json.dump({"step_time_ms": 77.0}, f)
+    ms3, _, cfg3 = _anchor_measured_ms(str(p))
+    assert ms3 == 77.0 and cfg3 == _ANCHOR_CFG_FALLBACK

@@ -57,15 +57,15 @@ _ANCHOR_CFG_FALLBACK = {"batch": 32, "remat": "selective", "unroll": True,
                         "param_dtype": "fp32", "ce": "chunked"}
 
 
-def _anchor_measured_ms():
+def _anchor_measured_ms(path=None):
     """(step_ms, device, config) of the last on-chip headline. The
     CONFIG matters as much as the time: bench.py may have recorded a
     sweep-winner or combo-adopted program (different batch/dtype/CE),
     and anchoring another program's flops to this time would skew
     f_eff — so the anchor compile below reproduces exactly the recorded
     config (older records without one get the builtin default)."""
-    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "out",
-                     "last_tpu_bench.json")
+    p = path or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "out", "last_tpu_bench.json")
     try:
         with open(p) as f:
             rec = json.load(f)

@@ -316,8 +316,14 @@ class ParallelAttention(Module):
         write ``index`` arrives via ``positions[:, 0]``-style absolute
         positions (all rows share the index — batched decode). Replaces
         the reference's dynamic-concat KV append op (inference path of
-        ``graph/ops``: dynamic concat)."""
-        k_buf, v_buf = kv_cache
+        ``graph/ops``: dynamic concat).
+
+        ``kv_cache``: (k_buf, v_buf) of shape (b, max_len, hkv, d), or
+        the QUANTIZED 4-tuple (k int8, k scales, v int8, v scales) with
+        (b, max_len, hkv, 1) fp32 scales (``generation.init_kv_caches``
+        with dtype=jnp.int8) — new rows quantize on write, the read
+        dequant fuses into the attention einsum."""
+        quant = len(kv_cache) == 4
         b, s, _ = x.shape
         index = positions[0, 0] if positions is not None else 0
         q = self.q_proj(params["q_proj"], x).reshape(
@@ -332,16 +338,39 @@ class ParallelAttention(Module):
                 else jnp.arange(s)[None, :]
             q = apply_rotary(q, cos, sin, positions=pos)
             k = apply_rotary(k, cos, sin, positions=pos)
-        k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k.astype(
-            k_buf.dtype), index, axis=1)
-        v_buf = jax.lax.dynamic_update_slice_in_dim(v_buf, v.astype(
-            v_buf.dtype), index, axis=1)
+
+        def upd(buf, new):
+            return jax.lax.dynamic_update_slice_in_dim(
+                buf, new.astype(buf.dtype), index, axis=1)
+
+        if quant:
+            # int8 KV cache: decode is HBM-bound on the cache read, so
+            # 1 byte/elem halves the bandwidth vs bf16 (and 4x vs fp32);
+            # XLA fuses the dequant into the attention einsum's operand
+            # stream (compiler-verified: workloads/quant_bench.py --aot).
+            # Per-(position, head) symmetric scales over head_dim; zero
+            # scales on never-written slots dequantize to exact 0, like
+            # the fp cache's zeros.
+            from hetu_tpu.ops.quantization import (dequantize_int8,
+                                                   quantize_int8)
+            kq_b, ks_b, vq_b, vs_b = kv_cache
+            knew_q, knew_s = quantize_int8(k, axis=-1)
+            vnew_q, vnew_s = quantize_int8(v, axis=-1)
+            kq_b, ks_b = upd(kq_b, knew_q), upd(ks_b, knew_s)
+            vq_b, vs_b = upd(vq_b, vnew_q), upd(vs_b, vnew_s)
+            k_buf = dequantize_int8(kq_b, ks_b, q.dtype)
+            v_buf = dequantize_int8(vq_b, vs_b, q.dtype)
+            new_cache = (kq_b, ks_b, vq_b, vs_b)
+        else:
+            k_buf, v_buf = kv_cache
+            k_buf, v_buf = upd(k_buf, k), upd(v_buf, v)
+            new_cache = (k_buf, v_buf)
         # causal offsets mask both the future and never-written slots
         # (their positions exceed every live q position)
         out = attention_reference(q, k_buf, v_buf, causal=self.causal,
                                   q_offset=index, kv_offset=0)
         out = out.reshape(b, s, self.num_heads * self.head_dim)
-        return self.out_proj(params["out_proj"], out), (k_buf, v_buf)
+        return self.out_proj(params["out_proj"], out), new_cache
 
 
 def remat_policy(name: str):

@@ -173,3 +173,47 @@ def test_topp_sampling_restricts_support(rng):
     out = generate(model, params, prompt, max_new_tokens=4,
                    temperature=0.8, top_p=0.9, rng=jax.random.key(7))
     assert out.shape == (1, 8)
+
+
+@pytest.mark.parametrize("model_cls,cfg", [
+    (GPTLMHeadModel, GPTConfig.tiny()),
+    (LlamaLMHeadModel, LlamaConfig.tiny()),
+])
+def test_int8_kv_cache_decode(rng, model_cls, cfg):
+    """int8 KV cache (the decode HBM-bandwidth lever): buffers really
+    store int8 + per-(position, head) scales, cached logits track the
+    fp32-cache logits to quantization error, and greedy generation runs
+    end to end producing the same tokens on a tiny model."""
+    model = model_cls(cfg)
+    params = model.init(rng, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.key(1), (2, 12), 0,
+                             cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(12)[None], (2, 12))
+
+    fp = init_kv_caches(model, 2, 16)
+    q8 = init_kv_caches(model, 2, 16, dtype=jnp.int8)
+    assert len(q8) == 4
+    assert q8[0].dtype == jnp.int8 and q8[1].dtype == jnp.float32
+    assert q8[1].shape[-1] == 1                    # per-row scales
+    # 1 byte/elem + tiny scales vs 4 bytes/elem
+    fp_bytes = sum(x.size * x.dtype.itemsize for x in fp)
+    q8_bytes = sum(x.size * x.dtype.itemsize for x in q8)
+    assert q8_bytes < 0.35 * fp_bytes
+
+    lf, _ = decode(model, params, ids, pos, fp)
+    lq, q8b = decode(model, params, ids, pos, q8)
+    # int8 symmetric rows: logits track to quantization error
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(lf),
+                               atol=0.15, rtol=0.05)
+    assert q8b[0].dtype == jnp.int8               # cache stayed int8
+    assert int(jnp.abs(q8b[0]).max()) > 0         # rows actually written
+
+    g_fp = generate(model, params, ids[:, :6], max_new_tokens=6,
+                    temperature=0.0)
+    g_q8 = generate(model, params, ids[:, :6], max_new_tokens=6,
+                    temperature=0.0, cache_dtype=jnp.int8)
+    # near-tied logits may legally flip an argmax under quantization
+    # error — require agreement, not exactness, so backend rounding
+    # differences (real TPU) can't fail a behaving cache
+    agree = (np.asarray(g_fp) == np.asarray(g_q8)).mean()
+    assert agree >= 0.9, (agree, g_fp, g_q8)

@@ -149,7 +149,8 @@ def _combo_probe(dt, batch, seq):
                 continue                     # smaller batch may fit
             return f"combo b{b} failed: {(tail or ['?'])[0][:120]}"
         # RESULT <mfu> <batch> <remat> <unroll> <attn> <ms> <tps> <kind>
-        dt_c = float(line.split()[5]) / 1e3
+        # (token 0 is the RESULT tag, so ms is index 6)
+        dt_c = float(line.split()[6]) / 1e3
         if b * seq / dt_c > secured_tps:
             return (dt_c, b,
                     f"combo adopted (bf16+fusedCE b{b}, "
@@ -306,7 +307,12 @@ def main():
             and not any(l == "winner" for l, *_ in attempts) \
             and os.environ.get("HETU_BENCH_COMBO", "1") != "0" \
             and user_ce is None and t_spent < 420:
-        combo_note = _combo_probe(dt, batch, seq)
+        try:
+            combo_note = _combo_probe(dt, batch, seq)
+        except Exception as e:               # noqa: BLE001
+            # the probe must never cost the secured headline — not even
+            # via its own parsing
+            combo_note = f"combo probe error: {str(e)[:120]}"
         if isinstance(combo_note, tuple):
             dt, batch, combo_note = combo_note
             measured_cfg = {"batch": batch, "remat": "selective",

@@ -99,3 +99,35 @@ def test_calibration_anchor_follows_recorded_config(tmp_path):
         json.dump({"step_time_ms": 77.0}, f)
     ms3, _, cfg3 = _anchor_measured_ms(str(p))
     assert ms3 == 77.0 and cfg3 == _ANCHOR_CFG_FALLBACK
+
+
+def test_combo_probe_parses_mfu_sweep_result_line(tmp_path,
+                                                  monkeypatch):
+    """The combo probe parses mfu_sweep's RESULT line by index — pin the
+    format end to end with the REAL measure_one print shape (index 6 is
+    ms: token 0 is the RESULT tag; a drift here once pointed at the attn
+    string and float('auto') would have crashed the secured bench)."""
+    sys.path.insert(0, _ROOT)
+    import subprocess as sp
+
+    import bench
+
+    line = "RESULT 0.4100 48 selective 1 auto 310.5 158000 TPU v5 lite"
+    # the exact shape measure_one prints (workloads/mfu_sweep.py)
+    assert line.split()[6] == "310.5"
+
+    def fake_run(cmd, timeout, capture_output, text):
+        class R:
+            returncode = 0
+            stdout = "warmup noise\n" + line + "\n"
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    # secured: b32 at 367.86ms -> 89077 tok/s; fake combo: 158k tok/s
+    out = bench._combo_probe(0.36786, 32, 1024)
+    assert isinstance(out, tuple), out
+    dt_c, b, note = out
+    assert b == 48 and abs(dt_c - 0.3105) < 1e-9
+    assert "adopted" in note

@@ -80,11 +80,30 @@ def probe_tpu(timeout: float = 300.0) -> bool:
     except Exception:
         return False
 
-from hetu_tpu import optim
+from hetu_tpu import optim, telemetry
 from hetu_tpu.core.dtypes import Policy, autocast
 from hetu_tpu.engine import make_plan, init_state, build_train_step
 from hetu_tpu.models import GPTConfig, GPTLMHeadModel
 from hetu_tpu.parallel.strategy import Strategy
+
+# Telemetry JSONL emitted alongside the BENCH_*.json headline the driver
+# commits — future rounds get trace artifacts (per-attempt spans, the
+# metric snapshot) for free. Read with tools/trace_summary.py.
+_TELEMETRY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_telemetry.jsonl")
+
+
+def _write_bench_telemetry(result: dict):
+    """Best-effort: the telemetry artifact must never cost the headline."""
+    tracer = telemetry.get_tracer()
+    reg = telemetry.get_registry()
+    with open(_TELEMETRY_PATH, "w") as f:
+        f.write(json.dumps({"kind": "bench_result", **result}) + "\n")
+        for rec in tracer.records():
+            f.write(json.dumps(rec) + "\n")
+        rec = reg.to_record()
+        if rec["metrics"]:
+            f.write(json.dumps(rec) + "\n")
 
 # bf16 peak FLOPs per chip by device kind (public spec sheets)
 PEAK_FLOPS = {
@@ -161,6 +180,7 @@ def _combo_probe(dt, batch, seq):
 
 
 def main():
+    telemetry.enable(True)
     if not probe_tpu():
         jax.config.update("jax_platforms", "cpu")
     try:
@@ -267,7 +287,9 @@ def main():
         last_err = None
         for b in batches:
             try:
-                dt, n_params = run(b, pol, strategy, attn_impl)
+                with telemetry.span("bench_attempt", label=label,
+                                    batch=b, remat=strategy.remat):
+                    dt, n_params = run(b, pol, strategy, attn_impl)
                 batch = b
                 break
             except Exception as e:
@@ -361,6 +383,10 @@ def main():
             result["vs_baseline"] = stale.get("vs_baseline", 0.0)
         except (OSError, ValueError):
             result["tpu_unavailable"] = True
+    try:
+        _write_bench_telemetry(result)
+    except Exception:
+        pass
     print(json.dumps(result))
 
 

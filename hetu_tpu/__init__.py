@@ -19,13 +19,19 @@ Layer map (mirrors SURVEY.md §1, re-architected for XLA):
   models/    GPT / Llama model families
   data/      datasets, packing buckets, loaders
   engine/    Trainer, planners, straggler monitor
+  telemetry/ spans, metric registry, cross-rank aggregation, goodput
   utils/     checkpoint (safetensors-compat), logging, profiler
 """
 
 from hetu_tpu.version import __version__
 
+from hetu_tpu.core import compat as _compat
+
+_compat.install()   # jax API shims (shard_map on 0.4.x) before submodules
+
 from hetu_tpu.core.dtypes import Policy, autocast, current_policy
 from hetu_tpu.core.mesh import make_mesh, local_devices
+from hetu_tpu import telemetry
 from hetu_tpu import nn
 from hetu_tpu import ops
 from hetu_tpu import optim
@@ -40,6 +46,7 @@ from hetu_tpu.parallel.sharding import (
 
 __all__ = [
     "__version__",
+    "telemetry",
     "Policy",
     "autocast",
     "current_policy",

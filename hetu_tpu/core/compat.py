@@ -1,0 +1,71 @@
+"""Runtime compatibility shims for older jax installs.
+
+The codebase targets the modern jax API surface (>= 0.6): top-level
+``jax.shard_map`` with ``axis_names=`` (the set of mesh axes the body
+handles manually) and ``check_vma=``. On older runtimes (0.4.x) the
+function lives at ``jax.experimental.shard_map.shard_map`` with the
+complementary ``auto=`` (axes NOT mapped manually) and ``check_rep=``.
+
+:func:`install` bridges the gap by publishing a translating wrapper as
+``jax.shard_map`` when the real one is absent, so every
+``from jax import shard_map`` site in the tree works unchanged. It also
+aliases the Pallas-TPU ``CompilerParams`` name (``TPUCompilerParams``
+before the rename). No-op on modern jax.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def install() -> None:
+    _install_pallas_compiler_params()
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    @functools.wraps(_legacy)
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        if axis_names is not None and "auto" not in kw:
+            kw["auto"] = frozenset(
+                set(mesh.axis_names) - set(axis_names))
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+def enable_cpu_collectives() -> None:
+    """Old jax defaults CPU collectives to "none", which makes every
+    multi-process CPU computation fail with "Multiprocess computations
+    aren't implemented on the CPU backend"; newer jax defaults to gloo.
+    Called from the distributed bootstrap — gloo needs the
+    ``jax.distributed`` client, so this must only flip in processes that
+    are about to initialize it (a global default would break
+    single-process CPU client creation on old jax)."""
+    try:
+        from jax._src import xla_bridge
+        flag = xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION
+        if flag.value == "none" \
+                and not xla_bridge.backends_are_initialized():
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+    except Exception:       # flag gone on modern jax: nothing to fix
+        pass
+
+
+def _install_pallas_compiler_params() -> None:
+    """``pltpu.CompilerParams`` was ``TPUCompilerParams`` before the
+    rename; alias the new name onto old runtimes."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:       # pallas unavailable on this backend build
+        return
+    if not hasattr(pltpu, "CompilerParams") \
+            and hasattr(pltpu, "TPUCompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams

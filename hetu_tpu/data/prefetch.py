@@ -15,9 +15,15 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
+from hetu_tpu import telemetry
+
 _SENTINEL = object()
+
+# consumer waits shorter than this are queue handoff noise, not stalls
+_STALL_SPAN_THRESHOLD_S = 1e-3
 
 
 def _producer_loop(q: "queue.Queue", place: Callable[[Any], Any],
@@ -90,7 +96,23 @@ class DevicePrefetcher:
     def __next__(self):
         if self._done:
             raise StopIteration   # iterator contract: keep raising
-        item = self._q.get()
+        if telemetry.enabled():
+            # time the blocking get: the consumer waiting here IS the
+            # data stall (the producer fell behind the step loop)
+            t0 = time.perf_counter()
+            item = self._q.get()
+            wait = time.perf_counter() - t0
+            reg = telemetry.get_registry()
+            reg.counter("data_stall_seconds",
+                        "train loop blocked waiting for batches").inc(wait)
+            reg.gauge("data_queue_depth",
+                      "staged batches after this fetch").set(
+                          self._q.qsize())
+            if wait > _STALL_SPAN_THRESHOLD_S:
+                telemetry.get_tracer().complete(
+                    "stall", wait, where="prefetch")
+        else:
+            item = self._q.get()
         if item is _SENTINEL:
             self._done = True
             if self._err_box:

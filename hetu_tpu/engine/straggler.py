@@ -56,10 +56,24 @@ class StragglerMonitor:
 
     def measure(self, devices: Optional[Sequence] = None
                 ) -> StragglerReport:
-        devices = list(devices) if devices is not None else jax.devices()
-        times = {d.id: self._bench_device(d) for d in devices}
+        from hetu_tpu import telemetry
+        with telemetry.span("straggler_measure", size=self.size):
+            devices = list(devices) if devices is not None \
+                else jax.devices()
+            times = {d.id: self._bench_device(d) for d in devices}
         best = min(times.values())
         ratios = {i: t / best for i, t in times.items()}
+        if telemetry.enabled():
+            # the Malleus planner's input, continuously scrapeable: a
+            # ratio gauge per device (1.0 = healthy, >threshold = replan)
+            reg = telemetry.get_registry()
+            g_ratio = reg.gauge("straggler_ratio",
+                                "device slowdown vs the fastest peer")
+            g_time = reg.gauge("straggler_bench_seconds",
+                               "matmul microbench wall time")
+            for d, t in times.items():
+                g_time.set(t, device=str(d))
+                g_ratio.set(ratios[d], device=str(d))
         return StragglerReport(times, ratios)
 
 

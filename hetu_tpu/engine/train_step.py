@@ -88,6 +88,13 @@ class TrainPlan:
 
 def make_plan(model: Module, opt: Transform, strategy: Strategy,
               devices=None) -> TrainPlan:
+    from hetu_tpu import telemetry
+    with telemetry.span("make_plan", strategy=strategy.to_json()):
+        return _make_plan(model, opt, strategy, devices)
+
+
+def _make_plan(model: Module, opt: Transform, strategy: Strategy,
+               devices=None) -> TrainPlan:
     mesh = strategy.build_mesh(devices)
     rules = strategy.axis_rules()
     param_specs = param_partition_specs(model, rules, mesh=mesh)
@@ -178,6 +185,7 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
     pp>1 routes through the pipeline executor
     (``hetu_tpu.parallel.pipeline.build_pipeline_train_step``).
     """
+    from hetu_tpu import telemetry
     strategy = plan.strategy
     if strategy.pp > 1:
         if loss_fn is not None:
@@ -186,8 +194,9 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
                 "executor schedules model.embed/blocks/head_loss itself; "
                 "override model.head_loss instead")
         from hetu_tpu.parallel.pipeline import build_pipeline_train_step
-        return build_pipeline_train_step(model, opt, plan,
-                                         attn_impl=attn_impl, donate=donate)
+        with telemetry.span("build_step", kind="pipeline"):
+            return build_pipeline_train_step(
+                model, opt, plan, attn_impl=attn_impl, donate=donate)
 
     base_loss = loss_fn or default_loss_fn(model, strategy, attn_impl)
     nm = strategy.num_microbatches

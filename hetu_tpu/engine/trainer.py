@@ -18,6 +18,7 @@ from typing import Any, Iterable, Iterator, Optional
 import jax
 import jax.numpy as jnp
 
+from hetu_tpu import telemetry
 from hetu_tpu.core.dtypes import BF16_COMPUTE, FP32, Policy, autocast
 from hetu_tpu.engine.state import TrainState
 from hetu_tpu.engine.train_step import (
@@ -26,6 +27,7 @@ from hetu_tpu.engine.train_step import (
 from hetu_tpu.optim.base import Transform
 from hetu_tpu.parallel.strategy import Strategy
 from hetu_tpu.parallel.switch import switch_strategy
+from hetu_tpu.telemetry import GoodputAccountant
 from hetu_tpu.utils.checkpoint import (
     CheckpointWriter, load_checkpoint, save_checkpoint,
 )
@@ -50,6 +52,19 @@ class TrainerConfig:
                                  # dataloader + dedicated H2D stream)
     eval_every: int = 0          # validation cadence for train(); 0 = off
                                  # (needs eval_batches passed to train)
+    telemetry: bool = False      # turn the global telemetry switch ON at
+                                 # construction (spans + metric registry;
+                                 # docs/OBSERVABILITY.md). Off: the
+                                 # instrumented call sites cost <1% of
+                                 # the step loop (asserted in tests).
+    trace_dir: Optional[str] = None
+                                 # where train() exports artifacts when
+                                 # telemetry is enabled: trace.json
+                                 # (Perfetto) + telemetry.jsonl (unified
+                                 # span/metric/goodput records)
+    peak_flops: Optional[float] = None
+                                 # per-chip peak for MFU in the goodput
+                                 # report; None = report goodput only
 
     def policy(self) -> Policy:
         return BF16_COMPUTE if self.precision == "bf16" else FP32
@@ -67,7 +82,26 @@ class Trainer:
         self._step_fn = None
         self._eval_fn = None
         self._ckpt_writer: Optional[CheckpointWriter] = None
-        self.metrics = MetricsLogger()
+        if self.config.telemetry:
+            telemetry.enable(True)
+        self.tracer = telemetry.get_tracer()
+        self.registry = telemetry.get_registry()
+        self.goodput: Optional[GoodputAccountant] = None
+        # JSONL export high-water mark; keyed to the tracer epoch so a
+        # telemetry.reset() between runs restarts the window instead of
+        # silently dropping the next run's spans
+        self._spans_exported = 0
+        self._spans_epoch = self.tracer.epoch
+        metrics_path = None
+        if self.config.trace_dir:
+            import os
+            os.makedirs(self.config.trace_dir, exist_ok=True)
+            metrics_path = os.path.join(self.config.trace_dir,
+                                        "telemetry.jsonl")
+        # one unified record per log interval: training metrics + the
+        # registry snapshot ride the same JSONL stream
+        self.metrics = MetricsLogger(path=metrics_path,
+                                     registry=self.registry)
         # plan pool: one compiled (plan, step, eval) per strategy, so
         # switching A -> B -> A reuses executables (the reference's
         # ExecGraphPlan pool, define_and_run_graph.h:23-64)
@@ -96,15 +130,22 @@ class Trainer:
             if strategy in self._plan_cache:
                 plan, step_fn, _ = self._plan_cache[strategy]
             else:
-                with autocast(self.config.policy()):
+                t0 = time.perf_counter()
+                with telemetry.span("compile", hetero=True,
+                                    strategy=strategy.to_json()), \
+                        autocast(self.config.policy()):
                     plan = make_hetero_plan(self.model, strategy,
                                             self.devices)
                     step_fn = build_hetero_train_step(
                         self.model, self.opt, plan,
                         attn_impl=self.config.attn_impl)
+                self._note("compile", time.perf_counter() - t0)
                 self._plan_cache[strategy] = (plan, step_fn, None)
             if self.state is not None:
-                self.state = state_to_hetero(to_homo_state(), plan)
+                t0 = time.perf_counter()
+                with telemetry.span("switch", hetero=True):
+                    self.state = state_to_hetero(to_homo_state(), plan)
+                self._note("switch", time.perf_counter() - t0)
                 get_logger().info(
                     f"hot-switched to hetero {strategy.to_json()} at "
                     f"step {int(self.state.step)}")
@@ -116,16 +157,23 @@ class Trainer:
         if strategy in self._plan_cache:
             plan, step_fn, eval_fn = self._plan_cache[strategy]
         else:
-            with autocast(self.config.policy()):
+            t0 = time.perf_counter()
+            with telemetry.span("compile", strategy=strategy.to_json()), \
+                    autocast(self.config.policy()):
                 plan = make_plan(self.model, self.opt, strategy,
                                  self.devices)
                 step_fn = build_train_step(self.model, self.opt, plan,
                                            attn_impl=self.config.attn_impl)
                 eval_fn = build_eval_step(self.model, plan,
                                           attn_impl=self.config.attn_impl)
+            self._note("compile", time.perf_counter() - t0)
             self._plan_cache[strategy] = (plan, step_fn, eval_fn)
         if self.state is not None:
+            t0 = time.perf_counter()
+            # switch_strategy records the "switch" span itself (with
+            # cross-topology + volume attrs); only the ledger lives here
             self.state = switch_strategy(to_homo_state(), plan)
+            self._note("switch", time.perf_counter() - t0)
             get_logger().info(
                 f"hot-switched to {strategy.to_json()} at step "
                 f"{int(jax.device_get(self.state.step))}")
@@ -133,6 +181,15 @@ class Trainer:
         self._step_fn = step_fn
         self._eval_fn = eval_fn
         return plan
+
+    def _note(self, category: str, seconds: float) -> None:
+        """Goodput ledger + cumulative counter for an overhead event."""
+        if self.goodput is not None:
+            self.goodput.record(category, seconds)
+        if telemetry.enabled():
+            self.registry.counter(
+                f"{category}_seconds_total",
+                f"cumulative {category} time").inc(seconds)
 
     def shrink_to(self, devices, strategy: Optional[Strategy] = None):
         """Elastic recovery on the live controller: rebuild plans over
@@ -190,25 +247,33 @@ class Trainer:
         path = path or self.config.ckpt_dir
         if path is None:
             raise ValueError("no checkpoint path configured")
-        if self._ckpt_writer is not None:
-            self._ckpt_writer.wait()  # one in-flight save at a time
-        from hetu_tpu.parallel.hetero import HeteroState, state_from_hetero
-        state = self.state
-        if isinstance(state, HeteroState):
-            # checkpoints are layout-independent: merge to one TrainState
-            state = state_from_hetero(state, self.plan, self.model)
-        if self.config.distributed_ckpt:
-            from hetu_tpu.utils.dist_checkpoint import (
-                save_checkpoint_distributed)
-            self._ckpt_writer = save_checkpoint_distributed(
-                path, state,
-                async_save=self.config.async_ckpt and not wait)
-        else:
-            self._ckpt_writer = save_checkpoint(
-                path, state,
-                async_save=self.config.async_ckpt and not wait)
-        if wait:
-            self._ckpt_writer.wait()
+        t0 = time.perf_counter()
+        with telemetry.span("checkpoint", path=path, wait=wait):
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.wait()  # one in-flight save at a time
+            from hetu_tpu.parallel.hetero import (
+                HeteroState, state_from_hetero)
+            state = self.state
+            if isinstance(state, HeteroState):
+                # checkpoints are layout-independent: merge to one
+                # TrainState
+                state = state_from_hetero(state, self.plan, self.model)
+            if self.config.distributed_ckpt:
+                from hetu_tpu.utils.dist_checkpoint import (
+                    save_checkpoint_distributed)
+                self._ckpt_writer = save_checkpoint_distributed(
+                    path, state,
+                    async_save=self.config.async_ckpt and not wait)
+            else:
+                self._ckpt_writer = save_checkpoint(
+                    path, state,
+                    async_save=self.config.async_ckpt and not wait)
+            if wait:
+                self._ckpt_writer.wait()
+        # the span/ledger cover what BLOCKED the loop (previous writer
+        # drain + device→host gather + sync write); an async write's own
+        # latency is tracked by checkpoint_write_seconds on its thread
+        self._note("checkpoint", time.perf_counter() - t0)
         return path
 
     # -- training ----------------------------------------------------------
@@ -240,8 +305,15 @@ class Trainer:
             self.initialize()
         steps = steps if steps is not None else self.config.total_steps
         history = []
+        tel = telemetry.enabled()
+        # goodput ledger for THIS run: every loop second lands in a
+        # category (compute/stall/eval here; compile/switch/checkpoint
+        # via set_strategy()/save()); report exported at the end
+        acct = GoodputAccountant(peak_flops=self.config.peak_flops)
+        self.goodput = acct
         t_last = time.perf_counter()
         tokens_since = 0
+        tokens_total = 0
         host_step = int(jax.device_get(self.state.step))
         prefetcher = None
         if self.config.prefetch > 0:
@@ -254,13 +326,25 @@ class Trainer:
             it = (self.plan.shard_batch(b) for b in batches)
         try:
             for _ in range(steps):
+                t_iter = time.perf_counter()
                 try:
                     sbatch = next(it)
                 except StopIteration:
                     break
+                t_fetch = time.perf_counter()
+                # waiting on the data path is a stall (the prefetcher
+                # additionally emits a "stall" span + counter itself)
+                acct.record("stall", t_fetch - t_iter)
+                if acct.flops_per_token is None and "input_ids" in sbatch:
+                    acct.flops_per_token = self._flops_per_token(
+                        int(sbatch["input_ids"].shape[-1]))
                 self.state, metrics = self._step_fn(self.state, sbatch)
                 host_step += 1
-                tokens_since += int(sbatch["input_ids"].size)
+                acct.add_step()
+                ntok = int(sbatch["input_ids"].size)
+                tokens_since += ntok
+                tokens_total += ntok
+                acct.add_tokens(ntok)
                 if self.config.log_every and \
                         host_step % self.config.log_every == 0:
                     loss = float(jax.device_get(metrics["loss"]))
@@ -270,22 +354,38 @@ class Trainer:
                         grad_norm=float(
                             jax.device_get(metrics["grad_norm"])),
                         tokens_per_sec=round(
-                            tokens_since / (now - t_last), 1))
+                            tokens_since / (now - t_last), 1),
+                        tokens_total=tokens_total)
                     history.append(rec)
                     t_last, tokens_since = now, 0
+                # step dispatch + the log boundary's blocking fetch: the
+                # productive slice of this iteration
+                acct.record("compute", time.perf_counter() - t_fetch)
                 if self.config.eval_every and eval_batches is not None \
                         and host_step % self.config.eval_every == 0:
-                    ev = self.evaluate(eval_batches())
+                    t0 = time.perf_counter()
+                    with telemetry.span("eval", step=host_step):
+                        ev = self.evaluate(eval_batches())
+                    acct.record("eval", time.perf_counter() - t0)
                     history.append(self.metrics.log(host_step,
                                                     eval_loss=ev))
                 if self.config.ckpt_every and self.config.ckpt_dir and \
                         host_step % self.config.ckpt_every == 0:
-                    self.save()
+                    self.save()   # notes "checkpoint" in the ledger
+            if self.config.ckpt_dir:
+                self.save(wait=True)
         finally:
             if prefetcher is not None:
                 prefetcher.close()
-        if self.config.ckpt_dir:
-            self.save(wait=True)
+            acct.freeze()   # later manual exports must not dilute goodput
+            # export in the failure path too: a crashed run is exactly
+            # when the operator needs the trace (best-effort — an export
+            # problem must not mask the training error)
+            if tel:
+                try:
+                    self.export_telemetry()
+                except Exception as e:
+                    get_logger().warning(f"telemetry export failed: {e}")
         return history
 
     def train_dynamic(self, dispatcher, seqs, epochs: int = 1, *,
@@ -306,23 +406,91 @@ class Trainer:
         if self.state is None:
             self.initialize()
         history = []
+        tel = telemetry.enabled()
+        acct = GoodputAccountant(peak_flops=self.config.peak_flops)
+        self.goodput = acct   # set_strategy switches/compiles feed it
         host_step = int(jax.device_get(self.state.step))
-        for _ in range(epochs):
-            for batch, plan in dispatcher.batches(seqs):
-                if use_bucket_strategies \
-                        and plan.strategy != self.strategy:
-                    self.set_strategy(plan.strategy)
-                metrics = self.train_step(batch)
-                host_step += 1   # host-side: no per-step device sync
-                if self.config.log_every and \
-                        host_step % self.config.log_every == 0:
-                    extra = {"strategy": plan.strategy.to_json()} \
-                        if use_bucket_strategies else {}
-                    history.append(self.metrics.log(
-                        host_step,
-                        loss=float(jax.device_get(metrics["loss"])),
-                        bucket=plan.bucket_len, **extra))
+        try:
+            for _ in range(epochs):
+                for batch, plan in dispatcher.batches(seqs):
+                    if use_bucket_strategies \
+                            and plan.strategy != self.strategy:
+                        self.set_strategy(plan.strategy)
+                    t0 = time.perf_counter()
+                    if acct.flops_per_token is None \
+                            and "input_ids" in batch:
+                        acct.flops_per_token = self._flops_per_token(
+                            int(batch["input_ids"].shape[-1]))
+                    metrics = self.train_step(batch)
+                    host_step += 1   # host-side: no per-step device sync
+                    acct.add_step()
+                    acct.add_tokens(int(batch["input_ids"].size))
+                    if self.config.log_every and \
+                            host_step % self.config.log_every == 0:
+                        extra = {"strategy": plan.strategy.to_json()} \
+                            if use_bucket_strategies else {}
+                        history.append(self.metrics.log(
+                            host_step,
+                            loss=float(jax.device_get(metrics["loss"])),
+                            bucket=plan.bucket_len, **extra))
+                    acct.record("compute", time.perf_counter() - t0)
+        finally:
+            acct.freeze()
+            if tel:
+                try:
+                    self.export_telemetry()
+                except Exception as e:
+                    get_logger().warning(f"telemetry export failed: {e}")
         return history
+
+    # -- telemetry ---------------------------------------------------------
+    def _flops_per_token(self, seq_len: int) -> Optional[float]:
+        """Model FLOPs/token from the config shapes (cost-model dims);
+        None when the model family doesn't expose transformer dims."""
+        cfg = getattr(self.model, "cfg", None)
+        if cfg is None or not hasattr(cfg, "num_layers") \
+                or not hasattr(cfg, "hidden_size"):
+            return None
+        try:
+            from hetu_tpu.tools.galvatron.cost_model import ModelDims
+            dims = ModelDims.from_config(cfg, seq_len=seq_len,
+                                         global_batch=1)
+            return telemetry.model_flops_per_token(dims)
+        except Exception:
+            return None
+
+    def export_telemetry(self) -> Optional[dict]:
+        """Flush telemetry artifacts for the last run to
+        ``config.trace_dir``: rewrite ``trace.json`` (all spans so far,
+        Perfetto-loadable) and append the new span records plus the
+        goodput report to ``telemetry.jsonl``. Returns the goodput
+        record (also without a trace_dir, for programmatic use)."""
+        rec = None
+        if self.goodput is not None:
+            rec = self.goodput.report().to_record()
+        if not self.config.trace_dir or not telemetry.enabled():
+            return rec
+        import os
+        self.tracer.export_chrome(
+            os.path.join(self.config.trace_dir, "trace.json"))
+        if self._spans_epoch != self.tracer.epoch:   # reset() since last
+            self._spans_exported = 0
+            self._spans_epoch = self.tracer.epoch
+        events = self.tracer.events()
+        for ev in events[self._spans_exported:]:
+            self.metrics.write_record(ev.to_record())
+        self._spans_exported = len(events)
+        if rec is not None:
+            self.metrics.write_record(rec)
+        return rec
+
+    def close(self) -> None:
+        """Release resources: drain any in-flight checkpoint write and
+        close the metrics JSONL stream (idempotent)."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait()
+            self._ckpt_writer = None
+        self.metrics.close()
 
     def evaluate(self, batches: Iterable[dict]) -> float:
         if self._eval_fn is None:

@@ -415,10 +415,13 @@ class HeteroTrainStep:
             })
         return out
 
-    def _forward_mb(self, state, mb, stage_in, extras_of, vjps=None):
+    def _forward_mb(self, state, mb, stage_in, extras_of, vjps=None,
+                    busy=None):
         """Run one microbatch's forward through stages 0..S-2, recording
         each stage's input (recompute backward) or its vjp closure
-        (residual backward)."""
+        (residual backward). ``busy`` (telemetry): per-stage seconds the
+        host spent dispatching/feeding that stage this step."""
+        import time as _time
         plan = self.plan
         S = len(plan.meshes)
         ids = jax.device_put(mb["input_ids"], plan.batch_shardings[0])
@@ -442,6 +445,7 @@ class HeteroTrainStep:
             extras["dropout_seed"] = np.uint32(
                 (int(state.step) * self.nm + j) & 0xFFFFFFFF)
         extras_of.append(extras)
+        t0 = _time.perf_counter() if busy is not None else 0.0
         if vjps is not None:
             h, vjp0 = self._fwd_res[0](state.outer, state.blocks[0], ids,
                                        positions, extras)
@@ -449,6 +453,10 @@ class HeteroTrainStep:
         else:
             h = self._fwd_first(state.outer, state.blocks[0], ids,
                                 positions, extras)
+        if busy is not None:
+            t1 = _time.perf_counter()
+            busy[0] += t1 - t0
+            t0 = t1
         stage_in[0].append((ids, positions, labels))
         for i in range(1, S):
             h = jax.device_put(h, plan.act_shardings[i])
@@ -462,22 +470,32 @@ class HeteroTrainStep:
                     vjps[i].append(vjp)
                 else:
                     h = self._fwd_mid[i](state.blocks[i], h, extras)
+            if busy is not None:
+                t1 = _time.perf_counter()
+                busy[i] += t1 - t0
+                t0 = t1
         # the last stage's forward is fused into bwd_last (one forward
         # in both modes)
 
     def _backward_mb(self, state, j, head_outer, stage_in, extras_of,
-                     gscale, acc, vjps=None):
+                     gscale, acc, vjps=None, busy=None):
         """Backward for microbatch ``j``; frees its stored inputs."""
+        import time as _time
         plan = self.plan
         S = len(plan.meshes)
         extras = extras_of[j]
         h_last = stage_in[S - 1][j]
         _, _, labels = stage_in[0][j]
+        t0 = _time.perf_counter() if busy is not None else 0.0
         loss, dho, dchunk, dh = self._bwd_last(
             head_outer, state.blocks[S - 1], h_last, labels,
             extras, gscale)
         acc["head_outer"] = self._acc(acc["head_outer"], dho)
         acc["blocks"][S - 1] = self._acc(acc["blocks"][S - 1], dchunk)
+        if busy is not None:
+            t1 = _time.perf_counter()
+            busy[S - 1] += t1 - t0
+            t0 = t1
         for i in range(S - 2, 0, -1):
             g = jax.device_put(dh, plan.act_shardings[i])
             if vjps is not None:
@@ -486,6 +504,10 @@ class HeteroTrainStep:
                 dchunk, dh = self._bwd_mid[i](state.blocks[i],
                                               stage_in[i][j], extras, g)
             acc["blocks"][i] = self._acc(acc["blocks"][i], dchunk)
+            if busy is not None:
+                t1 = _time.perf_counter()
+                busy[i] += t1 - t0
+                t0 = t1
         g = jax.device_put(dh, plan.act_shardings[0])
         if vjps is not None:
             douter, dchunk = self._bwd_apply[0](vjps[0][j], g)
@@ -495,6 +517,8 @@ class HeteroTrainStep:
                 state.outer, state.blocks[0], ids, positions, extras, g)
         acc["outer"] = self._acc(acc["outer"], douter)
         acc["blocks"][0] = self._acc(acc["blocks"][0], dchunk)
+        if busy is not None:
+            busy[0] += _time.perf_counter() - t0
         # 1F1B memory bound: drop this microbatch's stored activations
         # and residuals
         for i in range(S):
@@ -504,10 +528,19 @@ class HeteroTrainStep:
         return loss
 
     def __call__(self, state: HeteroState, batch: dict):
+        import time as _time
+        from hetu_tpu import telemetry
         plan, nm, pp = self.plan, self.nm, self.pp
         mbs = self._microbatches(batch)
         S = len(plan.meshes)
         gscale = jnp.asarray(1.0 / nm, jnp.float32)
+        # per-stage busy seconds (host dispatch + cross-mesh feed): on the
+        # host-scheduled executor the host blocks on each stage's
+        # transfers, so host time per stage is the schedule's view of
+        # stage load — its complement vs the step wall is the bubble
+        tel = telemetry.enabled()
+        busy = [0.0] * S if tel else None
+        t_step0 = _time.perf_counter() if tel else 0.0
 
         # bridge the shared outer params to the last stage's mesh
         head_outer = jax.device_put(state.outer, plan.head_outer_shardings) \
@@ -527,23 +560,25 @@ class HeteroTrainStep:
             # forward with one backward — at most S microbatches of
             # activations live at any time (1F1B's memory bound)
             for j, mb in enumerate(mbs):
-                self._forward_mb(state, mb, stage_in, extras_of, vjps)
+                self._forward_mb(state, mb, stage_in, extras_of, vjps,
+                                 busy)
                 if j >= S - 1:
                     k = j - (S - 1)
                     losses[k] = self._backward_mb(
                         state, k, head_outer, stage_in, extras_of,
-                        gscale, acc, vjps)
+                        gscale, acc, vjps, busy)
             for k in range(max(0, nm - (S - 1)), nm):
                 losses[k] = self._backward_mb(
                     state, k, head_outer, stage_in, extras_of, gscale,
-                    acc, vjps)
+                    acc, vjps, busy)
         else:  # gpipe: all forwards, then all backwards (newest first)
             for mb in mbs:
-                self._forward_mb(state, mb, stage_in, extras_of, vjps)
+                self._forward_mb(state, mb, stage_in, extras_of, vjps,
+                                 busy)
             for j in reversed(range(nm)):
                 losses[j] = self._backward_mb(
                     state, j, head_outer, stage_in, extras_of, gscale,
-                    acc, vjps)
+                    acc, vjps, busy)
         gouter, ghead_outer = acc["outer"], acc["head_outer"]
         gblocks = acc["blocks"]
 
@@ -567,6 +602,23 @@ class HeteroTrainStep:
         # host fetches only after every update is dispatched
         sq = sum(float(jax.device_get(s)) for s in sqs)
         loss = float(np.mean([jax.device_get(l) for l in losses]))
+        if tel:
+            wall = _time.perf_counter() - t_step0
+            reg = telemetry.get_registry()
+            h_busy = reg.histogram(
+                "hetero_stage_busy_seconds",
+                "host-scheduled dispatch+feed time per stage per step")
+            h_bub = reg.histogram(
+                "hetero_stage_bubble_seconds",
+                "step wall minus this stage's busy time (pipeline "
+                "bubble, host view)")
+            for i, b in enumerate(busy):
+                h_busy.observe(b, stage=str(i))
+                h_bub.observe(max(0.0, wall - b), stage=str(i))
+            telemetry.get_tracer().complete(
+                "hetero_step", wall, schedule=self.schedule,
+                microbatches=nm, stages=S,
+                busy_s=[round(b, 6) for b in busy])
         metrics = {"loss": jnp.asarray(loss),
                    "grad_norm": jnp.sqrt(jnp.asarray(sq))}
         return HeteroState(state.step + 1, new_outer, tuple(new_blocks),

@@ -23,7 +23,13 @@ import numpy as np
 
 import jax
 
+from hetu_tpu import telemetry
 from hetu_tpu.engine.state import TrainState
+
+
+def _state_bytes(state) -> int:
+    return sum(leaf.nbytes for leaf in jax.tree.leaves(state)
+               if hasattr(leaf, "nbytes"))
 
 
 def switch_strategy(state: TrainState, new_plan) -> TrainState:
@@ -40,9 +46,16 @@ def switch_strategy(state: TrainState, new_plan) -> TrainState:
                    if isinstance(leaf, jax.Array)
                    for d in leaf.sharding.device_set}
     new_devices = set(new_plan.mesh.devices.flat)
-    if old_devices <= new_devices or not old_devices:
-        return jax.device_put(state, new_plan.state_shardings)
-    return cross_topology_switch(state, new_plan)
+    same_set = old_devices <= new_devices or not old_devices
+    with telemetry.span("switch", cross_topology=not same_set) as sp:
+        if telemetry.enabled():
+            sp.set(state_bytes=_state_bytes(state))
+            telemetry.get_registry().counter(
+                "switches_total",
+                "hot strategy switches executed").inc()
+        if same_set:
+            return jax.device_put(state, new_plan.state_shardings)
+        return cross_topology_switch(state, new_plan)
 
 
 def cross_topology_switch(state: TrainState, new_plan) -> TrainState:

@@ -113,6 +113,8 @@ def bootstrap_distributed(*, coord_port: Optional[int] = None,
                 time.sleep(0.05)
                 addr = client.get(key)
         import jax
+        from hetu_tpu.core.compat import enable_cpu_collectives
+        enable_cpu_collectives()   # old-jax CPU default is "none"
         jax.distributed.initialize(addr, num_processes=n, process_id=rank)
 
     if heartbeat:

@@ -1,0 +1,115 @@
+"""Unified telemetry: spans, metrics, cross-rank aggregation, goodput.
+
+One subsystem replaces the two disconnected islands the framework grew up
+with (``utils/logging.py`` JSONL sink, ``utils/profiler.py`` step stats):
+
+- :mod:`~hetu_tpu.telemetry.spans` — control-plane span tracer
+  (plan compiles, hot switches, checkpoint writes, prefetch stalls),
+  exportable as Chrome-trace JSON for Perfetto;
+- :mod:`~hetu_tpu.telemetry.metrics` — Counter/Gauge/Histogram registry
+  with snapshot-to-dict and Prometheus-text exposition;
+- :mod:`~hetu_tpu.telemetry.aggregate` — per-host snapshots fanned
+  through the coordinator KV; rank 0 emits cluster min/max/mean;
+- :mod:`~hetu_tpu.telemetry.goodput` — goodput / MFU accountant.
+
+Process-global default instances live here (the Prometheus
+default-registry idiom): instrumented hot paths write through
+:func:`get_tracer` / :func:`get_registry` and pay near-zero cost until
+:func:`enable` turns collection on. ``docs/OBSERVABILITY.md`` documents
+what is emitted where.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from hetu_tpu.telemetry.aggregate import (
+    aggregate_snapshots, cluster_aggregate, collect_snapshots,
+    publish_snapshot,
+)
+from hetu_tpu.telemetry.goodput import (
+    CATEGORIES, GoodputAccountant, GoodputReport, format_goodput_table,
+    model_flops_per_token, report_from_records,
+)
+from hetu_tpu.telemetry.metrics import (
+    Counter, Gauge, Histogram, MetricRegistry, percentile,
+)
+from hetu_tpu.telemetry.spans import NULL_SPAN, SpanEvent, Tracer
+
+_TRACER = Tracer(enabled=False)
+_REGISTRY = MetricRegistry(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (disabled until :func:`enable`)."""
+    return _TRACER
+
+
+def get_registry() -> MetricRegistry:
+    """The process-global metric registry (disabled until :func:`enable`)."""
+    return _REGISTRY
+
+
+def enable(on: bool = True) -> None:
+    """Master switch for the global tracer + registry. Off by default;
+    the disabled fast path is a single attribute check per call site
+    (<1% of any real step loop — asserted in ``tests/test_telemetry.py``)."""
+    _TRACER.enabled = on
+    _REGISTRY.enabled = on
+
+
+def enabled() -> bool:
+    return _TRACER.enabled
+
+
+def reset() -> None:
+    """Drop all recorded events and metrics (tests / between runs)."""
+    _TRACER.clear()
+    _REGISTRY.clear()
+
+
+def span(name: str, cat: str = "span", **attrs):
+    """``with telemetry.span("compile", plan=...):`` on the global tracer."""
+    return _TRACER.span(name, cat=cat, **attrs)
+
+
+def export_dir(path: str, *, extra_records=(),
+               tracer: Optional[Tracer] = None,
+               registry: Optional[MetricRegistry] = None) -> dict:
+    """Write the standard artifact pair under ``path``:
+
+    - ``trace.json`` — Chrome-trace (open in Perfetto);
+    - ``telemetry.jsonl`` — span records + a metrics snapshot +
+      ``extra_records`` (e.g. a goodput report), one JSON object/line.
+
+    Returns ``{"trace": ..., "jsonl": ...}`` with the written paths."""
+    tracer = tracer if tracer is not None else _TRACER
+    registry = registry if registry is not None else _REGISTRY
+    os.makedirs(path, exist_ok=True)
+    trace_path = os.path.join(path, "trace.json")
+    jsonl_path = os.path.join(path, "telemetry.jsonl")
+    tracer.export_chrome(trace_path)
+    with open(jsonl_path, "w") as f:
+        for rec in tracer.records():
+            f.write(json.dumps(rec) + "\n")
+        snap_rec = registry.to_record()
+        if snap_rec["metrics"]:
+            f.write(json.dumps(snap_rec) + "\n")
+        for rec in extra_records:
+            f.write(json.dumps(rec) + "\n")
+    return {"trace": trace_path, "jsonl": jsonl_path}
+
+
+__all__ = [
+    "Tracer", "SpanEvent", "NULL_SPAN",
+    "MetricRegistry", "Counter", "Gauge", "Histogram", "percentile",
+    "GoodputAccountant", "GoodputReport", "CATEGORIES",
+    "model_flops_per_token", "format_goodput_table",
+    "report_from_records",
+    "publish_snapshot", "collect_snapshots", "aggregate_snapshots",
+    "cluster_aggregate",
+    "get_tracer", "get_registry", "enable", "enabled", "reset", "span",
+    "export_dir",
+]

@@ -1,0 +1,125 @@
+"""trace_summary: read telemetry artifacts, print the goodput breakdown.
+
+Usage::
+
+    python -m hetu_tpu.tools.trace_summary runs/exp1/telemetry.jsonl
+    python -m hetu_tpu.tools.trace_summary runs/exp1/trace.json --wall 42.0
+
+Accepts either artifact the telemetry subsystem writes
+(:func:`hetu_tpu.telemetry.export_dir` / ``Trainer`` with
+``trace_dir``): the unified JSONL (``kind: span|metrics|goodput|...``
+records) or a Chrome-trace JSON (``traceEvents``). Prints the goodput
+table (compute/compile/switch/checkpoint/stall vs wall), the heaviest
+spans, and the last logged training metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from hetu_tpu.telemetry.goodput import (
+    format_goodput_table, report_from_records,
+)
+
+
+def load_records(path: str) -> list[dict]:
+    """JSONL → record list; Chrome trace → synthesized span records."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)               # whole file = one document?
+    except json.JSONDecodeError:
+        return [json.loads(line) for line in text.splitlines()
+                if line.strip()]             # JSONL
+    if isinstance(obj, dict) and "traceEvents" in obj:
+        records = []
+        for ev in obj["traceEvents"]:
+            if ev.get("ph") != "X":
+                continue
+            records.append({
+                "kind": "span", "name": ev.get("name", ""),
+                "cat": ev.get("cat", "span"),
+                "ts_s": ev.get("ts", 0.0) / 1e6,
+                "dur_s": ev.get("dur", 0.0) / 1e6,
+                "tid": ev.get("tid", 0),
+                "depth": 0, "attrs": ev.get("args", {}),
+            })
+        return records
+    # a one-record JSONL parses as a single dict; a JSON array passes
+    # through as-is
+    return [obj] if isinstance(obj, dict) else list(obj)
+
+
+def span_rollup(records: list[dict], top: int = 10) -> list[tuple]:
+    """(name, count, total_s, max_s) rows for the heaviest span names."""
+    agg: dict[str, list[float]] = {}
+    for rec in records:
+        if rec.get("kind") != "span":
+            continue
+        agg.setdefault(rec.get("name", "?"), []).append(
+            rec.get("dur_s", 0.0))
+    rows = [(name, len(durs), sum(durs), max(durs))
+            for name, durs in agg.items()]
+    rows.sort(key=lambda r: -r[2])
+    return rows[:top]
+
+
+def last_metrics(records: list[dict]) -> Optional[dict]:
+    out = None
+    for rec in records:
+        if rec.get("kind") == "metrics":
+            out = rec
+    return out
+
+
+def summarize(path: str, *, wall_s: Optional[float] = None,
+              top: int = 10) -> str:
+    records = load_records(path)
+    report = report_from_records(records, wall_s=wall_s)
+    parts = [f"== goodput breakdown ({path}) ==",
+             format_goodput_table(report)]
+
+    rows = span_rollup(records, top=top)
+    if rows:
+        parts.append("")
+        parts.append(f"== heaviest spans ==")
+        parts.append(f"{'span':<24} {'n':>6} {'total s':>10} {'max s':>9}")
+        for name, n, total, mx in rows:
+            parts.append(f"{name:<24} {n:>6} {total:>10.3f} {mx:>9.3f}")
+
+    m = last_metrics(records)
+    if m is not None:
+        parts.append("")
+        keep = {k: v for k, v in m.items()
+                if k not in ("kind", "telemetry") and not isinstance(
+                    v, (dict, list))}
+        parts.append(f"== last metrics record ==")
+        parts.append(json.dumps(keep))
+    return "\n".join(parts)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_summary",
+        description="Goodput breakdown from hetu_tpu telemetry artifacts")
+    ap.add_argument("path", help="telemetry.jsonl or trace.json")
+    ap.add_argument("--wall", type=float, default=None,
+                    help="override wall-clock seconds (else taken from "
+                         "the goodput record / latest span end)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many span names to roll up")
+    args = ap.parse_args(argv)
+    try:
+        print(summarize(args.path, wall_s=args.wall, top=args.top))
+    except FileNotFoundError:
+        print(f"trace_summary: no such file: {args.path}",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

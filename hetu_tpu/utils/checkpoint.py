@@ -20,12 +20,14 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Any, Optional
 
 import jax
 import numpy as np
 from safetensors.numpy import load_file, save_file
 
+from hetu_tpu import telemetry
 from hetu_tpu.engine.state import TrainState
 
 _MODEL_PREFIX = "model."
@@ -73,11 +75,17 @@ def _rebuild_like(template: Any, flat: dict[str, np.ndarray],
 
 
 class CheckpointWriter:
-    """Handle for an (optionally async) in-flight save."""
+    """Handle for an (optionally async) in-flight save.
+
+    ``write_seconds`` carries the measured file-write latency once the
+    write completes (telemetry: async saves finish off the train loop, so
+    their cost is only visible through this and the
+    ``checkpoint_write`` span recorded on the writer thread)."""
 
     def __init__(self, thread: Optional[threading.Thread] = None):
         self._thread = thread
         self._error: Optional[BaseException] = None
+        self.write_seconds: Optional[float] = None
 
     def wait(self):
         if self._thread is not None:
@@ -102,22 +110,24 @@ def save_checkpoint(path: str, state: TrainState, *,
     """
     tensors: dict[str, np.ndarray] = {}
     quantized: list[str] = []
-    for name, leaf in _flatten(state.params).items():
-        arr = np.asarray(jax.device_get(leaf))
-        key = _MODEL_PREFIX + name
-        if quantize == "int8" and arr.ndim >= 2 and \
-                np.issubdtype(np.asarray(arr).dtype, np.floating):
-            from hetu_tpu.ops.quantization import quantize_int8
-            import jax.numpy as jnp
-            q, scale = quantize_int8(jnp.asarray(np.float32(arr)))
-            tensors[key] = np.asarray(jax.device_get(q))
-            tensors[key + ".q8scale"] = np.asarray(jax.device_get(scale))
-            quantized.append(key)
-        else:
-            tensors[key] = arr
-    for name, leaf in _flatten(state.opt_state).items():
-        tensors[_OPT_PREFIX + name] = np.asarray(jax.device_get(leaf))
-    step = int(jax.device_get(state.step))
+    with telemetry.span("checkpoint_gather", path=path):
+        for name, leaf in _flatten(state.params).items():
+            arr = np.asarray(jax.device_get(leaf))
+            key = _MODEL_PREFIX + name
+            if quantize == "int8" and arr.ndim >= 2 and \
+                    np.issubdtype(np.asarray(arr).dtype, np.floating):
+                from hetu_tpu.ops.quantization import quantize_int8
+                import jax.numpy as jnp
+                q, scale = quantize_int8(jnp.asarray(np.float32(arr)))
+                tensors[key] = np.asarray(jax.device_get(q))
+                tensors[key + ".q8scale"] = np.asarray(
+                    jax.device_get(scale))
+                quantized.append(key)
+            else:
+                tensors[key] = arr
+        for name, leaf in _flatten(state.opt_state).items():
+            tensors[_OPT_PREFIX + name] = np.asarray(jax.device_get(leaf))
+        step = int(jax.device_get(state.step))
 
     def write():
         os.makedirs(path, exist_ok=True)
@@ -135,19 +145,38 @@ def save_checkpoint(path: str, state: TrainState, *,
 
 def _run_write(write, async_save: bool) -> CheckpointWriter:
     """Run ``write()`` inline or on a daemon thread, surfacing errors on
-    ``writer.wait()`` (shared by the gathered and sharded save paths)."""
+    ``writer.wait()`` (shared by the gathered and sharded save paths).
+
+    The write is timed either way: a ``checkpoint_write`` span (recorded
+    from the writer thread — the tracer is thread-safe) plus
+    ``writer.write_seconds`` and a ``checkpoint_write_seconds`` histogram
+    in the global registry, so async save latency stays observable even
+    though it never blocks the train loop."""
     writer = CheckpointWriter()
+
+    def timed_write():
+        t0 = time.perf_counter()
+        with telemetry.span("checkpoint_write", background=async_save):
+            write()
+        writer.write_seconds = time.perf_counter() - t0
+        if telemetry.enabled():
+            telemetry.get_registry().histogram(
+                "checkpoint_write_seconds",
+                "checkpoint file-write latency").observe(
+                    writer.write_seconds,
+                    mode="async" if async_save else "sync")
+
     if async_save:
         def run():
             try:
-                write()
+                timed_write()
             except BaseException as e:  # surfaced on wait()
                 writer._error = e
         t = threading.Thread(target=run, daemon=True)
         writer._thread = t
         t.start()
     else:
-        write()
+        timed_write()
     return writer
 
 

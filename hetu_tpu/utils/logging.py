@@ -33,12 +33,21 @@ def get_logger() -> logging.Logger:
 
 
 class MetricsLogger:
-    """Append-only JSONL metrics stream (stdout and/or a file)."""
+    """Append-only JSONL metrics stream (stdout and/or a file).
+
+    Usable as a context manager (``with MetricsLogger(path) as m: ...``)
+    so the file handle is released even when the caller (or ``plot()``)
+    raises. With a ``registry`` (a
+    :class:`~hetu_tpu.telemetry.MetricRegistry`), every record carries
+    the registry's current snapshot under a ``telemetry`` key — one
+    unified record per log interval instead of two disconnected streams.
+    """
 
     def __init__(self, path: Optional[str] = None, echo: bool = True,
-                 max_history: int = 100_000):
+                 max_history: int = 100_000, registry=None):
         self._f = open(path, "a") if path else None
         self._echo = echo
+        self._registry = registry
         self._t0 = time.perf_counter()
         # bounded in-memory tail for plot(); the durable record is the
         # JSONL file (1M-step runs must not grow host memory unboundedly)
@@ -46,10 +55,15 @@ class MetricsLogger:
         self._max_history = max_history
 
     def log(self, step: int, **metrics):
-        rec = {"step": step,
+        rec = {"kind": "metrics", "step": step,
                "elapsed_s": round(time.perf_counter() - self._t0, 3),
                **{k: (float(v) if hasattr(v, "__float__") else v)
                   for k, v in metrics.items()}}
+        if self._registry is not None and \
+                getattr(self._registry, "enabled", False):
+            snap = self._registry.snapshot()
+            if snap:
+                rec["telemetry"] = snap
         self._history.append(rec)
         if len(self._history) > self._max_history:
             del self._history[:len(self._history) // 2]
@@ -59,6 +73,14 @@ class MetricsLogger:
             self._f.flush()
         if self._echo:
             get_logger().info(line)
+        return rec
+
+    def write_record(self, rec: dict) -> dict:
+        """Append a raw record (span/goodput exports share the stream);
+        not echoed and not kept in the plot history."""
+        if self._f:
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
         return rec
 
     def clear_history(self) -> None:
@@ -75,18 +97,30 @@ class MetricsLogger:
         matplotlib.use("Agg")
         import matplotlib.pyplot as plt
         fig, ax = plt.subplots(figsize=(7, 4))
-        for key in keys:
-            pts = [(r["step"], r[key]) for r in self._history if key in r]
-            if pts:
-                ax.plot(*zip(*pts), label=key)
-        ax.set_xlabel("step")
-        ax.legend()
-        ax.grid(True, alpha=0.3)
-        fig.tight_layout()
-        fig.savefig(path)
-        plt.close(fig)
+        try:
+            for key in keys:
+                pts = [(r["step"], r[key]) for r in self._history
+                       if key in r]
+                if pts:
+                    ax.plot(*zip(*pts), label=key)
+            ax.set_xlabel("step")
+            ax.legend()
+            ax.grid(True, alpha=0.3)
+            fig.tight_layout()
+            fig.savefig(path)
+        finally:
+            plt.close(fig)   # a savefig error must not leak the figure
         return path
 
     def close(self):
+        """Idempotent; also reached via the context-manager exit."""
         if self._f:
             self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

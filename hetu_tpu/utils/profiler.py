@@ -29,6 +29,11 @@ class StepStats:
     min_s: float
     max_s: float
     compile_s: Optional[float]
+    # tail latencies — operators page on p99, not on the mean
+    # (linear-interpolation percentiles, telemetry.metrics.percentile)
+    p90_s: float = 0.0
+    p99_s: float = 0.0
+    total_s: float = 0.0         # sum over counted steps (compile excluded)
 
     def tokens_per_sec(self, tokens_per_step: int) -> float:
         return tokens_per_step / self.mean_s if self.mean_s else 0.0
@@ -67,9 +72,14 @@ class StepProfiler:
             times = times[1:]
         if not times:
             return StepStats(0, 0.0, 0.0, 0.0, 0.0, compile_s)
+        from hetu_tpu.telemetry.metrics import percentile
+        svals = sorted(times)
         return StepStats(len(times), statistics.fmean(times),
                          statistics.median(times), min(times), max(times),
-                         compile_s)
+                         compile_s,
+                         p90_s=percentile(svals, 0.9),
+                         p99_s=percentile(svals, 0.99),
+                         total_s=sum(times))
 
 
 def device_memory_stats(device=None) -> dict[str, Any]:
@@ -243,7 +253,23 @@ def memory_breakdown(state, batch: Optional[dict] = None,
     """Live memory accounting: state/batch bytes by component + allocator
     peaks (per-micro-batch activation residency is the allocator peak
     minus the resident state). Reference: ``MicroBatchMemoryInfo``
-    (``graph/profiler.h:31-38``)."""
+    (``graph/profiler.h:31-38``).
+
+    ``activation_peak_bytes`` is an ESTIMATE with known error bars:
+
+    - donated buffers double-count: while a donated train step runs, the
+      allocator's peak can include both the old and new copies of any
+      leaf XLA chose not to update in place, so the raw
+      ``peak - resident`` overestimates activations by up to
+      ``param_bytes + opt_bytes`` in the worst case;
+    - to bound that, the peak is clamped to the device's ``bytes_limit``
+      before subtracting residents (a peak above the limit is allocator
+      bookkeeping, not live tensors);
+    - allocator fragmentation and transient fusion temporaries are
+      indistinguishable from activations here — treat the value as an
+      upper bound, and use XLA's AOT ``memory_analysis`` (see
+      ``workloads/mem_calibrate.py``) when a tight number matters.
+    """
     def tree_bytes(t):
         return int(sum(x.nbytes for x in jax.tree.leaves(t)
                        if hasattr(x, "nbytes")))
@@ -259,6 +285,8 @@ def memory_breakdown(state, batch: Optional[dict] = None,
     if "peak_bytes_in_use" in stats:
         resident = out["param_bytes"] + out["opt_bytes"] \
             + out.get("batch_bytes", 0)
-        out["activation_peak_bytes"] = max(
-            0, stats["peak_bytes_in_use"] - resident)
+        peak = stats["peak_bytes_in_use"]
+        if "bytes_limit" in stats:
+            peak = min(peak, stats["bytes_limit"])
+        out["activation_peak_bytes"] = max(0, peak - resident)
     return out

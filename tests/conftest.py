@@ -8,12 +8,22 @@ mesh, numerics against pure-jnp oracles.
 import os
 
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-    # 8 virtual devices on one physical core: the CPU collective
-    # rendezvous' default 40s hard abort trips spuriously under load
-    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-    " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
 )
+
+# 8 virtual devices on one physical core: the CPU collective rendezvous'
+# default 40s hard abort trips spuriously under load. The timeout knobs
+# only exist in newer XLA — an unknown flag in XLA_FLAGS is a hard abort
+# (parse_flags_from_env.cc), so gate on the jaxlib version.
+import jaxlib  # noqa: E402
+
+_jaxlib_ver = tuple(int(x) for x in jaxlib.__version__.split(".")[:2])
+if _jaxlib_ver >= (0, 6):
+    os.environ["XLA_FLAGS"] += (
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+    )
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
@@ -121,6 +131,12 @@ SLOW_TESTS = {
     "test_two_process_dp_training",
     "test_kill_restart_resumes_from_checkpoint",
     "test_restarts_exhausted_reports_failure",
+    "test_cross_rank_telemetry_aggregation",
+    # telemetry: heavier integration pieces (the acceptance-critical
+    # trainer smoke + overhead bound stay in the quick tier)
+    "test_hetero_stage_bubble_metrics",
+    "test_trainer_telemetry_off_no_artifacts",
+    "test_trainer_crash_still_exports_artifacts",
     # hetero pipeline
     "test_hetero_matches_homogeneous",
     "test_hetero_dp_matches_weighted_oracle",

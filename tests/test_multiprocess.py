@@ -16,6 +16,8 @@ from hetu_tpu.rpc.launcher import ElasticWorkerPool
 
 _WORKER = os.path.join(os.path.dirname(__file__), "workers",
                        "dp_worker.py")
+_TELEMETRY_WORKER = os.path.join(os.path.dirname(__file__), "workers",
+                                 "telemetry_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -77,6 +79,31 @@ def test_restarts_exhausted_reports_failure(tmp_path):
         summary = pool.run(timeout_s=300)
     assert summary.get("failed") is True
     assert summary["restarts"] == 0
+
+
+def test_cross_rank_telemetry_aggregation(tmp_path):
+    """Telemetry snapshots from two real OS processes fan through the
+    coordinator KV (publish → barrier → rank-0 reduce → republish);
+    every rank receives the same, correct cluster aggregate."""
+    env = {"HETU_OUT": str(tmp_path), "HETU_REPO": _REPO}
+    with ElasticWorkerPool(_TELEMETRY_WORKER, 2, env=env,
+                           log_dir=str(tmp_path / "logs")) as pool:
+        summary = pool.run(timeout_s=120)
+    assert summary.get("failed") is None
+    out = []
+    for r in range(2):
+        with open(os.path.join(tmp_path, f"telemetry-r{r}.json")) as f:
+            out.append(json.load(f))
+    # both ranks hold the identical aggregate
+    assert out[0]["aggregate"] == out[1]["aggregate"]
+    agg = out[0]["aggregate"]
+    # ranks published 10 and 11 steps; losses 2.0 and 3.0
+    assert agg["steps_total"] == {"min": 10.0, "max": 11.0,
+                                  "mean": 10.5, "sum": 21.0, "ranks": 2}
+    assert agg["loss"]["min"] == 2.0 and agg["loss"]["max"] == 3.0
+    st = agg["step_time_s"]
+    assert st["count"] == 8 and st["ranks"] == 2
+    assert st["min"] == 0.1 and st["max"] == 0.8
 
 
 def test_ssh_prefix_fanout(tmp_path):

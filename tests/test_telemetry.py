@@ -1,0 +1,643 @@
+"""Unified telemetry subsystem tests: span tracer (nesting + Chrome-trace
+export), metric registry (percentiles, exposition), cross-rank
+aggregation over the coordinator KV, goodput math on a synthetic
+timeline, the Trainer smoke (artifacts validate against the checked-in
+schema, goodput components cover the wall clock), and the telemetry-off
+overhead bound.
+
+Multiprocess aggregation (real OS processes) lives in
+``tests/test_multiprocess.py::test_cross_rank_telemetry_aggregation``.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from hetu_tpu import optim, telemetry
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.parallel.strategy import Strategy
+from hetu_tpu.telemetry import (
+    GoodputAccountant, MetricRegistry, Tracer, aggregate_snapshots,
+    cluster_aggregate, format_goodput_table, percentile,
+)
+
+CFG = GPTConfig.tiny()
+_SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "data",
+                            "telemetry_schema.json")
+
+
+@pytest.fixture
+def telem():
+    """Clean global telemetry, enabled for the test, off afterwards."""
+    telemetry.reset()
+    telemetry.enable(True)
+    yield telemetry
+    telemetry.enable(False)
+    telemetry.reset()
+
+
+def _validate_jsonl(path):
+    """Every line must validate against the checked-in record schema."""
+    import jsonschema
+    with open(_SCHEMA_PATH) as f:
+        schema = json.load(f)
+    records = []
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            jsonschema.validate(rec, schema)
+            records.append(rec)
+    assert records, f"{path} is empty"
+    return records
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_depth():
+    tr = Tracer()
+    with tr.span("outer", role="a"):
+        time.sleep(0.002)
+        with tr.span("inner"):
+            time.sleep(0.001)
+    evs = {e.name: e for e in tr.events()}
+    assert set(evs) == {"outer", "inner"}
+    assert evs["outer"].depth == 0 and evs["inner"].depth == 1
+    # inner is contained in outer on the timeline
+    assert evs["inner"].ts_s >= evs["outer"].ts_s
+    assert (evs["inner"].ts_s + evs["inner"].dur_s
+            <= evs["outer"].ts_s + evs["outer"].dur_s + 1e-6)
+    assert evs["outer"].attrs == {"role": "a"}
+    assert evs["outer"].dur_s >= 0.003
+
+
+def test_span_records_error_attr():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    (ev,) = tr.events()
+    assert ev.attrs["error"] == "ValueError"
+
+
+def test_chrome_trace_schema():
+    """The export is a loadable traceEvents document (Perfetto/chrome)."""
+    tr = Tracer()
+    with tr.span("compile", strategy="dp2"):
+        with tr.span("make_plan"):
+            pass
+    tr.complete("stall", 0.004, where="prefetch")
+    doc = json.loads(json.dumps(tr.to_chrome()))   # round-trips as JSON
+    assert isinstance(doc["traceEvents"], list)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    ms = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(xs) == 3 and ms, "complete events + metadata rows"
+    for e in xs:
+        assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid"}
+        assert e["ts"] >= 0 and e["dur"] > 0
+    assert {e["name"] for e in xs} == {"compile", "make_plan", "stall"}
+
+
+def test_disabled_tracer_records_nothing():
+    tr = Tracer(enabled=False)
+    with tr.span("x"):
+        pass
+    tr.complete("y", 1.0)
+    assert tr.events() == []
+
+
+def test_tracer_bounded_events():
+    tr = Tracer(max_events=4)
+    for i in range(10):
+        tr.complete(f"e{i}", 0.001)
+    assert len(tr.events()) == 4 and tr.dropped == 6
+
+
+def test_tracer_thread_safety():
+    tr = Tracer()
+
+    def work(k):
+        for i in range(50):
+            with tr.span(f"t{k}"):
+                pass
+
+    ts = [threading.Thread(target=work, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(tr.events()) == 200
+    # per-thread depth bookkeeping never leaked across threads
+    assert all(e.depth == 0 for e in tr.events())
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_histogram_percentiles_and_summary():
+    reg = MetricRegistry()
+    h = reg.histogram("step_time_s")
+    for v in range(1, 101):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 100 and s["min"] == 1.0 and s["max"] == 100.0
+    assert abs(s["p50"] - 50.5) < 1e-9
+    assert abs(s["p90"] - 90.1) < 1e-9
+    assert abs(s["p99"] - 99.01) < 1e-9
+    # labeled series are independent
+    h.observe(1000.0, stage="1")
+    assert h.summary(stage="1")["count"] == 1
+    assert h.summary()["count"] == 100
+
+
+def test_percentile_edges():
+    assert percentile([], 0.5) == 0.0
+    assert percentile([3.0], 0.99) == 3.0
+    assert percentile([1.0, 2.0], 0.5) == 1.5
+
+
+def test_counter_gauge_snapshot_and_prometheus():
+    reg = MetricRegistry()
+    reg.counter("steps_total", "steps run").inc(3)
+    reg.counter("steps_total").inc(2)
+    reg.gauge("queue_depth").set(4, loader="train")
+    snap = reg.snapshot()
+    assert snap["steps_total"] == 5.0
+    assert snap['queue_depth{loader="train"}'] == 4.0
+    text = reg.to_prometheus()
+    assert "# TYPE steps_total counter" in text
+    assert "steps_total 5.0" in text
+    assert 'queue_depth{loader="train"} 4.0' in text
+    with pytest.raises(ValueError):
+        reg.gauge("steps_total")          # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter("steps_total").inc(-1)
+
+
+def test_disabled_registry_is_noop():
+    reg = MetricRegistry(enabled=False)
+    reg.counter("c").inc(5)
+    reg.gauge("g").set(1)
+    reg.histogram("h").observe(2.0)
+    assert reg.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# cross-rank aggregation
+# ---------------------------------------------------------------------------
+
+def test_aggregate_snapshots_math():
+    snaps = [
+        {"loss": 2.0, "steps_total": 10.0,
+         "step_time_s": {"count": 4, "sum": 4.0, "min": 0.5, "max": 2.0,
+                         "p50": 1.0, "p90": 1.8, "p99": 2.0}},
+        {"loss": 4.0, "steps_total": 12.0,
+         "step_time_s": {"count": 6, "sum": 12.0, "min": 1.0, "max": 3.0,
+                         "p50": 2.0, "p90": 2.8, "p99": 3.0}},
+    ]
+    agg = aggregate_snapshots(snaps)
+    assert agg["loss"] == {"min": 2.0, "max": 4.0, "mean": 3.0,
+                           "sum": 6.0, "ranks": 2}
+    assert agg["steps_total"]["sum"] == 22.0
+    st = agg["step_time_s"]
+    assert st["count"] == 10 and st["sum"] == 16.0
+    assert st["min"] == 0.5 and st["max"] == 3.0
+    assert abs(st["mean"] - 1.6) < 1e-9
+    assert st["p50_min"] == 1.0 and st["p50_max"] == 2.0
+
+
+def test_cluster_aggregate_over_coordinator_kv():
+    """Two 'ranks' (threads with their own client connections) fan their
+    snapshots through the coordinator KV; every rank gets the same
+    cluster aggregate (the in-process form of the multiprocess test)."""
+    from hetu_tpu.rpc.client import CoordinatorClient
+    from hetu_tpu.rpc.coordinator import Coordinator
+
+    with Coordinator(prefer_native=False) as coord:
+        results = {}
+
+        def rank_main(rank):
+            c = CoordinatorClient(coord.port)
+            # round 1
+            snap = {"loss": 1.0 + rank, "steps_total": 5.0 * (rank + 1)}
+            r1 = cluster_aggregate(c, rank, 2, snap, run="test",
+                                   timeout_s=20)
+            # round 2 REUSES the run id (periodic cadence): the result
+            # must be round 2's values, never round 1's stale aggregate
+            r2 = cluster_aggregate(c, rank, 2, {"loss": 10.0 + rank},
+                                   run="test", timeout_s=20)
+            results[rank] = (r1, r2)
+            c.close()
+
+        ts = [threading.Thread(target=rank_main, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert set(results) == {0, 1}
+        assert results[0] == results[1]
+        r1, r2 = results[0]
+        assert r1["loss"] == {"min": 1.0, "max": 2.0, "mean": 1.5,
+                              "sum": 3.0, "ranks": 2}
+        assert r1["steps_total"]["sum"] == 15.0
+        assert r2["loss"] == {"min": 10.0, "max": 11.0, "mean": 10.5,
+                              "sum": 21.0, "ranks": 2}
+
+
+# ---------------------------------------------------------------------------
+# goodput
+# ---------------------------------------------------------------------------
+
+def test_goodput_math_synthetic_timeline():
+    """Accountant on a fake clock: exact component accounting, goodput,
+    MFU, and the formatted table."""
+    t = [0.0]
+    acct = GoodputAccountant(flops_per_token=1e9, peak_flops=1e12,
+                             clock=lambda: t[0])
+    acct.record("compute", 8.0)
+    acct.record("compile", 0.5)
+    acct.record("switch", 0.3)
+    acct.record("checkpoint", 0.7)
+    acct.record("stall", 0.4)
+    acct.add_tokens(5000)
+    acct.add_step(10)
+    t[0] = 10.0
+    rep = acct.report()
+    assert rep.wall_s == 10.0
+    assert abs(rep.accounted_s - 9.9) < 1e-9
+    assert abs(rep.other_s - 0.1) < 1e-9
+    assert abs(rep.goodput - 0.8) < 1e-9
+    assert abs(rep.tokens_per_sec - 500.0) < 1e-9
+    # MFU = tokens * flops/token / wall / peak = 5000*1e9/10/1e12
+    assert abs(rep.mfu - 0.5) < 1e-9
+    rec = rep.to_record()
+    assert rec["kind"] == "goodput"
+    assert abs(sum(rec["components"].values()) - 9.9) < 1e-6
+    table = format_goodput_table(rep)
+    for word in ("compute", "compile", "switch", "checkpoint", "stall",
+                 "goodput", "MFU", "WALL"):
+        assert word in table
+    assert "80.0%" in table
+    # freeze pins the wall: a report long after the run ended must not
+    # dilute goodput with idle time
+    acct.freeze()
+    t[0] = 100.0
+    assert acct.report().wall_s == 10.0
+    assert abs(acct.report().goodput - 0.8) < 1e-9
+
+
+def test_model_flops_per_token_matches_bench_accounting():
+    from hetu_tpu.tools.galvatron.cost_model import ModelDims
+    dims = ModelDims.from_config(CFG, seq_len=64, global_batch=8)
+    got = telemetry.model_flops_per_token(dims)
+    want = 6.0 * dims.total_params() \
+        + 6.0 * CFG.num_layers * CFG.hidden_size * 64
+    assert got == want > 0
+
+
+def test_report_from_span_records_fallback():
+    from hetu_tpu.telemetry import report_from_records
+    recs = [
+        {"kind": "span", "name": "compile", "ts_s": 0.0, "dur_s": 1.0,
+         "tid": 1, "depth": 0},
+        {"kind": "span", "name": "make_plan", "ts_s": 0.1, "dur_s": 0.5,
+         "tid": 1, "depth": 1},                  # nested: not re-counted
+        {"kind": "span", "name": "step", "ts_s": 1.0, "dur_s": 3.0,
+         "tid": 1, "depth": 0},
+        {"kind": "span", "name": "stall", "ts_s": 4.0, "dur_s": 1.0,
+         "tid": 1, "depth": 0},
+    ]
+    rep = report_from_records(recs)
+    assert rep.components == {"compile": 1.0, "compute": 3.0,
+                              "stall": 1.0}
+    assert rep.wall_s == 5.0
+
+
+# ---------------------------------------------------------------------------
+# satellite: StepStats tails, memory_breakdown clamp, MetricsLogger
+# ---------------------------------------------------------------------------
+
+def test_stepstats_tail_percentiles_and_total():
+    from hetu_tpu.utils.profiler import StepProfiler
+    prof = StepProfiler()
+    prof.record(9.0)                       # "compile" step, skipped
+    for v in range(1, 101):
+        prof.record(v / 100.0)
+    st = prof.stats()
+    assert st.count == 100 and st.compile_s == 9.0
+    assert abs(st.p50_s - 0.505) < 1e-9
+    assert abs(st.p90_s - 0.901) < 1e-9
+    assert abs(st.p99_s - 0.9901) < 1e-9
+    assert abs(st.total_s - sum(v / 100.0 for v in range(1, 101))) < 1e-9
+    assert st.tokens_per_sec(1000) > 0     # backward-compatible
+
+
+def test_memory_breakdown_clamps_donated_double_count(monkeypatch):
+    from hetu_tpu.utils import profiler as prof_mod
+
+    class FakeState:
+        params = {"w": np.zeros((100,), np.float32)}      # 400 B
+        opt_state = {"m": np.zeros((50,), np.float32)}    # 200 B
+
+    # peak reports ABOVE the limit (donation double-count scenario)
+    monkeypatch.setattr(
+        prof_mod, "device_memory_stats",
+        lambda device=None: {"peak_bytes_in_use": 5000,
+                             "bytes_limit": 2000})
+    out = prof_mod.memory_breakdown(FakeState())
+    # clamped: min(peak, limit) - resident = 2000 - 600
+    assert out["activation_peak_bytes"] == 1400
+    # without a limit the raw peak is used
+    monkeypatch.setattr(prof_mod, "device_memory_stats",
+                        lambda device=None: {"peak_bytes_in_use": 5000})
+    out = prof_mod.memory_breakdown(FakeState())
+    assert out["activation_peak_bytes"] == 4400
+
+
+def test_metrics_logger_context_manager_and_registry(tmp_path, telem):
+    from hetu_tpu.utils.logging import MetricsLogger
+    path = str(tmp_path / "m.jsonl")
+    reg = telem.get_registry()
+    reg.counter("compile_seconds_total").inc(1.25)
+    with MetricsLogger(path, echo=False, registry=reg) as m:
+        rec = m.log(1, loss=2.5)
+        assert rec["kind"] == "metrics"
+        assert rec["telemetry"]["compile_seconds_total"] == 1.25
+        m.write_record({"kind": "goodput", "wall_s": 1.0,
+                        "components": {}, "goodput": 0.0, "tokens": 0})
+        assert m._f is not None
+    assert m._f is None                     # closed by __exit__
+    m.close()                               # idempotent
+    lines = [json.loads(l) for l in open(path)]
+    assert [r["kind"] for r in lines] == ["metrics", "goodput"]
+
+
+# ---------------------------------------------------------------------------
+# instrumented subsystems
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_emits_stall_metrics(telem):
+    from hetu_tpu.data.prefetch import DevicePrefetcher
+
+    def slow_batches():
+        for i in range(3):
+            time.sleep(0.005)
+            yield {"x": i}
+
+    with DevicePrefetcher(slow_batches(), lambda b: b,
+                          buffer_size=2) as pf:
+        out = list(pf)
+    assert len(out) == 3
+    snap = telem.get_registry().snapshot()
+    assert snap["data_stall_seconds"] > 0
+    assert "data_queue_depth" in snap
+    stalls = [e for e in telem.get_tracer().events() if e.name == "stall"]
+    assert stalls and stalls[0].attrs["where"] == "prefetch"
+
+
+def test_straggler_monitor_emits_gauges(telem):
+    from hetu_tpu.engine.straggler import StragglerMonitor
+    report = StragglerMonitor(size=64, iters=1).measure(
+        jax.devices()[:2])
+    snap = telem.get_registry().snapshot()
+    for d in report.ratios:
+        assert snap[f'straggler_ratio{{device="{d}"}}'] >= 1.0
+    assert any(e.name == "straggler_measure"
+               for e in telem.get_tracer().events())
+
+
+def test_checkpoint_write_span_and_histogram(tmp_path, telem):
+    from hetu_tpu.engine.state import TrainState
+    from hetu_tpu.utils.checkpoint import save_checkpoint
+    state = TrainState(np.int32(1), {"w": np.ones((4,), np.float32)},
+                       {"m": np.zeros((4,), np.float32)})
+    writer = save_checkpoint(str(tmp_path / "ck"), state,
+                             async_save=True)
+    writer.wait()
+    assert writer.write_seconds is not None and writer.write_seconds > 0
+    names = {e.name for e in telem.get_tracer().events()}
+    assert {"checkpoint_gather", "checkpoint_write"} <= names
+    snap = telem.get_registry().snapshot()
+    assert snap['checkpoint_write_seconds{mode="async"}']["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Trainer smoke: the acceptance criterion
+# ---------------------------------------------------------------------------
+
+def _batches(n, seed=0, b=8, s=16, delay_s=0.0):
+    for i in range(n):
+        if delay_s:
+            time.sleep(delay_s)   # force real prefetch stalls
+        ids = jax.random.randint(jax.random.key(seed + i), (b, s + 1), 0,
+                                 CFG.vocab_size)
+        yield {"input_ids": np.asarray(ids[:, :-1]),
+               "labels": np.asarray(ids[:, 1:])}
+
+
+def test_trainer_telemetry_smoke(tmp_path, telem):
+    """CPU-mesh Trainer.train() with telemetry on produces (a) a
+    Perfetto-loadable Chrome trace, (b) a schema-valid unified JSONL with
+    compile/switch/checkpoint/stall spans and per-interval
+    loss/throughput, (c) a goodput breakdown whose components cover
+    >= 95% of wall time."""
+    from hetu_tpu.engine.trainer import Trainer, TrainerConfig
+    trace_dir = str(tmp_path / "tele")
+    tr = Trainer(
+        GPTLMHeadModel(CFG), optim.adamw(1e-3), Strategy(dp=2),
+        TrainerConfig(total_steps=4, log_every=2, precision="fp32",
+                      telemetry=True, trace_dir=trace_dir,
+                      ckpt_dir=str(tmp_path / "ck"), ckpt_every=2,
+                      prefetch=2))
+    tr.train(_batches(4, delay_s=0.004))
+    # hot switch mid-run, then continue: compile (new plan) + switch spans
+    tr.set_strategy(Strategy(dp=4))
+    tr.config.total_steps = 6
+    tr.train(_batches(2, seed=4, delay_s=0.004), steps=2)
+    tr.close()
+
+    # (a) Chrome trace: valid traceEvents schema
+    with open(os.path.join(trace_dir, "trace.json")) as f:
+        trace = json.load(f)
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert xs, "no complete events in trace.json"
+    for e in xs:
+        assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+        assert e["dur"] > 0
+    span_names = {e["name"] for e in xs}
+    assert {"compile", "switch", "checkpoint", "stall"} <= span_names
+
+    # (b) unified JSONL validates against the checked-in schema
+    records = _validate_jsonl(os.path.join(trace_dir, "telemetry.jsonl"))
+    kinds = {r["kind"] for r in records}
+    assert {"metrics", "span", "goodput"} <= kinds
+    jl_spans = {r["name"] for r in records if r["kind"] == "span"}
+    assert {"compile", "switch", "checkpoint", "stall"} <= jl_spans
+    mrecs = [r for r in records if r["kind"] == "metrics"]
+    assert all("loss" in r and "tokens_per_sec" in r for r in mrecs)
+    assert any("telemetry" in r for r in mrecs)   # unified record
+
+    # (c) goodput: components cover >= 95% of wall
+    grecs = [r for r in records if r["kind"] == "goodput"]
+    assert grecs
+    g = grecs[-1]
+    assert sum(g["components"].values()) >= 0.95 * g["wall_s"]
+    assert g["tokens"] > 0 and 0 < g["goodput"] <= 1
+    for cat in ("compute", "stall", "checkpoint"):
+        assert g["components"].get(cat, 0) > 0, cat
+
+    # trace_summary renders the breakdown from the artifact
+    from hetu_tpu.tools.trace_summary import summarize
+    out = summarize(os.path.join(trace_dir, "telemetry.jsonl"))
+    for word in ("goodput", "compute", "checkpoint", "WALL",
+                 "heaviest spans"):
+        assert word in out
+
+
+def test_trainer_crash_still_exports_artifacts(tmp_path, telem):
+    """A run that dies mid-loop is exactly when the operator needs the
+    trace: the export runs from the finally path."""
+    from hetu_tpu.engine.trainer import Trainer, TrainerConfig
+    trace_dir = str(tmp_path / "tele")
+    tr = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3), Strategy(dp=2),
+                 TrainerConfig(total_steps=4, log_every=1,
+                               precision="fp32", telemetry=True,
+                               trace_dir=trace_dir, prefetch=0))
+
+    def exploding():
+        yield next(_batches(1))
+        raise RuntimeError("data source died")
+
+    with pytest.raises(RuntimeError, match="data source died"):
+        tr.train(exploding())
+    tr.close()
+    records = _validate_jsonl(os.path.join(trace_dir, "telemetry.jsonl"))
+    kinds = {r["kind"] for r in records}
+    assert "goodput" in kinds and "span" in kinds
+    assert os.path.exists(os.path.join(trace_dir, "trace.json"))
+
+
+def test_trainer_telemetry_off_no_artifacts(tmp_path):
+    """telemetry=False (default): no spans recorded, no files written."""
+    from hetu_tpu.engine.trainer import Trainer, TrainerConfig
+    telemetry.reset()
+    assert not telemetry.enabled()
+    tr = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3), Strategy(dp=2),
+                 TrainerConfig(total_steps=2, log_every=1,
+                               precision="fp32"))
+    hist = tr.train(_batches(2))
+    assert len(hist) == 2
+    assert telemetry.get_tracer().events() == []
+    assert telemetry.get_registry().snapshot() == {}
+    tr.close()
+
+
+def test_telemetry_off_overhead_under_1pct():
+    """The acceptance bound: with telemetry disabled, the instrumentation
+    a step executes (span entries, enabled checks, counter incs) costs
+    <1% of a real step's wall time (StepProfiler-measured)."""
+    from hetu_tpu.engine import build_train_step, init_state, make_plan
+    from hetu_tpu.utils.profiler import StepProfiler
+    telemetry.enable(False)
+    tracer = telemetry.get_tracer()
+    reg = telemetry.get_registry()
+    c = reg.counter("overhead_probe_total")
+
+    # a real (tiny) train step on the CPU mesh, measured with StepProfiler
+    model = GPTLMHeadModel(CFG)
+    opt = optim.adamw(1e-3)
+    plan = make_plan(model, opt, Strategy(dp=2))
+    state = init_state(model, opt, plan, jax.random.key(0))
+    step = build_train_step(model, opt, plan)
+    batch = next(_batches(1))
+    sbatch = plan.shard_batch(batch)
+    prof = StepProfiler()
+    for _ in range(6):
+        with prof.step():
+            state, m = step(state, sbatch)
+            jax.block_until_ready(m["loss"])
+    step_s = prof.stats().p50_s           # first (compile) step excluded
+    assert step_s > 0
+
+    # per-step instrumentation pattern, x2000 for a stable mean: two
+    # spans, two enabled() checks, two counter updates — more than any
+    # single loop iteration actually executes
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with tracer.span("a", x=1):
+            pass
+        with tracer.span("b"):
+            pass
+        if telemetry.enabled():
+            c.inc(1.0)
+        if telemetry.enabled():
+            c.inc(1.0)
+        c.inc(1.0)
+        c.inc(1.0)
+    per_step_overhead = (time.perf_counter() - t0) / n
+    assert per_step_overhead < 0.01 * step_s, \
+        f"disabled-telemetry overhead {per_step_overhead * 1e6:.1f}us " \
+        f"vs step {step_s * 1e3:.2f}ms"
+
+
+def test_hetero_stage_bubble_metrics(telem):
+    """The host-scheduled hetero executor reports per-stage busy/bubble
+    seconds and a hetero_step span."""
+    from hetu_tpu.parallel.hetero import (
+        HeteroStrategy, StageSpec, build_hetero_train_step,
+        init_hetero_state, make_hetero_plan,
+    )
+    model = GPTLMHeadModel(CFG)
+    opt = optim.adamw(1e-3)
+    hs = HeteroStrategy(stages=(StageSpec(layers=1, tp=2),
+                                StageSpec(layers=1, tp=2)),
+                        num_microbatches=2)
+    plan = make_hetero_plan(model, hs)
+    state = init_hetero_state(model, opt, plan, jax.random.key(0))
+    step = build_hetero_train_step(model, opt, plan)
+    batch = next(_batches(1, b=4))
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    snap = telem.get_registry().snapshot()
+    for stage in ("0", "1"):
+        busy = snap[f'hetero_stage_busy_seconds{{stage="{stage}"}}']
+        bub = snap[f'hetero_stage_bubble_seconds{{stage="{stage}"}}']
+        assert busy["count"] == 1 and busy["sum"] > 0
+        assert bub["count"] == 1 and bub["sum"] >= 0
+    hsp = [e for e in telem.get_tracer().events()
+           if e.name == "hetero_step"]
+    assert hsp and hsp[0].attrs["stages"] == 2
+    # stage busy never exceeds the step wall
+    assert all(b <= hsp[0].dur_s + 1e-6 for b in hsp[0].attrs["busy_s"])
+
+
+def test_trace_summary_cli_on_synthetic_file(tmp_path, capsys):
+    from hetu_tpu.tools.trace_summary import main
+    path = str(tmp_path / "t.jsonl")
+    recs = [
+        {"kind": "span", "name": "compile", "ts_s": 0.0, "dur_s": 2.0,
+         "tid": 1, "depth": 0, "attrs": {}},
+        {"kind": "metrics", "step": 10, "elapsed_s": 9.0, "loss": 2.0,
+         "tokens_per_sec": 100.0},
+        {"kind": "goodput", "wall_s": 10.0,
+         "components": {"compute": 7.0, "compile": 2.0, "stall": 0.5},
+         "goodput": 0.7, "tokens": 1000, "steps": 10,
+         "tokens_per_sec": 100.0},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    assert main([path]) == 0
+    out = capsys.readouterr().out
+    assert "70.0%" in out          # goodput from the record
+    assert "compile" in out and "last metrics record" in out
+    assert main([str(tmp_path / "missing.jsonl")]) == 2

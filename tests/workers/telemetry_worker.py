@@ -1,0 +1,49 @@
+"""Worker entry for the multiprocess telemetry-aggregation test.
+
+Launched by ``ElasticWorkerPool`` (env: HETU_COORD_PORT/HETU_RANK/
+HETU_NUM_PROCS; the coordinator auth token rides HETU_COORD_TOKEN).
+Each rank fills its own metric registry with rank-dependent values,
+runs the full ``cluster_aggregate`` round over the coordinator KV
+(publish → barrier → rank-0 reduce → republish) and writes the cluster
+aggregate it received to ``HETU_OUT/telemetry-r{rank}.json`` — the test
+asserts every rank saw the same, correct reduction.
+
+No jax needed: the aggregation path is pure coordinator-KV plumbing.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.environ["HETU_REPO"])
+
+from hetu_tpu import telemetry
+from hetu_tpu.rpc.client import CoordinatorClient
+
+
+def main():
+    out_dir = os.environ["HETU_OUT"]
+    rank = int(os.environ["HETU_RANK"])
+    n = int(os.environ["HETU_NUM_PROCS"])
+    client = CoordinatorClient(
+        int(os.environ["HETU_COORD_PORT"]),
+        host=os.environ.get("HETU_COORD_HOST", "127.0.0.1"))
+
+    telemetry.enable(True)
+    reg = telemetry.get_registry()
+    reg.counter("steps_total").inc(10.0 + rank)
+    reg.gauge("loss").set(2.0 + rank)
+    h = reg.histogram("step_time_s")
+    for i in range(1, 5):
+        h.observe(i * (rank + 1) / 10.0)
+
+    agg = telemetry.cluster_aggregate(client, rank, n, reg.snapshot(),
+                                      run="mp-test", timeout_s=60)
+    with open(os.path.join(out_dir, f"telemetry-r{rank}.json"),
+              "w") as f:
+        json.dump({"rank": rank, "aggregate": agg}, f)
+    client.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -82,9 +82,12 @@ def probe_tpu(timeout: float = 300.0) -> bool:
 
 from hetu_tpu import optim, telemetry
 from hetu_tpu.core.dtypes import Policy, autocast
-from hetu_tpu.engine import make_plan, init_state, build_train_step
+from hetu_tpu.engine import (
+    compile_strategy, get_step_cache, init_state, make_plan,
+)
 from hetu_tpu.models import GPTConfig, GPTLMHeadModel
 from hetu_tpu.parallel.strategy import Strategy
+from hetu_tpu.parallel.switch import switch_strategy
 
 # Telemetry JSONL emitted alongside the BENCH_*.json headline the driver
 # commits — future rounds get trace artifacts (per-attempt spans, the
@@ -240,17 +243,34 @@ def main():
     opt = optim.adamw(1e-4, weight_decay=0.01)
     # single chip (the driver validates multi-chip via dryrun_multichip)
 
+    cache = get_step_cache()
+    control = {}     # control-plane numbers for the winning attempt
+
     def run(batch, dtype_policy, strategy, attn_impl):
+        policy_key = f"{dtype_policy.param_dtype}/{dtype_policy.compute_dtype}"
         with autocast(dtype_policy):
-            plan = make_plan(model, opt, strategy)
+            # through the StepCache so the bench measures (and reports)
+            # the same control-plane path the Trainer uses
+            key = cache.key_for(model, opt, strategy, attn_impl=attn_impl,
+                                policy_key=policy_key)
+            t_c0 = time.perf_counter()
+            entry = cache.get_or_build(key, lambda: compile_strategy(
+                model, opt, strategy, attn_impl=attn_impl,
+                build_eval=False))
+            plan, step = entry.plan, entry
             state = init_state(model, opt, plan, jax.random.key(0))
-            step = build_train_step(model, opt, plan, attn_impl=attn_impl)
             ids = jax.random.randint(jax.random.key(1), (batch, seq + 1),
                                      0, cfg.vocab_size)
             batch_data = plan.shard_batch(
                 {"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
-            for _ in range(warmup):
+            for i in range(warmup):
                 state, metrics = step(state, batch_data)
+                if i == 0:
+                    # first call = trace + XLA compile: the cold-start
+                    # cost a StepCache hit (or AOT precompile) removes
+                    float(jax.device_get(metrics["loss"]))
+                    control["compile_time_s"] = round(
+                        time.perf_counter() - t_c0, 3)
             # host fetch forces the full dependency chain to finish
             # (donated state chains step N → N+1), robust even where
             # block_until_ready is lazy (remote PJRT relays)
@@ -261,6 +281,24 @@ def main():
             final_loss = float(jax.device_get(metrics["loss"]))
             dt = (time.perf_counter() - t0) / steps
             assert final_loss == final_loss, "NaN loss in bench"
+            # warm-switch cost: drive the PRODUCTION switch path A→B→A
+            # (switch_strategy both legs) and time the return leg incl.
+            # the cache lookup. Single-chip caveat: plans share one
+            # device, so this measures the switch machinery's fixed
+            # overhead (full-state device_put dispatch + ledger), not
+            # cross-device resharding traffic.
+            import dataclasses as _dc
+            plan_b = make_plan(model, opt, _dc.replace(
+                strategy, remat="none" if strategy.remat != "none"
+                else "full"))
+            state_b = switch_strategy(state, plan_b)
+            jax.block_until_ready(state_b)
+            t_s0 = time.perf_counter()
+            assert cache.lookup(key) is entry
+            state = switch_strategy(state_b, plan)
+            jax.block_until_ready(state)
+            control["warm_switch_ms"] = round(
+                (time.perf_counter() - t_s0) * 1e3, 3)
         n = sum(x.size for x in jax.tree.leaves(state.params))
         return dt, n
 
@@ -346,6 +384,7 @@ def main():
     peak = peak_flops(dev)
     mfu = flops / peak if peak else 0.0
 
+    cache_stats = cache.stats()
     result = {
         "metric": "gpt2_small_pretrain_mfu" if on_tpu else "gpt2_tiny_cpu_smoke",
         "value": round(mfu, 4) if on_tpu else round(tokens_per_sec, 1),
@@ -355,6 +394,13 @@ def main():
         "step_time_ms": round(dt * 1e3, 2),
         "n_params": n_params,
         "device": getattr(dev, "device_kind", dev.platform),
+        # control-plane slice (ISSUE 2): what a cold start costs, what a
+        # warm A→B→A switch costs, and how the step cache performed
+        "compile_time_s": control.get("compile_time_s"),
+        "warm_switch_ms": control.get("warm_switch_ms"),
+        "cache_hit_rate": round(cache_stats["hit_rate"], 4),
+        "cache_hits": cache_stats["hits"],
+        "cache_misses": cache_stats["misses"],
     }
     if degraded is not None:
         # the sweep winner config failed and the built-ins carried the

@@ -19,6 +19,12 @@ import functools
 
 import jax
 
+#: True on pre-0.6 runtimes (e.g. the 0.4.37 container). Version-gated
+#: behavior (adafactor numerics test, the SPMD pipeline executor demo
+#: phase) keys off this single predicate instead of re-parsing
+#: jax.__version__ at every site.
+JAX_PRE_06 = tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 6)
+
 
 def install() -> None:
     _install_pallas_compiler_params()

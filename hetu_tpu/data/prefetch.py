@@ -9,6 +9,15 @@ thread runs the (numpy-producing) host iterator and eagerly issues
 training loop asks for batch N+1 its transfer has already been riding
 alongside step N's compute. A bounded queue applies back-pressure so at
 most ``buffer_size`` batches of HBM are pinned.
+
+Overlap accounting: every fetch records whether the batch was already
+staged (``prefetch_ready_total`` — true H2D/compute overlap) or the
+consumer had to block (``data_stall_seconds`` + a ``stall`` span);
+``stats()`` exposes the same numbers programmatically. Hot switching
+mid-stream is supported via :meth:`DevicePrefetcher.set_place`: the
+Trainer re-points placement at the new plan's ``shard_batch`` and any
+batch staged under the old plan is re-placed (from its retained host
+form) on fetch — never dropped, never double-permuted.
 """
 
 from __future__ import annotations
@@ -26,13 +35,14 @@ _SENTINEL = object()
 _STALL_SPAN_THRESHOLD_S = 1e-3
 
 
-def _producer_loop(q: "queue.Queue", place: Callable[[Any], Any],
-                   it: Iterator[Any], max_items: Optional[int],
-                   stop: threading.Event, err_box: List[BaseException]):
+def _producer_loop(pf: "_ProducerState", it: Iterator[Any],
+                   max_items: Optional[int]):
     """Module-level so the thread holds NO reference to the prefetcher —
     an abandoned DevicePrefetcher stays collectable and its ``__del__``
     can stop this loop (a bound-method target would pin ``self`` and leak
-    the thread plus every staged device batch)."""
+    the thread plus every staged device batch). ``pf`` is the shared
+    producer/consumer state only (queue, stop flag, place fn)."""
+    q, stop = pf.q, pf.stop
 
     def put(item) -> bool:
         while not stop.is_set():
@@ -54,15 +64,37 @@ def _producer_loop(q: "queue.Queue", place: Callable[[Any], Any],
                 batch = next(it)
             except StopIteration:
                 break
+            # read (place, epoch) atomically: a concurrent set_place must
+            # never pair the new epoch with the old placement
+            with pf.lock:
+                place, epoch = pf.place, pf.epoch
             # device_put inside shard_batch is async — this enqueues the
-            # H2D copies without blocking on them
-            if not put(place(batch)):
+            # H2D copies without blocking on them. The HOST batch rides
+            # along so a post-switch consumer can re-place it under the
+            # new plan (re-placing the device batch would double-apply
+            # layout permutes like zigzag CP).
+            if not put((epoch, batch, place(batch))):
                 return
             n += 1
     except BaseException as e:   # propagate to the consumer
-        err_box.append(e)
+        pf.err_box.append(e)
     finally:
         put(_SENTINEL)
+
+
+class _ProducerState:
+    """State shared between producer thread and consumer, reference-free
+    with respect to the DevicePrefetcher object itself."""
+
+    __slots__ = ("q", "stop", "err_box", "lock", "place", "epoch")
+
+    def __init__(self, q, place):
+        self.q = q
+        self.stop = threading.Event()
+        self.err_box: List[BaseException] = []
+        self.lock = threading.Lock()
+        self.place = place
+        self.epoch = 0
 
 
 class DevicePrefetcher:
@@ -80,29 +112,72 @@ class DevicePrefetcher:
         if buffer_size < 1:
             raise ValueError("buffer_size must be >= 1")
         self._q: queue.Queue = queue.Queue(maxsize=buffer_size)
-        self._err_box: List[BaseException] = []
-        self._stop = threading.Event()
+        self._state = _ProducerState(self._q, place)
         self._done = False
+        # overlap accounting (host-side ints: no lock needed beyond GIL)
+        self.consumed = 0
+        self.ready_hits = 0       # batch already staged when asked for
+        self.restaged = 0         # re-placed after a mid-run set_place
+        self.stall_seconds = 0.0
         self._thread = threading.Thread(
             target=_producer_loop,
-            args=(self._q, place, iter(batches), max_items, self._stop,
-                  self._err_box),
+            args=(self._state, iter(batches), max_items),
             daemon=True)
         self._thread.start()
 
+    # -- hot-switch integration ---------------------------------------------
+    def set_place(self, place: Callable[[Any], Any]) -> None:
+        """Swap the placement function mid-stream (Trainer hot switch):
+        batches produced from now on use ``place``; batches already in
+        the queue are re-placed from their host form when fetched."""
+        with self._state.lock:
+            self._state.place = place
+            self._state.epoch += 1
+
+    def stats(self) -> dict:
+        """Overlap counters: ``ready_hits``/``consumed`` is the fraction
+        of fetches that never blocked — direct evidence the H2D path ran
+        under the previous step's compute."""
+        return {"consumed": self.consumed, "ready_hits": self.ready_hits,
+                "restaged": self.restaged,
+                "stall_seconds": round(self.stall_seconds, 6),
+                "queue_depth": self._q.qsize()}
+
+    # -- iteration ----------------------------------------------------------
     def __iter__(self):
         return self
 
     def __next__(self):
         if self._done:
             raise StopIteration   # iterator contract: keep raising
-        if telemetry.enabled():
+        tel = telemetry.enabled()
+        try:
+            item = self._q.get_nowait()
+            wait = 0.0
+            ready = True
+        except queue.Empty:
             # time the blocking get: the consumer waiting here IS the
             # data stall (the producer fell behind the step loop)
             t0 = time.perf_counter()
             item = self._q.get()
             wait = time.perf_counter() - t0
+            ready = False
+        if item is _SENTINEL:
+            self._done = True
+            if self._state.err_box:
+                raise self._state.err_box.pop()
+            raise StopIteration
+        self.consumed += 1
+        self.ready_hits += ready
+        self.stall_seconds += wait
+        if tel:
             reg = telemetry.get_registry()
+            reg.counter("prefetch_batches_total",
+                        "batches served by the device prefetcher").inc()
+            if ready:
+                reg.counter("prefetch_ready_total",
+                            "fetches that found the batch already "
+                            "staged (H2D overlapped compute)").inc()
             reg.counter("data_stall_seconds",
                         "train loop blocked waiting for batches").inc(wait)
             reg.gauge("data_queue_depth",
@@ -111,17 +186,23 @@ class DevicePrefetcher:
             if wait > _STALL_SPAN_THRESHOLD_S:
                 telemetry.get_tracer().complete(
                     "stall", wait, where="prefetch")
-        else:
-            item = self._q.get()
-        if item is _SENTINEL:
-            self._done = True
-            if self._err_box:
-                raise self._err_box.pop()
-            raise StopIteration
-        return item
+        epoch, host_batch, placed = item
+        if epoch != self._state.epoch:
+            # staged under a pre-switch plan: re-place the retained host
+            # batch under the current one (bounded: <= buffer_size items
+            # per switch)
+            with self._state.lock:
+                place = self._state.place
+            placed = place(host_batch)
+            self.restaged += 1
+            if tel:
+                telemetry.get_registry().counter(
+                    "prefetch_restaged_total",
+                    "staged batches re-placed after a hot switch").inc()
+        return placed
 
     def close(self) -> None:
-        self._stop.set()          # producer aborts within its put timeout
+        self._state.stop.set()    # producer aborts within its put timeout
         self._done = True
         # join BEFORE draining: a producer blocked in put() could
         # otherwise succeed after the drain and leave one staged device

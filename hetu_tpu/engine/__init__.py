@@ -8,6 +8,14 @@ from hetu_tpu.engine.state import TrainState
 from hetu_tpu.engine.train_step import (
     TrainPlan, make_plan, init_state, build_train_step, build_eval_step,
     build_grad_accum_steps,
+    CachedStep, StepCache, compile_strategy, get_step_cache,
+    abstract_batch, abstract_train_state, trace_counts,
+    reset_trace_counts,
+)
+from hetu_tpu.engine.precompile import (
+    PrecompileHandle, PrecompileResult,
+    enable_persistent_compilation_cache, precompile_strategies,
+    precompile_top_k,
 )
 
 from hetu_tpu.engine.malleus import plan_hetero
@@ -15,5 +23,11 @@ from hetu_tpu.engine.malleus import plan_hetero
 __all__ = [
     "TrainState", "TrainPlan", "make_plan", "init_state",
     "build_train_step", "build_eval_step", "build_grad_accum_steps",
+    "CachedStep", "StepCache", "compile_strategy", "get_step_cache",
+    "abstract_batch", "abstract_train_state", "trace_counts",
+    "reset_trace_counts",
+    "PrecompileHandle", "PrecompileResult",
+    "enable_persistent_compilation_cache", "precompile_strategies",
+    "precompile_top_k",
     "plan_hetero",
 ]

@@ -17,12 +17,21 @@ consumed here:
 - ``remat``/``offload`` — ``jax.checkpoint`` policy applied per block.
 - ``num_microbatches`` — grad-accumulation ``lax.scan`` (pp=1) or the
                 pipeline schedule (pp>1).
+
+Control-plane latency: a :class:`StepCache` memoizes the compiled
+artifacts of :func:`compile_strategy` — (TrainPlan, jitted step, eval) per
+(model, optimizer, Strategy, attn/donate/policy) — so hot switching
+A→B→A never re-traces on the return leg (the reference's ExecGraphPlan
+pool), and :mod:`hetu_tpu.engine.precompile` can AOT-compile candidate
+strategies into the same entries on a background thread.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -134,6 +143,283 @@ def init_state(model: Module, opt: Transform, plan: TrainPlan,
     return fn(key)
 
 
+# -- trace accounting -------------------------------------------------------
+# jit re-traces run the Python step body; executions do not. A counter
+# bumped INSIDE the body is therefore an exact re-trace/recompile count —
+# the signal the compile-count regression tests assert on (and the
+# telemetry registry mirrors it when enabled).
+_TRACE_COUNTS: dict[str, int] = {}
+_TRACE_LOCK = threading.Lock()
+_TRACE_LOCAL = threading.local()   # per-thread total, see trace_total()
+
+
+def record_trace(what: str) -> None:
+    """Count one (re)trace of a jitted step body. Called at trace time
+    only — a warm executable never re-enters the Python body."""
+    with _TRACE_LOCK:
+        _TRACE_COUNTS[what] = _TRACE_COUNTS.get(what, 0) + 1
+    _TRACE_LOCAL.total = getattr(_TRACE_LOCAL, "total", 0) + 1
+    from hetu_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.get_registry().counter(
+            "step_traces_total",
+            "jit traces of step bodies (recompile detector)").inc(
+                what=what)
+
+
+def trace_counts() -> dict[str, int]:
+    """``{step-kind: trace count}`` since process start (or last reset),
+    across ALL threads (background AOT lowers included)."""
+    with _TRACE_LOCK:
+        return dict(_TRACE_COUNTS)
+
+
+def trace_total() -> int:
+    """Step-body traces recorded ON THE CALLING THREAD. The Trainer
+    snapshots this around each step call to attribute a traced step's
+    wall time to the ``compile`` goodput category instead of
+    ``compute`` — per-thread so a background precompile worker tracing
+    concurrently never misclassifies foreground compute as compile."""
+    return getattr(_TRACE_LOCAL, "total", 0)
+
+
+def reset_trace_counts() -> None:
+    with _TRACE_LOCK:
+        _TRACE_COUNTS.clear()
+
+
+# -- step cache -------------------------------------------------------------
+def _batch_key(batch: dict) -> tuple:
+    """Shape/dtype signature of a batch dict (device arrays, host numpy
+    or ShapeDtypeStructs — anything with .shape/.dtype)."""
+    def sig(v):
+        if not hasattr(v, "shape") or not hasattr(v, "dtype"):
+            import numpy as np
+            v = np.asarray(v)
+        return tuple(v.shape), str(v.dtype)
+
+    return tuple(sorted((k,) + sig(v) for k, v in batch.items()
+                        if v is not None))
+
+
+class CachedStep:
+    """One compiled strategy: plan + jitted step/eval + AOT executables.
+
+    Calling the entry runs the step. When an ahead-of-time executable for
+    the batch signature exists (``engine.precompile``), it is used — zero
+    traces even on the very first step after a switch; otherwise the
+    jitted ``step_fn`` runs (which re-uses ITS executable cache across
+    A→B→A switches because the entry object itself is memoized).
+    """
+
+    __slots__ = ("plan", "step_fn", "eval_fn", "aot", "_aot_ok",
+                 "compile_seconds", "_refs")
+
+    def __init__(self, plan, step_fn, eval_fn=None, *,
+                 compile_seconds: float = 0.0, refs: tuple = ()):
+        self.plan = plan
+        self.step_fn = step_fn
+        self.eval_fn = eval_fn
+        self.aot: dict = {}          # batch signature -> Compiled
+        self._aot_ok: set = set()    # signatures proven callable
+        self.compile_seconds = compile_seconds
+        # strong refs (model, opt): entries are keyed by object identity,
+        # pinning the objects guarantees an id() is never reused while
+        # its cache entry is alive
+        self._refs = refs
+
+    def __call__(self, state, batch):
+        if self.aot:
+            key = _batch_key(batch)
+            exe = self.aot.get(key)
+            if exe is not None:
+                if key in self._aot_ok:
+                    return exe(state, batch)
+                try:
+                    out = exe(state, batch)
+                except (TypeError, ValueError):
+                    # aval drift raises TypeError, sharding drift raises
+                    # ValueError — both BEFORE consuming donated buffers
+                    # — drop the stale executable and fall back to jit
+                    self.aot.pop(key, None)
+                else:
+                    self._aot_ok.add(key)
+                    return out
+        return self.step_fn(state, batch)
+
+
+class StepCache:
+    """Memo of :class:`CachedStep` entries keyed by
+    (model, optimizer, Strategy, attn_impl, donate, policy, devices).
+
+    The analogue of the reference's ``ExecGraphPlan`` pool
+    (``define_and_run_graph.h:23-64``) lifted to a process-wide resource:
+    every Trainer (and the AOT pre-compiler) shares the default instance,
+    so a strategy compiled once — eagerly, in the background, or by a
+    previous run via the persistent XLA cache — is a lookup forever
+    after. Bounded LRU so long sweeps cannot pin unbounded executables.
+    Thread-safe with single-flight builds (a background precompile and a
+    foreground switch racing to the same key compile once).
+    """
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = capacity
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._entries: dict = {}          # insertion-ordered => LRU
+        self._building: dict = {}         # key -> threading.Event
+        self._gen = 0                     # bumped by clear(): in-flight
+                                          # builds from before a clear
+                                          # must not re-populate it
+        self._lock = threading.RLock()
+
+    @staticmethod
+    def key_for(model, opt, strategy, *, attn_impl: str = "auto",
+                donate: bool = True, policy_key: str = "",
+                devices=None) -> tuple:
+        dev_key = None if devices is None else \
+            tuple(getattr(d, "id", d) for d in devices)
+        return (id(model), id(opt), strategy, attn_impl, donate,
+                policy_key, dev_key)
+
+    def _count(self, hit: bool) -> None:
+        from hetu_tpu import telemetry
+        if hit:
+            self.hits += 1
+        else:
+            self.misses += 1
+        if telemetry.enabled():
+            telemetry.get_registry().counter(
+                "step_cache_hits_total" if hit
+                else "step_cache_misses_total",
+                "StepCache lookups that found / missed a compiled "
+                "entry").inc()
+
+    def lookup(self, key) -> Optional[CachedStep]:
+        """Peek without building (does not count a miss)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:                    # refresh LRU order
+                self._entries.pop(key)
+                self._entries[key] = entry
+            return entry
+
+    def get_or_build(self, key, builder: Callable[[], CachedStep]
+                     ) -> CachedStep:
+        """Return the cached entry for ``key``, building it (once, even
+        under concurrent callers) via ``builder`` on a miss."""
+        while True:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.pop(key)
+                    self._entries[key] = entry
+                    self._count(hit=True)
+                    return entry
+                ev = self._building.get(key)
+                if ev is None:
+                    ev = self._building[key] = threading.Event()
+                    gen = self._gen
+                    break
+            ev.wait()        # another thread is compiling this key
+        try:
+            entry = builder()
+        except BaseException:
+            with self._lock:
+                self._building.pop(key, None)
+            ev.set()
+            raise
+        with self._lock:
+            if self._gen == gen:
+                # a clear() during the build (device loss) invalidates
+                # what we just compiled — hand it to the caller but do
+                # NOT resurrect it in the pool
+                self._entries[key] = entry
+                while len(self._entries) > self.capacity:
+                    self._entries.pop(next(iter(self._entries)))
+                    self.evictions += 1
+            self._count(hit=False)
+            self._building.pop(key, None)
+        ev.set()
+        return entry
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses, "evictions": self.evictions,
+                    "hit_rate": self.hits / total if total else 0.0}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._gen += 1   # in-flight builds must not re-insert
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+
+_STEP_CACHE = StepCache()
+
+
+def get_step_cache() -> StepCache:
+    """The process-default :class:`StepCache` (shared by Trainers and
+    ``engine.precompile`` unless one is injected explicitly)."""
+    return _STEP_CACHE
+
+
+def compile_strategy(model: Module, opt: Transform, strategy: Strategy, *,
+                     devices=None, attn_impl: str = "auto",
+                     donate: bool = True, loss_fn: Optional[Callable] = None,
+                     build_eval: bool = True) -> CachedStep:
+    """Plan + build the jitted step (and eval) for one Strategy, returning
+    a :class:`CachedStep`. Callers wanting memoization go through
+    :meth:`StepCache.get_or_build`; callers wanting dtype policy wrap this
+    in ``autocast(policy)`` (tracing happens lazily at first call / AOT
+    lower, but ``make_plan``'s init shapes are taken here)."""
+    from hetu_tpu import telemetry
+    t0 = time.perf_counter()
+    with telemetry.span("build_plan_and_step",
+                        strategy=strategy.to_json()):
+        plan = make_plan(model, opt, strategy, devices)
+        step_fn = build_train_step(model, opt, plan, loss_fn=loss_fn,
+                                   attn_impl=attn_impl, donate=donate)
+        eval_fn = None
+        if build_eval:
+            eval_fn = build_eval_step(model, plan, loss_fn=loss_fn,
+                                      attn_impl=attn_impl)
+    return CachedStep(plan, step_fn, eval_fn,
+                      compile_seconds=time.perf_counter() - t0,
+                      refs=(model, opt))
+
+
+def abstract_train_state(model: Module, opt: Transform, plan: TrainPlan,
+                         dtype=None) -> TrainState:
+    """ShapeDtypeStruct pytree of the sharded train state — the abstract
+    argument AOT lowering needs (``engine.precompile``). Run under the
+    same ``autocast`` policy as the real ``init_state`` so dtypes match."""
+    shapes = jax.eval_shape(
+        lambda k: new_train_state(model.init(k, dtype=dtype), opt),
+        jax.random.key(0))
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, plan.state_shardings)
+
+
+def abstract_batch(plan: TrainPlan, batch_shape: tuple, *,
+                   keys=("input_ids", "labels"), dtype=jnp.int32) -> dict:
+    """ShapeDtypeStruct batch dict for AOT lowering: ``batch_shape`` is
+    the global (batch, seq) the training loop will feed (post
+    ``shard_batch`` — zigzag permutes never change shapes)."""
+    sharding = plan.batch_sharding(len(batch_shape))
+    return {k: jax.ShapeDtypeStruct(tuple(batch_shape), dtype,
+                                    sharding=sharding) for k in keys}
+
+
 def effective_remat(strategy: Strategy) -> str:
     if strategy.offload:
         return "offload"
@@ -233,6 +519,7 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
     grad_fn = jax.value_and_grad(compute_loss)
 
     def step(state: TrainState, batch: dict):
+        record_trace("train_step")   # runs at trace time only
         # deterministic per-step key: resume-at-step-N reproduces masks
         key = step_dropout_key(state.step) if thread_dropout else None
         if nm > 1:
@@ -285,7 +572,8 @@ def build_eval_step(model: Module, plan: TrainPlan, *,
 
 def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
                            *, loss_fn: Optional[Callable] = None,
-                           attn_impl: str = "auto"):
+                           attn_impl: str = "auto",
+                           donate_acc: bool = True):
     """Split-phase training — the reference's partial-execution RunLevels
     (``graph.h:33-39``): RunLevel::GRAD accumulates gradients across
     *separate step calls* (arbitrary-size global batches without holding
@@ -301,6 +589,19 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
       masks (``i`` is a traced operand: no recompile per index)
     - ``state, metrics = apply_step(state, acc, n_accum)`` — mean over
       ``n_accum`` accumulations, optimizer update; ``acc`` is consumed
+
+    Accumulator buffer lifecycle (``donate_acc``): with the default
+    ``True``, ``apply_step`` donates ``acc`` so XLA reuses its fp32
+    param-shaped buffers for the update's outputs — optimal *peak*
+    memory, but the next update must allocate a fresh buffer via
+    ``init_acc()``. With ``donate_acc=False``, ``apply_step`` only reads
+    ``acc`` and the caller recycles the same buffer across updates with
+    ``acc = init_acc(like=acc)`` — the ``like`` argument is donated to a
+    zero-fill, so steady-state training performs **no** accumulator
+    allocation at all (HBM allocator churn is the enemy on long runs).
+    ``init_acc(like=...)`` after a donating ``apply_step`` raises jax's
+    deleted-buffer error — the two modes are mutually exclusive by
+    construction.
     """
     strategy = plan.strategy
     if strategy.pp > 1:
@@ -335,14 +636,31 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
             "enable it", stacklevel=2)
 
     @functools.partial(jax.jit, out_shardings=param_shardings)
-    def init_acc():
+    def _fresh_acc():
         return jax.tree.map(
             lambda s: jnp.zeros(s.shape, jnp.float32),
             model.abstract_params())
 
+    # zero-fill INTO the donated previous accumulator: XLA rewrites this
+    # to an in-place memset of the existing buffer — no allocation
+    @functools.partial(jax.jit, donate_argnums=(0,),
+                       out_shardings=param_shardings)
+    def _rezero_acc(like):
+        return jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
+                            like)
+
+    def init_acc(like=None):
+        """Zeroed fp32 grad accumulator. Pass the previous update's
+        ``acc`` as ``like`` (requires ``donate_acc=False``) to recycle
+        its buffer instead of allocating a fresh one."""
+        if like is None:
+            return _fresh_acc()
+        return _rezero_acc(like)
+
     @functools.partial(jax.jit, donate_argnums=(1,),
                        out_shardings=(param_shardings, None))
     def grad_step(state: TrainState, acc, batch, accum_index=0):
+        record_trace("grad_step")
         # accum_index is traced (fold_in takes traced ints): one compile
         # serves every index
         key = jax.random.fold_in(step_dropout_key(state.step),
@@ -351,7 +669,8 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
         return jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
                             acc, grads), loss
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1),
+    @functools.partial(jax.jit,
+                       donate_argnums=(0, 1) if donate_acc else (0,),
                        out_shardings=(plan.state_shardings, None))
     def apply_step(state: TrainState, acc, n_accum):
         grads = jax.tree.map(lambda g: g / n_accum, acc)

@@ -12,6 +12,7 @@ iterator of host batches (``hetu_tpu.data.build_data_loader``).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Iterable, Iterator, Optional
 
@@ -22,7 +23,8 @@ from hetu_tpu import telemetry
 from hetu_tpu.core.dtypes import BF16_COMPUTE, FP32, Policy, autocast
 from hetu_tpu.engine.state import TrainState
 from hetu_tpu.engine.train_step import (
-    build_eval_step, build_train_step, init_state, make_plan,
+    CachedStep, StepCache, compile_strategy, get_step_cache, init_state,
+    trace_total,
 )
 from hetu_tpu.optim.base import Transform
 from hetu_tpu.parallel.strategy import Strategy
@@ -65,6 +67,18 @@ class TrainerConfig:
     peak_flops: Optional[float] = None
                                  # per-chip peak for MFU in the goodput
                                  # report; None = report goodput only
+    step_cache: bool = True      # memoize compiled (plan, step, eval)
+                                 # per strategy in the shared StepCache
+                                 # so A→B→A switching never re-traces;
+                                 # False rebuilds on every set_strategy
+                                 # (the cache-disabled baseline for
+                                 # goodput A/B runs — docs/PERFORMANCE.md)
+    compile_cache_dir: Optional[str] = None
+                                 # persistent XLA compilation cache dir
+                                 # (engine.precompile.enable_persistent_
+                                 # compilation_cache): restarts re-trace
+                                 # but skip the XLA compile. Also honors
+                                 # $HETU_COMPILE_CACHE_DIR when unset.
 
     def policy(self) -> Policy:
         return BF16_COMPUTE if self.precision == "bf16" else FP32
@@ -72,7 +86,8 @@ class TrainerConfig:
 
 class Trainer:
     def __init__(self, model, opt: Transform, strategy: Strategy,
-                 config: Optional[TrainerConfig] = None, devices=None):
+                 config: Optional[TrainerConfig] = None, devices=None,
+                 step_cache: Optional[StepCache] = None):
         self.model = model
         self.opt = opt
         self.config = config if config is not None else TrainerConfig()
@@ -81,7 +96,14 @@ class Trainer:
         self.plan = None
         self._step_fn = None
         self._eval_fn = None
+        self._live_prefetcher = None   # re-pointed on mid-run hot switch
         self._ckpt_writer: Optional[CheckpointWriter] = None
+        if self.config.compile_cache_dir \
+                or "HETU_COMPILE_CACHE_DIR" in os.environ:
+            from hetu_tpu.engine.precompile import (
+                enable_persistent_compilation_cache)
+            enable_persistent_compilation_cache(
+                self.config.compile_cache_dir)
         if self.config.telemetry:
             telemetry.enable(True)
         self.tracer = telemetry.get_tracer()
@@ -94,7 +116,6 @@ class Trainer:
         self._spans_epoch = self.tracer.epoch
         metrics_path = None
         if self.config.trace_dir:
-            import os
             os.makedirs(self.config.trace_dir, exist_ok=True)
             metrics_path = os.path.join(self.config.trace_dir,
                                         "telemetry.jsonl")
@@ -102,85 +123,122 @@ class Trainer:
         # registry snapshot ride the same JSONL stream
         self.metrics = MetricsLogger(path=metrics_path,
                                      registry=self.registry)
-        # plan pool: one compiled (plan, step, eval) per strategy, so
+        # step cache: one compiled (plan, step, eval) per strategy, so
         # switching A -> B -> A reuses executables (the reference's
-        # ExecGraphPlan pool, define_and_run_graph.h:23-64)
-        self._plan_cache: dict = {}
+        # ExecGraphPlan pool, define_and_run_graph.h:23-64). Shared with
+        # engine.precompile's background AOT worker by default, so
+        # planner-announced candidate strategies are already warm when
+        # set_strategy asks for them.
+        self.cache = step_cache if step_cache is not None \
+            else get_step_cache()
+        # kept as an alias: tests / callers may inspect the pool size
+        self._plan_cache = self.cache
         self.set_strategy(strategy)
 
     # -- strategy / hot switching ------------------------------------------
+    def _cache_key(self, strategy):
+        return self.cache.key_for(
+            self.model, self.opt, strategy,
+            attn_impl=self.config.attn_impl, donate=True,
+            policy_key=self.config.precision, devices=self.devices)
+
     def set_strategy(self, strategy):
         """Compile the plan for ``strategy`` (a :class:`Strategy` or a
         Malleus :class:`~hetu_tpu.parallel.hetero.HeteroStrategy`); if
         training is live, hot-switch the full train state — params AND
         optimizer moments — onto the new layout (HotSPa; hetero via the
-        homo<->hetero converters)."""
+        homo<->hetero converters).
+
+        The compiled artifacts come from the :class:`StepCache`: a
+        strategy seen before (or pre-compiled by ``precompile()`` /
+        ``engine.precompile``) makes the switch pure data movement —
+        cache lookup + one ``device_put`` of the live state."""
         from hetu_tpu.parallel.hetero import (
             HeteroState, HeteroStrategy, build_hetero_train_step,
             make_hetero_plan, state_from_hetero, state_to_hetero,
         )
         strategy.validate(len(self.devices or jax.devices()))
+        hetero = isinstance(strategy, HeteroStrategy)
 
         def to_homo_state():
             if isinstance(self.state, HeteroState):
                 return state_from_hetero(self.state, self.plan, self.model)
             return self.state
 
-        if isinstance(strategy, HeteroStrategy):
-            if strategy in self._plan_cache:
-                plan, step_fn, _ = self._plan_cache[strategy]
-            else:
-                t0 = time.perf_counter()
-                with telemetry.span("compile", hetero=True,
-                                    strategy=strategy.to_json()), \
-                        autocast(self.config.policy()):
+        def build() -> CachedStep:
+            t0 = time.perf_counter()
+            with telemetry.span("compile", hetero=hetero,
+                                strategy=strategy.to_json()), \
+                    autocast(self.config.policy()):
+                if hetero:
                     plan = make_hetero_plan(self.model, strategy,
                                             self.devices)
                     step_fn = build_hetero_train_step(
                         self.model, self.opt, plan,
                         attn_impl=self.config.attn_impl)
-                self._note("compile", time.perf_counter() - t0)
-                self._plan_cache[strategy] = (plan, step_fn, None)
-            if self.state is not None:
-                t0 = time.perf_counter()
-                with telemetry.span("switch", hetero=True):
-                    self.state = state_to_hetero(to_homo_state(), plan)
-                self._note("switch", time.perf_counter() - t0)
-                get_logger().info(
-                    f"hot-switched to hetero {strategy.to_json()} at "
-                    f"step {int(self.state.step)}")
-            self.plan = plan
-            self._step_fn = step_fn
-            self._eval_fn = None   # evaluate() under hetero: switch back
-            return plan
-
-        if strategy in self._plan_cache:
-            plan, step_fn, eval_fn = self._plan_cache[strategy]
-        else:
-            t0 = time.perf_counter()
-            with telemetry.span("compile", strategy=strategy.to_json()), \
-                    autocast(self.config.policy()):
-                plan = make_plan(self.model, self.opt, strategy,
-                                 self.devices)
-                step_fn = build_train_step(self.model, self.opt, plan,
-                                           attn_impl=self.config.attn_impl)
-                eval_fn = build_eval_step(self.model, plan,
-                                          attn_impl=self.config.attn_impl)
+                    entry = CachedStep(plan, step_fn, None,
+                                       refs=(self.model, self.opt))
+                    entry.compile_seconds = time.perf_counter() - t0
+                else:
+                    entry = compile_strategy(
+                        self.model, self.opt, strategy,
+                        devices=self.devices,
+                        attn_impl=self.config.attn_impl)
             self._note("compile", time.perf_counter() - t0)
-            self._plan_cache[strategy] = (plan, step_fn, eval_fn)
+            return entry
+
+        if self.config.step_cache:
+            entry = self.cache.get_or_build(self._cache_key(strategy),
+                                            build)
+        else:
+            entry = build()
+
         if self.state is not None:
             t0 = time.perf_counter()
-            # switch_strategy records the "switch" span itself (with
-            # cross-topology + volume attrs); only the ledger lives here
-            self.state = switch_strategy(to_homo_state(), plan)
+            if hetero:
+                with telemetry.span("switch", hetero=True):
+                    self.state = state_to_hetero(to_homo_state(),
+                                                 entry.plan)
+            else:
+                # switch_strategy records the "switch" span itself (with
+                # cross-topology + volume attrs); only the ledger lives
+                # here
+                self.state = switch_strategy(to_homo_state(), entry.plan)
             self._note("switch", time.perf_counter() - t0)
             get_logger().info(
-                f"hot-switched to {strategy.to_json()} at step "
+                f"hot-switched to {'hetero ' if hetero else ''}"
+                f"{strategy.to_json()} at step "
                 f"{int(jax.device_get(self.state.step))}")
-        self.plan = plan
-        self._step_fn = step_fn
-        self._eval_fn = eval_fn
-        return plan
+        self.plan = entry.plan
+        self._step_fn = entry
+        self._eval_fn = entry.eval_fn  # None under hetero: switch back
+        if self._live_prefetcher is not None:
+            # a mid-run switch re-points the input pipeline: batches
+            # staged under the old plan are re-placed lazily on fetch
+            self._live_prefetcher.set_place(self.plan.shard_batch)
+        return entry.plan
+
+    def precompile(self, strategies, *, batch_shape=None,
+                   batch_keys=("input_ids", "labels"),
+                   block: bool = False):
+        """Warm the step cache for candidate ``strategies`` (e.g. the
+        Galvatron search's top-k) on a background thread — see
+        :func:`hetu_tpu.engine.precompile.precompile_strategies`. With a
+        ``batch_shape`` each candidate is AOT-compiled for it, making a
+        later ``set_strategy`` + first step completely compile-free;
+        ``batch_keys`` must match the run's real batch dict (packed
+        loaders carry positions + segment_ids)."""
+        from hetu_tpu.engine.precompile import precompile_strategies
+        handle = precompile_strategies(
+            self.model, self.opt, strategies, batch_shape=batch_shape,
+            batch_keys=batch_keys,
+            devices=self.devices, attn_impl=self.config.attn_impl,
+            policy=self.config.policy(),
+            policy_key=self.config.precision, cache=self.cache,
+            background=not block)
+        if block:
+            handle.wait()
+        return handle
 
     def _note(self, category: str, seconds: float) -> None:
         """Goodput ledger + cumulative counter for an overhead event."""
@@ -202,7 +260,10 @@ class Trainer:
         which must fit the surviving device count.
         """
         self.devices = list(devices)
-        self._plan_cache.clear()      # cached plans pin dead devices
+        # cached plans pin dead devices — drop the whole pool (the cache
+        # may be process-shared: a device loss invalidates every plan
+        # compiled for the old topology anyway)
+        self.cache.clear()
         return self.set_strategy(strategy if strategy is not None
                                  else self.strategy)
 
@@ -321,6 +382,9 @@ class Trainer:
             prefetcher = DevicePrefetcher(
                 batches, self.plan.shard_batch,
                 buffer_size=self.config.prefetch, max_items=steps)
+            # registered so a mid-run set_strategy() re-points placement
+            # at the new plan (staged batches re-place lazily on fetch)
+            self._live_prefetcher = prefetcher
             it: Iterator[dict] = prefetcher
         else:
             it = (self.plan.shard_batch(b) for b in batches)
@@ -338,6 +402,7 @@ class Trainer:
                 if acct.flops_per_token is None and "input_ids" in sbatch:
                     acct.flops_per_token = self._flops_per_token(
                         int(sbatch["input_ids"].shape[-1]))
+                n_traces = trace_total()
                 self.state, metrics = self._step_fn(self.state, sbatch)
                 host_step += 1
                 acct.add_step()
@@ -359,8 +424,18 @@ class Trainer:
                     history.append(rec)
                     t_last, tokens_since = now, 0
                 # step dispatch + the log boundary's blocking fetch: the
-                # productive slice of this iteration
-                acct.record("compute", time.perf_counter() - t_fetch)
+                # productive slice of this iteration — UNLESS the step
+                # body re-traced, in which case the wall went to
+                # trace+XLA-compile (a cold/cache-disabled first step)
+                # and belongs in the compile ledger, not compute
+                step_s = time.perf_counter() - t_fetch
+                if trace_total() > n_traces:
+                    acct.record("compile", step_s)
+                    if tel:
+                        self.tracer.complete("compile", step_s,
+                                             where="step_trace")
+                else:
+                    acct.record("compute", step_s)
                 if self.config.eval_every and eval_batches is not None \
                         and host_step % self.config.eval_every == 0:
                     t0 = time.perf_counter()
@@ -376,6 +451,7 @@ class Trainer:
                 self.save(wait=True)
         finally:
             if prefetcher is not None:
+                self._live_prefetcher = None
                 prefetcher.close()
             acct.freeze()   # later manual exports must not dilute goodput
             # export in the failure path too: a crashed run is exactly
@@ -421,6 +497,7 @@ class Trainer:
                             and "input_ids" in batch:
                         acct.flops_per_token = self._flops_per_token(
                             int(batch["input_ids"].shape[-1]))
+                    n_traces = trace_total()
                     metrics = self.train_step(batch)
                     host_step += 1   # host-side: no per-step device sync
                     acct.add_step()
@@ -433,7 +510,9 @@ class Trainer:
                             host_step,
                             loss=float(jax.device_get(metrics["loss"])),
                             bucket=plan.bucket_len, **extra))
-                    acct.record("compute", time.perf_counter() - t0)
+                    acct.record(
+                        "compile" if trace_total() > n_traces
+                        else "compute", time.perf_counter() - t0)
         finally:
             acct.freeze()
             if tel:
@@ -482,6 +561,12 @@ class Trainer:
         self._spans_exported = len(events)
         if rec is not None:
             self.metrics.write_record(rec)
+        # final registry snapshot: the control-plane counters (cache
+        # hits, prefetch overlap, switch fast path) as of run end —
+        # trace_summary's "control plane" section reads the LAST one
+        snap = self.registry.to_record()
+        if snap["metrics"]:
+            self.metrics.write_record(snap)
         return rec
 
     def close(self) -> None:

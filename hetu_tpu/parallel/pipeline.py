@@ -346,6 +346,8 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
     thread_dropout = model_dropout_active(model)
 
     def step(state: TrainState, batch: dict):
+        from hetu_tpu.engine.train_step import record_trace
+        record_trace("pipeline_step")   # runs at trace time only
         key = step_dropout_key(state.step) if thread_dropout else None
         loss, grads = grad_fn(state.params, batch, key)
         gnorm = global_norm(grads)

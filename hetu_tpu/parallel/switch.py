@@ -58,6 +58,34 @@ def switch_strategy(state: TrainState, new_plan) -> TrainState:
         return cross_topology_switch(state, new_plan)
 
 
+def _norm_indices(sharding, shape) -> set:
+    """Normalized shard regions a sharding materializes: a set of
+    per-dim (start, stop) tuples (slices are unhashable before 3.12)."""
+    out = set()
+    for idx in sharding.devices_indices_map(shape).values():
+        out.add(tuple(
+            (sl.start or 0, dim if sl.stop is None else sl.stop)
+            for sl, dim in zip(idx, shape)))
+    return out
+
+
+def _shardings_compatible(src, dst, shape) -> bool:
+    """True when every destination shard region is exactly a source
+    shard region (equivalent layouts, or a destination that drops
+    replicas) — then ``jax.device_put`` is pure whole-shard copies and
+    the host-side reassembly is unnecessary. A fully-replicated source
+    also qualifies: any destination slice is local to every device."""
+    try:
+        src_idx = _norm_indices(src, shape)
+        if len(src_idx) == 1:          # fully replicated (or rank-0)
+            only = next(iter(src_idx))
+            if all(a == 0 and b == d for (a, b), d in zip(only, shape)):
+                return True
+        return _norm_indices(dst, shape) <= src_idx
+    except Exception:
+        return False
+
+
 def cross_topology_switch(state: TrainState, new_plan) -> TrainState:
     """Reshard onto a (possibly disjoint or differently-sized) device
     set: destination shards are assembled via
@@ -65,15 +93,28 @@ def cross_topology_switch(state: TrainState, new_plan) -> TrainState:
     from host memory — the in-memory analogue of the sharded checkpoint's
     restore path (same :func:`assemble_window` intersection core).
 
+    Fast path: leaves whose destination shard layout matches the source
+    (per :func:`_shardings_compatible`) skip the numpy round trip and go
+    through ``jax.device_put`` directly — whole-shard copies the runtime
+    executes without host-side slicing. On a typical shrink most of the
+    optimizer state (replicated or identically-sharded leaves) takes
+    this path; only genuinely re-sliced leaves pay reassembly.
+
     Sources must be fully addressable to this process (single-controller
     flows); volume accounting raises otherwise — multi-process elastic
     resharding goes through the sharded checkpoint instead.
     """
     from hetu_tpu.utils.windows import assemble_window
 
+    counts = {"fast": 0, "reassembled": 0}
+
     def move(leaf, sharding):
         if not isinstance(leaf, jax.Array):
             return jax.device_put(leaf, sharding)
+        if _shardings_compatible(leaf.sharding, sharding, leaf.shape):
+            counts["fast"] += 1
+            return jax.device_put(leaf, sharding)
+        counts["reassembled"] += 1
         seen = set()
         pieces = []
         for s in leaf.addressable_shards:
@@ -91,4 +132,13 @@ def cross_topology_switch(state: TrainState, new_plan) -> TrainState:
 
         return jax.make_array_from_callback(leaf.shape, sharding, window)
 
-    return jax.tree.map(move, state, new_plan.state_shardings)
+    out = jax.tree.map(move, state, new_plan.state_shardings)
+    if telemetry.enabled():
+        reg = telemetry.get_registry()
+        reg.counter("switch_fastpath_leaves_total",
+                    "cross-topology leaves moved by direct device_put"
+                    ).inc(counts["fast"])
+        reg.counter("switch_reassembled_leaves_total",
+                    "cross-topology leaves rebuilt from host shards"
+                    ).inc(counts["reassembled"])
+    return out

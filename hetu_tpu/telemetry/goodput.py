@@ -29,6 +29,11 @@ CATEGORIES = ("compute", "compile", "switch", "checkpoint", "stall",
 SPAN_CATEGORIES = {
     "compute": "compute", "step": "compute", "hetero_step": "compute",
     "compile": "compile", "make_plan": None, "build_step": None,
+    "build_plan_and_step": None,
+    # background AOT compilation (engine/precompile.py) runs OFF the
+    # training thread — it is not foreground overhead and must not be
+    # summed into the wall breakdown (it still shows in the span rollup)
+    "precompile": None,
     "switch": "switch", "cross_topology_switch": None,
     "checkpoint": "checkpoint", "checkpoint_write": None,
     "checkpoint_gather": None,

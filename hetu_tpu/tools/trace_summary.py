@@ -75,12 +75,80 @@ def last_metrics(records: list[dict]) -> Optional[dict]:
     return out
 
 
+#: control-plane counters surfaced as their own section: the direct
+#: evidence the StepCache / AOT precompiler / prefetch overlap are (or
+#: are not) killing the compile+stall tax (docs/PERFORMANCE.md).
+_CONTROL_PLANE_COUNTERS = (
+    "step_cache_hits_total", "step_cache_misses_total",
+    "precompiled_strategies_total",
+    "prefetch_batches_total", "prefetch_ready_total",
+    "prefetch_restaged_total",
+    "switch_fastpath_leaves_total", "switch_reassembled_leaves_total",
+    "switches_total", "data_stall_seconds",
+)
+
+
+def control_plane_summary(records: list[dict]) -> Optional[list[str]]:
+    """Lines for the step-cache / prefetch counter section, or None when
+    no telemetry snapshot carries them. Reads the LAST snapshot seen
+    (counters are cumulative)."""
+    snap: Optional[dict] = None
+    for rec in records:
+        cand = rec.get("metrics") if rec.get("kind") == "metrics_snapshot" \
+            else rec.get("telemetry")
+        if isinstance(cand, dict) and any(
+                k.split("{")[0] in _CONTROL_PLANE_COUNTERS for k in cand):
+            snap = cand
+    if snap is None:
+        return None
+    vals = {}
+    for series, v in snap.items():
+        base = series.split("{")[0]
+        if base in _CONTROL_PLANE_COUNTERS and isinstance(v, (int, float)):
+            vals[base] = vals.get(base, 0.0) + v
+    if not vals:
+        return None
+    lines = []
+    hits = vals.get("step_cache_hits_total", 0.0)
+    misses = vals.get("step_cache_misses_total", 0.0)
+    if hits or misses:
+        rate = hits / (hits + misses) if (hits + misses) else 0.0
+        lines.append(f"step cache       {int(hits)} hits / "
+                     f"{int(misses)} misses ({100.0 * rate:.0f}% hit)")
+    if vals.get("precompiled_strategies_total"):
+        lines.append(f"precompiled      "
+                     f"{int(vals['precompiled_strategies_total'])} "
+                     f"strategies (background AOT)")
+    served = vals.get("prefetch_batches_total", 0.0)
+    if served:
+        ready = vals.get("prefetch_ready_total", 0.0)
+        lines.append(f"prefetch         {int(ready)}/{int(served)} "
+                     f"batches pre-staged "
+                     f"({100.0 * ready / served:.0f}% overlapped)")
+    if vals.get("prefetch_restaged_total"):
+        lines.append(f"restaged         "
+                     f"{int(vals['prefetch_restaged_total'])} batches "
+                     f"(post-switch re-place)")
+    fast = vals.get("switch_fastpath_leaves_total", 0.0)
+    slow = vals.get("switch_reassembled_leaves_total", 0.0)
+    if fast or slow:
+        lines.append(f"switch leaves    {int(fast)} device_put fast path"
+                     f" / {int(slow)} host-reassembled")
+    return lines
+
+
 def summarize(path: str, *, wall_s: Optional[float] = None,
               top: int = 10) -> str:
     records = load_records(path)
     report = report_from_records(records, wall_s=wall_s)
     parts = [f"== goodput breakdown ({path}) ==",
              format_goodput_table(report)]
+
+    cp = control_plane_summary(records)
+    if cp:
+        parts.append("")
+        parts.append("== control plane ==")
+        parts.extend(cp)
 
     rows = span_rollup(records, top=top)
     if rows:

@@ -96,12 +96,14 @@ _TELEMETRY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_telemetry.jsonl")
 
 
-def _write_bench_telemetry(result: dict):
+def _write_bench_telemetry(result: dict, extra_records=()):
     """Best-effort: the telemetry artifact must never cost the headline."""
     tracer = telemetry.get_tracer()
     reg = telemetry.get_registry()
     with open(_TELEMETRY_PATH, "w") as f:
         f.write(json.dumps({"kind": "bench_result", **result}) + "\n")
+        for rec in extra_records:
+            f.write(json.dumps(rec) + "\n")
         for rec in tracer.records():
             f.write(json.dumps(rec) + "\n")
         rec = reg.to_record()
@@ -385,6 +387,8 @@ def main():
     mfu = flops / peak if peak else 0.0
 
     cache_stats = cache.stats()
+    from hetu_tpu.parallel import overlap as _overlap
+    dp_stats = _overlap.comm_stats()
     result = {
         "metric": "gpt2_small_pretrain_mfu" if on_tpu else "gpt2_tiny_cpu_smoke",
         "value": round(mfu, 4) if on_tpu else round(tokens_per_sec, 1),
@@ -401,6 +405,12 @@ def main():
         "cache_hit_rate": round(cache_stats["hit_rate"], 4),
         "cache_hits": cache_stats["hits"],
         "cache_misses": cache_stats["misses"],
+        # data-plane slice (ISSUE 3): what fraction of collective bytes
+        # rode an overlapping path (ring matmul / double-buffered pp),
+        # and how many DP grad reductions each optimizer update cost
+        # (1.0 = fully delayed sync; 0.0 here = no grad-accum exercised)
+        "comm_overlap_ratio": round(dp_stats["overlap_ratio"], 4),
+        "dp_sync_per_step": round(dp_stats["dp_sync_per_step"], 4),
     }
     if degraded is not None:
         # the sweep winner config failed and the built-ins carried the
@@ -430,7 +440,12 @@ def main():
         except (OSError, ValueError):
             result["tpu_unavailable"] = True
     try:
-        _write_bench_telemetry(result)
+        # measured_step record: the observed step time keyed by strategy
+        # JSON — the Galvatron search re-ranks its candidates by these
+        # (search_uniform(measured_path=...) / $HETU_MEASURED_TELEMETRY)
+        _write_bench_telemetry(result, extra_records=(
+            {"kind": "measured_step", "strategy": strategy.to_json(),
+             "step_time_s": dt, "steps": steps},))
     except Exception:
         pass
     print(json.dumps(result))

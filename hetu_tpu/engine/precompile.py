@@ -199,6 +199,7 @@ def precompile_strategies(model, opt, strategies: Iterable[Strategy], *,
 def precompile_top_k(model, opt, dims, topo, *, k: int = 3,
                      batch_shape: Optional[tuple] = None,
                      num_devices: Optional[int] = None,
+                     measured_path: Optional[str] = None,
                      **kw) -> PrecompileHandle:
     """Drive the AOT worker from the Galvatron search: take the top-``k``
     feasible candidates of :func:`~hetu_tpu.tools.galvatron.search.
@@ -206,10 +207,16 @@ def precompile_top_k(model, opt, dims, topo, *, k: int = 3,
     planner-directed hot switch to ANY of its likely picks is warm.
 
     ``num_devices`` filters candidates to what the live mesh can host
-    (defaults to ``jax.device_count()``)."""
+    (defaults to ``jax.device_count()``). ``measured_path`` (or
+    ``$HETU_MEASURED_TELEMETRY``) points at a telemetry JSONL whose
+    ``measured_step`` records re-rank the candidates by OBSERVED step
+    time before the top-``k`` cut — the precompiled set then reflects
+    what actually ran fastest, not just the analytic model."""
     from hetu_tpu.tools.galvatron.search import search_uniform
     n = num_devices if num_devices is not None else jax.device_count()
-    cands = [c.strategy for c in search_uniform(dims, topo)
+    cands = [c.strategy
+             for c in search_uniform(dims, topo,
+                                     measured_path=measured_path)
              if c.strategy.num_devices <= n]
     return precompile_strategies(model, opt, cands[:k],
                                  batch_shape=batch_shape, **kw)

@@ -36,6 +36,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hetu_tpu.engine.state import TrainState, new_train_state
@@ -130,7 +131,8 @@ def _make_plan(model: Module, opt: Transform, strategy: Strategy,
         mesh,
         batch=("dp", "ep") if strategy.ep > 1 else "dp",
         seq="cp", tp="tp", cp_layout=strategy.effective_cp_layout,
-        cp_impl=strategy.cp_impl, sp=strategy.sp)
+        cp_impl=strategy.cp_impl, sp=strategy.sp,
+        tp_overlap=strategy.tp_overlap)
     return TrainPlan(strategy, mesh, param_specs, state_specs,
                      named_shardings(mesh, state_specs), act)
 
@@ -573,7 +575,8 @@ def build_eval_step(model: Module, plan: TrainPlan, *,
 def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
                            *, loss_fn: Optional[Callable] = None,
                            attn_impl: str = "auto",
-                           donate_acc: bool = True):
+                           donate_acc: bool = True,
+                           delay_grad_sync: bool = False):
     """Split-phase training — the reference's partial-execution RunLevels
     (``graph.h:33-39``): RunLevel::GRAD accumulates gradients across
     *separate step calls* (arbitrary-size global batches without holding
@@ -602,12 +605,37 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
     ``init_acc(like=...)`` after a donating ``apply_step`` raises jax's
     deleted-buffer error — the two modes are mutually exclusive by
     construction.
+
+    Delayed gradient synchronization (``delay_grad_sync=True``, ZeRO
+    SC'20 §5 / DDP ``no_sync``): per-microbatch gradients stay **local
+    to each dp group** — the accumulator gains a leading ``dp`` dim
+    sharded over dp and ``grad_step`` computes group-local grads inside
+    a partial-manual ``shard_map`` over dp (tp/cp stay GSPMD-auto), so
+    NO cross-dp gradient traffic moves until ``apply_step`` reduces the
+    leading dim once per optimizer update — an O(accum_steps) reduction
+    in DP bytes. With ZeRO on, that single reduction feeds the sharded
+    optimizer directly (reduce-scatter → update → all-gather, once).
+    The per-call ``dp_grad_syncs_total`` / ``optimizer_updates_total``
+    counters (``parallel.overlap``) make the rate auditable:
+    eager = ``accum_steps`` syncs/update, delayed = exactly 1.
+    Unsupported with ``fsdp`` (params are dp-sharded — group-local
+    grads of a sharded param would need the very gather being delayed)
+    and ``ep > 1`` (the batch dim carries ep); both raise.
     """
     strategy = plan.strategy
     if strategy.pp > 1:
         raise NotImplementedError(
             "split-phase accumulation with pp > 1: use "
             "num_microbatches inside the pipeline step instead")
+    if delay_grad_sync and strategy.fsdp:
+        raise ValueError(
+            "delay_grad_sync=True is incompatible with fsdp: params are "
+            "dp-sharded, so group-local gradients would require the "
+            "param all-gather the delay is meant to avoid")
+    if delay_grad_sync and strategy.ep > 1:
+        raise ValueError(
+            "delay_grad_sync=True is incompatible with ep > 1 (the "
+            "batch dim is sharded over dp×ep)")
     base_loss = loss_fn or default_loss_fn(model, strategy, attn_impl)
 
     def compute_loss(params, batch, key):
@@ -618,6 +646,8 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
 
     grad_fn = jax.value_and_grad(compute_loss)
     param_shardings = plan.state_shardings.params
+    ndp = plan.mesh.shape.get("dp", 1)
+    delayed = delay_grad_sync and ndp > 1   # dp=1 has nothing to delay
     # same dropout contract as build_train_step: thread keys when the
     # model wants dropout AND the loss fn can take them; warn otherwise
     import inspect
@@ -635,16 +665,29 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
             "build_grad_accum_steps; accept a dropout_key kwarg to "
             "enable it", stacklevel=2)
 
-    @functools.partial(jax.jit, out_shardings=param_shardings)
+    if delayed:
+        # the accumulator gains a leading dp dim (one local grad shard
+        # per dp group) — P("dp", *param_spec) keeps each group's shard
+        # on its own devices, so accumulation is comm-free
+        acc_specs = jax.tree.map(
+            lambda s: P("dp", *tuple(s)), plan.state_specs.params,
+            is_leaf=lambda x: isinstance(x, P))
+        acc_shardings = named_shardings(plan.mesh, acc_specs)
+        acc_lead = (ndp,)
+    else:
+        acc_shardings = param_shardings
+        acc_lead = ()
+
+    @functools.partial(jax.jit, out_shardings=acc_shardings)
     def _fresh_acc():
         return jax.tree.map(
-            lambda s: jnp.zeros(s.shape, jnp.float32),
+            lambda s: jnp.zeros(acc_lead + tuple(s.shape), jnp.float32),
             model.abstract_params())
 
     # zero-fill INTO the donated previous accumulator: XLA rewrites this
     # to an in-place memset of the existing buffer — no allocation
     @functools.partial(jax.jit, donate_argnums=(0,),
-                       out_shardings=param_shardings)
+                       out_shardings=acc_shardings)
     def _rezero_acc(like):
         return jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
                             like)
@@ -658,26 +701,105 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
         return _rezero_acc(like)
 
     @functools.partial(jax.jit, donate_argnums=(1,),
-                       out_shardings=(param_shardings, None))
+                       out_shardings=(acc_shardings, None))
     def grad_step(state: TrainState, acc, batch, accum_index=0):
         record_trace("grad_step")
         # accum_index is traced (fold_in takes traced ints): one compile
         # serves every index
         key = jax.random.fold_in(step_dropout_key(state.step),
                                  accum_index) if thread_dropout else None
-        loss, grads = grad_fn(state.params, batch, key)
+        if not delayed:
+            loss, grads = grad_fn(state.params, batch, key)
+            return jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                acc, grads), loss
+        loss, grads = _local_grads(state.params, batch, key)
         return jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
                             acc, grads), loss
 
+    def _local_grads(params, batch, key):
+        """Per-dp-group (loss, grads) with a leading dp dim and ZERO
+        cross-dp traffic: a partial-manual ``shard_map`` over dp — each
+        group differentiates its local batch shard against the full
+        (dp-replicated) params; tp/cp collectives stay GSPMD-auto
+        exactly as in the pipeline executor's manual region."""
+        from hetu_tpu.parallel.sharding import ManualAxes, no_act_sharding
+        mesh = plan.mesh
+
+        def body(params, batch_l, gid, *key_arg):
+            def lloss(p):
+                k = None
+                if key_arg:
+                    # decorrelate dp groups via the explicit group-id
+                    # operand (axis_index would lower to PartitionId,
+                    # which SPMD partitioning of the auto axes rejects)
+                    k = jax.random.fold_in(key_arg[0], gid[0])
+                with no_act_sharding(), \
+                        ManualAxes(mesh, frozenset({"dp"})):
+                    if k is not None:
+                        return base_loss(p, batch_l, dropout_key=k)
+                    return base_loss(p, batch_l)
+
+            loss, g = jax.value_and_grad(lloss)(params)
+            return loss.reshape(1), jax.tree.map(lambda v: v[None], g)
+
+        in_b = {k: P("dp") for k in batch}
+        in_p = jax.tree.map(lambda _: P(), params)
+        gids = jnp.arange(ndp, dtype=jnp.int32)
+        out_g = jax.tree.map(lambda _: P("dp"), params)
+        if key is None:
+            f = shard_map(lambda p, b, g: body(p, b, g), mesh=mesh,
+                          in_specs=(in_p, in_b, P("dp")),
+                          out_specs=(P("dp"), out_g),
+                          axis_names={"dp"}, check_vma=False)
+            losses, grads = f(params, batch, gids)
+        else:
+            f = shard_map(body, mesh=mesh,
+                          in_specs=(in_p, in_b, P("dp"), P()),
+                          out_specs=(P("dp"), out_g),
+                          axis_names={"dp"}, check_vma=False)
+            losses, grads = f(params, batch, gids, key)
+        # scalarizing the per-group loss vector moves 4·dp bytes — a
+        # metric read, not a gradient sync
+        return jnp.mean(losses), grads
+
+    # delayed acc buffers ((ndp, ...) leaves) can never alias the
+    # update's outputs — donating them only buys a warning per compile
     @functools.partial(jax.jit,
-                       donate_argnums=(0, 1) if donate_acc else (0,),
+                       donate_argnums=(0, 1) if donate_acc and not delayed
+                       else (0,),
                        out_shardings=(plan.state_shardings, None))
     def apply_step(state: TrainState, acc, n_accum):
-        grads = jax.tree.map(lambda g: g / n_accum, acc)
+        if delayed:
+            # THE one DP gradient reduction of the whole update: the
+            # leading (dp-sharded) dim sums down to the synced grad —
+            # under ZeRO the sharded moment specs turn it into the
+            # reduce-scatter → update → all-gather triplet, once
+            grads = jax.tree.map(
+                lambda g: jnp.sum(g, axis=0) / (ndp * n_accum), acc)
+        else:
+            grads = jax.tree.map(lambda g: g / n_accum, acc)
         gnorm = global_norm(grads)
         updates, new_opt = opt.update(grads, state.opt_state, state.params)
         new_params = apply_updates(state.params, updates)
         return (TrainState(state.step + 1, new_params, new_opt),
                 {"grad_norm": gnorm})
 
-    return init_acc, grad_step, apply_step
+    # host-side data-plane accounting (exact per call): eager issues one
+    # DP grad reduction per MICROBATCH, delayed exactly one per UPDATE
+    from hetu_tpu.parallel import overlap as _overlap
+    grad_bytes = 4 * int(sum(
+        int(functools.reduce(lambda a, b: a * b, l.shape, 1))
+        for l in jax.tree.leaves(model.abstract_params())))
+
+    def grad_step_fn(state, acc, batch, accum_index=0):
+        if ndp > 1 and not delayed:
+            _overlap.record_dp_sync(1, grad_bytes=grad_bytes)
+        return grad_step(state, acc, batch, accum_index)
+
+    def apply_step_fn(state, acc, n_accum):
+        _overlap.record_optimizer_update(1)
+        if delayed:
+            _overlap.record_dp_sync(1, grad_bytes=grad_bytes)
+        return apply_step(state, acc, n_accum)
+
+    return init_acc, grad_step_fn, apply_step_fn

@@ -79,6 +79,22 @@ class TrainerConfig:
                                  # compilation_cache): restarts re-trace
                                  # but skip the XLA compile. Also honors
                                  # $HETU_COMPILE_CACHE_DIR when unset.
+    comm_overlap: str = "auto"   # "auto": wire XLA's async-collective +
+                                 # latency-hiding-scheduler flags on TPU
+                                 # (parallel.overlap.enable_xla_overlap)
+                                 # — the automatic comm/compute overlap
+                                 # fallback when the manual ring
+                                 # (Strategy.tp_overlap="ring") is off;
+                                 # "off": leave XLA_FLAGS alone. Only
+                                 # effective before backend init.
+    aggregate_every: int = 0     # cadence (steps) for publishing this
+                                 # rank's metric snapshot through
+                                 # telemetry.cluster_aggregate during
+                                 # train() (multi-host: pass dist= to
+                                 # the Trainer; single-process runs
+                                 # reduce locally). 0 = off. Aggregates
+                                 # land in telemetry.jsonl as
+                                 # kind=cluster_aggregate records.
 
     def policy(self) -> Policy:
         return BF16_COMPUTE if self.precision == "bf16" else FP32
@@ -87,11 +103,21 @@ class TrainerConfig:
 class Trainer:
     def __init__(self, model, opt: Transform, strategy: Strategy,
                  config: Optional[TrainerConfig] = None, devices=None,
-                 step_cache: Optional[StepCache] = None):
+                 step_cache: Optional[StepCache] = None, dist=None):
         self.model = model
         self.opt = opt
         self.config = config if config is not None else TrainerConfig()
         self.devices = devices
+        # dist: a rpc.launcher.DistContext (or anything with .client /
+        # .rank / .num_processes) — enables the cross-rank telemetry
+        # aggregation cadence (config.aggregate_every) on multi-host runs
+        self._dist = dist
+        if self.config.comm_overlap != "off":
+            # XLA-side comm/compute overlap: best-effort (only lands
+            # before backend init, TPU-only flags), the data-plane
+            # fallback when the manual ring is not in force
+            from hetu_tpu.parallel.overlap import enable_xla_overlap
+            enable_xla_overlap()
         self.state: Optional[TrainState] = None
         self.plan = None
         self._step_fn = None
@@ -444,6 +470,9 @@ class Trainer:
                     acct.record("eval", time.perf_counter() - t0)
                     history.append(self.metrics.log(host_step,
                                                     eval_loss=ev))
+                if self.config.aggregate_every and telemetry.enabled() \
+                        and host_step % self.config.aggregate_every == 0:
+                    self._aggregate_cluster(host_step)
                 if self.config.ckpt_every and self.config.ckpt_dir and \
                         host_step % self.config.ckpt_every == 0:
                     self.save()   # notes "checkpoint" in the ledger
@@ -523,6 +552,41 @@ class Trainer:
         return history
 
     # -- telemetry ---------------------------------------------------------
+    def _aggregate_cluster(self, step: int) -> Optional[dict]:
+        """One cross-rank aggregation round on the train() cadence
+        (``config.aggregate_every``): publish this rank's registry
+        snapshot through the coordinator KV, take back the cluster
+        min/max/mean reduction, and log it as a ``cluster_aggregate``
+        record. Without a ``dist`` context (single process) the snapshot
+        reduces locally — same record shape, ranks=1 — so the cadence
+        and artifact schema are exercised everywhere. Failures are
+        logged, never fatal: telemetry must not kill training."""
+        snap = self.registry.snapshot()
+        t0 = time.perf_counter()
+        try:
+            with telemetry.span("cluster_aggregate", step=step):
+                if self._dist is not None and \
+                        getattr(self._dist, "num_processes", 1) > 1:
+                    agg = telemetry.cluster_aggregate(
+                        self._dist.client, self._dist.rank,
+                        self._dist.num_processes, snap, run="trainer-agg")
+                    ranks = self._dist.num_processes
+                else:
+                    agg = telemetry.aggregate_snapshots([snap])
+                    ranks = 1
+        except Exception as e:   # noqa: BLE001 — observability side-path
+            get_logger().warning(
+                f"cluster aggregation failed at step {step}: {e}")
+            return None
+        finally:
+            # the blocking barrier time is overhead the goodput ledger
+            # must see (the cadence is the operator's knob against it)
+            self._note("telemetry", time.perf_counter() - t0)
+        rec = {"kind": "cluster_aggregate", "step": step,
+               "ranks": ranks, "metrics": agg}
+        self.metrics.write_record(rec)
+        return agg
+
     def _flops_per_token(self, seq_len: int) -> Optional[float]:
         """Model FLOPs/token from the config shapes (cost-model dims);
         None when the model family doesn't expose transformer dims."""
@@ -561,6 +625,20 @@ class Trainer:
         self._spans_exported = len(events)
         if rec is not None:
             self.metrics.write_record(rec)
+            # per-strategy OBSERVED step time: the record the Galvatron
+            # search's measured re-rank consumes
+            # (tools.galvatron.search.rerank_by_measured) — closing the
+            # planner loop from the gain side
+            comp, steps = rec["components"].get("compute", 0.0), \
+                rec.get("steps", 0)
+            if comp > 0 and steps:
+                try:
+                    self.metrics.write_record({
+                        "kind": "measured_step",
+                        "strategy": self.strategy.to_json(),
+                        "step_time_s": comp / steps, "steps": steps})
+                except Exception:   # hetero strategies: no to_json parity
+                    pass
         # final registry snapshot: the control-plane counters (cache
         # hits, prefetch overlap, switch fast path) as of run end —
         # trace_summary's "control plane" section reads the LAST one

@@ -39,22 +39,45 @@ from hetu_tpu.parallel.sharding import (
 )
 
 
+def _ring_overlap_active(overlap: str) -> bool:
+    """Resolve a layer's ``overlap`` mode against the ambient context:
+    "ring" forces the decomposed collective matmul, "off" never uses it,
+    "auto" (default) follows the Strategy's ``tp_overlap`` via the
+    :class:`~hetu_tpu.parallel.sharding.ActivationSharding` context —
+    so one Strategy flag flips every TP layer in the model."""
+    if overlap == "off":
+        return False
+    ctx = current_act_sharding()
+    if ctx is None:
+        return False        # single device / manual pipeline region
+    if overlap == "ring":
+        return True
+    return getattr(ctx, "tp_overlap", "off") == "ring"
+
+
 class ColumnParallelLinear(Module):
     """Linear whose *output* features shard over tp (Y = XW, W: (in, out/tp)).
 
     Reference: ``HtMultiColumnParallelLinear`` (`parallel_multi_ds.py:328`).
     No gather is emitted here — the consumer is expected to be tp-local
     (attention heads, MLP hidden) until a RowParallelLinear reduces back.
+
+    ``overlap="ring"`` (or "auto" + ``Strategy(tp_overlap="ring")``)
+    decomposes the Megatron-SP all-gather→matmul pair into a ppermute
+    ring of chunk matmuls (``parallel.overlap.ring_ag_matmul``) so each
+    comm hop hides behind the previous chunk's compute. Without sp the
+    column matmul has no gather to hide and the mode is a no-op.
     """
 
     def __init__(self, in_features: int, out_features: int, *,
                  bias: bool = True, init=None, axis: str = "mlp",
-                 out_kind: str = "hidden"):
+                 out_kind: str = "hidden", overlap: str = "auto"):
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
         self.use_bias = bias
         self.out_kind = out_kind
+        self.overlap = overlap
         self.param("weight", (in_features, out_features),
                    init or normal_init(0.02), axes=("embed", axis))
         if bias:
@@ -62,7 +85,19 @@ class ColumnParallelLinear(Module):
 
     def __call__(self, params, x):
         dt = self.compute_dtype()
-        y = jnp.matmul(x.astype(dt), params["weight"].astype(dt))
+        x = x.astype(dt)
+        w = params["weight"].astype(dt)
+        if _ring_overlap_active(self.overlap):
+            from hetu_tpu.parallel.overlap import (
+                ring_ag_matmul, ring_column_applicable,
+            )
+            ctx = current_act_sharding()
+            if ring_column_applicable(ctx, x.shape, w.shape):
+                b = params["bias"].astype(dt) if self.use_bias else None
+                y = ring_ag_matmul(x, w, b, ctx=ctx,
+                                   out_kind=self.out_kind)
+                return act_constrain(y, self.out_kind)
+        y = jnp.matmul(x, w)
         if self.use_bias:
             y = y + params["bias"].astype(dt)
         return act_constrain(y, self.out_kind)
@@ -78,11 +113,13 @@ class RowParallelLinear(Module):
     """
 
     def __init__(self, in_features: int, out_features: int, *,
-                 bias: bool = True, init=None, axis: str = "mlp"):
+                 bias: bool = True, init=None, axis: str = "mlp",
+                 overlap: str = "auto"):
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
         self.use_bias = bias
+        self.overlap = overlap
         self.param("weight", (in_features, out_features),
                    init or normal_init(0.02), axes=(axis, "embed"))
         if bias:
@@ -90,7 +127,22 @@ class RowParallelLinear(Module):
 
     def __call__(self, params, x):
         dt = self.compute_dtype()
-        y = jnp.matmul(x.astype(dt), params["weight"].astype(dt))
+        x = x.astype(dt)
+        w = params["weight"].astype(dt)
+        if _ring_overlap_active(self.overlap):
+            from hetu_tpu.parallel.overlap import (
+                ring_matmul_rs, ring_row_applicable,
+            )
+            ctx = current_act_sharding()
+            if ring_row_applicable(ctx, x.shape, w.shape):
+                # the ring IS the reduce(-scatter): no act_constrain
+                # needed to trigger the collective, the output already
+                # carries the "tokens" layout
+                y = ring_matmul_rs(x, w, ctx=ctx)
+                if self.use_bias:
+                    y = y + params["bias"].astype(dt)
+                return y
+        y = jnp.matmul(x, w)
         y = act_constrain(y, "tokens")
         if self.use_bias:
             y = y + params["bias"].astype(dt)

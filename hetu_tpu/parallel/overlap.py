@@ -1,0 +1,348 @@
+"""Comm/compute overlap: decomposed collective matmuls + data-plane ledger.
+
+The GSPMD-default data plane serializes collectives with the matmuls that
+depend on them: the tp all-gather finishes before the column matmul starts,
+the row matmul finishes before its all-reduce starts. The reference hides
+these on dedicated comm streams (``AttnCommRing``-style grouped P2P); the
+TPU-native equivalent is the *decomposed collective matmul* (Wang et al.,
+"Overlap Communication with Dependent Computation via Decomposition",
+ASPLOS'23): a ``shard_map`` ring where each ``ppermute`` hop moves the next
+operand chunk while the current chunk's partial matmul runs — the two ops
+share no data dependency inside one ring step, so the scheduler (and the
+TPU's async collective-permute) overlaps them.
+
+Two ring kernels cover the canonical Megatron pair:
+
+- :func:`ring_ag_matmul` — all-gather→matmul (ColumnParallelLinear with
+  Megatron-SP sequence-sharded input): each device matmuls the seq chunk it
+  holds while ppermuting it onward; after ``tp`` steps every device has the
+  full-sequence output without a standalone all-gather.
+- :func:`ring_matmul_rs` — matmul→reduce-scatter (RowParallelLinear): the
+  partial-sum accumulator rides the ring, each step adding the local
+  partial for the chunk it currently holds; the terminal all-reduce
+  decomposes into overlappable hops (plus one tiled all-gather when the
+  consumer wants the replicated layout, i.e. sp is off).
+
+Everything here also feeds the **data-plane ledger**: analytic payload
+bytes per traced step program (`comm_bytes_total{kind=...}`), DP gradient
+sync counts from the delayed-sync wrappers in
+``engine.train_step.build_grad_accum_steps``, and the derived
+``comm_overlap_ratio`` that ``bench.py`` and ``tools/trace_summary.py``
+report. When the manual ring is off, :func:`enable_xla_overlap` wires
+XLA's async-collective + latency-hiding-scheduler flags as the automatic
+fallback (``TrainerConfig.comm_overlap``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+# -- data-plane ledger -------------------------------------------------------
+#
+# Byte accounting is ANALYTIC: ring kernels record at trace time (shapes are
+# static), the grad-sync wrappers record per host-side call. Semantics:
+# `comm_bytes_total{kind}` approximates the payload bytes one *executed*
+# step/call moves for that collective kind; a re-trace of the same program
+# records again (re-traces are themselves counted by `step_traces_total`,
+# so the operator can tell). The ledger mirrors the registry so tests and
+# bench.py read it without enabling telemetry.
+
+_LOCK = threading.Lock()
+_BYTES: dict[str, int] = {}          # kind -> analytic payload bytes
+_OVERLAPPED_BYTES: dict[str, int] = {}   # subset moved on overlap paths
+_DP_SYNCS = {"syncs": 0, "updates": 0}
+
+
+def record_comm_bytes(kind: str, nbytes: int, *,
+                      overlapped: bool = False) -> None:
+    """Account ``nbytes`` of data-plane traffic under ``kind``.
+
+    ``overlapped``: the bytes move on a comm/compute-overlapping path
+    (manual ring, double-buffered pipeline) rather than a serialized
+    collective — the numerator of ``comm_overlap_ratio``. Tracked per
+    RECORD, so a kind traced both ways (e.g. pp_ppermute with and
+    without ``pp_overlap``) is apportioned, not all-or-nothing."""
+    nbytes = int(nbytes)
+    if nbytes <= 0:
+        return
+    with _LOCK:
+        _BYTES[kind] = _BYTES.get(kind, 0) + nbytes
+        if overlapped:
+            _OVERLAPPED_BYTES[kind] = \
+                _OVERLAPPED_BYTES.get(kind, 0) + nbytes
+    from hetu_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.get_registry().counter(
+            "comm_bytes_total",
+            "analytic data-plane collective payload bytes").inc(
+                nbytes, kind=kind)
+        if overlapped:
+            telemetry.get_registry().counter(
+                "comm_overlapped_bytes_total",
+                "data-plane bytes moved on overlapping paths").inc(
+                    nbytes, kind=kind)
+
+
+def record_dp_sync(n: int = 1, *, grad_bytes: int = 0) -> None:
+    """Count ``n`` DP gradient reductions (host-side, exact per call)."""
+    with _LOCK:
+        _DP_SYNCS["syncs"] += n
+    from hetu_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.get_registry().counter(
+            "dp_grad_syncs_total",
+            "DP gradient reductions issued").inc(n)
+    if grad_bytes:
+        record_comm_bytes("dp_grad_sync", grad_bytes * n)
+
+
+def record_optimizer_update(n: int = 1) -> None:
+    """Count optimizer updates — the denominator of ``dp_sync_per_step``."""
+    with _LOCK:
+        _DP_SYNCS["updates"] += n
+    from hetu_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.get_registry().counter(
+            "optimizer_updates_total",
+            "optimizer updates applied (grad-accum apply steps)").inc(n)
+
+
+def comm_stats() -> dict:
+    """Ledger snapshot: bytes by kind, overlap ratio, DP sync rate.
+
+    ``overlap_ratio`` mixes recording granularities — ring/pipeline
+    bytes land once per trace, grad-sync bytes once per host call — so
+    read it within one run mode; per-kind byte totals are always
+    unambiguous."""
+    with _LOCK:
+        by_kind = dict(_BYTES)
+        overlapped = sum(_OVERLAPPED_BYTES.values())
+        syncs, updates = _DP_SYNCS["syncs"], _DP_SYNCS["updates"]
+    total = sum(by_kind.values())
+    return {
+        "bytes_by_kind": by_kind,
+        "bytes_total": total,
+        "bytes_overlapped": overlapped,
+        "overlap_ratio": overlapped / total if total else 0.0,
+        "dp_syncs": syncs,
+        "optimizer_updates": updates,
+        "dp_sync_per_step": syncs / updates if updates else 0.0,
+    }
+
+
+def reset_comm_stats() -> None:
+    with _LOCK:
+        _BYTES.clear()
+        _OVERLAPPED_BYTES.clear()
+        _DP_SYNCS["syncs"] = 0
+        _DP_SYNCS["updates"] = 0
+
+
+# -- ring collective matmuls -------------------------------------------------
+
+def _tp_degree(ctx) -> int:
+    if ctx is None or not isinstance(ctx.tp, str):
+        return 1
+    return ctx.mesh.shape.get(ctx.tp, 1)
+
+
+def ring_column_applicable(ctx, x_shape, w_shape) -> bool:
+    """The column AG→matmul ring needs an all-gather to hide: the input
+    must be sequence-sharded over tp (Megatron-SP), the seq dim must
+    split evenly into (cp·tp) chunks, and the trace must be in a GSPMD
+    region (no ambient context = single-device or manual pipeline body,
+    where there is nothing to decompose)."""
+    ntp = _tp_degree(ctx)
+    if ntp <= 1 or not ctx.sp or len(x_shape) != 3:
+        return False
+    seq_div = ntp
+    if isinstance(ctx.seq, str):
+        seq_div *= ctx.mesh.shape.get(ctx.seq, 1)
+    return x_shape[1] % seq_div == 0 and w_shape[1] % ntp == 0
+
+
+def ring_row_applicable(ctx, x_shape, w_shape) -> bool:
+    """The row matmul→RS ring decomposes the partial-sum all-reduce; it
+    needs tp>1, a tp-divisible local sequence, and a tp-divisible
+    contraction dim (the weight's row shards)."""
+    ntp = _tp_degree(ctx)
+    if ntp <= 1 or len(x_shape) != 3:
+        return False
+    s_local = x_shape[1]
+    if isinstance(ctx.seq, str):
+        cp = ctx.mesh.shape.get(ctx.seq, 1)
+        if s_local % cp:
+            return False
+        s_local //= cp
+    return s_local % ntp == 0 and x_shape[2] % ntp == 0
+
+
+def ring_ag_matmul(x, w, bias=None, *, ctx, out_kind: str = "hidden"):
+    """Decomposed all-gather→matmul (ColumnParallelLinear under sp).
+
+    ``x``: (B, S, E) sequence-sharded over (cp, tp) per ``ctx``'s
+    "tokens" spec; ``w``: (E, H) column-sharded over tp. Equivalent to
+    ``all_gather(x, tp) @ w`` but as a ``tp``-step ring: step *k* matmuls
+    the chunk received at step *k-1* while ppermuting it onward — the
+    hop hides behind the partial matmul. Per-output-element arithmetic
+    is identical to the fused path (the contraction dim is never split),
+    so results are bitwise-equal to overlap-off.
+    """
+    tp = ctx.tp
+    mesh = ctx.mesh
+    ntp = mesh.shape[tp]
+    in_x = ctx.spec("tokens")            # P(batch, (seq, tp), None)
+    in_w = P(None, tp)
+    in_b = P(tp)
+    out = ctx.spec(out_kind)             # P(batch, seq, tp)
+    record_comm_bytes(
+        "tp_ring_all_gather",
+        x.size * x.dtype.itemsize * (ntp - 1) // max(ntp, 1),
+        overlapped=True)
+    # receive-from-right: after k hops a device holds the chunk that
+    # started on rank (r + k) % ntp
+    perm = [(i, (i - 1) % ntp) for i in range(ntp)]
+
+    def body(xl, wl, bl):
+        r = jax.lax.axis_index(tp)
+        s_loc = xl.shape[1]
+        y = jnp.zeros((xl.shape[0], s_loc * ntp, wl.shape[1]), xl.dtype)
+        cur = xl
+        for k in range(ntp):
+            # the ppermute moving chunk k+1 and the matmul consuming
+            # chunk k only READ `cur` — no dependency, XLA overlaps them
+            part = jnp.matmul(cur, wl)
+            src = (r + k) % ntp
+            y = jax.lax.dynamic_update_slice_in_dim(
+                y, part, src * s_loc, 1)
+            if k + 1 < ntp:
+                cur = jax.lax.ppermute(cur, tp, perm)
+        if bl is not None:
+            y = y + bl
+        return y
+
+    if bias is None:
+        fn = shard_map(lambda xl, wl: body(xl, wl, None), mesh=mesh,
+                       in_specs=(in_x, in_w), out_specs=out,
+                       check_vma=False)
+        return fn(x, w)
+    fn = shard_map(body, mesh=mesh, in_specs=(in_x, in_w, in_b),
+                   out_specs=out, check_vma=False)
+    return fn(x, w, bias)
+
+
+def ring_matmul_rs(x, w, *, ctx):
+    """Decomposed matmul→reduce-scatter (RowParallelLinear).
+
+    ``x``: (B, S, H) feature-sharded over tp; ``w``: (H, E) row-sharded.
+    The tp-partial sums accumulate around the ring: each step ppermutes
+    the accumulator one hop while the local partial matmul for the newly
+    held seq chunk computes. With sp the seq-scattered result is the
+    final layout; otherwise one tiled all-gather rebuilds the replicated
+    output (the all-reduce's second half — the first half is what the
+    ring overlapped).
+    """
+    tp = ctx.tp
+    mesh = ctx.mesh
+    ntp = mesh.shape[tp]
+    in_x = ctx.spec("hidden")            # P(batch, seq, tp)
+    in_w = P(tp, None)
+    out = ctx.spec("tokens")             # sp: P(batch, (seq, tp), None)
+    record_comm_bytes(
+        "tp_ring_reduce_scatter",
+        x.size // max(x.shape[-1], 1) * w.shape[-1]
+        * x.dtype.itemsize * (ntp - 1) // max(ntp, 1),
+        overlapped=True)
+    perm = [(i, (i + 1) % ntp) for i in range(ntp)]
+
+    def body(xl, wl):
+        r = jax.lax.axis_index(tp)
+        s_loc = xl.shape[1] // ntp
+
+        def chunk(idx):
+            return jax.lax.dynamic_slice_in_dim(xl, idx * s_loc, s_loc, 1)
+
+        # device r holds the accumulator for chunk (r + ntp-1-k) at step
+        # k; after ntp-1 hops it lands on its own chunk r fully reduced
+        acc = jnp.matmul(chunk((r + ntp - 1) % ntp), wl)
+        for k in range(1, ntp):
+            # ppermute(acc) and the next partial matmul share no data —
+            # the hop hides behind the chunk compute
+            acc = jax.lax.ppermute(acc, tp, perm)
+            acc = acc + jnp.matmul(chunk((r + ntp - 1 - k) % ntp), wl)
+        if not ctx.sp:
+            # consumer wants the tp-replicated layout: finish the
+            # all-reduce with the (serialized) gather half
+            acc = jax.lax.all_gather(acc, tp, axis=1, tiled=True)
+        return acc
+
+    fn = shard_map(body, mesh=mesh, in_specs=(in_x, in_w),
+                   out_specs=out, check_vma=False)
+    return fn(x, w)
+
+
+# -- XLA scheduler fallback --------------------------------------------------
+
+#: Async-collective + latency-hiding-scheduler flags: XLA's own
+#: comm/compute overlap, used when the manual ring is off (or for the
+#: collectives the ring does not cover — ZeRO gathers, pipeline
+#: ppermutes). Known-good set from public TPU training recipes.
+XLA_OVERLAP_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+)
+
+
+def xla_overlap_flags() -> tuple:
+    return XLA_OVERLAP_FLAGS
+
+
+def enable_xla_overlap(*, force: bool = False) -> bool:
+    """Append the async-collective/latency-hiding flags to ``XLA_FLAGS``.
+
+    Only effective BEFORE backend initialization, and only applied when
+    the process is headed for a TPU backend (the flags are TPU-spelled;
+    an unknown flag is a hard abort on other backends) — ``force=True``
+    overrides the platform guess. Returns True when the environment was
+    modified. Idempotent."""
+    try:
+        from jax._src import xla_bridge
+        if xla_bridge.backends_are_initialized():
+            return False
+    except Exception:
+        if getattr(jax, "_src", None) is None:  # pragma: no cover
+            return False
+    if not force and not _tpu_expected():
+        return False
+    cur = os.environ.get("XLA_FLAGS", "")
+    # exact flag-name match: several names here are prefixes of others
+    # (e.g. ...async_collective_fusion vs ..._fuse_all_gather), so a
+    # substring test would let a preset longer flag suppress the base
+    present = {tok.split("=")[0] for tok in cur.split()}
+    missing = [f for f in XLA_OVERLAP_FLAGS
+               if f.split("=")[0] not in present]
+    if not missing:
+        return False
+    os.environ["XLA_FLAGS"] = (cur + " " + " ".join(missing)).strip()
+    return True
+
+
+def _tpu_expected() -> bool:
+    plats = os.environ.get("JAX_PLATFORMS", "") \
+        or os.environ.get("JAX_PLATFORM_NAME", "")
+    if plats:
+        return "tpu" in plats
+    import importlib.util
+    return importlib.util.find_spec("libtpu") is not None

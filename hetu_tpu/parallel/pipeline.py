@@ -45,7 +45,8 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
                     cp_layout: str = "contiguous",
                     cp_impl: str = "ring",
                     unroll: bool = False,
-                    param_manual_specs: Any = None):
+                    param_manual_specs: Any = None,
+                    double_buffer: bool = False):
     """Run ``payload`` microbatches through pp pipeline stages.
 
     ``block_fn(layer_params, x, **extras)`` applies one transformer block
@@ -56,10 +57,23 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
     segment_ids) that travel with the activations through the ring.
     Returns final hidden states (nm, mb, s, E), or ``(h, aux)`` with aux
     of shape (nm,) when blocks carry an aux loss.
+
+    ``double_buffer``: issue the inter-stage ``ppermute`` for the
+    activations produced at tick *t* alongside tick *t+1*'s stage
+    compute instead of on its critical path. The tick body then has NO
+    data dependency between its collective-permute and its block scan,
+    so the scheduler (async collective-permute on TPU) hides the hop
+    behind the stage body. Cost: one extra in-flight payload buffer per
+    stage and a transit latency of 2 ticks per hop — the schedule runs
+    ``nm + 2(pp-1)`` ticks (vs ``nm + pp - 1``), a good trade whenever
+    per-tick permute time is a visible fraction of stage compute and
+    nm >> pp. Microbatch results are bitwise-identical either way (same
+    ops on the same data, only the schedule shifts).
     """
     nm = num_microbatches
     pp = mesh.shape[pp_axis]
-    ticks = nm + pp - 1
+    hop = 2 if double_buffer else 1      # ticks per inter-stage transit
+    ticks = nm + hop * (pp - 1)
     payload = {k: v for k, v in payload.items() if v is not None}
     if block_returns_aux:
         payload["aux"] = jnp.zeros((nm,), jnp.float32)
@@ -120,32 +134,58 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
         zero = jax.tree.map(lambda v: jnp.zeros_like(v[0]), payload_all)
         out_bufs = {k: jnp.zeros_like(payload_all[k]) for k in collect}
         perm = [(i, (i + 1) % pp) for i in range(pp)]
+        drain = hop * (pp - 1)
 
-        def tick(carry, t):
-            cur, out_bufs = carry
+        def feed_at(t):
             # stage 0 ingests microbatch t (clamped during drain)
-            feed = jax.tree.map(
+            return jax.tree.map(
                 lambda v: jax.lax.dynamic_index_in_dim(
                     v, jnp.clip(t, 0, nm - 1), axis=0, keepdims=False),
                 payload_all)
-            cur = jax.tree.map(
-                lambda f, c: jnp.where(stage == 0, f, c), feed, cur)
-            y = stage_fn(cur)
-            # last stage emits microbatch t-(pp-1) (during fill: masked off)
-            slot = jnp.clip(t - (pp - 1), 0, nm - 1)
+
+        def collect_at(y, out_bufs, t):
+            # last stage emits microbatch t - drain (fill: masked off)
+            slot = jnp.clip(t - drain, 0, nm - 1)
             new_bufs = {}
             for key in collect:
                 updated = jax.lax.dynamic_update_index_in_dim(
                     out_bufs[key], y[key].astype(out_bufs[key].dtype),
                     slot, 0)
-                new_bufs[key] = jnp.where(t >= pp - 1, updated,
+                new_bufs[key] = jnp.where(t >= drain, updated,
                                           out_bufs[key])
+            return new_bufs
+
+        def tick(carry, t):
+            cur, out_bufs = carry
+            cur = jax.tree.map(
+                lambda f, c: jnp.where(stage == 0, f, c), feed_at(t), cur)
+            y = stage_fn(cur)
+            new_bufs = collect_at(y, out_bufs, t)
             nxt = jax.tree.map(
                 lambda a: jax.lax.ppermute(a, pp_axis, perm), y)
             return (nxt, new_bufs), None
 
-        (_, out_bufs), _ = jax.lax.scan(
-            tick, (zero, out_bufs), jnp.arange(ticks))
+        def tick_db(carry, t):
+            # double-buffered: permute LAST tick's outputs (inflight)
+            # while THIS tick computes on what arrived two ticks ago
+            # (rx) — the ppermute and the stage body share no data, so
+            # they overlap; y lands in the inflight buffer for the next
+            # tick's permute
+            rx, inflight, out_bufs = carry
+            moved = jax.tree.map(
+                lambda a: jax.lax.ppermute(a, pp_axis, perm), inflight)
+            cur = jax.tree.map(
+                lambda f, c: jnp.where(stage == 0, f, c), feed_at(t), rx)
+            y = stage_fn(cur)
+            new_bufs = collect_at(y, out_bufs, t)
+            return (moved, y, new_bufs), None
+
+        if double_buffer:
+            (_, _, out_bufs), _ = jax.lax.scan(
+                tick_db, (zero, zero, out_bufs), jnp.arange(ticks))
+        else:
+            (_, out_bufs), _ = jax.lax.scan(
+                tick, (zero, out_bufs), jnp.arange(ticks))
         # only the last stage holds real outputs; broadcast over the ring
         return {k: jax.lax.psum(
             jnp.where(stage == pp - 1, v, jnp.zeros([], v.dtype)), pp_axis)
@@ -173,6 +213,14 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
 
     payload_specs = {k: payload_spec(k, v) for k, v in payload.items()}
     out_specs = {k: payload_spec(k, payload[k]) for k in collect}
+
+    # data-plane ledger: one microbatch payload crosses a stage boundary
+    # per tick (analytic, forward pass; the backward mirrors it)
+    from hetu_tpu.parallel.overlap import record_comm_bytes
+    per_tick = sum(v.size // max(nm, 1) * v.dtype.itemsize
+                   for k, v in payload.items() if k != "aux")
+    record_comm_bytes("pp_ppermute", per_tick * ticks,
+                      overlapped=double_buffer)
 
     fn = shard_map(
         device_fn, mesh=mesh,
@@ -327,7 +375,8 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
                 cp_layout=strategy.effective_cp_layout,
                 cp_impl=strategy.cp_impl,
                 unroll=strategy.unroll,
-                param_manual_specs=param_manual_specs)
+                param_manual_specs=param_manual_specs,
+                double_buffer=strategy.pp_overlap)
             aux = jnp.zeros([], jnp.float32)
             if block.returns_aux:
                 h, aux_mb = out

@@ -162,6 +162,10 @@ class ActivationSharding:
                             # residual stream) also shard seq over tp —
                             # GSPMD emits the reduce-scatter/all-gather
                             # pairs Megatron inserts by hand
+    tp_overlap: str = "off"  # "ring": parallel layers decompose their
+                            # AG→matmul / matmul→RS pairs into ppermute
+                            # rings (parallel.overlap) instead of
+                            # relying on GSPMD's serialized collectives
 
     def spec(self, kind: str) -> Optional[P]:
         if kind == "tokens":        # (batch, seq, embed)

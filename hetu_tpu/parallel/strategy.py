@@ -55,6 +55,21 @@ class Strategy:
                                  # code: faster per stage, compile time
                                  # grows with layers; under pp>1 the
                                  # PER-STAGE scan unrolls)
+    tp_overlap: str = "off"      # "ring": decompose the Megatron-SP
+                                 # all-gather→matmul / matmul→reduce-
+                                 # scatter pairs into ppermute rings of
+                                 # chunk matmuls so each comm hop hides
+                                 # behind partial compute
+                                 # (parallel.overlap, ASPLOS'23-style);
+                                 # "off": GSPMD collectives (pair with
+                                 # TrainerConfig.comm_overlap="auto" for
+                                 # XLA's async-collective scheduler)
+    pp_overlap: bool = False     # double-buffer the pipeline ring: the
+                                 # ppermute of tick t's activations is
+                                 # issued alongside tick t+1's stage
+                                 # compute (one extra in-flight buffer
+                                 # and pp-1 extra ticks buy comm that
+                                 # fully hides behind the stage body)
 
     # -- derived -----------------------------------------------------------
     @property
@@ -118,6 +133,8 @@ class Strategy:
             raise ValueError(f"unknown cp_layout {self.cp_layout!r}")
         if self.cp_impl not in ("ring", "ulysses"):
             raise ValueError(f"unknown cp_impl {self.cp_impl!r}")
+        if self.tp_overlap not in ("off", "ring"):
+            raise ValueError(f"unknown tp_overlap {self.tp_overlap!r}")
         if self.pp > 1 and self.num_microbatches % self.pp != 0:
             raise ValueError(
                 f"num_microbatches ({self.num_microbatches}) must be a "

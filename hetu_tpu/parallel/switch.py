@@ -28,8 +28,12 @@ from hetu_tpu.engine.state import TrainState
 
 
 def _state_bytes(state) -> int:
+    """Device bytes the switch actually moves: only ``jax.Array``
+    leaves count — a leaf with ``.nbytes`` that is NOT a device array
+    (numpy host mirrors the prefetcher stages alongside device batches)
+    would double-count state that never crosses the interconnect."""
     return sum(leaf.nbytes for leaf in jax.tree.leaves(state)
-               if hasattr(leaf, "nbytes"))
+               if isinstance(leaf, jax.Array))
 
 
 def switch_strategy(state: TrainState, new_plan) -> TrainState:

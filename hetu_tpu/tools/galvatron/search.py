@@ -33,12 +33,23 @@ from hetu_tpu.tools.galvatron.dp_core import solve_layer_dp
 class Candidate:
     strategy: Strategy
     cost: CostBreakdown
+    measured_step_time: Optional[float] = None   # observed seconds/step
+                                                 # (rerank_by_measured)
+
+    @property
+    def effective_step_time(self) -> float:
+        """What the ranking sorts on: the observed step time when a
+        measurement exists, the analytic estimate otherwise."""
+        return self.measured_step_time if self.measured_step_time \
+            is not None else self.cost.step_time
 
     def __repr__(self):
         c = self.cost
+        meas = "" if self.measured_step_time is None else \
+            f", measured={self.measured_step_time * 1e3:.2f}ms"
         return (f"Candidate({self.strategy.to_json()}, "
                 f"step={c.step_time * 1e3:.2f}ms, "
-                f"mem={c.mem_per_device / 1e9:.1f}GB)")
+                f"mem={c.mem_per_device / 1e9:.1f}GB{meas})")
 
 
 def _factorizations(n: int, dims: ModelDims, max_tp: int = 16,
@@ -92,6 +103,7 @@ def enumerate_candidates(dims: ModelDims, topo: TPUTopology, *,
 
 def search_uniform(dims: ModelDims, topo: TPUTopology, *,
                    mem_budget: Optional[float] = None,
+                   measured_path: Optional[str] = None,
                    **kw) -> list[Candidate]:
     """All feasible candidates, fastest first. ``[0]`` is the pick.
 
@@ -101,7 +113,13 @@ def search_uniform(dims: ModelDims, topo: TPUTopology, *,
     scales). If NO candidate survives the calibrated constraint, the
     search falls back to the uncalibrated analytic model with a warning
     instead of starving the caller — a best-effort plan beats none, and
-    the warning tells the operator which regime they are in."""
+    the warning tells the operator which regime they are in.
+
+    ``measured_path``: a telemetry JSONL (``BENCH_telemetry.jsonl``, a
+    Trainer's ``telemetry.jsonl``) whose ``measured_step`` records carry
+    OBSERVED per-strategy step times — when present, the final ranking
+    is re-ordered by measurement via :func:`rerank_by_measured` (the
+    ROADMAP's "feed measured goodput back into the planner" loop)."""
     budget = mem_budget if mem_budget is not None else topo.hbm_bytes
     cands = [c for c in enumerate_candidates(dims, topo, **kw)
              if c.cost.mem_per_device <= budget]
@@ -119,7 +137,82 @@ def search_uniform(dims: ModelDims, topo: TPUTopology, *,
                 "picked strategy may OOM on real hardware (verify with "
                 "workloads/aot_check.py check_step)", stacklevel=2)
     cands.sort(key=lambda c: c.cost.step_time)
+    if measured_path is None:
+        import os
+        measured_path = os.environ.get("HETU_MEASURED_TELEMETRY")
+    if measured_path:
+        measured = load_measured_step_times(measured_path)
+        if measured:
+            cands = rerank_by_measured(cands, measured)
     return cands
+
+
+def load_measured_step_times(path: str) -> dict[str, float]:
+    """``{strategy-json: observed seconds/step}`` from a telemetry JSONL.
+
+    Consumes ``measured_step`` records (emitted by ``bench.py`` and by
+    ``Trainer.export_telemetry`` — strategy JSON + ``step_time_s``).
+    Later records win (the freshest measurement of a strategy).
+    Missing/unreadable files return ``{}`` — measurement is an overlay,
+    never a requirement."""
+    import json
+    import os
+    out: dict[str, float] = {}
+    if not path or not os.path.exists(path):
+        return out
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") != "measured_step":
+                    continue
+                s, t = rec.get("strategy"), rec.get("step_time_s")
+                if isinstance(s, str) and isinstance(t, (int, float)) \
+                        and t > 0:
+                    # normalize through Strategy so key spelling (field
+                    # order, defaults) can't split identical strategies
+                    try:
+                        s = Strategy.from_json(s).to_json()
+                    except Exception:
+                        pass
+                    out[s] = float(t)
+    except OSError:
+        return {}
+    return out
+
+
+def rerank_by_measured(cands: Sequence[Candidate],
+                       measured: dict[str, float]) -> list[Candidate]:
+    """Re-rank candidates by OBSERVED step time.
+
+    Candidates with a measurement adopt it outright. Unmeasured ones
+    stay comparable by scaling their analytic estimate with the median
+    observed/analytic ratio of the measured set — a one-point
+    calibration of the cost model against reality, so a systematically
+    optimistic (or pessimistic) model cannot bury a measured winner or
+    crown an unmeasured laggard. Returns a NEW sorted list; the inputs
+    are not mutated."""
+    if not measured:
+        return list(cands)
+    ratios = []
+    out = []
+    for c in cands:
+        t = measured.get(c.strategy.to_json())
+        out.append(dataclasses.replace(c, measured_step_time=t))
+        if t is not None and c.cost.step_time > 0:
+            ratios.append(t / c.cost.step_time)
+    ratios.sort()
+    scale = ratios[len(ratios) // 2] if ratios else 1.0
+    out.sort(key=lambda c: c.measured_step_time
+             if c.measured_step_time is not None
+             else c.cost.step_time * scale)
+    return out
 
 
 def search_layerwise(dims: ModelDims, topo: TPUTopology,

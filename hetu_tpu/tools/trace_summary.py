@@ -137,6 +137,75 @@ def control_plane_summary(records: list[dict]) -> Optional[list[str]]:
     return lines
 
 
+#: data-plane counters (comm bytes, overlap share, DP sync rate): the
+#: direct evidence the ring matmuls / delayed grad sync / double-buffered
+#: pipeline are (or are not) killing the collective tax
+#: (docs/PERFORMANCE.md "Data plane").
+_DATA_PLANE_COUNTERS = (
+    "comm_bytes_total", "comm_overlapped_bytes_total",
+    "dp_grad_syncs_total", "optimizer_updates_total",
+)
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TB"
+
+
+def data_plane_summary(records: list[dict]) -> Optional[list[str]]:
+    """Lines for the comm-bytes / overlap / DP-sync section, or None
+    when no snapshot carries data-plane counters. Reads the LAST
+    snapshot (counters are cumulative)."""
+    snap: Optional[dict] = None
+    for rec in records:
+        cand = rec.get("metrics") if rec.get("kind") == "metrics_snapshot" \
+            else rec.get("telemetry")
+        if isinstance(cand, dict) and any(
+                k.split("{")[0] in _DATA_PLANE_COUNTERS for k in cand):
+            snap = cand
+    if snap is None:
+        return None
+    by_kind: dict[str, float] = {}
+    overlapped = 0.0
+    syncs = updates = 0.0
+    for series, v in snap.items():
+        if not isinstance(v, (int, float)):
+            continue
+        base = series.split("{")[0]
+        if base == "comm_bytes_total":
+            kind = "?"
+            if "{" in series and 'kind="' in series:
+                kind = series.split('kind="', 1)[1].split('"', 1)[0]
+            by_kind[kind] = by_kind.get(kind, 0.0) + v
+        elif base == "comm_overlapped_bytes_total":
+            overlapped += v
+        elif base == "dp_grad_syncs_total":
+            syncs += v
+        elif base == "optimizer_updates_total":
+            updates += v
+    lines = []
+    total = sum(by_kind.values())
+    width = max([len(f"comm[{k}]") for k in by_kind] + [16]) + 2
+    # cumulative, not per-step: ring/pipeline bytes accrue per TRACE,
+    # dp_grad_sync bytes per host call (see parallel/overlap.py)
+    for kind in sorted(by_kind, key=lambda k: -by_kind[k]):
+        lines.append(f"comm[{kind}]".ljust(width)
+                     + f"{_fmt_bytes(by_kind[kind])} cumulative")
+    if total:
+        lines.append("overlap ratio".ljust(width)
+                     + f"{100.0 * overlapped / total:.0f}% "
+                     f"of data-plane bytes on overlapping paths")
+    if updates:
+        lines.append("dp syncs/update".ljust(width)
+                     + f"{syncs / updates:.2f} "
+                     f"({int(syncs)} syncs / {int(updates)} updates — "
+                     f"1.00 = fully delayed grad sync)")
+    return lines or None
+
+
 def summarize(path: str, *, wall_s: Optional[float] = None,
               top: int = 10) -> str:
     records = load_records(path)
@@ -149,6 +218,12 @@ def summarize(path: str, *, wall_s: Optional[float] = None,
         parts.append("")
         parts.append("== control plane ==")
         parts.extend(cp)
+
+    dp = data_plane_summary(records)
+    if dp:
+        parts.append("")
+        parts.append("== data plane ==")
+        parts.extend(dp)
 
     rows = span_rollup(records, top=top)
     if rows:

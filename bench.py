@@ -408,10 +408,21 @@ def main():
         # data-plane slice (ISSUE 3): what fraction of collective bytes
         # rode an overlapping path (ring matmul / double-buffered pp),
         # and how many DP grad reductions each optimizer update cost
-        # (1.0 = fully delayed sync; 0.0 here = no grad-accum exercised)
+        # (1.0 = fully delayed sync — the in-scan nm>1 path and the
+        # nm=1 path both sync once; eager accumulation pays nm)
         "comm_overlap_ratio": round(dp_stats["overlap_ratio"], 4),
         "dp_sync_per_step": round(dp_stats["dp_sync_per_step"], 4),
     }
+    # memory-plane slice (ISSUE 4): the ledger's analytic peak for the
+    # measured strategy, plus the backend's own peak allocation where
+    # the runtime exposes it (TPU; CPU returns nothing)
+    from hetu_tpu.engine import memory as _mem
+    mem_stats = _mem.memory_stats()
+    if mem_stats.get("peak_bytes"):
+        result["peak_hbm_bytes"] = int(mem_stats["peak_bytes"])
+    dev_peak = _mem.device_peak_bytes()
+    if dev_peak:
+        result["device_peak_hbm_bytes"] = dev_peak
     if degraded is not None:
         # the sweep winner config failed and the built-ins carried the
         # number — visible so a winner-specific regression gets fixed

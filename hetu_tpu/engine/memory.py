@@ -1,0 +1,271 @@
+"""Memory-plane ledger + remat policy engine.
+
+The step-time/HBM tradeoff has three knobs — parallel degrees, remat
+policy, per-device batch — and until now only the planner's private
+memory formula priced them. This module is the ONE analytic model both
+sides consume:
+
+- the **byte ledger** (:func:`estimate_breakdown`): per-device bytes by
+  class (params / grads / optimizer / activations) for a (model,
+  Strategy) pair, the same arithmetic ``tools.galvatron.cost_model``
+  ranks candidates with (selective activation recomputation factors per
+  Korthikanti et al.; ZeRO shard divisors per Rajbhandari et al. SC'20);
+- the **runtime recorder** (:func:`record_model_memory_plane`): the
+  train step seeds a process-global snapshot + ``mem_*`` telemetry
+  gauges on its first call, so ``trace_summary`` / ``bench.py`` report
+  the memory plane next to the control/data planes — and the Perfetto
+  counter tracks render it over time;
+- the **remat policy engine** (:func:`derive_remat_mask`): given an HBM
+  budget, derive the minimal per-layer recompute mask
+  (``Strategy(remat_mask=...)`` → ``StackedBlocks``) instead of the
+  all-or-nothing per-block switch.
+
+Byte numbers here are ANALYTIC (model-shape arithmetic, optionally
+scaled by the AOT-measured calibration) — the ground-truth companion is
+``jax.local_devices()[0].memory_stats()`` where the backend exposes it
+(``bench.py`` records both).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Optional
+
+from hetu_tpu.parallel.strategy import Strategy
+
+#: activation bytes per (token, hidden) as a multiple of bytes_per_el —
+#: the standard transformer residual accounting by remat policy
+#: (none = every matmul input + attention residuals live to bwd;
+#: selective = flash outputs + checkpointed dots only; full = block
+#: boundaries; offload = streamed to host)
+REMAT_ACT_FACTORS = {"none": 14.0, "selective": 6.0, "full": 2.0,
+                     "offload": 1.0}
+
+#: step-compute multiplier: recompute replays (part of) the forward
+#: during backward — fwd is 1/3 of the 6N fwd+bwd total, selective
+#: replays only attention+norms
+REMAT_COMPUTE_FACTORS = {"none": 1.0, "selective": 1.12,
+                         "full": 4.0 / 3.0, "offload": 4.0 / 3.0}
+
+
+def act_factor(remat: str) -> float:
+    return REMAT_ACT_FACTORS.get(remat, 14.0)
+
+
+def compute_factor(remat: str) -> float:
+    return REMAT_COMPUTE_FACTORS.get(remat, 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryBreakdown:
+    """Per-device bytes by class for one (model dims, Strategy) pair.
+
+    ``act_bytes_per_microbatch`` is UNSCALED (the per-live-microbatch
+    residual footprint); ``act_bytes`` applies schedule liveness
+    (``live_microbatches``, the scan-flush pipeline keeps nm+pp-1 alive)
+    and the measured ``act_scale`` calibration.
+    """
+
+    params_bytes: float
+    grads_bytes: float
+    opt_bytes: float
+    act_bytes_per_microbatch: float
+    live_microbatches: int
+    act_scale: float
+    remat: str
+    remat_recompute_flops: float
+
+    @property
+    def act_bytes(self) -> float:
+        return self.act_bytes_per_microbatch * self.live_microbatches \
+            * self.act_scale
+
+    @property
+    def peak_bytes(self) -> float:
+        return self.params_bytes + self.grads_bytes + self.opt_bytes \
+            + self.act_bytes
+
+    def classes(self) -> dict[str, float]:
+        return {"params": self.params_bytes, "grads": self.grads_bytes,
+                "opt": self.opt_bytes, "act": self.act_bytes}
+
+    def to_record(self) -> dict:
+        return {"kind": "memory_plane", "remat": self.remat,
+                "peak_bytes": self.peak_bytes,
+                "act_bytes_per_microbatch": self.act_bytes_per_microbatch,
+                "live_microbatches": self.live_microbatches,
+                "remat_recompute_flops": self.remat_recompute_flops,
+                **{f"{k}_bytes": v for k, v in self.classes().items()}}
+
+
+def estimate_breakdown(dims, strategy: Strategy, *,
+                       act_scale: float = 1.0) -> MemoryBreakdown:
+    """Analytic per-device memory breakdown (the arithmetic
+    ``cost_model.estimate`` ranks with, split by class).
+
+    ``dims`` is duck-typed on the ``ModelDims`` fields (num_layers,
+    hidden, total_params(), layer_params(), seq_len, global_batch,
+    bytes_per_el, ...).
+    """
+    s = strategy
+    p_shard = dims.total_params() / (s.tp * s.pp * max(s.ep, 1))
+    dp_shard = s.dp if (s.fsdp or s.zero) else 1
+    opt_div = s.dp if s.zero else 1
+    # weights bf16 + fp32 grads; fsdp shards the grad copy over dp
+    # (ZeRO-3 reduce-scattered grads), two fp32 Adam moments under zero
+    params_bytes = p_shard * 2.0
+    grads_bytes = p_shard * (4.0 / dp_shard if s.fsdp else 4.0)
+    opt_bytes = p_shard * 8.0 / opt_div
+
+    b_loc = dims.global_batch / max(s.dp * s.ep, 1)
+    seq_loc = dims.seq_len / s.cp
+    nm = max(s.num_microbatches, 1)
+    layers_per_stage = dims.num_layers / s.pp
+    act_mb = b_loc / nm * seq_loc * dims.hidden * act_factor(s.remat) \
+        * layers_per_stage * dims.bytes_per_el / s.tp
+    # the scan-flush pipeline keeps every microbatch's residuals live
+    # until its backward REGARDLESS of remat (validated against XLA
+    # memory_analysis — see cost_model history); plain accumulation
+    # keeps one
+    live_mb = (nm + s.pp - 1) if s.pp > 1 else 1
+
+    # recompute FLOPs/step/device: the fwd share replayed during bwd
+    tokens_loc = b_loc * dims.seq_len
+    flops_layer = 6.0 * tokens_loc * dims.layer_params()
+    flops_attn = 6.0 * b_loc * dims.seq_len * dims.seq_len \
+        * dims.hidden / 2
+    base_flops = (flops_layer + flops_attn) * layers_per_stage \
+        / (s.tp * s.cp)
+    recompute = (compute_factor(s.remat) - 1.0) * base_flops
+
+    return MemoryBreakdown(
+        params_bytes=params_bytes, grads_bytes=grads_bytes,
+        opt_bytes=opt_bytes, act_bytes_per_microbatch=act_mb,
+        live_microbatches=live_mb, act_scale=act_scale, remat=s.remat,
+        remat_recompute_flops=recompute)
+
+
+def derive_remat_mask(dims, strategy: Strategy, *,
+                      hbm_budget_bytes: float,
+                      act_scale: float = 1.0) -> Optional[tuple]:
+    """Minimal per-layer recompute mask fitting ``hbm_budget_bytes``.
+
+    Returns ``None`` when the strategy fits WITHOUT recompute (uniform
+    ``remat="none"`` is optimal — recompute is never free), else a
+    ``Strategy(remat_mask=...)``-shaped tuple with the smallest number
+    of leading True (rematted) layers that brings the ledger peak under
+    budget. Raises ``ValueError`` when even full recompute does not fit
+    (the planner must change parallel degrees instead). The rematted
+    layers use ``strategy.remat`` when it names a policy, else "full"
+    (matching ``StackedBlocks``' mask semantics).
+    """
+    import dataclasses as _dc
+    none_bd = estimate_breakdown(
+        dims, _dc.replace(strategy, remat="none"), act_scale=act_scale)
+    if none_bd.peak_bytes <= hbm_budget_bytes:
+        return None
+    policy = strategy.remat if strategy.remat != "none" else "full"
+    remat_bd = estimate_breakdown(
+        dims, _dc.replace(strategy, remat=policy), act_scale=act_scale)
+    if remat_bd.peak_bytes > hbm_budget_bytes:
+        raise ValueError(
+            f"over HBM budget even with remat={policy!r} on every "
+            f"layer ({remat_bd.peak_bytes / 1e9:.2f}GB > "
+            f"{hbm_budget_bytes / 1e9:.2f}GB) — change parallel "
+            f"degrees, not remat")
+    n = dims.num_layers
+    # per-layer activation contribution (schedule-scaled), none vs remat
+    layer_none = none_bd.act_bytes / n
+    layer_remat = remat_bd.act_bytes / n
+    fixed = none_bd.params_bytes + none_bd.grads_bytes \
+        + none_bd.opt_bytes
+    # fixed + (n-k)·layer_none + k·layer_remat <= budget
+    import math
+    k = math.ceil((fixed + n * layer_none - hbm_budget_bytes)
+                  / max(layer_none - layer_remat, 1e-9))
+    k = max(1, min(n, k))
+    return tuple(i < k for i in range(n))
+
+
+# -- runtime ledger ----------------------------------------------------------
+#
+# Mirrors parallel.overlap's pattern: a module-level snapshot tests and
+# bench.py read without enabling telemetry, plus mem_* gauges in the
+# registry when it is on. Last-write-wins per class (gauge semantics —
+# the memory plane is a state, not a flow).
+
+_LOCK = threading.Lock()
+_LEDGER: dict[str, float] = {}
+
+
+def record_memory_plane(bd: MemoryBreakdown,
+                        strategy: Optional[Strategy] = None) -> None:
+    """Install ``bd`` as the process's current memory-plane snapshot and
+    mirror it into the ``mem_*`` telemetry gauges."""
+    vals = {f"{k}_bytes": float(v) for k, v in bd.classes().items()}
+    vals["peak_bytes"] = float(bd.peak_bytes)
+    vals["remat_recompute_flops"] = float(bd.remat_recompute_flops)
+    with _LOCK:
+        _LEDGER.update(vals)
+        _LEDGER["remat"] = bd.remat
+        if strategy is not None:
+            _LEDGER["strategy"] = strategy.to_json()
+    from hetu_tpu import telemetry
+    if telemetry.enabled():
+        reg = telemetry.get_registry()
+        for name, help_ in (
+                ("mem_params_bytes", "ledger: param bytes per device"),
+                ("mem_grads_bytes", "ledger: gradient bytes per device"),
+                ("mem_opt_bytes", "ledger: optimizer-state bytes"),
+                ("mem_act_bytes", "ledger: live activation bytes"),
+                ("mem_peak_bytes", "ledger: peak HBM estimate"),
+                ("mem_remat_recompute_flops",
+                 "ledger: recompute FLOPs/step the remat policy costs")):
+            key = name[len("mem_"):]
+            reg.gauge(name, help_).set(vals[key])
+
+
+def record_model_memory_plane(model, strategy: Strategy,
+                              batch: dict) -> Optional[MemoryBreakdown]:
+    """Derive dims from the model config + batch shape and record the
+    breakdown (called once per compiled step, on its first invocation).
+    Returns None for model families without transformer dims."""
+    cfg = getattr(model, "cfg", None)
+    if cfg is None or not hasattr(cfg, "num_layers") \
+            or not hasattr(cfg, "hidden_size"):
+        return None
+    ids = batch.get("input_ids") if hasattr(batch, "get") else None
+    if ids is None or getattr(ids, "ndim", 0) < 2:
+        return None
+    from hetu_tpu.tools.galvatron.cost_model import ModelDims
+    dims = ModelDims.from_config(cfg, seq_len=int(ids.shape[1]),
+                                 global_batch=int(ids.shape[0]))
+    bd = estimate_breakdown(dims, strategy)
+    record_memory_plane(bd, strategy)
+    return bd
+
+
+def memory_stats() -> dict:
+    """Snapshot of the last recorded memory plane ({} before any step)."""
+    with _LOCK:
+        return dict(_LEDGER)
+
+
+def reset_memory_stats() -> None:
+    with _LOCK:
+        _LEDGER.clear()
+
+
+def device_peak_bytes() -> Optional[int]:
+    """Ground truth where available: the backend's own peak allocation
+    (``memory_stats()["peak_bytes_in_use"]`` on TPU; None on CPU)."""
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use")
+    return int(peak) if peak is not None else None

@@ -108,6 +108,7 @@ def _make_plan(model: Module, opt: Transform, strategy: Strategy,
     mesh = strategy.build_mesh(devices)
     rules = strategy.axis_rules()
     param_specs = param_partition_specs(model, rules, mesh=mesh)
+    fsdp_gather_specs = None
     if strategy.fsdp:
         # ZeRO-3 completeness pass: the rule table's "embed"→dp covers the
         # transformer families' big params, but ANY param another model
@@ -118,9 +119,33 @@ def _make_plan(model: Module, opt: Transform, strategy: Strategy,
         from hetu_tpu.parallel.zero import add_axis_to_spec
         shapes = jax.tree.map(lambda ps: ps.shape, model.abstract_specs(),
                               is_leaf=lambda x: isinstance(x, ParamSpec))
-        param_specs = jax.tree.map(
-            lambda spec, shape: add_axis_to_spec(spec, shape, mesh, "dp"),
-            param_specs, shapes, is_leaf=lambda x: isinstance(x, P))
+        # per-layer gather ring (fsdp_overlap="ring"): every block leaf's
+        # dp shard must live on an INNER dim — a shard on the stacked
+        # ``layers`` dim cannot be regathered one layer at a time — so
+        # the completeness pass skips dim 0 for the block subtree. Models
+        # without a stacked block list keep the GSPMD formulation.
+        ring_blocks = (strategy.fsdp_overlap == "ring"
+                       and isinstance(param_specs, dict)
+                       and "blocks" in param_specs)
+
+        def _complete(spec_tree, shape_tree, skip0: bool):
+            return jax.tree.map(
+                lambda spec, shape: add_axis_to_spec(
+                    spec, shape, mesh, "dp",
+                    skip_dims=(0,) if skip0 else ()),
+                spec_tree, shape_tree,
+                is_leaf=lambda x: isinstance(x, P))
+
+        if ring_blocks:
+            param_specs = {
+                k: _complete(v, shapes[k], k == "blocks")
+                for k, v in param_specs.items()}
+            if mesh.shape.get("dp", 1) > 1:
+                from hetu_tpu.parallel.overlap import per_layer_gather_specs
+                fsdp_gather_specs = per_layer_gather_specs(
+                    param_specs["blocks"])
+        else:
+            param_specs = _complete(param_specs, shapes, False)
     params_struct = model.abstract_params()
     opt_struct = jax.eval_shape(opt.init, params_struct)
     opt_specs = opt_state_partition_specs(
@@ -132,7 +157,9 @@ def _make_plan(model: Module, opt: Transform, strategy: Strategy,
         batch=("dp", "ep") if strategy.ep > 1 else "dp",
         seq="cp", tp="tp", cp_layout=strategy.effective_cp_layout,
         cp_impl=strategy.cp_impl, sp=strategy.sp,
-        tp_overlap=strategy.tp_overlap)
+        tp_overlap=strategy.tp_overlap,
+        fsdp_overlap=strategy.fsdp_overlap if strategy.fsdp else "off",
+        fsdp_specs=fsdp_gather_specs)
     return TrainPlan(strategy, mesh, param_specs, state_specs,
                      named_shardings(mesh, state_specs), act)
 
@@ -235,7 +262,14 @@ class CachedStep:
             key = _batch_key(batch)
             exe = self.aot.get(key)
             if exe is not None:
+                # the AOT executable bypasses step_fn, and with it the
+                # host-side data/memory-plane accounting — invoke the
+                # hook build_train_step attached (None for pipeline /
+                # hetero step fns, which do their own accounting)
+                hook = getattr(self.step_fn, "on_execute", None)
                 if key in self._aot_ok:
+                    if hook is not None:
+                        hook(batch)
                     return exe(state, batch)
                 try:
                     out = exe(state, batch)
@@ -246,6 +280,8 @@ class CachedStep:
                     self.aot.pop(key, None)
                 else:
                     self._aot_ok.add(key)
+                    if hook is not None:
+                        hook(batch)
                     return out
         return self.step_fn(state, batch)
 
@@ -464,6 +500,88 @@ def default_loss_fn(model: Module, strategy: Strategy,
     return loss_fn
 
 
+def build_local_grad_fn(base_loss, mesh: Mesh, ndp: int) -> Callable:
+    """Per-dp-group ``(loss, grads)`` with a leading dp dim and ZERO
+    cross-dp traffic: a partial-manual ``shard_map`` over dp — each
+    group differentiates its local batch shard against the full
+    (dp-replicated) params; tp/cp collectives stay GSPMD-auto exactly
+    as in the pipeline executor's manual region. Shared by the
+    split-phase path (``build_grad_accum_steps(delay_grad_sync=True)``)
+    and the in-scan path (``Strategy(delay_grad_sync=True)`` with
+    ``num_microbatches > 1``). Returns ``local_grads(params, batch,
+    key)``; the key-vs-keyless shard_map variant is picked at trace
+    time from ``key is None``."""
+    from hetu_tpu.parallel.sharding import ManualAxes, no_act_sharding
+
+    def local_grads(params, batch, key):
+        def body(params, batch_l, gid, *key_arg):
+            def lloss(p):
+                k = None
+                if key_arg:
+                    # decorrelate dp groups via the explicit group-id
+                    # operand (axis_index would lower to PartitionId,
+                    # which SPMD partitioning of the auto axes rejects)
+                    k = jax.random.fold_in(key_arg[0], gid[0])
+                with no_act_sharding(), \
+                        ManualAxes(mesh, frozenset({"dp"})):
+                    if k is not None:
+                        return base_loss(p, batch_l, dropout_key=k)
+                    return base_loss(p, batch_l)
+
+            loss, g = jax.value_and_grad(lloss)(params)
+            return loss.reshape(1), jax.tree.map(lambda v: v[None], g)
+
+        in_b = {k: P("dp") for k in batch}
+        in_p = jax.tree.map(lambda _: P(), params)
+        gids = jnp.arange(ndp, dtype=jnp.int32)
+        out_g = jax.tree.map(lambda _: P("dp"), params)
+        if key is None:
+            f = shard_map(lambda p, b, g: body(p, b, g), mesh=mesh,
+                          in_specs=(in_p, in_b, P("dp")),
+                          out_specs=(P("dp"), out_g),
+                          axis_names={"dp"}, check_vma=False)
+            losses, grads = f(params, batch, gids)
+        else:
+            f = shard_map(body, mesh=mesh,
+                          in_specs=(in_p, in_b, P("dp"), P()),
+                          out_specs=(P("dp"), out_g),
+                          axis_names={"dp"}, check_vma=False)
+            losses, grads = f(params, batch, gids, key)
+        # scalarizing the per-group loss vector moves 4·dp bytes — a
+        # metric read, not a gradient sync
+        return jnp.mean(losses), grads
+
+    return local_grads
+
+
+def _fsdp_gspmd_gather_bytes(model: Module, param_specs, ndp: int, *,
+                             skip_blocks: bool) -> int:
+    """Analytic payload of the monolithic GSPMD param all-gather: every
+    dp-sharded leaf's (ndp-1)/ndp remote share. With the per-layer ring
+    active (``skip_blocks``) the block subtree gathers on the ring and
+    only the remaining leaves (embeddings, LM head, final norm) stay on
+    the serialized GSPMD path — they must still be accounted, or the
+    overlap ratio overstates the ring's coverage."""
+    from hetu_tpu.parallel.overlap import _dp_dim
+    abstract = model.abstract_params()
+    if skip_blocks and isinstance(param_specs, dict):
+        param_specs = {k: v for k, v in param_specs.items()
+                       if k != "blocks"}
+        abstract = {k: v for k, v in abstract.items() if k != "blocks"}
+    spec_leaves = jax.tree.leaves(param_specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+    leaves = jax.tree.leaves(abstract)
+    if len(spec_leaves) != len(leaves):
+        return 0
+    total = 0
+    for leaf, spec in zip(leaves, spec_leaves):
+        if _dp_dim(spec) is None:
+            continue
+        size = functools.reduce(lambda a, b: a * int(b), leaf.shape, 1)
+        total += size * leaf.dtype.itemsize * (ndp - 1) // ndp
+    return total
+
+
 def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
                      loss_fn: Optional[Callable] = None,
                      attn_impl: str = "auto",
@@ -472,9 +590,22 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
 
     pp>1 routes through the pipeline executor
     (``hetu_tpu.parallel.pipeline.build_pipeline_train_step``).
+
+    ``Strategy(delay_grad_sync=True)`` with ``num_microbatches > 1``
+    moves the DP gradient reduction OUT of the accumulation ``lax.scan``:
+    microbatch grads stay dp-group-local (leading dp-sharded accumulator
+    dim, grads computed in a partial-manual ``shard_map`` over dp) and
+    ONE reduction fires per optimizer update instead of one per
+    microbatch — the in-jit twin of
+    ``build_grad_accum_steps(delay_grad_sync=True)``, counter-audited by
+    ``dp_grad_syncs_total`` / ``optimizer_updates_total``.
     """
     from hetu_tpu import telemetry
     strategy = plan.strategy
+    if strategy.delay_grad_sync and strategy.pp > 1:
+        raise ValueError(
+            "delay_grad_sync=True is unsupported with pp > 1 — the "
+            "pipeline executor owns its own microbatch schedule")
     if strategy.pp > 1:
         if loss_fn is not None:
             raise ValueError(
@@ -519,9 +650,42 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
             return base_loss(params, batch)
 
     grad_fn = jax.value_and_grad(compute_loss)
+    ndp = plan.mesh.shape.get("dp", 1)
+    if strategy.delay_grad_sync and strategy.fsdp:
+        raise ValueError(
+            "delay_grad_sync=True is incompatible with fsdp: params are "
+            "dp-sharded, so group-local gradients would require the "
+            "param all-gather the delay is meant to avoid")
+    if strategy.delay_grad_sync and strategy.ep > 1:
+        raise ValueError(
+            "delay_grad_sync=True is incompatible with ep > 1 (the "
+            "batch dim is sharded over dp×ep)")
+    delayed = strategy.delay_grad_sync and ndp > 1 and nm > 1
+    if delayed:
+        # group-local grads need the RAW loss fn (no GSPMD activation
+        # constraints inside the manual-dp region)
+        local_grad_fn = build_local_grad_fn(base_loss, plan.mesh, ndp)
+        acc_specs = jax.tree.map(
+            lambda s: P("dp", *tuple(s)), plan.state_specs.params,
+            is_leaf=lambda x: isinstance(x, P))
+        acc_shardings = named_shardings(plan.mesh, acc_specs)
+
+    from hetu_tpu.parallel import overlap as _overlap
+    fsdp_gspmd_bytes = 0
+    if strategy.fsdp and ndp > 1:
+        # GSPMD gather accounting (serialized): ALL dp-sharded leaves on
+        # the fallback path; with the per-block ring active, just the
+        # non-block leaves (embeddings/head) — the ring path accounts
+        # its per-block gathers itself, as overlapped
+        fsdp_gspmd_bytes = _fsdp_gspmd_gather_bytes(
+            model, plan.param_specs, ndp,
+            skip_blocks=getattr(plan.act, "fsdp_specs", None) is not None)
 
     def step(state: TrainState, batch: dict):
         record_trace("train_step")   # runs at trace time only
+        if fsdp_gspmd_bytes:         # trace-time, like the ring kernels
+            _overlap.record_comm_bytes("fsdp_gather", fsdp_gspmd_bytes,
+                                       overlapped=False)
         # deterministic per-step key: resume-at-step-N reproduces masks
         key = step_dropout_key(state.step) if thread_dropout else None
         if nm > 1:
@@ -529,22 +693,55 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
                 lambda x: x.reshape((nm, x.shape[0] // nm) + x.shape[1:]),
                 batch)
 
-            def body(acc, xs):
-                mb, i = xs
-                mb_key = None if key is None else jax.random.fold_in(key, i)
-                loss, grads = grad_fn(state.params, mb, mb_key)
-                acc_loss, acc_g = acc
-                return (acc_loss + loss,
-                        jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
-                                     acc_g, grads)), None
+            if delayed:
+                # leading dp-sharded dim: each dp group accumulates its
+                # OWN grads — no cross-dp traffic inside the scan
+                def body(acc, xs):
+                    mb, i = xs
+                    mb_key = None if key is None \
+                        else jax.random.fold_in(key, i)
+                    loss, grads = local_grad_fn(state.params, mb, mb_key)
+                    acc_loss, acc_g = acc
+                    return (acc_loss + loss,
+                            jax.tree.map(
+                                lambda a, g: a + g.astype(jnp.float32),
+                                acc_g, grads)), None
 
-            zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            (loss, grads), _ = jax.lax.scan(
-                body, (jnp.zeros([], jnp.float32), zeros),
-                (mbs, jnp.arange(nm)))
-            loss = loss / nm
-            grads = jax.tree.map(lambda g: g / nm, grads)
+                zeros = jax.lax.with_sharding_constraint(
+                    jax.tree.map(
+                        lambda p: jnp.zeros((ndp,) + p.shape,
+                                            jnp.float32), state.params),
+                    acc_shardings)
+                (loss, acc_g), _ = jax.lax.scan(
+                    body, (jnp.zeros([], jnp.float32), zeros),
+                    (mbs, jnp.arange(nm)))
+                loss = loss / nm
+                # THE one DP gradient reduction of the whole update:
+                # summing the leading (dp-sharded) dim down to the
+                # synced grad — under ZeRO it becomes the
+                # reduce-scatter → update → all-gather triplet, once
+                grads = jax.tree.map(
+                    lambda g: jnp.sum(g, axis=0) / (ndp * nm), acc_g)
+            else:
+                def body(acc, xs):
+                    mb, i = xs
+                    mb_key = None if key is None \
+                        else jax.random.fold_in(key, i)
+                    loss, grads = grad_fn(state.params, mb, mb_key)
+                    acc_loss, acc_g = acc
+                    return (acc_loss + loss,
+                            jax.tree.map(
+                                lambda a, g: a + g.astype(jnp.float32),
+                                acc_g, grads)), None
+
+                zeros = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32),
+                    state.params)
+                (loss, grads), _ = jax.lax.scan(
+                    body, (jnp.zeros([], jnp.float32), zeros),
+                    (mbs, jnp.arange(nm)))
+                loss = loss / nm
+                grads = jax.tree.map(lambda g: g / nm, grads)
         else:
             loss, grads = grad_fn(state.params, batch, key)
 
@@ -554,10 +751,45 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
         metrics = {"loss": loss, "grad_norm": gnorm}
         return TrainState(state.step + 1, new_params, new_opt), metrics
 
-    return jax.jit(
+    jitted = jax.jit(
         step,
         out_shardings=(plan.state_shardings, None),
         donate_argnums=(0,) if donate else ())
+
+    # host-side data-plane accounting (exact per call, mirroring
+    # build_grad_accum_steps): the jitted path issues one DP grad
+    # reduction per microbatch when eager, exactly one per update when
+    # delayed (or when nm == 1 — nothing to delay). First call also
+    # seeds the memory-plane ledger from the model config + batch shape.
+    syncs_per_call = 0 if ndp <= 1 else (1 if (nm == 1 or delayed) else nm)
+    grad_bytes = 4 * int(sum(
+        functools.reduce(lambda a, b: a * b, l.shape, 1)
+        for l in jax.tree.leaves(model.abstract_params())))
+    seeded = []
+
+    def _host_account(batch):
+        if not seeded:
+            seeded.append(True)
+            try:
+                from hetu_tpu.engine.memory import record_model_memory_plane
+                record_model_memory_plane(model, strategy, batch)
+            except Exception:   # ledger is observability, never fatal
+                pass
+        if syncs_per_call:
+            _overlap.record_dp_sync(syncs_per_call, grad_bytes=grad_bytes)
+        _overlap.record_optimizer_update(1)
+
+    def step_call(state, batch):
+        _host_account(batch)
+        return jitted(state, batch)
+
+    # AOT lowering (engine.precompile) goes through .lower on the entry;
+    # AOT EXECUTION bypasses step_call (CachedStep dispatches the
+    # executable directly), so the accounting hook rides along for
+    # CachedStep.__call__ to invoke on that path
+    step_call.lower = jitted.lower
+    step_call.on_execute = _host_account
+    return step_call
 
 
 def build_eval_step(model: Module, plan: TrainPlan, *,
@@ -716,51 +948,10 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
         return jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
                             acc, grads), loss
 
-    def _local_grads(params, batch, key):
-        """Per-dp-group (loss, grads) with a leading dp dim and ZERO
-        cross-dp traffic: a partial-manual ``shard_map`` over dp — each
-        group differentiates its local batch shard against the full
-        (dp-replicated) params; tp/cp collectives stay GSPMD-auto
-        exactly as in the pipeline executor's manual region."""
-        from hetu_tpu.parallel.sharding import ManualAxes, no_act_sharding
-        mesh = plan.mesh
-
-        def body(params, batch_l, gid, *key_arg):
-            def lloss(p):
-                k = None
-                if key_arg:
-                    # decorrelate dp groups via the explicit group-id
-                    # operand (axis_index would lower to PartitionId,
-                    # which SPMD partitioning of the auto axes rejects)
-                    k = jax.random.fold_in(key_arg[0], gid[0])
-                with no_act_sharding(), \
-                        ManualAxes(mesh, frozenset({"dp"})):
-                    if k is not None:
-                        return base_loss(p, batch_l, dropout_key=k)
-                    return base_loss(p, batch_l)
-
-            loss, g = jax.value_and_grad(lloss)(params)
-            return loss.reshape(1), jax.tree.map(lambda v: v[None], g)
-
-        in_b = {k: P("dp") for k in batch}
-        in_p = jax.tree.map(lambda _: P(), params)
-        gids = jnp.arange(ndp, dtype=jnp.int32)
-        out_g = jax.tree.map(lambda _: P("dp"), params)
-        if key is None:
-            f = shard_map(lambda p, b, g: body(p, b, g), mesh=mesh,
-                          in_specs=(in_p, in_b, P("dp")),
-                          out_specs=(P("dp"), out_g),
-                          axis_names={"dp"}, check_vma=False)
-            losses, grads = f(params, batch, gids)
-        else:
-            f = shard_map(body, mesh=mesh,
-                          in_specs=(in_p, in_b, P("dp"), P()),
-                          out_specs=(P("dp"), out_g),
-                          axis_names={"dp"}, check_vma=False)
-            losses, grads = f(params, batch, gids, key)
-        # scalarizing the per-group loss vector moves 4·dp bytes — a
-        # metric read, not a gradient sync
-        return jnp.mean(losses), grads
+    # shared with the in-scan path (Strategy(delay_grad_sync=True)):
+    # partial-manual shard_map over dp, group-local grads, leading dp dim
+    _local_grads = build_local_grad_fn(base_loss, plan.mesh, ndp) \
+        if delayed else None
 
     # delayed acc buffers ((ndp, ...) leaves) can never alias the
     # update's outputs — donating them only buys a warning per compile

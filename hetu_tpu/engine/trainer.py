@@ -449,6 +449,11 @@ class Trainer:
                         tokens_total=tokens_total)
                     history.append(rec)
                     t_last, tokens_since = now, 0
+                    if tel:
+                        # sample the mem_*/comm_* registry series into
+                        # Perfetto counter tracks on the log cadence
+                        self.tracer.record_counters(
+                            self.registry.snapshot())
                 # step dispatch + the log boundary's blocking fetch: the
                 # productive slice of this iteration — UNLESS the step
                 # body re-traced, in which case the wall went to

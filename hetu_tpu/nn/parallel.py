@@ -89,7 +89,8 @@ class ColumnParallelLinear(Module):
         w = params["weight"].astype(dt)
         if _ring_overlap_active(self.overlap):
             from hetu_tpu.parallel.overlap import (
-                ring_ag_matmul, ring_column_applicable,
+                maybe_record_column_fallback, ring_ag_matmul,
+                ring_column_applicable,
             )
             ctx = current_act_sharding()
             if ring_column_applicable(ctx, x.shape, w.shape):
@@ -97,6 +98,7 @@ class ColumnParallelLinear(Module):
                 y = ring_ag_matmul(x, w, b, ctx=ctx,
                                    out_kind=self.out_kind)
                 return act_constrain(y, self.out_kind)
+            maybe_record_column_fallback(ctx, x.shape, w.shape)
         y = jnp.matmul(x, w)
         if self.use_bias:
             y = y + params["bias"].astype(dt)
@@ -131,7 +133,8 @@ class RowParallelLinear(Module):
         w = params["weight"].astype(dt)
         if _ring_overlap_active(self.overlap):
             from hetu_tpu.parallel.overlap import (
-                ring_matmul_rs, ring_row_applicable,
+                maybe_record_row_fallback, ring_matmul_rs,
+                ring_row_applicable,
             )
             ctx = current_act_sharding()
             if ring_row_applicable(ctx, x.shape, w.shape):
@@ -142,6 +145,7 @@ class RowParallelLinear(Module):
                 if self.use_bias:
                     y = y + params["bias"].astype(dt)
                 return y
+            maybe_record_row_fallback(ctx, x.shape, w.shape)
         y = jnp.matmul(x, w)
         y = act_constrain(y, "tokens")
         if self.use_bias:
@@ -533,6 +537,20 @@ class StackedBlocks(Module):
                                    **kwargs)
             return self._block(layer_params, h, **kwargs)
 
+        # per-layer ZeRO-3 gather ring (Strategy(fsdp_overlap="ring")):
+        # block params arrive dp-sharded on inner dims and each layer is
+        # gathered explicitly — block k+1's gather prefetched under
+        # block k's compute — instead of GSPMD's monolithic all-gather
+        ctx = current_act_sharding()
+        if (ctx is not None
+                and getattr(ctx, "fsdp_overlap", "off") == "ring"
+                and getattr(ctx, "fsdp_specs", None) is not None
+                and ctx.mesh.shape.get("dp", 1) > 1):
+            return self._fsdp_ring_scan(
+                params, x, ctx, remat=remat, remat_mask=remat_mask,
+                unroll=unroll, n_layers=n_layers, layer_keys=layer_keys,
+                call_block=call_block)
+
         if self._block.returns_aux:
             def body(carry, xs):
                 layer_params, xs_key = xs
@@ -583,6 +601,118 @@ class StackedBlocks(Module):
             return x, aux
         x, _ = jax.lax.scan(body, x, (params, layer_keys), unroll=unroll_n)
         return x
+
+    def _fsdp_ring_scan(self, params, x, ctx, *, remat, remat_mask,
+                        unroll, n_layers, layer_keys, call_block):
+        """ZeRO-3 per-block execution: every layer's dp-sharded params
+        ring-gather (``parallel.overlap.ring_gather_block_params``)
+        instead of riding one monolithic GSPMD all-gather.
+
+        Two scan shapes, chosen per remat mode:
+
+        - no remat → **prefetch-by-one**: the gathered params of layer
+          *k* ride the scan carry while layer *k+1*'s gather is issued at
+          the top of the body — the ring hops share no data with the
+          block matmuls, so the scheduler overlaps them (ZeRO SC'20 §5.3
+          prefetch);
+        - remat → **gather inside the checkpointed region**: the saved
+          residuals are the 1/ndp local shards, so the backward
+          REGATHERS each block instead of pinning full replicated layer
+          params (prefetch-by-one would make the gathered carry a saved
+          checkpoint input, defeating ZeRO-3's memory point).
+        """
+        from hetu_tpu.parallel.overlap import (
+            record_fsdp_gather_bytes, ring_gather_block_params,
+        )
+        mesh, specs = ctx.mesh, ctx.fsdp_specs
+        ndp = mesh.shape["dp"]
+        # analytic trace-time accounting: stacked leaf sizes already
+        # cover every layer, and the ring is an overlapping path.
+        # Rematted layers gather TWICE per step (the backward regathers
+        # inside the checkpointed region) — scale their share.
+        if remat_mask is not None:
+            n_regather = sum(bool(f) for f in remat_mask)
+        elif remat != "none":
+            n_regather = n_layers
+        else:
+            n_regather = 0
+        record_fsdp_gather_bytes(
+            params, specs, ndp,
+            n_layers=(n_layers + n_regather) / n_layers, overlapped=True)
+
+        def gather(layer_params):
+            return ring_gather_block_params(layer_params, specs,
+                                            mesh=mesh)
+
+        aux_mode = self._block.returns_aux
+
+        def compute(g_params, carry, xs_key):
+            if aux_mode:
+                h, aux = carry
+                h2, a = call_block(g_params, h, xs_key)
+                return (h2, aux + a)
+            return call_block(g_params, carry, xs_key)
+
+        def seg_prefetch(carry, lo, hi):
+            g0 = gather(jax.tree.map(lambda p: p[lo], params))
+            idxs = jnp.arange(lo, hi)
+            keys = None if layer_keys is None else layer_keys[lo:hi]
+
+            def body(c, xs):
+                i, xs_key = xs
+                inner, g_cur = c
+                # issue layer i+1's gather BEFORE layer i's compute —
+                # the two share no data, XLA overlaps them (the last
+                # iteration regathers hi-1; its result is discarded)
+                nxt = jnp.minimum(i + 1, hi - 1)
+                p_next = jax.tree.map(
+                    lambda p: jax.lax.dynamic_index_in_dim(
+                        p, nxt, 0, keepdims=False), params)
+                g_next = gather(p_next)
+                return (compute(g_cur, inner, xs_key), g_next), None
+
+            (carry, _), _ = jax.lax.scan(
+                body, (carry, g0), (idxs, keys),
+                unroll=(hi - lo) if unroll else 1)
+            return carry
+
+        def seg_remat(carry, lo, hi, policy_name):
+            seg = jax.tree.map(lambda p: p[lo:hi], params)
+            keys = None if layer_keys is None else layer_keys[lo:hi]
+
+            def body(c, xs):
+                lp, xs_key = xs
+                return compute(gather(lp), c, xs_key), None
+
+            b = jax.checkpoint(body, policy=remat_policy(policy_name),
+                               prevent_cse=False)
+            carry, _ = jax.lax.scan(
+                b, carry, (seg, keys),
+                unroll=(hi - lo) if unroll else 1)
+            return carry
+
+        carry = (x, jnp.zeros([], jnp.float32)) if aux_mode else x
+        if remat_mask is not None:
+            if len(remat_mask) != n_layers:
+                raise ValueError(
+                    f"remat_mask has {len(remat_mask)} entries for "
+                    f"{n_layers} layers")
+            policy_name = remat if remat != "none" else "full"
+            runs = []
+            start = 0
+            for i in range(1, n_layers + 1):
+                if i == n_layers \
+                        or bool(remat_mask[i]) != bool(remat_mask[start]):
+                    runs.append((start, i, bool(remat_mask[start])))
+                    start = i
+            for lo, hi, flag in runs:
+                carry = seg_remat(carry, lo, hi, policy_name) if flag \
+                    else seg_prefetch(carry, lo, hi)
+        elif remat != "none":
+            carry = seg_remat(carry, 0, n_layers, remat)
+        else:
+            carry = seg_prefetch(carry, 0, n_layers)
+        return carry
 
     def decode(self, params, x, caches, **kwargs):
         """Incremental decoding: scan layers threading per-layer KV caches

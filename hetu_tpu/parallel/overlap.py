@@ -58,6 +58,8 @@ _LOCK = threading.Lock()
 _BYTES: dict[str, int] = {}          # kind -> analytic payload bytes
 _OVERLAPPED_BYTES: dict[str, int] = {}   # subset moved on overlap paths
 _DP_SYNCS = {"syncs": 0, "updates": 0}
+_RING_FALLBACKS: dict[str, int] = {}     # site -> dense-fallback count
+_WARNED_FALLBACK_SITES: set = set()
 
 
 def record_comm_bytes(kind: str, nbytes: int, *,
@@ -114,6 +116,35 @@ def record_optimizer_update(n: int = 1) -> None:
             "optimizer updates applied (grad-accum apply steps)").inc(n)
 
 
+def record_ring_fallback(site: str, detail: str = "") -> None:
+    """Count (and warn ONCE per site about) a ring matmul that silently
+    degraded to the dense/GSPMD path on shapes the ring cannot split —
+    the operator asked for overlap and is not getting it, which used to
+    be invisible (ISSUE 4 satellite). Audited by the
+    ``tp_ring_fallback_total`` counter; divisible-dim tests assert 0."""
+    with _LOCK:
+        _RING_FALLBACKS[site] = _RING_FALLBACKS.get(site, 0) + 1
+        first = site not in _WARNED_FALLBACK_SITES
+        _WARNED_FALLBACK_SITES.add(site)
+    from hetu_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.get_registry().counter(
+            "tp_ring_fallback_total",
+            "ring collective matmuls that fell back to the dense path "
+            "on non-divisible dims").inc(site=site)
+    if first:
+        import warnings
+        warnings.warn(
+            f"tp_overlap='ring' fell back to the serialized GSPMD path "
+            f"at {site}: {detail} (warned once per site; counted in "
+            f"tp_ring_fallback_total)", stacklevel=3)
+
+
+def ring_fallbacks() -> dict[str, int]:
+    with _LOCK:
+        return dict(_RING_FALLBACKS)
+
+
 def comm_stats() -> dict:
     """Ledger snapshot: bytes by kind, overlap ratio, DP sync rate.
 
@@ -123,17 +154,21 @@ def comm_stats() -> dict:
     unambiguous."""
     with _LOCK:
         by_kind = dict(_BYTES)
-        overlapped = sum(_OVERLAPPED_BYTES.values())
+        overlapped_by_kind = dict(_OVERLAPPED_BYTES)
+        overlapped = sum(overlapped_by_kind.values())
         syncs, updates = _DP_SYNCS["syncs"], _DP_SYNCS["updates"]
+        fallbacks = sum(_RING_FALLBACKS.values())
     total = sum(by_kind.values())
     return {
         "bytes_by_kind": by_kind,
         "bytes_total": total,
         "bytes_overlapped": overlapped,
+        "bytes_overlapped_by_kind": overlapped_by_kind,
         "overlap_ratio": overlapped / total if total else 0.0,
         "dp_syncs": syncs,
         "optimizer_updates": updates,
         "dp_sync_per_step": syncs / updates if updates else 0.0,
+        "tp_ring_fallbacks": fallbacks,
     }
 
 
@@ -143,6 +178,8 @@ def reset_comm_stats() -> None:
         _OVERLAPPED_BYTES.clear()
         _DP_SYNCS["syncs"] = 0
         _DP_SYNCS["updates"] = 0
+        _RING_FALLBACKS.clear()
+        _WARNED_FALLBACK_SITES.clear()
 
 
 # -- ring collective matmuls -------------------------------------------------
@@ -182,6 +219,33 @@ def ring_row_applicable(ctx, x_shape, w_shape) -> bool:
             return False
         s_local //= cp
     return s_local % ntp == 0 and x_shape[2] % ntp == 0
+
+
+def maybe_record_column_fallback(ctx, x_shape, w_shape) -> None:
+    """Classify a failed column-ring applicability check: with sp on and
+    tp>1 on a 3-D input, the ONLY reason the ring is skipped is a
+    non-divisible dim — that degradation is counted and warned (a
+    missing sp / tp=1 / manual region is a legitimate fall-through,
+    not a fallback)."""
+    ntp = _tp_degree(ctx)
+    if ntp <= 1 or ctx is None or not ctx.sp or len(x_shape) != 3:
+        return
+    record_ring_fallback(
+        "column_ag_matmul",
+        f"x{tuple(x_shape)} @ w{tuple(w_shape)} needs seq % "
+        f"(cp*tp) == 0 and w.shape[1] % tp == 0 at tp={ntp}")
+
+
+def maybe_record_row_fallback(ctx, x_shape, w_shape) -> None:
+    """Row-ring twin of :func:`maybe_record_column_fallback`: tp>1 on a
+    3-D input means only divisibility can have failed."""
+    ntp = _tp_degree(ctx)
+    if ntp <= 1 or len(x_shape) != 3:
+        return
+    record_ring_fallback(
+        "row_matmul_rs",
+        f"x{tuple(x_shape)} @ w{tuple(w_shape)} needs local seq and "
+        f"contraction dims divisible by tp={ntp}")
 
 
 def ring_ag_matmul(x, w, bias=None, *, ctx, out_kind: str = "hidden"):
@@ -286,6 +350,169 @@ def ring_matmul_rs(x, w, *, ctx):
     fn = shard_map(body, mesh=mesh, in_specs=(in_x, in_w),
                    out_specs=out, check_vma=False)
     return fn(x, w)
+
+
+# -- per-layer ZeRO-3 parameter gather ring ----------------------------------
+#
+# The fsdp fallback is one monolithic GSPMD all-gather of every dp-sharded
+# param where it is first consumed; the memory-plane formulation (ZeRO
+# SC'20 §5.3 prefetch, ROADMAP "per-layer gather formulation") gathers ONE
+# block's params at a time, driven from the model's stacked block list
+# (``nn.StackedBlocks``), so block k+1's gather rides the ring while block
+# k computes. The gather itself is a tp-style ppermute ring (the PR 3
+# machinery extended to the parameter axis): ndp-1 hops, each moving one
+# 1/ndp param shard, every hop free of data dependencies on the block
+# matmuls the scheduler interleaves it with.
+
+def per_layer_gather_specs(stacked_specs):
+    """Per-layer gather specs from the STACKED block param specs: drop the
+    leading ``layers`` dim entry; leaves whose remaining spec carries no
+    ``dp`` component come back as ``P()`` (pass-through — nothing to
+    gather). ``make_plan`` stores the result on the ActivationSharding
+    context for ``StackedBlocks`` to consume."""
+    def per_layer(spec: P) -> P:
+        parts = list(spec)[1:]
+        while parts and parts[-1] is None:
+            parts.pop()
+        if any(p == "dp" or (isinstance(p, tuple) and "dp" in p)
+               for p in parts):
+            return P(*parts)
+        return P()
+
+    import jax
+    return jax.tree.map(per_layer, stacked_specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _dp_dim(spec: P):
+    for i, p in enumerate(spec):
+        if p == "dp" or (isinstance(p, (tuple, list)) and "dp" in p):
+            return i
+    return None
+
+
+def _strip_dp(spec: P) -> P:
+    parts = []
+    for p in spec:
+        if p == "dp":
+            parts.append(None)
+        elif isinstance(p, (tuple, list)) and "dp" in p:
+            rest = tuple(a for a in p if a != "dp")
+            parts.append(rest[0] if len(rest) == 1 else (rest or None))
+        else:
+            parts.append(p)
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def ring_gather_block_params(params, specs, *, mesh):
+    """All-gather ONE block's dp-sharded param leaves via a ppermute ring.
+
+    ``params``: one layer's param pytree (inside the layer scan);
+    ``specs``: matching pytree of per-layer PartitionSpecs
+    (:func:`per_layer_gather_specs`) — leaves with a ``dp`` component
+    ring-gather, ``P()`` leaves pass through untouched. The ring is a
+    fully-manual ``shard_map`` (every mesh axis bound, tp shards ring
+    over dp independently) so the hops lower to async collective-permutes
+    a latency-hiding scheduler can slide under block compute.
+
+    Backward: gathering is the identity on values — the registered VJP
+    re-constrains each cotangent to the dp-sharded layout, which is
+    exactly ZeRO-3's reduce-scattered gradient (the cross-dp sum is
+    produced upstream where GSPMD resolves the replicated cotangent), so
+    no gradient bytes ride the ring twice.
+    """
+    ndp = mesh.shape.get("dp", 1)
+    leaves = jax.tree.leaves(params)
+    spec_leaves = jax.tree.leaves(specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+    if len(leaves) != len(spec_leaves):
+        raise ValueError(
+            f"fsdp gather specs do not match block params "
+            f"({len(spec_leaves)} specs vs {len(leaves)} leaves)")
+    ring_idx = [i for i, s in enumerate(spec_leaves)
+                if _dp_dim(s) is not None]
+    if ndp <= 1 or not ring_idx:
+        return params
+    ring_specs = [spec_leaves[i] for i in ring_idx]
+    dims = [_dp_dim(s) for s in ring_specs]
+    out_specs = tuple(_strip_dp(s) for s in ring_specs)
+    # receive-from-right: after k hops a device holds the shard that
+    # started on dp rank (r + k) % ndp (same orientation as the tp rings)
+    perm = [(i, (i - 1) % ndp) for i in range(ndp)]
+
+    def ring_body(*locs):
+        r = jax.lax.axis_index("dp")
+        outs = []
+        for pl, d in zip(locs, dims):
+            chunk = pl.shape[d]
+            full = list(pl.shape)
+            full[d] = chunk * ndp
+            out = jnp.zeros(tuple(full), pl.dtype)
+            cur = pl
+            for k in range(ndp):
+                # the ppermute moving shard k+1 and the update placing
+                # shard k only READ `cur` — no dependency, XLA overlaps
+                src = (r + k) % ndp
+                out = jax.lax.dynamic_update_slice_in_dim(
+                    out, cur, src * chunk, d)
+                if k + 1 < ndp:
+                    cur = jax.lax.ppermute(cur, "dp", perm)
+            outs.append(out)
+        return tuple(outs)
+
+    sm = shard_map(ring_body, mesh=mesh,
+                   in_specs=tuple(ring_specs), out_specs=out_specs,
+                   check_vma=False)
+
+    @jax.custom_vjp
+    def gathered(*locs):
+        return sm(*locs)
+
+    def _fwd(*locs):
+        return sm(*locs), None
+
+    def _bwd(_, cts):
+        from jax.sharding import NamedSharding
+        return tuple(
+            jax.lax.with_sharding_constraint(ct, NamedSharding(mesh, s))
+            for ct, s in zip(cts, ring_specs))
+
+    gathered.defvjp(_fwd, _bwd)
+    out = gathered(*[leaves[i] for i in ring_idx])
+    merged = list(leaves)
+    for i, g in zip(ring_idx, out):
+        merged[i] = g
+    return jax.tree.unflatten(jax.tree.structure(params), merged)
+
+
+def record_fsdp_gather_bytes(params, specs, ndp: int, *,
+                             n_layers: float = 1.0,
+                             overlapped: bool = True) -> None:
+    """Analytic byte accounting for the fsdp param gathers of one traced
+    step: each device receives (ndp-1)/ndp of every dp-sharded leaf.
+    Pass the STACKED block tree with ``n_layers=1`` (leaf sizes already
+    include the layer dim) or a single layer's tree with the stack
+    depth; fractional multipliers account regather-in-backward layers
+    (gathered twice per step under remat)."""
+    if ndp <= 1:
+        return
+    leaves = jax.tree.leaves(params)
+    spec_leaves = jax.tree.leaves(specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+    if len(leaves) != len(spec_leaves):
+        return
+    nbytes = 0
+    for leaf, spec in zip(leaves, spec_leaves):
+        if _dp_dim(spec) is None:
+            continue
+        size = 1
+        for d in leaf.shape:
+            size *= int(d)
+        nbytes += size * leaf.dtype.itemsize * (ndp - 1) // ndp
+    record_comm_bytes("fsdp_gather", int(nbytes * n_layers),
+                      overlapped=overlapped)
 
 
 # -- XLA scheduler fallback --------------------------------------------------

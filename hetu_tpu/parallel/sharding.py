@@ -166,6 +166,15 @@ class ActivationSharding:
                             # AG→matmul / matmul→RS pairs into ppermute
                             # rings (parallel.overlap) instead of
                             # relying on GSPMD's serialized collectives
+    fsdp_overlap: str = "off"  # "ring": StackedBlocks gathers each
+                            # block's dp-sharded params via the ppermute
+                            # ring (parallel.overlap.ring_gather_block_
+                            # params), prefetching block k+1's gather
+                            # under block k's compute
+    fsdp_specs: Any = None  # per-layer PartitionSpec pytree for the
+                            # block params (parallel.overlap.
+                            # per_layer_gather_specs output); None =
+                            # no per-layer gather (GSPMD fallback)
 
     def spec(self, kind: str) -> Optional[P]:
         if kind == "tokens":        # (batch, seq, embed)

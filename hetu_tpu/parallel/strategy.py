@@ -70,6 +70,24 @@ class Strategy:
                                  # compute (one extra in-flight buffer
                                  # and pp-1 extra ticks buy comm that
                                  # fully hides behind the stage body)
+    fsdp_overlap: str = "off"    # "ring": reformulate the ZeRO-3 param
+                                 # all-gather as PER-BLOCK ppermute-ring
+                                 # gathers driven from the model's block
+                                 # structure — block k+1's gather
+                                 # overlaps block k's compute
+                                 # (parallel.overlap.ring_gather_block_
+                                 # params); "off": one monolithic GSPMD
+                                 # all-gather (always the fallback for
+                                 # models without a stacked block list)
+    delay_grad_sync: bool = False  # in-jit grad accumulation
+                                 # (num_microbatches>1, pp=1): keep
+                                 # per-microbatch grads dp-group-local
+                                 # in the lax.scan (leading dp-sharded
+                                 # accumulator dim) and reduce ONCE per
+                                 # optimizer update instead of once per
+                                 # microbatch — the scan-path twin of
+                                 # build_grad_accum_steps(
+                                 # delay_grad_sync=True)
 
     # -- derived -----------------------------------------------------------
     @property
@@ -135,6 +153,17 @@ class Strategy:
             raise ValueError(f"unknown cp_impl {self.cp_impl!r}")
         if self.tp_overlap not in ("off", "ring"):
             raise ValueError(f"unknown tp_overlap {self.tp_overlap!r}")
+        if self.fsdp_overlap not in ("off", "ring"):
+            raise ValueError(f"unknown fsdp_overlap {self.fsdp_overlap!r}")
+        if self.delay_grad_sync and self.fsdp:
+            raise ValueError(
+                "delay_grad_sync=True is incompatible with fsdp: params "
+                "are dp-sharded, so group-local gradients would require "
+                "the param all-gather the delay is meant to avoid")
+        if self.delay_grad_sync and self.ep > 1:
+            raise ValueError(
+                "delay_grad_sync=True is incompatible with ep > 1 (the "
+                "batch dim is sharded over dp×ep)")
         if self.pp > 1 and self.num_microbatches % self.pp != 0:
             raise ValueError(
                 f"num_microbatches ({self.num_microbatches}) must be a "

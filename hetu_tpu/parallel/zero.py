@@ -17,10 +17,13 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def add_axis_to_spec(spec: P, shape, mesh: Mesh, axis: str) -> P:
+def add_axis_to_spec(spec: P, shape, mesh: Mesh, axis: str,
+                     skip_dims: tuple = ()) -> P:
     """Shard ``axis`` onto the first unsharded dim it divides; no-op if none
     fits or the axis has degree 1 (mirrors the reference's
-    ``states_can_be_split`` validity rule)."""
+    ``states_can_be_split`` validity rule). ``skip_dims``: dim indices the
+    axis must not land on (the stacked ``layers`` dim of block params when
+    the per-layer fsdp gather ring needs every shard on an inner dim)."""
     if mesh.shape.get(axis, 1) <= 1:
         return spec
     size = mesh.shape[axis]
@@ -30,6 +33,8 @@ def add_axis_to_spec(spec: P, shape, mesh: Mesh, axis: str) -> P:
         if part == axis or (isinstance(part, tuple) and axis in part):
             return spec
     for i, (part, dim) in enumerate(zip(parts, shape)):
+        if i in skip_dims:
+            continue
         if part is None and dim % size == 0:
             parts[i] = axis
             while parts and parts[-1] is None:

@@ -36,7 +36,9 @@ from hetu_tpu.telemetry.goodput import (
 from hetu_tpu.telemetry.metrics import (
     Counter, Gauge, Histogram, MetricRegistry, percentile,
 )
-from hetu_tpu.telemetry.spans import NULL_SPAN, SpanEvent, Tracer
+from hetu_tpu.telemetry.spans import (
+    DEFAULT_COUNTER_TRACK_PREFIXES, NULL_SPAN, SpanEvent, Tracer,
+)
 
 _TRACER = Tracer(enabled=False)
 _REGISTRY = MetricRegistry(enabled=False)
@@ -90,6 +92,9 @@ def export_dir(path: str, *, extra_records=(),
     os.makedirs(path, exist_ok=True)
     trace_path = os.path.join(path, "trace.json")
     jsonl_path = os.path.join(path, "telemetry.jsonl")
+    # final counter-track sample so every exported trace carries at
+    # least one point per mem_*/comm_* series (Perfetto counter tracks)
+    tracer.record_counters(registry.snapshot())
     tracer.export_chrome(trace_path)
     with open(jsonl_path, "w") as f:
         for rec in tracer.records():
@@ -104,6 +109,7 @@ def export_dir(path: str, *, extra_records=(),
 
 __all__ = [
     "Tracer", "SpanEvent", "NULL_SPAN",
+    "DEFAULT_COUNTER_TRACK_PREFIXES",
     "MetricRegistry", "Counter", "Gauge", "Histogram", "percentile",
     "GoodputAccountant", "GoodputReport", "CATEGORIES",
     "model_flops_per_token", "format_goodput_table",

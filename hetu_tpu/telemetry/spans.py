@@ -64,6 +64,16 @@ class _NullSpan:
 
 NULL_SPAN = _NullSpan()
 
+#: registry series sampled into Perfetto counter tracks by default: the
+#: memory-plane gauges, the data-plane byte/sync counters and the
+#: control-plane cache counters — the series an operator scrubs against
+#: the span timeline (everything else stays snapshot-only to keep traces
+#: small).
+DEFAULT_COUNTER_TRACK_PREFIXES = (
+    "mem_", "comm_", "dp_grad_syncs_total", "optimizer_updates_total",
+    "step_cache_", "tp_ring_fallback_total", "data_stall_seconds",
+)
+
 
 class _Span:
     """Live span handle; records a SpanEvent on exit."""
@@ -111,6 +121,7 @@ class Tracer:
         self.epoch_unix = time.time()
         self.dropped = 0
         self._events: list[SpanEvent] = []
+        self._counters: list[tuple] = []   # (name, ts_s, value) samples
         self._lock = threading.Lock()
         self._local = threading.local()
 
@@ -141,6 +152,48 @@ class Tracer:
         """Zero-duration marker event."""
         self.complete(name, 0.0, cat=cat, **attrs)
 
+    def counter(self, name: str, value: float,
+                ts_s: Optional[float] = None) -> None:
+        """One sample of a counter track (Perfetto ``ph: "C"``): the
+        time series a metric-registry gauge/counter traces out. Bounded
+        by ``max_events`` like spans (over-limit samples count as
+        dropped)."""
+        if not self.enabled:
+            return
+        ts = time.perf_counter() - self.epoch if ts_s is None else ts_s
+        with self._lock:
+            if len(self._counters) >= self.max_events:
+                self.dropped += 1
+                return
+            self._counters.append((name, ts, float(value)))
+
+    def record_counters(self, snapshot: dict, *,
+                        prefixes=DEFAULT_COUNTER_TRACK_PREFIXES,
+                        ts_s: Optional[float] = None) -> int:
+        """Sample every numeric series of a registry snapshot whose base
+        name matches ``prefixes`` (None = all numeric series) into
+        counter tracks; returns how many samples were taken. Called on
+        the Trainer's log cadence so the memory-ledger gauges and the
+        data-plane byte counters render as scrubbed tracks next to the
+        span timeline."""
+        if not self.enabled:
+            return 0
+        n = 0
+        for series, v in snapshot.items():
+            if not isinstance(v, (int, float)):
+                continue          # histogram summaries stay snapshot-only
+            if prefixes is not None:
+                base = series.split("{")[0]
+                if not any(base.startswith(p) for p in prefixes):
+                    continue
+            self.counter(series, v, ts_s=ts_s)
+            n += 1
+        return n
+
+    def counter_samples(self) -> list[tuple]:
+        with self._lock:
+            return list(self._counters)
+
     def _record(self, ev: SpanEvent) -> None:
         with self._lock:
             if len(self._events) >= self.max_events:
@@ -156,6 +209,7 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._counters.clear()
             self.dropped = 0
         self.epoch = time.perf_counter()
         self.epoch_unix = time.time()
@@ -179,6 +233,14 @@ class Tracer:
                 "dur": max(round(ev.dur_s * 1e6, 3), 0.001),
                 "pid": pid, "tid": ev.tid,
                 "args": {k: v for k, v in ev.attrs.items()},
+            })
+        # counter tracks (ph "C"): one Perfetto track per sampled series
+        # — the memory-ledger gauges / data-plane counters over time
+        for name, ts, value in self.counter_samples():
+            trace_events.append({
+                "name": name, "cat": "counter", "ph": "C",
+                "ts": round(ts * 1e6, 3), "pid": pid,
+                "args": {"value": value},
             })
         # thread-name metadata rows so Perfetto labels the tracks
         meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,

@@ -24,6 +24,7 @@ import json
 import os
 from typing import Optional
 
+from hetu_tpu.engine.memory import compute_factor, estimate_breakdown
 from hetu_tpu.parallel.strategy import Strategy
 
 # Default location of the measured calibration written by
@@ -206,10 +207,9 @@ def estimate(dims: ModelDims, strategy: Strategy,
     flops_dev = (flops_layer + flops_attn) * layers_per_stage \
         / (s.tp * s.cp)
     # remat recomputes forward work during bwd: fwd share is 1/3 of 6N
-    # (full = whole block fwd again; selective ≈ attention+norms only)
-    remat_factor = {"none": 1.0, "selective": 1.12, "full": 4.0 / 3.0,
-                    "offload": 4.0 / 3.0}.get(s.remat, 1.0)
-    flops_dev *= remat_factor
+    # (full = whole block fwd again; selective ≈ attention+norms only) —
+    # factors shared with the runtime ledger (engine.memory)
+    flops_dev *= compute_factor(s.remat)
     # embedding + lm head on the last/first stage
     flops_head = 6.0 * tokens_loc * dims.vocab * h / (s.tp * s.cp)
     t_compute = (flops_dev + flops_head) \
@@ -240,27 +240,15 @@ def estimate(dims: ModelDims, strategy: Strategy,
     step = (t_compute + t_tp + t_cp) * bubble + t_dp
 
     # ---- memory -----------------------------------------------------------
-    p_shard = dims.total_params() / (s.tp * s.pp * max(s.ep, 1))
-    dp_shard = s.dp if (s.fsdp or s.zero) else 1
-    # weights bf16 + fp32 master-ish grads + two fp32 Adam moments
-    opt_div = s.dp if s.zero else 1
-    mem_params = p_shard * (2 + 4 / dp_shard if s.fsdp else 6)
-    mem_opt = p_shard * 8 / opt_div
-    act_factor = {"none": 14.0, "selective": 6.0, "full": 2.0,
-                  "offload": 1.0}.get(s.remat, 14.0)
-    mem_act_mb = b_loc / nm * seq_loc * h * act_factor \
-        * layers_per_stage * dims.bytes_per_el / s.tp
-    # the scan-flush pipeline keeps every microbatch's residuals live
-    # until its backward, REGARDLESS of remat (remat shrinks the per-mb
-    # residual footprint — the act_factor above — not the schedule's
-    # liveness; validated against XLA memory_analysis, which REFUSES
-    # pp4-none at GPT-2-small scale while the old remat-gated formula
-    # predicted 1 GiB). Plain grad accumulation keeps one microbatch.
-    live_mb = (nm + s.pp - 1) if s.pp > 1 else 1
-    mem_act = mem_act_mb * live_mb * topo.act_scale(s.remat)
-    mem = mem_params + mem_opt + mem_act
+    # one formula for planner and runtime: the memory-plane ledger
+    # (engine.memory.estimate_breakdown) — weights + (ZeRO-sharded)
+    # grads/moments, per-remat activation factors, scan-flush liveness
+    # (nm+pp-1 live microbatches under pp — validated against XLA
+    # memory_analysis), scaled by the AOT-measured calibration.
+    bd = estimate_breakdown(dims, s, act_scale=topo.act_scale(s.remat))
 
     return CostBreakdown(step, t_compute * bubble, t_tp * bubble,
-                         t_cp * bubble, t_dp, bubble, mem,
-                         mem_params=mem_params, mem_opt=mem_opt,
-                         mem_act_per_microbatch=mem_act_mb)
+                         t_cp * bubble, t_dp, bubble, bd.peak_bytes,
+                         mem_params=bd.params_bytes + bd.grads_bytes,
+                         mem_opt=bd.opt_bytes,
+                         mem_act_per_microbatch=bd.act_bytes_per_microbatch)

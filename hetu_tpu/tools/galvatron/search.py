@@ -103,9 +103,18 @@ def enumerate_candidates(dims: ModelDims, topo: TPUTopology, *,
 
 def search_uniform(dims: ModelDims, topo: TPUTopology, *,
                    mem_budget: Optional[float] = None,
+                   hbm_budget_bytes: Optional[float] = None,
                    measured_path: Optional[str] = None,
                    **kw) -> list[Candidate]:
     """All feasible candidates, fastest first. ``[0]`` is the pick.
+
+    ``hbm_budget_bytes``: explicit per-device HBM ceiling (the memory
+    plane's knob — same meaning as ``mem_budget``, named for operators).
+    Passing it also widens the default remat sweep to
+    ``("none", "selective", "full")`` so the search prices recompute
+    (``engine.memory.REMAT_COMPUTE_FACTORS`` via the cost model) jointly
+    with parallel degrees instead of treating remat as an afterthought;
+    over-budget candidates are REJECTED, not penalized.
 
     The memory constraint uses the AOT-measured activation scales when
     a calibration is loaded (``mem_calibration.json`` — conservative:
@@ -120,6 +129,9 @@ def search_uniform(dims: ModelDims, topo: TPUTopology, *,
     OBSERVED per-strategy step times — when present, the final ranking
     is re-ordered by measurement via :func:`rerank_by_measured` (the
     ROADMAP's "feed measured goodput back into the planner" loop)."""
+    if hbm_budget_bytes is not None:
+        mem_budget = hbm_budget_bytes
+        kw.setdefault("remats", ("none", "selective", "full"))
     budget = mem_budget if mem_budget is not None else topo.hbm_bytes
     cands = [c for c in enumerate_candidates(dims, topo, **kw)
              if c.cost.mem_per_device <= budget]

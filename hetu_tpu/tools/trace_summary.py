@@ -206,6 +206,70 @@ def data_plane_summary(records: list[dict]) -> Optional[list[str]]:
     return lines or None
 
 
+#: memory-plane gauges (engine.memory ledger): peak bytes by class, the
+#: recompute tax the remat policy pays, and the fsdp gather accounting —
+#: the direct evidence the per-layer gather ring / remat policy engine
+#: are (or are not) killing the memory tax (docs/PERFORMANCE.md
+#: "Memory plane").
+_MEMORY_PLANE_GAUGES = (
+    "mem_params_bytes", "mem_grads_bytes", "mem_opt_bytes",
+    "mem_act_bytes", "mem_peak_bytes", "mem_remat_recompute_flops",
+)
+
+
+def memory_plane_summary(records: list[dict]) -> Optional[list[str]]:
+    """Lines for the memory-ledger section, or None when no snapshot
+    carries ``mem_*`` gauges. Reads the LAST snapshot (gauges are
+    last-write-wins); the fsdp gather split comes from the data-plane
+    byte counters in the same snapshot."""
+    snap: Optional[dict] = None
+    for rec in records:
+        cand = rec.get("metrics") if rec.get("kind") == "metrics_snapshot" \
+            else rec.get("telemetry")
+        if isinstance(cand, dict) and any(
+                k.split("{")[0] in _MEMORY_PLANE_GAUGES for k in cand):
+            snap = cand
+    if snap is None:
+        return None
+    vals: dict[str, float] = {}
+    fsdp_bytes = fsdp_overlapped = 0.0
+    for series, v in snap.items():
+        if not isinstance(v, (int, float)):
+            continue
+        base = series.split("{")[0]
+        if base in _MEMORY_PLANE_GAUGES:
+            vals[base] = v
+        elif base == "comm_bytes_total" and 'kind="fsdp_gather"' in series:
+            fsdp_bytes += v
+        elif base == "comm_overlapped_bytes_total" \
+                and 'kind="fsdp_gather"' in series:
+            fsdp_overlapped += v
+    if not vals:
+        return None
+    lines = []
+    width = 18
+    if vals.get("mem_peak_bytes"):
+        lines.append("peak (ledger)".ljust(width)
+                     + f"{_fmt_bytes(vals['mem_peak_bytes'])} per device")
+    for label, key in (("params", "mem_params_bytes"),
+                       ("grads", "mem_grads_bytes"),
+                       ("optimizer", "mem_opt_bytes"),
+                       ("activations", "mem_act_bytes")):
+        if key in vals:
+            lines.append(f"  {label}".ljust(width)
+                         + _fmt_bytes(vals[key]))
+    rf = vals.get("mem_remat_recompute_flops", 0.0)
+    if rf:
+        lines.append("remat recompute".ljust(width)
+                     + f"{rf / 1e12:.2f} TFLOP/step replayed in bwd")
+    if fsdp_bytes:
+        lines.append("fsdp gathers".ljust(width)
+                     + f"{_fmt_bytes(fsdp_bytes)} cumulative "
+                     f"({100.0 * fsdp_overlapped / fsdp_bytes:.0f}% on "
+                     f"the per-block overlap ring)")
+    return lines
+
+
 def summarize(path: str, *, wall_s: Optional[float] = None,
               top: int = 10) -> str:
     records = load_records(path)
@@ -224,6 +288,12 @@ def summarize(path: str, *, wall_s: Optional[float] = None,
         parts.append("")
         parts.append("== data plane ==")
         parts.extend(dp)
+
+    mp = memory_plane_summary(records)
+    if mp:
+        parts.append("")
+        parts.append("== memory plane ==")
+        parts.extend(mp)
 
     rows = span_rollup(records, top=top)
     if rows:

@@ -223,6 +223,34 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.quick)
 
 
+# -- quick-tier time-budget audit -------------------------------------------
+# The quick tier is the builder's inner loop AND the driver's tier-1
+# gate: a new test landing without a `slow` marker that takes minutes
+# silently rots the loop for everyone. Budget chosen WELL above the
+# slowest legitimate quick test (53s solo / 92s under full-suite load
+# on the 8-CPU mesh) so only genuine misplacements trip; override with
+# HETU_QUICK_TIER_BUDGET_S (0 = off).
+QUICK_TIER_BUDGET_S = float(
+    os.environ.get("HETU_QUICK_TIER_BUDGET_S", "180"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if (QUICK_TIER_BUDGET_S > 0 and rep.when == "call" and rep.passed
+            and "slow" not in item.keywords
+            and call.duration > QUICK_TIER_BUDGET_S):
+        rep.outcome = "failed"
+        rep.longrepr = (
+            f"{item.nodeid} PASSED but took {call.duration:.1f}s — over "
+            f"the {QUICK_TIER_BUDGET_S:.0f}s quick-tier budget. Mark it "
+            f"slow (add it to SLOW_TESTS in tests/conftest.py or use "
+            f"@pytest.mark.slow) so it runs in the full tier only, or "
+            f"raise HETU_QUICK_TIER_BUDGET_S if this machine is "
+            f"legitimately slow.")
+
+
 @pytest.fixture
 def rng():
     return jax.random.key(0)

@@ -1,0 +1,428 @@
+"""Memory plane (ISSUE 4): per-layer ZeRO-3 gather rings, in-scan
+delayed grad sync, and the remat policy engine + byte ledger.
+
+Parity discipline mirrors test_overlap.py: memory-plane mechanisms must
+be numerically TRANSPARENT. The gather ring moves bits without
+arithmetic and at degree-2 meshes every cross-device reduction is a
+two-term sum, so fsdp ring-vs-GSPMD losses assert bitwise; the in-scan
+delayed sync re-associates the per-microbatch mean (group means vs
+global mean), so it asserts tight allclose.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hetu_tpu import optim, telemetry
+from hetu_tpu.engine import memory as mem
+from hetu_tpu.engine.train_step import (
+    build_train_step, init_state, make_plan,
+)
+from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from hetu_tpu.parallel import overlap as ov
+from hetu_tpu.parallel.strategy import Strategy
+from hetu_tpu.tools.galvatron import ModelDims, TPUTopology, search_uniform
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledgers():
+    ov.reset_comm_stats()
+    mem.reset_memory_stats()
+    yield
+    ov.reset_comm_stats()
+    mem.reset_memory_stats()
+
+
+CFG = GPTConfig.tiny()
+B, S = 8, 32
+
+
+def _run(model, strategy, steps=2, collect_state=False):
+    opt = optim.adamw(1e-3)
+    plan = make_plan(model, opt, strategy)
+    step = build_train_step(model, opt, plan, donate=False)
+    state = init_state(model, opt, plan, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (B, S + 1), 0,
+                             CFG.vocab_size)
+    sb = plan.shard_batch({"input_ids": ids[:, :-1],
+                           "labels": ids[:, 1:]})
+    losses = []
+    for _ in range(steps):
+        state, m = step(state, sb)
+        losses.append(float(jax.device_get(m["loss"])))
+    if collect_state:
+        return losses, jax.device_get(state.params)
+    return losses
+
+
+# -- in-scan delayed grad sync ----------------------------------------------
+
+def test_in_scan_delayed_sync_counter_parity():
+    """ACCEPTANCE: the nm>1 jitted scan with delay_grad_sync=True
+    performs exactly ONE DP reduction per optimizer update (counters:
+    eager = nm per step, delayed = 1), with losses/params matching the
+    eager path (allclose: group-mean vs global-mean re-association)."""
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        model = GPTLMHeadModel(CFG)
+        le, pe = _run(model, Strategy(dp=2, num_microbatches=2),
+                      collect_state=True)
+        se = ov.comm_stats()
+        assert se["dp_syncs"] == 4          # nm=2 × 2 steps
+        assert se["optimizer_updates"] == 2
+        assert se["dp_sync_per_step"] == 2.0
+        ov.reset_comm_stats()
+        ld, pd = _run(model, Strategy(dp=2, num_microbatches=2,
+                                      delay_grad_sync=True),
+                      collect_state=True)
+        sd = ov.comm_stats()
+        assert sd["dp_syncs"] == 2          # one per update
+        assert sd["optimizer_updates"] == 2
+        assert sd["dp_sync_per_step"] == 1.0
+        reg = telemetry.get_registry()
+        assert reg.counter("dp_grad_syncs_total").value() == 6
+        assert reg.counter("optimizer_updates_total").value() == 4
+        np.testing.assert_allclose(le, ld, rtol=0, atol=2e-5)
+        for a, b in zip(jax.tree.leaves(pe), jax.tree.leaves(pd)):
+            np.testing.assert_allclose(a, b, rtol=0, atol=1e-5)
+    finally:
+        telemetry.reset()
+        telemetry.enable(False)
+
+
+def test_in_scan_delay_rejections():
+    model = GPTLMHeadModel(CFG)
+    opt = optim.adamw(1e-3)
+    with pytest.raises(ValueError, match="fsdp"):
+        build_train_step(model, opt, make_plan(
+            model, opt, Strategy(dp=2, fsdp=True, delay_grad_sync=True)))
+    with pytest.raises(ValueError, match="pp > 1"):
+        build_train_step(model, opt, make_plan(
+            model, opt, Strategy(pp=2, num_microbatches=2,
+                                 delay_grad_sync=True)))
+    with pytest.raises(ValueError, match="fsdp"):
+        Strategy(dp=2, fsdp=True, delay_grad_sync=True).validate()
+    with pytest.raises(ValueError, match="fsdp_overlap"):
+        Strategy(fsdp_overlap="prefetch").validate()
+    s = Strategy(dp=2, fsdp=True, fsdp_overlap="ring",
+                 delay_grad_sync=False)
+    assert Strategy.from_json(s.to_json()) == s
+
+
+def test_aot_executable_records_host_accounting():
+    """An AOT executable dispatched by CachedStep bypasses the jitted
+    wrapper — the on_execute hook must still record the dp-sync /
+    optimizer-update counters and seed the memory ledger (the exact
+    runs engine.precompile optimizes would otherwise go dark)."""
+    from hetu_tpu.engine.train_step import (
+        _batch_key, abstract_batch, abstract_train_state,
+        compile_strategy,
+    )
+    model = GPTLMHeadModel(CFG)
+    opt = optim.adamw(1e-3)
+    entry = compile_strategy(model, opt, Strategy(dp=2),
+                             build_eval=False)
+    state_sds = abstract_train_state(model, opt, entry.plan)
+    batch_sds = abstract_batch(entry.plan, (B, S))
+    entry.aot[_batch_key(batch_sds)] = \
+        entry.step_fn.lower(state_sds, batch_sds).compile()
+    state = init_state(model, opt, entry.plan, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (B, S + 1), 0,
+                             CFG.vocab_size)
+    sb = entry.plan.shard_batch({"input_ids": ids[:, :-1],
+                                 "labels": ids[:, 1:]})
+    ov.reset_comm_stats()
+    mem.reset_memory_stats()
+    state, _ = entry(state, sb)         # AOT fast path
+    s = ov.comm_stats()
+    assert s["optimizer_updates"] == 1
+    assert s["dp_syncs"] == 1
+    assert mem.memory_stats().get("peak_bytes", 0) > 0
+    state, _ = entry(state, sb)         # proven-callable fast path
+    assert ov.comm_stats()["optimizer_updates"] == 2
+
+
+# -- per-layer ZeRO-3 gather ring -------------------------------------------
+
+def test_ring_gather_block_params_unit(rng):
+    """The gather ring is the identity on values: gathered leaves equal
+    the ungathered originals bitwise, pass-through leaves are untouched,
+    and the VJP hands back the (dp-shard-constrained) cotangent — the
+    reduce-scattered ZeRO-3 gradient."""
+    from hetu_tpu.parallel.overlap import (
+        per_layer_gather_specs, ring_gather_block_params,
+    )
+    mesh = Strategy(dp=2, tp=2).build_mesh()
+    w = jax.random.normal(rng, (8, 16), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (6,), jnp.float32)
+    params = {"w": jax.device_put(w, NamedSharding(mesh, P("dp", "tp"))),
+              "b": jax.device_put(b, NamedSharding(mesh, P()))}
+    specs = {"w": P("dp", "tp"), "b": P()}
+
+    @jax.jit
+    def f(p):
+        return ring_gather_block_params(p, specs, mesh=mesh)
+
+    out = f(params)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(w))
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.asarray(b))
+    stats = ov.comm_stats()
+    # recording happens in StackedBlocks, not the raw ring — no bytes yet
+    assert "fsdp_gather" not in stats["bytes_by_kind"]
+
+    @jax.jit
+    def g(p):
+        o = ring_gather_block_params(p, specs, mesh=mesh)
+        return (o["w"] * 2.0).sum() + o["b"].sum()
+
+    grads = jax.grad(g)(params)
+    np.testing.assert_array_equal(np.asarray(grads["w"]),
+                                  np.full((8, 16), 2.0, np.float32))
+
+    # stacked spec -> per-layer gather spec derivation
+    derived = per_layer_gather_specs(
+        {"w": P(None, "dp", "tp"), "ln": P("pp"), "b": P(None, "dp")})
+    assert derived == {"w": P("dp", "tp"), "ln": P(), "b": P("dp")}
+
+
+@pytest.mark.slow
+def test_fsdp_ring_gather_parity_bitwise():
+    """ACCEPTANCE: Strategy(fsdp_overlap="ring") per-block gathers give
+    bitwise-identical losses to the monolithic GSPMD fallback at
+    degree-2 meshes, and the byte ledger books the gathers as
+    overlapped (where the GSPMD path books them serialized)."""
+    model = GPTLMHeadModel(CFG)
+    base = _run(model, Strategy(dp=2, fsdp=True), steps=3)
+    sg = ov.comm_stats()
+    assert sg["bytes_by_kind"].get("fsdp_gather", 0) > 0
+    assert sg["bytes_overlapped_by_kind"].get("fsdp_gather", 0) == 0
+    ov.reset_comm_stats()
+    ring = _run(model, Strategy(dp=2, fsdp=True, fsdp_overlap="ring"),
+                steps=3)
+    sr = ov.comm_stats()
+    assert base == ring, f"fsdp ring changed numerics: {base} vs {ring}"
+    got = sr["bytes_by_kind"].get("fsdp_gather", 0)
+    over = sr["bytes_overlapped_by_kind"].get("fsdp_gather", 0)
+    # block gathers ride the ring (overlapped); the non-block leaves
+    # (wte/wpe/ln_f, dp-sharded by the completeness pass) stay on the
+    # serialized GSPMD path and must still be accounted
+    assert 0 < over < got
+    # the block subtree dominates gpt-tiny's dp-sharded bytes
+    assert over > (got - over)
+
+
+@pytest.mark.slow
+def test_fsdp_ring_with_tp_and_remat_parity():
+    """The ring composes with tp (dp=2 × tp=2 mesh: tp shards ring over
+    dp independently) and with remat — the checkpointed path regathers
+    in backward; losses stay bitwise at degree 2."""
+    model = GPTLMHeadModel(CFG)
+    base = _run(model, Strategy(dp=2, tp=2, fsdp=True), steps=3)
+    ring = _run(model, Strategy(dp=2, tp=2, fsdp=True,
+                                fsdp_overlap="ring"), steps=3)
+    assert base == ring, f"{base} vs {ring}"
+    base_r = _run(model, Strategy(dp=2, fsdp=True, remat="full"), steps=3)
+    ring_r = _run(model, Strategy(dp=2, fsdp=True, fsdp_overlap="ring",
+                                  remat="full"), steps=3)
+    assert base_r == ring_r, f"{base_r} vs {ring_r}"
+    ring_m = _run(model, Strategy(dp=2, fsdp=True, fsdp_overlap="ring",
+                                  remat_mask=(True, False)), steps=3)
+    np.testing.assert_allclose(base_r, ring_m, rtol=0, atol=1e-6)
+
+
+# -- remat policy engine + memory ledger ------------------------------------
+
+def test_remat_policy_parity_and_ledger_seeding():
+    """Selective remat keeps the loss bitwise-identical to remat="none"
+    on gpt-tiny while the ledger (seeded by the step's first call)
+    records strictly fewer activation bytes."""
+    model = GPTLMHeadModel(CFG)
+    ln = _run(model, Strategy(), steps=2)
+    ms_none = mem.memory_stats()
+    assert ms_none.get("peak_bytes", 0) > 0
+    assert ms_none["remat"] == "none"
+    mem.reset_memory_stats()
+    ls = _run(model, Strategy(remat="selective"), steps=2)
+    ms_sel = mem.memory_stats()
+    assert ln == ls, f"selective remat changed numerics: {ln} vs {ls}"
+    assert ms_sel["act_bytes"] < ms_none["act_bytes"]
+    assert ms_sel["remat_recompute_flops"] > 0
+    assert ms_none["remat_recompute_flops"] == 0
+    # class split sums to peak
+    for ms in (ms_none, ms_sel):
+        assert ms["peak_bytes"] == pytest.approx(
+            ms["params_bytes"] + ms["grads_bytes"] + ms["opt_bytes"]
+            + ms["act_bytes"])
+
+
+def test_estimate_breakdown_matches_cost_model():
+    """One formula: the planner's mem_per_device IS the ledger's peak."""
+    from hetu_tpu.tools.galvatron.cost_model import estimate
+    dims = ModelDims.from_config(GPTConfig.small(), seq_len=1024,
+                                 global_batch=64)
+    topo = TPUTopology(num_devices=8)
+    for s in (Strategy(dp=8), Strategy(dp=4, tp=2, zero=True),
+              Strategy(dp=2, pp=4, num_microbatches=8, remat="full"),
+              Strategy(dp=8, fsdp=True, remat="selective")):
+        bd = mem.estimate_breakdown(dims, s,
+                                    act_scale=topo.act_scale(s.remat))
+        c = estimate(dims, s, topo)
+        assert c.mem_per_device == pytest.approx(bd.peak_bytes)
+        assert c.mem_opt == pytest.approx(bd.opt_bytes)
+
+
+def test_derive_remat_mask():
+    dims = ModelDims.from_config(GPTConfig.small(), seq_len=1024,
+                                 global_batch=64)
+    s = Strategy(dp=8, zero=True)
+    none_bd = mem.estimate_breakdown(dims, s)
+    # fits without remat -> None (recompute is never free)
+    assert mem.derive_remat_mask(
+        dims, s, hbm_budget_bytes=none_bd.peak_bytes * 2) is None
+    # tight budget -> minimal prefix of rematted layers
+    mask = mem.derive_remat_mask(
+        dims, s, hbm_budget_bytes=none_bd.peak_bytes * 0.75)
+    assert mask is not None and len(mask) == dims.num_layers
+    k = sum(mask)
+    assert 0 < k < dims.num_layers
+    assert mask == tuple(i < k for i in range(dims.num_layers))
+    # the mask actually fits: interpolate the two uniform ledgers
+    full_bd = mem.estimate_breakdown(
+        dims, Strategy(dp=8, zero=True, remat="full"))
+    fixed = none_bd.params_bytes + none_bd.grads_bytes + none_bd.opt_bytes
+    mixed = fixed \
+        + none_bd.act_bytes * (dims.num_layers - k) / dims.num_layers \
+        + full_bd.act_bytes * k / dims.num_layers
+    assert mixed <= none_bd.peak_bytes * 0.75
+    # infeasible even at full remat -> the planner must change degrees
+    with pytest.raises(ValueError, match="parallel"):
+        mem.derive_remat_mask(dims, s, hbm_budget_bytes=1e6)
+
+
+def test_search_uniform_hbm_budget_rejection():
+    """ACCEPTANCE: search_uniform(hbm_budget_bytes=...) rejects
+    over-budget candidates and prices remat recompute — a remat
+    candidate of the same shape estimates slower, never faster."""
+    from hetu_tpu.models import LlamaConfig
+    dims = ModelDims.from_config(LlamaConfig.llama_7b(), seq_len=4096,
+                                 global_batch=64)
+    topo = TPUTopology(num_devices=8)
+    budget = 30e9
+    cands = search_uniform(dims, topo, hbm_budget_bytes=budget)
+    assert cands
+    assert all(c.cost.mem_per_device <= budget for c in cands)
+    # the budget-aware sweep prices selective remat as a candidate
+    assert any(c.strategy.remat == "selective" for c in cands)
+    by_shape = {}
+    for c in cands:
+        key = (c.strategy.dp, c.strategy.tp, c.strategy.pp,
+               c.strategy.num_microbatches, c.strategy.zero)
+        by_shape.setdefault(key, {})[c.strategy.remat] = c.cost.step_time
+    priced = 0
+    for remats in by_shape.values():
+        if "none" in remats and "full" in remats:
+            assert remats["full"] > remats["none"]
+            priced += 1
+    # generous budget: nothing needs recompute, "none" leads
+    roomy = search_uniform(dims, TPUTopology(num_devices=8,
+                                             hbm_bytes=500e9),
+                           hbm_budget_bytes=500e9)
+    assert roomy[0].strategy.remat == "none"
+
+
+# -- observability satellites ------------------------------------------------
+
+def test_tracer_counter_tracks():
+    """Satellite: registry snapshots sample into Perfetto counter
+    tracks (ph "C") in the Chrome export; non-matching / non-numeric
+    series stay out."""
+    from hetu_tpu.telemetry import Tracer
+    t = Tracer(enabled=True)
+    n = t.record_counters({
+        "mem_peak_bytes": 123.0,
+        'comm_bytes_total{kind="fsdp_gather"}': 9.0,
+        "loss": 5.0,                       # not a tracked prefix
+        "step_time_hist": {"count": 3},    # histogram summary
+    })
+    assert n == 2
+    chrome = t.to_chrome()
+    cevents = [e for e in chrome["traceEvents"] if e.get("ph") == "C"]
+    assert {e["name"] for e in cevents} == {
+        "mem_peak_bytes", 'comm_bytes_total{kind="fsdp_gather"}'}
+    assert all(e["args"]["value"] > 0 for e in cevents)
+    # disabled tracer: no samples, no cost
+    t2 = Tracer(enabled=False)
+    assert t2.record_counters({"mem_peak_bytes": 1.0}) == 0
+
+
+def test_trace_summary_memory_plane_section(tmp_path):
+    """Satellite: trace_summary renders the memory-plane section from
+    the mem_* gauges + fsdp_gather byte split of the last snapshot."""
+    from hetu_tpu.tools.trace_summary import summarize
+    p = tmp_path / "telemetry.jsonl"
+    snap = {
+        "mem_params_bytes": 2e6, "mem_grads_bytes": 4e6,
+        "mem_opt_bytes": 8e6, "mem_act_bytes": 16e6,
+        "mem_peak_bytes": 30e6, "mem_remat_recompute_flops": 2.5e12,
+        'comm_bytes_total{kind="fsdp_gather"}': 1000.0,
+        'comm_overlapped_bytes_total{kind="fsdp_gather"}': 1000.0,
+    }
+    with open(p, "w") as f:
+        f.write(json.dumps({"kind": "metrics_snapshot",
+                            "metrics": snap}) + "\n")
+    out = summarize(str(p))
+    assert "== memory plane ==" in out
+    assert "peak (ledger)" in out
+    assert "activations" in out
+    assert "remat recompute" in out and "2.50 TFLOP" in out
+    assert "100% on the per-block overlap ring" in out
+
+
+def test_tp_ring_fallback_counter(rng):
+    """Satellite: a ring matmul hitting non-divisible dims increments
+    tp_ring_fallback_total (and warns once) instead of silently
+    degrading; the dense result stays correct."""
+    import warnings
+    from hetu_tpu.nn.parallel import RowParallelLinear
+    from hetu_tpu.parallel.sharding import (
+        ActivationSharding, param_partition_specs, shard_params,
+    )
+    st = Strategy(dp=2, tp=2, sp=True)
+    mesh = st.build_mesh()
+    ctx = ActivationSharding(mesh, batch="dp", seq=None, tp="tp",
+                             sp=True, tp_overlap="ring")
+    row = RowParallelLinear(32, 16, bias=False)
+    pr = shard_params(row.init(rng, dtype=jnp.float32), mesh,
+                      param_partition_specs(row, st.axis_rules(),
+                                            mesh=mesh))
+    # seq=5: not divisible by tp=2 — the ring cannot split it
+    x = jax.random.normal(jax.random.key(2), (4, 5, 32), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None, "tp")))
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+
+            @jax.jit
+            def f(p, x):
+                with ctx:
+                    return row(p, x)
+
+            y = np.asarray(f(pr, xs))
+        assert ov.comm_stats()["tp_ring_fallbacks"] == 1
+        assert telemetry.get_registry().counter(
+            "tp_ring_fallback_total").value(site="row_matmul_rs") == 1
+        assert any("fell back" in str(m.message) for m in w)
+        ref = np.asarray(
+            x.reshape(-1, 32) @ np.asarray(jax.device_get(pr["weight"]))
+        ).reshape(4, 5, 16)
+        np.testing.assert_allclose(y, ref, atol=1e-5)
+    finally:
+        telemetry.reset()
+        telemetry.enable(False)

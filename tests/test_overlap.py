@@ -103,6 +103,9 @@ def test_ring_matmul_layer_smoke(rng):
     assert stats["bytes_by_kind"].get("tp_ring_reduce_scatter", 0) > 0
     # both ring kinds are overlapping paths
     assert stats["overlap_ratio"] == 1.0
+    # divisible dims: the ring must have engaged, never the dense
+    # fallback (tp_ring_fallback_total audits silent degradation)
+    assert stats["tp_ring_fallbacks"] == 0
 
 
 def test_ring_column_requires_sp(rng):
@@ -137,6 +140,9 @@ def test_ring_column_requires_sp(rng):
     stats = ov.comm_stats()
     assert "tp_ring_all_gather" not in stats["bytes_by_kind"]
     assert stats["bytes_by_kind"].get("tp_ring_reduce_scatter", 0) > 0
+    # sp off is a legitimate fall-through (nothing to hide), NOT a
+    # divisibility fallback — the counter must stay 0
+    assert stats["tp_ring_fallbacks"] == 0
 
 
 @pytest.mark.slow

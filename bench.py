@@ -184,6 +184,90 @@ def _combo_probe(dt, batch, seq):
     return "combo: all batches OOM/compile-refused"
 
 
+_BENCH_SERVING_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_serving.json")
+
+
+def serving_main():
+    """``bench.py --serving``: offered-load sweep of the continuous-
+    batching engine (hetu_tpu/serving). Each level submits a burst of
+    requests and drains it, recording throughput, TTFT percentiles and
+    mean slot occupancy; BENCH_serving.json carries the full sweep and
+    the headline JSON line reports the best sustained tokens/s."""
+    telemetry.enable(True)
+    if not probe_tpu():
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    import numpy as np
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    if on_tpu:
+        cfg = GPTConfig.small()
+        slots, max_len, chunk, max_tokens = 16, 512, 64, 64
+        loads = (4, 16, 48)
+    else:   # CPU smoke: tiny model, enough churn to exercise the queue
+        cfg = GPTConfig.tiny()
+        slots, max_len, chunk, max_tokens = 4, 64, 16, 12
+        loads = (2, 8, 16)
+
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.key(0), dtype=jnp.float32)
+    engine = ServingEngine(model, params, slots=slots, max_len=max_len,
+                           prefill_chunk=chunk)
+    rng = np.random.default_rng(0)
+    sp = SamplingParams(max_tokens=max_tokens)
+    reg = telemetry.get_registry()
+
+    # warm the one compile outside the measured sweep
+    engine.generate_many([rng.integers(1, cfg.vocab_size, (5,)).tolist()],
+                         SamplingParams(max_tokens=2))
+
+    sweep = []
+    for offered in loads:
+        telemetry.reset()
+        prompts = [rng.integers(1, cfg.vocab_size,
+                                (int(rng.integers(4, max_len
+                                                  - max_tokens)),)).tolist()
+                   for _ in range(offered)]
+        for p in prompts:
+            engine.submit(p, sp)
+        occ, t0 = [], time.perf_counter()
+        while engine.has_work():
+            engine.step()
+            occ.append(engine.scheduler.occupancy)
+        wall = time.perf_counter() - t0
+        ttft = reg.histogram("serving_ttft_seconds").summary()
+        tpot = reg.histogram("serving_tpot_seconds").summary()
+        gen = reg.counter("serving_tokens_total").value(kind="generated")
+        sweep.append({
+            "offered": offered,
+            "tokens_per_sec": round(gen / wall, 1),
+            "ttft_p50_ms": round(ttft["p50"] * 1e3, 2),
+            "ttft_p99_ms": round(ttft["p99"] * 1e3, 2),
+            "tpot_p50_ms": round(tpot["p50"] * 1e3, 2),
+            "occupancy_mean": round(float(np.mean(occ)), 3) if occ
+            else 0.0,
+        })
+    best = max(s["tokens_per_sec"] for s in sweep)
+    result = {
+        "metric": "serving_tokens_per_sec"
+        if on_tpu else "serving_tokens_per_sec_cpu_smoke",
+        "value": best, "unit": "tokens/sec", "vs_baseline": 0.0,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "slots": slots, "max_len": max_len, "prefill_chunk": chunk,
+        "max_tokens": max_tokens, "sweep": sweep,
+    }
+    with open(_BENCH_SERVING_PATH, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
 def main():
     telemetry.enable(True)
     if not probe_tpu():
@@ -463,4 +547,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--serving" in sys.argv:
+        serving_main()
+    else:
+        main()

@@ -19,6 +19,7 @@ Layer map (mirrors SURVEY.md §1, re-architected for XLA):
   models/    GPT / Llama model families
   data/      datasets, packing buckets, loaders
   engine/    Trainer, planners, straggler monitor
+  serving/   continuous-batching inference engine (slot-pooled KV cache)
   telemetry/ spans, metric registry, cross-rank aggregation, goodput
   utils/     checkpoint (safetensors-compat), logging, profiler
 """

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Any, Optional
+from typing import Any, Optional, Sequence
 
 from hetu_tpu.parallel.strategy import Strategy
 
@@ -146,20 +146,51 @@ def estimate_breakdown(dims, strategy: Strategy, *,
         remat_recompute_flops=recompute)
 
 
+def layer_act_weights(dims) -> tuple:
+    """Per-layer relative activation-byte weights from the ledger's
+    per-class split: a layer's residual footprint decomposes into an
+    MLP share and an attention share (proxied by each side's width —
+    ``ModelDims.attn_param_share``), and the attention share scales
+    with the layer's attention intensity (``dims.layer_attn_scale``:
+    1.0 = full causal attention, ``window/seq_len`` for sliding-window
+    layers). Homogeneous stacks get uniform weights."""
+    n = dims.num_layers
+    scales = getattr(dims, "layer_attn_scale", None)
+    if scales is None:
+        return (1.0,) * n
+    if len(scales) != n:
+        raise ValueError(
+            f"layer_attn_scale has {len(scales)} entries for {n} layers")
+    attn = dims.attn_param_share() if hasattr(dims, "attn_param_share") \
+        else 0.5
+    return tuple((1.0 - attn) + attn * float(s) for s in scales)
+
+
 def derive_remat_mask(dims, strategy: Strategy, *,
                       hbm_budget_bytes: float,
-                      act_scale: float = 1.0) -> Optional[tuple]:
-    """Minimal per-layer recompute mask fitting ``hbm_budget_bytes``.
+                      act_scale: float = 1.0,
+                      weights: Optional[Sequence[float]] = None
+                      ) -> Optional[tuple]:
+    """Per-layer recompute mask fitting ``hbm_budget_bytes`` with the
+    fewest rematted layers.
 
     Returns ``None`` when the strategy fits WITHOUT recompute (uniform
     ``remat="none"`` is optimal — recompute is never free), else a
-    ``Strategy(remat_mask=...)``-shaped tuple with the smallest number
-    of leading True (rematted) layers that brings the ledger peak under
-    budget. Raises ``ValueError`` when even full recompute does not fit
-    (the planner must change parallel degrees instead). The rematted
-    layers use ``strategy.remat`` when it names a policy, else "full"
-    (matching ``StackedBlocks``' mask semantics).
-    """
+    ``Strategy(remat_mask=...)``-shaped tuple selecting the smallest
+    set of layers that brings the ledger peak under budget. Raises
+    ``ValueError`` when even full recompute does not fit (the planner
+    must change parallel degrees instead). The rematted layers use
+    ``strategy.remat`` when it names a policy, else "full" (matching
+    ``StackedBlocks``' mask semantics).
+
+    Layer selection is GREEDY BY SAVINGS, not a fixed prefix: each
+    layer's live-residual bytes are weighted by ``weights`` (default:
+    :func:`layer_act_weights` — the ledger's attention/MLP byte split
+    times the per-layer attention intensity), so ATTENTION-HEAVY layers
+    are rematted first (Korthikanti et al.: attention residuals
+    dominate and recompute cheapest). A homogeneous stack has uniform
+    weights and degrades to the historical leading-prefix mask (greedy
+    ties break on layer index)."""
     import dataclasses as _dc
     none_bd = estimate_breakdown(
         dims, _dc.replace(strategy, remat="none"), act_scale=act_scale)
@@ -175,17 +206,86 @@ def derive_remat_mask(dims, strategy: Strategy, *,
             f"{hbm_budget_bytes / 1e9:.2f}GB) — change parallel "
             f"degrees, not remat")
     n = dims.num_layers
-    # per-layer activation contribution (schedule-scaled), none vs remat
-    layer_none = none_bd.act_bytes / n
+    w = tuple(weights) if weights is not None else layer_act_weights(dims)
+    if len(w) != n:
+        raise ValueError(f"weights has {len(w)} entries for {n} layers")
+    wsum = sum(w)
+    # per-layer activation contribution (schedule-scaled): the uniform
+    # ledger total split by weight for the "none" residuals; the remat
+    # floor (saved block boundaries / flash residuals) is uniform
+    layer_none = [none_bd.act_bytes * wi / wsum for wi in w]
     layer_remat = remat_bd.act_bytes / n
     fixed = none_bd.params_bytes + none_bd.grads_bytes \
         + none_bd.opt_bytes
-    # fixed + (n-k)·layer_none + k·layer_remat <= budget
-    import math
-    k = math.ceil((fixed + n * layer_none - hbm_budget_bytes)
-                  / max(layer_none - layer_remat, 1e-9))
-    k = max(1, min(n, k))
-    return tuple(i < k for i in range(n))
+    need = fixed + sum(layer_none) - hbm_budget_bytes
+    # biggest savings first; stable sort keeps index order on ties, so
+    # uniform stacks produce the historical leading prefix
+    order = sorted(range(n),
+                   key=lambda i: -(layer_none[i] - layer_remat))
+    chosen: set[int] = set()
+    saved = 0.0
+    for i in order:
+        if saved >= need and chosen:
+            break
+        chosen.add(i)
+        saved += max(layer_none[i] - layer_remat, 0.0)
+    return tuple(i in chosen for i in range(n))
+
+
+# -- serving plane: KV-pool sizing -------------------------------------------
+#
+# The serving engine's admission control is a BYTES question — how many
+# fixed-shape KV slots fit next to the weights — and this ledger is the
+# one place that arithmetic lives (the training planner and the serving
+# scheduler must not disagree about what a layer weighs).
+
+#: bytes per KV element by cache dtype: fp32/bf16 dense caches, int8 =
+#: 1 byte/elem + per-(position, head) fp32 scales amortized over
+#: head_dim (``generation.init_kv_caches`` quantized layout)
+KV_CACHE_BYTES_PER_EL = {"fp32": 4.0, "bf16": 2.0, "int8": 1.0}
+
+
+def kv_bytes_per_slot(cfg, *, max_len: int, cache_dtype: str = "fp32",
+                      tp: int = 1) -> float:
+    """Per-slot bytes of one request's K+V rows across every layer
+    (the unit the serving scheduler admits in)."""
+    if cache_dtype not in KV_CACHE_BYTES_PER_EL:
+        raise ValueError(f"cache_dtype must be one of "
+                         f"{sorted(KV_CACHE_BYTES_PER_EL)}, "
+                         f"got {cache_dtype!r}")
+    hkv = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
+    d = getattr(cfg, "head_dim", None) or cfg.hidden_size // cfg.num_heads
+    rows = cfg.num_layers * max_len * (hkv / max(tp, 1))
+    per_el = KV_CACHE_BYTES_PER_EL[cache_dtype]
+    bytes_kv = 2.0 * rows * d * per_el          # K and V
+    if cache_dtype == "int8":
+        bytes_kv += 2.0 * rows * 4.0            # fp32 row scales
+    return bytes_kv
+
+
+def size_kv_pool(cfg, *, hbm_budget_bytes: float, max_len: int,
+                 cache_dtype: str = "fp32", tp: int = 1,
+                 param_bytes_per_el: float = 4.0,
+                 headroom: float = 0.1) -> int:
+    """How many serving slots fit in ``hbm_budget_bytes`` next to the
+    weights (``param_bytes_per_el`` per parameter, sharded over tp).
+
+    Raises ``ValueError`` when not even one slot fits — the caller must
+    shrink ``max_len``, quantize the cache, or raise tp."""
+    from hetu_tpu.tools.galvatron.cost_model import ModelDims
+    dims = ModelDims.from_config(cfg, seq_len=max_len, global_batch=1)
+    weights = dims.total_params() * param_bytes_per_el / max(tp, 1)
+    avail = hbm_budget_bytes * (1.0 - headroom) - weights
+    per_slot = kv_bytes_per_slot(cfg, max_len=max_len,
+                                 cache_dtype=cache_dtype, tp=tp)
+    slots = int(avail // per_slot)
+    if slots < 1:
+        raise ValueError(
+            f"KV pool does not fit: weights {weights / 1e9:.2f}GB + one "
+            f"{per_slot / 1e6:.1f}MB slot exceed the "
+            f"{hbm_budget_bytes / 1e9:.2f}GB budget — shrink max_len, "
+            f"use an int8 cache, or raise tp")
+    return slots
 
 
 # -- runtime ledger ----------------------------------------------------------

@@ -40,14 +40,20 @@ def init_kv_caches(model, batch: int, max_len: int, dtype=jnp.float32):
     return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 
-def decode(model, params, input_ids, positions, caches):
+def decode(model, params, input_ids, positions, caches, *,
+           slot_mask=None):
     """Run a chunk through the model in decode mode.
 
-    ``positions`` (b, s) absolute positions (identical across the batch —
-    batched decode). Returns (logits (b, s, V), new caches)."""
+    ``positions`` (b, s) absolute positions. Without ``slot_mask`` they
+    must be identical across the batch (batched decode, one shared write
+    index). With ``slot_mask`` (b,) bool every row decodes at ITS OWN
+    ``positions[r, 0]`` — the serving engine's slot-pooled path — and
+    masked-off rows leave their KV rows untouched. Returns
+    (logits (b, s, V), new caches)."""
     h = model.embed(params, input_ids, positions=positions)
     h, caches = model.blocks.decode(params["blocks"], h, caches,
-                                    positions=positions)
+                                    positions=positions,
+                                    slot_mask=slot_mask)
     h = model.hidden_norm(params, h)
     w = _head_weight(model, params)
     logits = jnp.einsum("bse,ve->bsv", h.astype(jnp.float32),
@@ -79,35 +85,68 @@ def generate(model, params, input_ids, *, max_new_tokens: int,
              max_len: Optional[int] = None, temperature: float = 0.0,
              top_k: int = 0, top_p: float = 0.0,
              rng: Optional[jax.Array] = None,
-             eos_id: Optional[int] = None, cache_dtype=jnp.float32):
+             eos_id: Optional[int] = None, pad_id: Optional[int] = None,
+             prompt_lens=None, cache_dtype=jnp.float32):
     """Generate ``max_new_tokens`` continuations for a (b, s) prompt.
 
-    Returns (b, s + max_new_tokens) token ids; positions after an EOS are
-    filled with ``eos_id`` when given. jit-able end to end.
+    Returns (b, s + max_new_tokens) token ids; positions after an EOS
+    are filled with ``pad_id`` when given, else with ``eos_id`` (the
+    historical behavior — callers that need to tell a real EOS from
+    fill must pass a distinct ``pad_id``). jit-able end to end.
+
+    ``prompt_lens`` (b,) enables RAGGED prompts: row r's real prompt is
+    ``input_ids[r, :prompt_lens[r]]`` (right-padded to s). Prefill then
+    samples at each row's LAST REAL position instead of column s-1 (a
+    padded batch otherwise samples at a pad position), and decode
+    writes row r's tokens at positions ``prompt_lens[r] + t`` with a
+    per-row causal mask, so stale pad KV rows are never attended.
+    Generated tokens still occupy the trailing ``max_new_tokens``
+    columns of the output for every row. When omitted, every prompt is
+    assumed to span the full s columns (the historical batched path,
+    bit-for-bit unchanged).
     """
     b, s = input_ids.shape
     total = max_len or (s + max_new_tokens)
     caches = init_kv_caches(model, b, total, cache_dtype)
     rng = rng if rng is not None else jax.random.key(0)
+    ragged = prompt_lens is not None
+    fill_id = pad_id if pad_id is not None else eos_id
 
     # prefill the prompt in one pass
     prefill_pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     logits, caches = decode(model, params, input_ids, prefill_pos, caches)
     rng, sub = jax.random.split(rng)
-    tok = _sample(logits[:, -1], temperature=temperature, top_k=top_k,
+    if ragged:
+        plens = jnp.asarray(prompt_lens, jnp.int32)
+        # pad-aware gather: sample at each row's last REAL position
+        last_logits = jnp.take_along_axis(
+            logits, (plens - 1)[:, None, None], axis=1)[:, 0]
+        pos0 = plens                       # next write index per row
+    else:
+        last_logits = logits[:, -1]
+        pos0 = None
+    tok = _sample(last_logits, temperature=temperature, top_k=top_k,
                   top_p=top_p, rng=sub)
     done = jnp.zeros((b,), bool) if eos_id is None else (tok == eos_id)
 
     def step(carry, i):
         caches, tok, done, rng = carry
-        pos = jnp.broadcast_to((s + i)[None, None], (b, 1))
-        logits, caches = decode(model, params, tok[:, None], pos, caches)
+        if ragged:
+            pos = (pos0 + i)[:, None]
+            logits, caches = decode(model, params, tok[:, None], pos,
+                                    caches,
+                                    slot_mask=jnp.ones((b,), bool))
+        else:
+            pos = jnp.broadcast_to((s + i)[None, None], (b, 1))
+            logits, caches = decode(model, params, tok[:, None], pos,
+                                    caches)
         rng, sub = jax.random.split(rng)
         nxt = _sample(logits[:, -1], temperature=temperature,
                       top_k=top_k, top_p=top_p, rng=sub)
         if eos_id is not None:
-            nxt = jnp.where(done, eos_id, nxt)
-            done = done | (nxt == eos_id)
+            raw = nxt
+            nxt = jnp.where(done, fill_id, raw)
+            done = done | (raw == eos_id)
         return (caches, nxt, done, rng), tok
 
     (_, last, _, _), toks = jax.lax.scan(

@@ -99,13 +99,15 @@ class LlamaBlock(Module):
         self.attn_pdrop = cfg.attn_pdrop
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
-                 attn_impl="auto", kv_cache=None, dropout_key=None):
+                 attn_impl="auto", kv_cache=None, slot_mask=None,
+                 dropout_key=None):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
                                      self.input_norm(
                                          params["input_norm"], x),
                                      positions=positions,
-                                     kv_cache=kv_cache)
+                                     kv_cache=kv_cache,
+                                     slot_mask=slot_mask)
             x = x + a
             h = self.mlp(params["mlp"],
                          self.post_attn_norm(params["post_attn_norm"], x))

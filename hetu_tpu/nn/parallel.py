@@ -293,10 +293,11 @@ class ParallelAttention(Module):
             self._rope = None
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
-                 attn_impl: str = "auto", kv_cache=None,
+                 attn_impl: str = "auto", kv_cache=None, slot_mask=None,
                  dropout_rate: float = 0.0, dropout_key=None):
         if kv_cache is not None:
-            return self._decode(params, x, kv_cache, positions=positions)
+            return self._decode(params, x, kv_cache, positions=positions,
+                                slot_mask=slot_mask)
         b, s, _ = x.shape
         q = self.q_proj(params["q_proj"], x).reshape(
             b, s, self.num_heads, self.head_dim)
@@ -365,7 +366,8 @@ class ParallelAttention(Module):
         out = out.reshape(b, s, self.num_heads * self.head_dim)
         return self.out_proj(params["out_proj"], out)
 
-    def _decode(self, params, x, kv_cache, *, positions=None):
+    def _decode(self, params, x, kv_cache, *, positions=None,
+                slot_mask=None):
         """Incremental decoding with a KV cache.
 
         ``kv_cache``: (k_buf, v_buf) of shape (b, max_len, hkv, d); the
@@ -378,10 +380,22 @@ class ParallelAttention(Module):
         the QUANTIZED 4-tuple (k int8, k scales, v int8, v scales) with
         (b, max_len, hkv, 1) fp32 scales (``generation.init_kv_caches``
         with dtype=jnp.int8) — new rows quantize on write, the read
-        dequant fuses into the attention einsum."""
+        dequant fuses into the attention einsum.
+
+        ``slot_mask`` switches to PER-ROW decode (the serving engine's
+        slot-pooled path): every batch row writes at its own
+        ``positions[:, 0]`` index and the causal mask uses per-row
+        offsets, so requests at different depths decode in one batched
+        call. Rows with ``slot_mask=False`` (free / prefilling slots)
+        leave their cache rows untouched (their compute is discarded by
+        the caller)."""
         quant = len(kv_cache) == 4
         b, s, _ = x.shape
-        index = positions[0, 0] if positions is not None else 0
+        per_row = slot_mask is not None
+        if per_row:
+            index = positions[:, 0]                     # (b,) per-slot
+        else:
+            index = positions[0, 0] if positions is not None else 0
         q = self.q_proj(params["q_proj"], x).reshape(
             b, s, self.num_heads, self.head_dim)
         k = self.k_proj(params["k_proj"], x).reshape(
@@ -396,6 +410,15 @@ class ParallelAttention(Module):
             k = apply_rotary(k, cos, sin, positions=pos)
 
         def upd(buf, new):
+            if per_row:
+                # per-slot scatter: row r writes its s new entries at
+                # index[r]; inactive slots select their old rows back
+                written = jax.vmap(
+                    lambda bb, nn, ii: jax.lax.dynamic_update_slice_in_dim(
+                        bb, nn, ii, axis=0))(buf, new.astype(buf.dtype),
+                                             index)
+                keep = slot_mask.reshape((b,) + (1,) * (buf.ndim - 1))
+                return jnp.where(keep, written, buf)
             return jax.lax.dynamic_update_slice_in_dim(
                 buf, new.astype(buf.dtype), index, axis=1)
 

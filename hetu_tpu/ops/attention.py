@@ -62,9 +62,19 @@ def attention_reference(q, k, v, *, causal: bool = False,
 
     mask = jnp.ones((b, 1, sq, sk), dtype=bool)
     if causal:
-        qpos = jnp.arange(sq)[:, None] + q_offset
-        kpos = jnp.arange(sk)[None, :] + kv_offset
-        mask = mask & (qpos >= kpos)[None, None]
+        qoff = jnp.asarray(q_offset)
+        koff = jnp.asarray(kv_offset)
+        if qoff.ndim or koff.ndim:
+            # per-batch-row offsets (serving: every KV-pool slot decodes
+            # at its own absolute position) — (b,) or scalar, broadcast
+            # to (b, sq, sk) then into the (b, 1, sq, sk) mask layout
+            qpos = jnp.arange(sq)[None, :, None] + qoff.reshape(-1, 1, 1)
+            kpos = jnp.arange(sk)[None, None, :] + koff.reshape(-1, 1, 1)
+            mask = mask & (qpos >= kpos)[:, None]
+        else:
+            qpos = jnp.arange(sq)[:, None] + q_offset
+            kpos = jnp.arange(sk)[None, :] + kv_offset
+            mask = mask & (qpos >= kpos)[None, None]
     if segment_ids is not None:
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
         mask = mask & (segment_ids[:, None, :, None] == kv_seg[:, None, None, :])

@@ -74,6 +74,38 @@ class CoordinatorClient:
         """Blocks until ``n`` distinct participants arrive."""
         assert self._cmd(f"BARRIER {name} {n} {who}") == "OK"
 
+    # -- serving plane (hetu_tpu/serving — coordinator with an engine) ------
+    def _serving_payload(self, prompt, **sampling) -> str:
+        obj = {"prompt": [int(t) for t in prompt], **sampling}
+        return urllib.parse.quote(
+            json.dumps(obj, separators=(",", ":")), safe="")
+
+    def serving_submit(self, prompt, **sampling) -> int:
+        """Queue a generation request; returns its id (FCFS)."""
+        resp = self._cmd(f"SUBMIT {self._serving_payload(prompt, **sampling)}")
+        if not resp.startswith("ID "):
+            raise RuntimeError(f"serving submit failed: {resp}")
+        return int(resp.split()[1])
+
+    def serving_result(self, req_id: int,
+                       timeout_ms: int = 0) -> Optional[dict]:
+        """Poll a queued request: dict result, or None while pending."""
+        resp = self._cmd(f"RESULT {req_id} {timeout_ms}")
+        if resp == "PEND":
+            return None
+        if not resp.startswith("VAL "):
+            raise RuntimeError(f"serving result failed: {resp}")
+        return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
+
+    def serving_generate(self, prompt, **sampling) -> dict:
+        """Blocking generate over the line protocol (engine loop must
+        be running server-side, e.g. ``ServingServer.start()``)."""
+        resp = self._cmd(
+            f"GENERATE {self._serving_payload(prompt, **sampling)}")
+        if not resp.startswith("VAL "):
+            raise RuntimeError(f"serving generate failed: {resp}")
+        return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
+
     def ping(self) -> bool:
         return self._cmd("PING") == "PONG"
 

@@ -88,6 +88,13 @@ class _Handler(socketserver.StreamRequestHandler):
                         ev = b["event"]
                 ev.wait()
                 self._send("OK")
+            elif cmd in ("SUBMIT", "RESULT", "GENERATE"):
+                # serving-plane verbs (hetu_tpu/serving/server.py) —
+                # lazy import keeps the bare coordinator jax-free
+                from hetu_tpu.serving.server import handle_serving_command
+                resp = handle_serving_command(
+                    getattr(self.server, "serving", None), cmd, args)
+                self._send(resp or "ERR unknown command")
             elif cmd == "PING":
                 self._send("PONG")
             elif cmd == "SHUTDOWN":
@@ -105,10 +112,11 @@ class _Handler(socketserver.StreamRequestHandler):
 
 class PyCoordinatorServer:
     def __init__(self, port: int, bind: str = "127.0.0.1",
-                 token: str = ""):
+                 token: str = "", serving=None):
         self.bind = bind
         self.port = port
         self.token = token
+        self.serving = serving   # optional ServingEngine (SUBMIT/...)
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._ready = threading.Event()
@@ -119,6 +127,7 @@ class PyCoordinatorServer:
             (self.bind, self.port), _Handler)
         self._server.state = _State()  # type: ignore[attr-defined]
         self._server.token = self.token  # type: ignore[attr-defined]
+        self._server.serving = self.serving  # type: ignore[attr-defined]
         self._server.daemon_threads = True
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)

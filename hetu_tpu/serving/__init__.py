@@ -1,0 +1,25 @@
+"""Serving plane: continuous-batching inference over a slot-pooled KV
+cache.
+
+- :mod:`~hetu_tpu.serving.kv_pool` — the fixed-shape KV arena + sizing
+  from the memory-plane ledger;
+- :mod:`~hetu_tpu.serving.engine` — the jit-once fused step (chunked
+  prefill + all-slot decode, per-slot SamplingParams as traced
+  operands) and the :class:`ServingEngine` host loop;
+- :mod:`~hetu_tpu.serving.scheduler` — FCFS admission, slot gating,
+  completion/eviction;
+- :mod:`~hetu_tpu.serving.server` — the line-protocol front end over
+  ``rpc/py_server.py`` plus payload codecs.
+
+``docs/SERVING.md`` documents the architecture and slot lifecycle.
+"""
+
+from hetu_tpu.serving.engine import ServingEngine, sample_slots
+from hetu_tpu.serving.kv_pool import KVPool, cache_dtype_name
+from hetu_tpu.serving.scheduler import Request, SamplingParams, Scheduler
+
+__all__ = [
+    "ServingEngine", "sample_slots",
+    "KVPool", "cache_dtype_name",
+    "Request", "SamplingParams", "Scheduler",
+]

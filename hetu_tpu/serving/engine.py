@@ -1,0 +1,443 @@
+"""Continuous-batching inference engine: one jit, any request churn.
+
+The static-batch ``models.generation.generate`` compiles one program per
+(batch, prompt length) — admitting a request means retracing, the exact
+control-plane tax PR 2 spent a subsystem killing on the training side.
+This engine is the serving-plane answer, built from the two techniques
+that turn a decode loop into a serving engine, mapped onto TPU idioms:
+
+- **iteration-level scheduling** (Orca, OSDI'22): the unit of work is
+  ONE engine iteration — one decode token for every active slot plus
+  one chunk of prefill for the admitting request — so new requests join
+  and finished ones leave between iterations, never mid-batch;
+- **slot-pooled KV** (the fixed-shape cousin of vLLM's PagedAttention,
+  SOSP'23): requests of any length live in one preallocated arena
+  (:class:`~hetu_tpu.serving.kv_pool.KVPool`) indexed by per-slot
+  control vectors, so the compiled step sees ONE signature forever.
+
+The fused step is jitted once: chunked prefill (``lax.cond``-gated, a
+fixed-size chunk written into the admitting slot via dynamic slices)
+and the all-slot decode (per-row KV writes + per-row causal offsets —
+``ParallelAttention._decode``'s slot mode) run in the same program, with
+per-slot ``SamplingParams`` as traced operands. Request churn therefore
+never recompiles — audited with the PR 2 ``record_trace`` counter
+(``trace_counts()["serving_step"]`` stays at its initial compile count,
+asserted in ``tests/test_serving.py``).
+
+TP-sharded serving rides the existing ``Strategy``/``make_plan`` path:
+pass ``plan=`` and the step traces under ``plan.act`` against sharded
+params, exactly like ``generate`` under a tp mesh.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu import telemetry
+from hetu_tpu.engine.train_step import record_trace
+from hetu_tpu.models import generation
+from hetu_tpu.serving.kv_pool import KVPool
+from hetu_tpu.serving.scheduler import Request, SamplingParams, Scheduler
+
+
+def sample_slots(logits, temperature, top_k, top_p, rng):
+    """Per-slot sampling with TRACED knobs: (S, V) logits + (S,) params
+    → (S,) int32 tokens. Mirrors ``generation._sample`` semantics
+    (greedy at temperature 0, top-k keeps values >= the kth, nucleus
+    keeps the smallest prefix whose prior mass < top_p) but every knob
+    is data, not Python — one compile covers every request mix."""
+    S, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.where(temperature > 0.0, temperature, 1.0)
+    scaled = logits / t[:, None].astype(logits.dtype)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=1)
+    keep_k = (top_k <= 0)[:, None] | (scaled >= kth)
+    masked = jnp.where(keep_k, scaled, -jnp.inf)
+    # the k-mask only replaces a value-SUFFIX of the sorted order with
+    # -inf, so the sorted masked distribution is derivable — no second
+    # O(V log V) sort on the decode hot path
+    sd = jnp.where((top_k <= 0)[:, None] | (sorted_desc >= kth),
+                   sorted_desc, -jnp.inf)
+    probs = jax.nn.softmax(sd, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < top_p[:, None]    # mass *before* this token
+    cutoff = jnp.min(jnp.where(keep, sd, jnp.inf), axis=-1,
+                     keepdims=True)
+    use_p = ((top_p > 0.0) & (top_p < 1.0))[:, None]
+    masked = jnp.where(use_p & (masked < cutoff), -jnp.inf, masked)
+    drawn = jax.vmap(jax.random.categorical)(
+        jax.random.split(rng, S), masked)
+    return jnp.where(temperature == 0.0, greedy, drawn).astype(jnp.int32)
+
+
+class ServingEngine:
+    """Slot-pooled continuous-batching engine over one model + params.
+
+    Offline: :meth:`generate_many`. Online: :meth:`submit` +
+    :meth:`result` with the :meth:`start` background loop (the
+    ``rpc/py_server.py`` front end drives exactly that pair).
+    """
+
+    def __init__(self, model, params, *, slots: Optional[int] = None,
+                 max_len: int = 256, prefill_chunk: int = 16,
+                 cache_dtype=jnp.float32,
+                 hbm_budget_bytes: Optional[float] = None,
+                 plan=None, seed: int = 0,
+                 counter_sample_every: int = 32):
+        if slots is None:
+            if hbm_budget_bytes is None:
+                raise ValueError("pass slots= or hbm_budget_bytes=")
+            tp = plan.strategy.tp if plan is not None else 1
+            self.pool = KVPool.sized_for(
+                model, hbm_budget_bytes=hbm_budget_bytes,
+                max_len=max_len, cache_dtype=cache_dtype, tp=tp)
+        else:
+            self.pool = KVPool(model, slots, max_len, cache_dtype)
+        self.model = model
+        self.params = params
+        self.prefill_chunk = int(prefill_chunk)
+        if self.pool.max_len % self.prefill_chunk != 0:
+            # a final chunk may only run past the prompt, never past the
+            # arena — dynamic_update_slice would CLAMP the start index
+            # and silently corrupt the preceding rows otherwise
+            raise ValueError(
+                f"max_len {self.pool.max_len} must be a multiple of "
+                f"prefill_chunk {self.prefill_chunk}")
+        self.scheduler = Scheduler(self.pool.slots, self.pool.max_len)
+        self._plan = plan
+        self._counter_sample_every = counter_sample_every
+
+        S = self.pool.slots
+        self._pos = np.zeros(S, np.int32)        # next KV write index
+        self._last_tok = np.zeros(S, np.int32)   # sampled, not yet fed
+        self._active = np.zeros(S, bool)         # decoding slots
+        self._temp = np.zeros(S, np.float32)
+        self._topk = np.zeros(S, np.int32)
+        self._topp = np.zeros(S, np.float32)
+        self._slot_req: list[Optional[Request]] = [None] * S
+        self._prefill: Optional[dict] = None     # the admitting request
+        self._key = jax.random.key(seed)
+        self._iter = 0
+        self._next_id = 0
+        self._requests_by_id: dict[int, Request] = {}  # RPC poll map
+        self._lock = threading.RLock()
+        # serializes whole engine ITERATIONS: step() mutates _prefill
+        # and passes pool.caches to a buffer-DONATING jit — two drivers
+        # (the start() background loop + a direct run_until_drained)
+        # must never interleave an iteration
+        self._step_lock = threading.Lock()
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+        self._fn = self._build_step()
+
+    # -- the jit-once fused step --------------------------------------------
+    def _build_step(self):
+        model = self.model
+        C = self.prefill_chunk
+
+        def step(params, caches, ctl, pf, key, it):
+            record_trace("serving_step")    # churn must never re-enter
+            rng = jax.random.fold_in(key, it)
+            rng_dec, rng_pf = jax.random.split(rng)
+
+            # one decode token for EVERY slot; free/prefilling slots
+            # compute garbage that the slot mask keeps out of the pool
+            # and the host ignores. cond-gated so prefill-only
+            # iterations (cold admission) skip the discarded forward.
+            def do_decode(caches):
+                logits, caches = generation.decode(
+                    model, params, ctl["last_tok"][:, None],
+                    ctl["pos"][:, None], caches,
+                    slot_mask=ctl["active"])
+                return caches, sample_slots(
+                    logits[:, 0], ctl["temp"], ctl["topk"],
+                    ctl["topp"], rng_dec)
+
+            def no_decode(caches):
+                return caches, jnp.zeros(
+                    (ctl["pos"].shape[0],), jnp.int32)
+
+            caches, emitted = jax.lax.cond(
+                ctl["active"].any(), do_decode, no_decode, caches)
+
+            # one chunk of prefill for the admitting slot (cond keeps
+            # idle iterations from paying the chunk's compute)
+            def do_prefill(caches):
+                slot = pf["slot"]
+                sc = jax.tree.map(
+                    lambda c: jax.lax.dynamic_slice_in_dim(
+                        c, slot, 1, axis=1), caches)
+                pos = (pf["start"]
+                       + jnp.arange(C, dtype=jnp.int32))[None]
+                h = model.embed(params, pf["tokens"][None],
+                                positions=pos)
+                h, sc = model.blocks.decode(params["blocks"], h, sc,
+                                            positions=pos)
+                caches = jax.tree.map(
+                    lambda c, s_: jax.lax.dynamic_update_slice_in_dim(
+                        c, s_, slot, axis=1), caches, sc)
+                # request's FIRST token: head on the last REAL row only
+                # (pad rows of a partial final chunk sit beyond it)
+                h_last = jax.lax.dynamic_slice_in_dim(
+                    h, pf["valid"] - 1, 1, axis=1)
+                h_last = model.hidden_norm(params, h_last)
+                w = generation._head_weight(model, params)
+                lg = jnp.einsum("bse,ve->bsv",
+                                h_last.astype(jnp.float32),
+                                w.astype(jnp.float32))[:, 0]
+                first = sample_slots(
+                    lg, ctl["temp"][slot][None],
+                    ctl["topk"][slot][None], ctl["topp"][slot][None],
+                    rng_pf)[0]
+                return caches, first
+
+            def no_prefill(caches):
+                return caches, jnp.int32(0)
+
+            caches, first_tok = jax.lax.cond(
+                pf["run"], do_prefill, no_prefill, caches)
+            return caches, emitted, first_tok
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               sampling: Optional[SamplingParams] = None) -> Request:
+        """Queue one request (FCFS). Returns the live Request — poll
+        ``req.done`` / :meth:`result`, or drive :meth:`step` yourself."""
+        sampling = sampling or SamplingParams()
+        with self._lock:
+            req = Request(id=self._next_id,
+                          prompt=np.asarray(prompt, np.int32).ravel(),
+                          sampling=sampling, submit_s=time.monotonic())
+            self._next_id += 1
+            admitted = self.scheduler.submit(req)
+        reg = telemetry.get_registry()
+        reg.counter("serving_requests_total",
+                    "serving requests by outcome").inc(
+            outcome="submitted" if admitted else "rejected")
+        self._record_gauges()
+        return req
+
+    def result(self, req: Request,
+               timeout: Optional[float] = None) -> Optional[dict]:
+        """Wait for ``req`` to finish; None on timeout."""
+        if not req.done.wait(timeout):
+            return None
+        return req.result()
+
+    # -- the host loop ------------------------------------------------------
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self.scheduler.queue) or self._active.any() \
+                or self._prefill is not None
+
+    def step(self) -> bool:
+        """One engine iteration; False when there was nothing to do.
+        Safe to call while the :meth:`start` loop runs (iterations are
+        serialized), though one driver is the intended mode."""
+        with self._step_lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> bool:
+        t0 = time.monotonic()
+        with self._lock:
+            if self._prefill is None:
+                adm = self.scheduler.next_admission()
+                if adm is not None:
+                    req, slot = adm
+                    sp = req.sampling
+                    self._temp[slot] = sp.temperature
+                    self._topk[slot] = sp.top_k
+                    self._topp[slot] = sp.top_p
+                    self._slot_req[slot] = req
+                    self._prefill = {"req": req, "slot": slot, "off": 0}
+            pf_host = self._prefill
+            active_prev = np.nonzero(self._active)[0]
+            if pf_host is None and active_prev.size == 0:
+                return False
+            ctl = {"pos": jnp.asarray(self._pos),
+                   "last_tok": jnp.asarray(self._last_tok),
+                   "active": jnp.asarray(self._active),
+                   "temp": jnp.asarray(self._temp),
+                   "topk": jnp.asarray(self._topk),
+                   "topp": jnp.asarray(self._topp)}
+            C = self.prefill_chunk
+            chunk = np.zeros(C, np.int32)
+            if pf_host is not None:
+                req, off = pf_host["req"], pf_host["off"]
+                part = req.prompt[off:off + C]
+                chunk[:len(part)] = part
+                pf = {"run": np.True_,
+                      "slot": np.int32(pf_host["slot"]),
+                      "start": np.int32(off),
+                      "valid": np.int32(len(part)),
+                      "tokens": chunk}
+                pf_last = off + len(part) >= len(req.prompt)
+                pf_valid = len(part)
+            else:
+                pf = {"run": np.False_, "slot": np.int32(0),
+                      "start": np.int32(0), "valid": np.int32(1),
+                      "tokens": chunk}
+                pf_last = False
+                pf_valid = 0
+
+        ctx = self._plan.act if self._plan is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            caches, emitted, first_tok = self._fn(
+                self.params, self.pool.caches, ctl, pf, self._key,
+                np.int32(self._iter))
+        self.pool.caches = caches
+        em = np.asarray(emitted)
+        now = time.monotonic()
+
+        reg = telemetry.get_registry()
+        with self._lock:
+            self._iter += 1
+            # decode results for the slots that were active going in
+            for r in active_prev:
+                self._on_token(int(r), int(em[r]), now, reg)
+            # prefill progress
+            if pf_host is not None:
+                pf_host["off"] += pf_valid
+                reg.counter("serving_tokens_total",
+                            "serving tokens by kind").inc(
+                    pf_valid, kind="prompt")
+                if pf_last:
+                    req, slot = pf_host["req"], pf_host["slot"]
+                    self._pos[slot] = len(req.prompt)
+                    self._active[slot] = True
+                    req.status = "decode"
+                    req.first_token_s = now
+                    reg.histogram(
+                        "serving_ttft_seconds",
+                        "time submit -> first token").observe(
+                        now - req.submit_s)
+                    self._on_token(slot, int(first_tok), now, reg)
+                    self._prefill = None
+            self._record_gauges()
+        reg.histogram("serving_step_seconds",
+                      "one fused engine iteration").observe(
+            time.monotonic() - t0)
+        if self._counter_sample_every and \
+                self._iter % self._counter_sample_every == 0:
+            telemetry.get_tracer().record_counters(reg.snapshot())
+        return True
+
+    def _on_token(self, slot: int, tok: int, now: float, reg) -> None:
+        """Record one sampled token for ``slot`` (caller holds lock):
+        append, advance the slot cursor, finish on EOS / budget."""
+        req = self._slot_req[slot]
+        req.tokens.append(tok)
+        self._last_tok[slot] = tok
+        # the cursor only advances once the token is FED (next decode
+        # writes its KV at the current pos) — pos was set by prefill
+        if req.status == "decode" and len(req.tokens) > 1:
+            self._pos[slot] += 1
+        reg.counter("serving_tokens_total",
+                    "serving tokens by kind").inc(kind="generated")
+        sp = req.sampling
+        hit_eos = sp.eos_id is not None and tok == sp.eos_id
+        if hit_eos or len(req.tokens) >= sp.max_tokens:
+            self._finish(slot, now, reg)
+
+    def _finish(self, slot: int, now: float, reg) -> None:
+        req = self._slot_req[slot]
+        req.status = "done"
+        req.finish_s = now
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        self.scheduler.release(slot)
+        reg.counter("serving_requests_total",
+                    "serving requests by outcome").inc(
+            outcome="completed")
+        n = len(req.tokens)
+        if n > 1 and req.first_token_s is not None:
+            reg.histogram("serving_tpot_seconds",
+                          "per-output-token time after the first").observe(
+                (now - req.first_token_s) / (n - 1))
+        req.done.set()
+
+    def _record_gauges(self) -> None:
+        reg = telemetry.get_registry()
+        reg.gauge("serving_queue_depth",
+                  "requests waiting for a slot").set(self.scheduler.depth)
+        reg.gauge("serving_slot_occupancy",
+                  "fraction of KV-pool slots in use").set(
+            self.scheduler.occupancy)
+
+    def run_until_drained(self, max_steps: int = 1_000_000) -> int:
+        """Drive :meth:`step` until queue + slots are empty; returns the
+        number of iterations run."""
+        n = 0
+        while self.has_work():
+            if n >= max_steps:
+                raise RuntimeError(
+                    f"serving engine not drained after {max_steps} "
+                    f"iterations")
+            self.step()
+            n += 1
+        return n
+
+    # -- offline API --------------------------------------------------------
+    def generate_many(
+            self, prompts: Sequence[Sequence[int]],
+            sampling: Union[SamplingParams, Sequence[SamplingParams],
+                            None] = None) -> list[list[int]]:
+        """Submit every prompt, run to drain, return per-request tokens
+        (continuous batching under the hood — arrival order and slot
+        assignment do not change any request's tokens)."""
+        if sampling is None or isinstance(sampling, SamplingParams):
+            sampling = [sampling or SamplingParams()] * len(prompts)
+        reqs = [self.submit(p, sp) for p, sp in zip(prompts, sampling)]
+        bad = [r for r in reqs if r.status == "rejected"]
+        if bad:
+            # fail FAST and loud (a silent [] is indistinguishable from
+            # a legitimate empty generation); un-queue the siblings so
+            # the engine is left clean
+            with self._lock:
+                for r in reqs:
+                    if r.status == "queued":
+                        try:
+                            self.scheduler.queue.remove(r)
+                        except ValueError:
+                            pass
+                        r.status = "cancelled"
+                        r.error = "batch aborted: sibling rejected"
+                        r.done.set()
+            raise ValueError(
+                f"{len(bad)} request(s) rejected at admission: "
+                + "; ".join(f"#{r.id}: {r.error}" for r in bad[:3]))
+        self.run_until_drained()
+        return [list(r.tokens) for r in reqs]
+
+    # -- background loop (online front ends) --------------------------------
+    def start(self, idle_sleep_s: float = 0.002) -> None:
+        if self._thread is not None:
+            return
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.is_set():
+                if not self.step():
+                    self._stop.wait(idle_sleep_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        self._thread = None

@@ -1,0 +1,83 @@
+"""Slot-pooled KV cache: one fixed-shape arena for request churn.
+
+The training side already solved "dynamic work on static shapes" twice
+(fixed KV buffers + ``dynamic_update_slice`` in ``models/generation``,
+fixed-capacity expert buffers in MoE); this module applies the same idiom
+to SERVING. Instead of one cache per request (vLLM allocates pages, the
+reference dynamically concats KV), the pool is a single
+``(layers, slots, max_len, kv_heads, head_dim)`` arena allocated once:
+
+- a request of ANY length maps onto one free slot — admission is a host
+  bookkeeping operation, never an allocation, so the engine step keeps
+  one compiled signature across arbitrary request churn;
+- per-slot depth lives in the engine's control vectors (``pos``), and
+  the per-row causal mask guarantees a reused slot never attends a
+  previous tenant's stale rows (every attended position was written by
+  the current request first);
+- the fp32/bf16/int8 layouts are exactly
+  ``generation.init_kv_caches`` — the int8 pool quarters decode's HBM
+  bandwidth (the serving bottleneck) with per-(position, head) scales.
+
+Sizing is delegated to the memory-plane ledger
+(:func:`hetu_tpu.engine.memory.size_kv_pool`): slots are whatever HBM
+remains next to the weights, so the scheduler's admission gate and the
+planner price bytes with the same arithmetic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from hetu_tpu.models.generation import init_kv_caches
+
+
+def cache_dtype_name(dtype) -> str:
+    """Canonical ledger name for a cache dtype (fp32 | bf16 | int8)."""
+    if dtype == jnp.int8:
+        return "int8"
+    if dtype == jnp.bfloat16:
+        return "bf16"
+    return "fp32"
+
+
+class KVPool:
+    """The slot arena plus its shape metadata (free-slot bookkeeping
+    belongs to the scheduler; the pool is just bytes)."""
+
+    def __init__(self, model, slots: int, max_len: int,
+                 cache_dtype=jnp.float32):
+        max_positions = getattr(getattr(model, "cfg", None),
+                                "max_positions", None)
+        if max_positions is not None and max_len > max_positions:
+            raise ValueError(
+                f"pool max_len {max_len} exceeds the model's "
+                f"max_positions {max_positions}")
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.cache_dtype = cache_dtype
+        self.caches = init_kv_caches(model, self.slots, self.max_len,
+                                     cache_dtype)
+
+    @classmethod
+    def sized_for(cls, model, *, hbm_budget_bytes: float, max_len: int,
+                  cache_dtype=jnp.float32, tp: int = 1,
+                  max_slots: Optional[int] = None) -> "KVPool":
+        """Build the largest pool the HBM budget allows (ledger-sized)."""
+        from hetu_tpu.engine.memory import size_kv_pool
+        slots = size_kv_pool(model.cfg,
+                             hbm_budget_bytes=hbm_budget_bytes,
+                             max_len=max_len,
+                             cache_dtype=cache_dtype_name(cache_dtype),
+                             tp=tp)
+        if max_slots is not None:
+            slots = min(slots, max_slots)
+        return cls(model, slots, max_len, cache_dtype)
+
+    @property
+    def quantized(self) -> bool:
+        return len(self.caches) == 4
+
+    def nbytes(self) -> int:
+        return sum(int(x.size) * x.dtype.itemsize for x in self.caches)

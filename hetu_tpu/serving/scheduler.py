@@ -1,0 +1,114 @@
+"""Request lifecycle + FCFS slot scheduler for the serving engine.
+
+Orca-style continuous batching (iteration-level scheduling, OSDI'22)
+reduces, on the scheduling side, to a small amount of bookkeeping: a
+FCFS queue, a free-slot list over the KV pool, and an admission gate
+that answers one question — does this request's worst case
+(``len(prompt) + max_tokens``) fit a slot? Everything dynamic
+(admission, completion, eviction) is a host-side list operation; the
+device only ever sees fixed-shape control vectors.
+
+The scheduler is deliberately free of jax and telemetry: pure logic the
+engine drives (and tests exercise without a device). Preemption is a
+non-goal — admission guarantees a request admitted to a slot runs to
+completion (no swapping, no recompute-on-resume), which is the right
+trade for fixed-shape slots where eviction can't free partial bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode knobs — traced per-slot operands in the engine
+    step (so changing them across requests never recompiles)."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    eos_id: Optional[int] = None
+    max_tokens: int = 16
+
+
+@dataclasses.dataclass
+class Request:
+    """One request's full lifecycle: queued → prefill → decode → done
+    (or rejected at admission)."""
+
+    id: int
+    prompt: np.ndarray                 # (P,) int32
+    sampling: SamplingParams
+    submit_s: float
+    status: str = "queued"
+    slot: Optional[int] = None
+    tokens: list = dataclasses.field(default_factory=list)
+    first_token_s: Optional[float] = None
+    finish_s: Optional[float] = None
+    error: Optional[str] = None
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False, compare=False)
+
+    def result(self) -> dict:
+        return {"id": self.id, "status": self.status,
+                "tokens": list(self.tokens), "error": self.error}
+
+
+class Scheduler:
+    """FCFS admission over a fixed slot pool.
+
+    ``max_len`` gating is the HBM-budget gate in disguise: the pool was
+    sized so ``slots * max_len`` rows fit the budget
+    (``engine.memory.size_kv_pool``), so "fits a slot" == "fits HBM".
+    """
+
+    def __init__(self, slots: int, max_len: int):
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.queue: deque[Request] = deque()
+        self.free: list[int] = list(range(self.slots))
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, req: Request) -> bool:
+        """Queue ``req`` FCFS; False = rejected (can never fit a slot)."""
+        worst = len(req.prompt) + req.sampling.max_tokens
+        if len(req.prompt) == 0:
+            req.status, req.error = "rejected", "empty prompt"
+        elif worst > self.max_len:
+            req.status, req.error = "rejected", (
+                f"prompt {len(req.prompt)} + max_tokens "
+                f"{req.sampling.max_tokens} exceeds the {self.max_len}"
+                f"-token slot (HBM-budget gate)")
+        if req.status == "rejected":
+            req.done.set()
+            return False
+        self.queue.append(req)
+        return True
+
+    def next_admission(self) -> Optional[tuple[Request, int]]:
+        """Pop the oldest queued request into a free slot, or None."""
+        if not self.queue or not self.free:
+            return None
+        req = self.queue.popleft()
+        slot = self.free.pop(0)
+        req.slot = slot
+        req.status = "prefill"
+        return req, slot
+
+    def release(self, slot: int) -> None:
+        self.free.append(slot)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def occupancy(self) -> float:
+        return 1.0 - len(self.free) / self.slots

@@ -1,0 +1,129 @@
+"""Serving front end over the coordinator line protocol.
+
+The cluster already speaks one wire format — the newline-delimited
+command protocol of ``rpc/py_server.py`` / ``csrc/coordinator.cpp`` —
+so the serving plane rides it instead of inventing a second server:
+three commands (SUBMIT / RESULT / GENERATE) carry URL-quoted compact
+JSON payloads, which keeps every payload a single space-free token in
+the line protocol and survives any tokenizer's ids.
+
+``ServingServer`` is the convenience bundle: engine background loop +
+coordinator with the engine attached. ``CoordinatorClient`` grows the
+matching ``serving_*`` calls in ``rpc/client.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+from typing import Optional
+
+from hetu_tpu.serving.engine import ServingEngine
+from hetu_tpu.serving.scheduler import Request, SamplingParams
+
+
+def encode_payload(obj: dict) -> str:
+    """dict → one URL-quoted, space-free line-protocol token."""
+    return urllib.parse.quote(
+        json.dumps(obj, separators=(",", ":")), safe="")
+
+
+def decode_payload(tok: str) -> dict:
+    return json.loads(urllib.parse.unquote(tok))
+
+
+def sampling_from_payload(p: dict) -> SamplingParams:
+    return SamplingParams(
+        temperature=float(p.get("temperature", 0.0)),
+        top_k=int(p.get("top_k", 0)),
+        top_p=float(p.get("top_p", 0.0)),
+        eos_id=None if p.get("eos_id") is None else int(p["eos_id"]),
+        max_tokens=int(p.get("max_tokens", 16)))
+
+
+def submit_payload(engine: ServingEngine, tok: str) -> Request:
+    """SUBMIT handler: decode one request payload and queue it."""
+    p = decode_payload(tok)
+    return engine.submit(p["prompt"], sampling_from_payload(p))
+
+
+class ServingServer:
+    """Engine loop + coordinator in one lifecycle.
+
+    The coordinator keeps its full role (RANK/KV/BARRIER for the
+    training fleet); the serving commands only light up when an engine
+    is attached — one process can coordinate training AND serve.
+    """
+
+    def __init__(self, engine: ServingEngine, port: int,
+                 bind: str = "127.0.0.1", token: str = ""):
+        from hetu_tpu.rpc.py_server import PyCoordinatorServer
+        self.engine = engine
+        self.coordinator = PyCoordinatorServer(port, bind=bind,
+                                               token=token,
+                                               serving=engine)
+
+    def start(self) -> None:
+        self.engine.start()
+        self.coordinator.start()
+
+    def wait_ready(self, timeout: float = 10.0) -> None:
+        self.coordinator.wait_ready(timeout)
+
+    def stop(self) -> None:
+        self.coordinator.stop()
+        self.engine.stop()
+
+
+#: SUBMITted-but-never-polled requests must not leak in a long-running
+#: server: beyond this many live entries, FINISHED requests are evicted
+#: oldest-first (in-flight ones are always kept — their slots are real)
+_REQUEST_MAP_CAP = 4096
+
+
+def _prune_request_map(m: dict) -> None:
+    if len(m) <= _REQUEST_MAP_CAP:
+        return
+    for rid in [rid for rid, r in m.items()
+                if r.done.is_set()][:len(m) - _REQUEST_MAP_CAP]:
+        m.pop(rid, None)
+
+
+def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
+                           args: list) -> Optional[str]:
+    """Dispatch one serving line-protocol command; None = not ours.
+
+    Kept here (not in ``py_server``) so the coordinator stays
+    importable without jax — it only calls in when an engine was
+    attached and a serving verb arrives.
+    """
+    if cmd not in ("SUBMIT", "RESULT", "GENERATE"):
+        return None
+    if engine is None:
+        return "ERR serving disabled"
+    try:
+        if cmd == "SUBMIT":
+            req = submit_payload(engine, args[0])
+            if req.status == "rejected":
+                return f"ERR rejected: {req.error}"
+            engine._requests_by_id[req.id] = req
+            _prune_request_map(engine._requests_by_id)
+            return f"ID {req.id}"
+        if cmd == "RESULT":
+            req = engine._requests_by_id.get(int(args[0]))
+            if req is None:
+                return "ERR unknown request id"
+            timeout_ms = int(args[1]) if len(args) > 1 else 0
+            r = engine.result(req, timeout=timeout_ms / 1e3)
+            if r is None:
+                return "PEND"
+            engine._requests_by_id.pop(req.id, None)
+            return f"VAL {encode_payload(r)}"
+        # GENERATE: blocking submit + wait (the engine loop must be
+        # running — ServingServer.start does that)
+        req = submit_payload(engine, args[0])
+        r = req.result() if req.status == "rejected" \
+            else engine.result(req, timeout=None)
+        return f"VAL {encode_payload(r)}"
+    except Exception as e:                        # noqa: BLE001
+        return f"ERR {type(e).__name__}: {e}"

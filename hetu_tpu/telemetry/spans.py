@@ -72,6 +72,7 @@ NULL_SPAN = _NullSpan()
 DEFAULT_COUNTER_TRACK_PREFIXES = (
     "mem_", "comm_", "dp_grad_syncs_total", "optimizer_updates_total",
     "step_cache_", "tp_ring_fallback_total", "data_stall_seconds",
+    "serving_",
 )
 
 

@@ -122,6 +122,12 @@ class ModelDims:
     bytes_per_el: int = 2             # bf16 activations/weights on the wire
     num_experts: int = 0
     moe_top_k: int = 2
+    # per-layer relative attention intensity (len = num_layers), e.g.
+    # 1.0 for full attention, window/seq_len for sliding-window layers.
+    # None = homogeneous stack. Consumed by the memory-plane remat
+    # policy engine (engine.memory.derive_remat_mask) to remat the
+    # attention-heavy layers FIRST instead of an arbitrary prefix.
+    layer_attn_scale: Optional[tuple] = None
 
     @classmethod
     def from_config(cls, cfg, *, seq_len: int, global_batch: int):
@@ -147,6 +153,15 @@ class ModelDims:
         if self.num_experts > 0:
             mlp_dense *= self.num_experts
         return attn + mlp_dense
+
+    def attn_param_share(self) -> float:
+        """Attention's fraction of one block's params — the proxy the
+        memory ledger uses to split a layer's residual bytes into
+        attention vs MLP classes (widths drive residual sizes)."""
+        h, hd = self.hidden, self.hidden // self.num_heads
+        attn = h * (self.num_heads * hd + 2 * self.num_kv_heads * hd) \
+            + self.num_heads * hd * h
+        return attn / self.layer_params()
 
     def total_params(self) -> float:
         return self.num_layers * self.layer_params() \

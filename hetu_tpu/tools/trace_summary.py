@@ -270,6 +270,77 @@ def memory_plane_summary(records: list[dict]) -> Optional[list[str]]:
     return lines
 
 
+#: serving-plane series (hetu_tpu/serving): request/token flow, latency
+#: histograms (TTFT/TPOT), queue depth and slot occupancy — the direct
+#: evidence the continuous-batching engine is (or is not) keeping the
+#: pool busy without queueing collapse (docs/SERVING.md).
+_SERVING_PLANE_SERIES = (
+    "serving_requests_total", "serving_tokens_total",
+    "serving_queue_depth", "serving_slot_occupancy",
+    "serving_ttft_seconds", "serving_tpot_seconds",
+    "serving_step_seconds",
+)
+
+
+def serving_plane_summary(records: list[dict]) -> Optional[list[str]]:
+    """Lines for the serving-engine section, or None when no snapshot
+    carries ``serving_*`` series. Reads the LAST snapshot (counters are
+    cumulative, gauges last-write-wins, histograms carry their own
+    percentile summaries)."""
+    snap: Optional[dict] = None
+    for rec in records:
+        cand = rec.get("metrics") if rec.get("kind") == "metrics_snapshot" \
+            else rec.get("telemetry")
+        if isinstance(cand, dict) and any(
+                k.split("{")[0] in _SERVING_PLANE_SERIES for k in cand):
+            snap = cand
+    if snap is None:
+        return None
+    by_label: dict[str, dict[str, float]] = {}
+    hists: dict[str, dict] = {}
+    gauges: dict[str, float] = {}
+    for series, v in snap.items():
+        base = series.split("{")[0]
+        if base not in _SERVING_PLANE_SERIES:
+            continue
+        label = series.split('="', 1)[1].split('"', 1)[0] \
+            if "{" in series else ""
+        if isinstance(v, dict):                    # histogram summary
+            hists[base] = v
+        elif base in ("serving_queue_depth", "serving_slot_occupancy"):
+            gauges[base] = float(v)
+        else:
+            by_label.setdefault(base, {})[label] = float(v)
+    lines = []
+    width = 18
+    toks = by_label.get("serving_tokens_total", {})
+    if toks:
+        parts = " / ".join(f"{int(v)} {k}" for k, v in sorted(toks.items()))
+        lines.append("tokens".ljust(width) + parts)
+    reqs = by_label.get("serving_requests_total", {})
+    if reqs:
+        parts = " / ".join(f"{int(v)} {k}" for k, v in sorted(reqs.items()))
+        lines.append("requests".ljust(width) + parts)
+    for label, key in (("ttft", "serving_ttft_seconds"),
+                       ("tpot", "serving_tpot_seconds"),
+                       ("engine step", "serving_step_seconds")):
+        h = hists.get(key)
+        if h and h.get("count"):
+            lines.append(label.ljust(width)
+                         + f"p50 {h['p50'] * 1e3:.1f}ms  "
+                         f"p99 {h['p99'] * 1e3:.1f}ms  "
+                         f"(n={int(h['count'])})")
+    if "serving_slot_occupancy" in gauges:
+        lines.append("slot occupancy".ljust(width)
+                     + f"{100.0 * gauges['serving_slot_occupancy']:.0f}%"
+                     f" (last sample)")
+    if "serving_queue_depth" in gauges:
+        lines.append("queue depth".ljust(width)
+                     + f"{gauges['serving_queue_depth']:.0f} waiting "
+                     f"(last sample)")
+    return lines or None
+
+
 def summarize(path: str, *, wall_s: Optional[float] = None,
               top: int = 10) -> str:
     records = load_records(path)
@@ -294,6 +365,12 @@ def summarize(path: str, *, wall_s: Optional[float] = None,
         parts.append("")
         parts.append("== memory plane ==")
         parts.extend(mp)
+
+    sv = serving_plane_summary(records)
+    if sv:
+        parts.append("")
+        parts.append("== serving plane ==")
+        parts.extend(sv)
 
     rows = span_rollup(records, top=top)
     if rows:

@@ -114,6 +114,7 @@ SLOW_TESTS = {
     "test_generate_under_tp_mesh_matches_single_device",
     # driver artifacts
     "test_bench_emits_json_contract",
+    "test_bench_serving_emits_json_contract",
     "test_graft_entry_fn_runs",
     "test_dryrun_multichip_smoke",
     # example-script smoke

@@ -24,6 +24,28 @@ def test_bench_emits_json_contract():
     assert rec["value"] > 0
 
 
+def test_bench_serving_emits_json_contract(tmp_path):
+    """``bench.py --serving`` must emit the offered-load sweep headline
+    and write BENCH_serving.json (the serving-plane round evidence)."""
+    env = dict(os.environ)
+    env["HETU_TPU_BENCH_PLATFORM"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--serving"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "sweep"):
+        assert key in rec, (key, rec)
+    assert rec["value"] > 0
+    assert len(rec["sweep"]) >= 2
+    for row in rec["sweep"]:
+        for key in ("offered", "tokens_per_sec", "ttft_p50_ms",
+                    "ttft_p99_ms", "occupancy_mean"):
+            assert key in row, (key, row)
+    with open(os.path.join(_ROOT, "BENCH_serving.json")) as f:
+        assert json.load(f) == rec
+
+
 def test_graft_entry_fn_runs():
     import jax
     sys.path.insert(0, _ROOT)

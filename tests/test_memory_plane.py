@@ -304,6 +304,58 @@ def test_derive_remat_mask():
         mem.derive_remat_mask(dims, s, hbm_budget_bytes=1e6)
 
 
+def test_derive_remat_mask_attention_first():
+    """Beyond uniform prefixes: with per-layer attention intensity
+    (ModelDims.layer_attn_scale) the mask remats the ATTENTION-HEAVY
+    layers first — greedy by the ledger's per-class byte split — and
+    homogeneous stacks still degrade to the historical prefix."""
+    import dataclasses as dc
+    base = ModelDims.from_config(GPTConfig.small(), seq_len=1024,
+                                 global_batch=64)
+    s = Strategy(dp=8, zero=True)
+    none_bd = mem.estimate_breakdown(base, s)
+    budget = none_bd.peak_bytes * 0.75
+
+    # heterogeneous stack: even layers full attention, odd layers a
+    # 1/8 sliding window (attention residuals 8x smaller)
+    scales = tuple(1.0 if i % 2 == 0 else 0.125
+                   for i in range(base.num_layers))
+    dims = dc.replace(base, layer_attn_scale=scales)
+    w = mem.layer_act_weights(dims)
+    assert w[0] > w[1]                    # attention-heavy weighs more
+    mask = mem.derive_remat_mask(dims, s, hbm_budget_bytes=budget)
+    assert mask is not None and 0 < sum(mask) < base.num_layers
+    # every rematted layer is attention-heavy before ANY windowed layer
+    # is touched (the greedy picks by descending savings)
+    if sum(mask) <= base.num_layers // 2:
+        assert all(scales[i] == 1.0
+                   for i in range(base.num_layers) if mask[i])
+        assert any(not mask[i] for i in range(base.num_layers))
+        assert mask != tuple(i < sum(mask)
+                             for i in range(base.num_layers))
+
+    # the chosen mask actually fits per the weighted ledger split
+    full_bd = mem.estimate_breakdown(
+        base, Strategy(dp=8, zero=True, remat="full"))
+    fixed = none_bd.params_bytes + none_bd.grads_bytes + none_bd.opt_bytes
+    wsum = sum(w)
+    n = base.num_layers
+    peak = fixed + sum(
+        (full_bd.act_bytes / n) if mask[i]
+        else none_bd.act_bytes * w[i] / wsum for i in range(n))
+    assert peak <= budget
+
+    # uniform weights → the historical prefix (ties break on index)
+    pref = mem.derive_remat_mask(base, s, hbm_budget_bytes=budget)
+    k = sum(pref)
+    assert pref == tuple(i < k for i in range(base.num_layers))
+    # explicit weights override: weight the TAIL heavier, mask follows
+    rev = mem.derive_remat_mask(
+        base, s, hbm_budget_bytes=budget,
+        weights=tuple(range(1, base.num_layers + 1)))
+    assert rev is not None and rev[-1] and not rev[0]
+
+
 def test_search_uniform_hbm_budget_rejection():
     """ACCEPTANCE: search_uniform(hbm_budget_bytes=...) rejects
     over-budget candidates and prices remat recompute — a remat

@@ -1,0 +1,323 @@
+"""Serving plane (ISSUE 5): continuous-batching engine over the
+slot-pooled KV cache.
+
+Acceptance discipline: the engine is a SCHEDULING transform, not a
+numerical one — every request's greedy tokens must be identical to a
+one-shot ``models.generation.generate`` of that request alone (same
+cache capacity), independent of arrival order, slot assignment, chunked
+prefill, and cache dtype; and request churn must never recompile the
+fused step (the PR 2 ``record_trace`` counter stays at its initial
+compile count).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu import telemetry
+from hetu_tpu.engine import trace_counts
+from hetu_tpu.models import (
+    GPTConfig, GPTLMHeadModel, LlamaConfig, LlamaLMHeadModel, generate,
+)
+from hetu_tpu.serving import (
+    KVPool, Request, SamplingParams, Scheduler, ServingEngine,
+)
+
+MAX_LEN = 32
+CHUNK = 8
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.key(0), dtype=jnp.float32)
+    return cfg, model, params
+
+
+def _prompts(cfg, lens, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, (L,)).tolist() for L in lens]
+
+
+def _ref(model, params, prompt, max_tokens, **kw):
+    """One-shot generate of a single request at the POOL's cache
+    capacity (same reduction lengths as the slot arena)."""
+    out = generate(model, params, jnp.asarray(prompt, jnp.int32)[None],
+                   max_new_tokens=max_tokens, max_len=MAX_LEN, **kw)
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+def test_engine_matches_generate_any_arrival_order(gpt):
+    """ACCEPTANCE: greedy tokens are identical to per-request one-shot
+    generate, for every request, under both arrival orders — with only
+    2 slots so later requests queue and recycle evicted slots."""
+    cfg, model, params = gpt
+    prompts = _prompts(cfg, [5, 11, 3, 8, 17, 2, 9, 6])
+    sp = SamplingParams(max_tokens=6)
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK)
+    want = [_ref(model, params, p, 6) for p in prompts]
+    assert eng.generate_many(prompts, sp) == want
+    assert eng.generate_many(list(reversed(prompts)), sp) \
+        == list(reversed(want))
+
+
+def test_engine_zero_retraces_across_churn(gpt):
+    """ACCEPTANCE: >= 8 admits/evictions churn one compiled step — the
+    re-trace counter equals the initial compile count (exactly 1)."""
+    cfg, model, params = gpt
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK)
+    before = trace_counts().get("serving_step", 0)
+    prompts = _prompts(cfg, [5, 11, 3, 8, 17, 2, 9, 6, 13, 4], seed=3)
+    outs = eng.generate_many(prompts, SamplingParams(max_tokens=4))
+    assert len(outs) == 10 and all(len(o) == 4 for o in outs)
+    after = trace_counts().get("serving_step", 0)
+    assert after - before == 1, (
+        f"request churn re-traced the fused step "
+        f"({after - before} traces for 10 admits/evictions)")
+    # second engine over the SAME model/shapes: jit cache hit, still no
+    # new trace even across engine objects
+    eng2 = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                         prefill_chunk=CHUNK)
+    eng2.generate_many(prompts[:3], SamplingParams(max_tokens=3))
+    assert trace_counts().get("serving_step", 0) - after <= 1
+
+
+def test_engine_int8_pool_matches_int8_generate(gpt):
+    """ACCEPTANCE: the quantized pool reproduces one-shot int8-cache
+    generation token for token (row-wise scales make chunked prefill
+    quantization identical to one-pass quantization)."""
+    cfg, model, params = gpt
+    prompts = _prompts(cfg, [5, 11, 3, 14], seed=1)
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, cache_dtype=jnp.int8)
+    assert eng.pool.quantized
+    sp = SamplingParams(max_tokens=5)
+    want = [_ref(model, params, p, 5, cache_dtype=jnp.int8)
+            for p in prompts]
+    assert eng.generate_many(prompts, sp) == want
+
+
+def test_engine_eos_and_sampling_params(gpt):
+    """Per-slot sampling params are traced operands: mixed greedy and
+    sampled requests run in one batch without retracing, EOS stops a
+    request early, and sampled tokens stay in range."""
+    cfg, model, params = gpt
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK)
+    prompts = _prompts(cfg, [6, 9, 4], seed=2)
+    before = trace_counts().get("serving_step", 0)
+    greedy = SamplingParams(max_tokens=8)
+    sampled = SamplingParams(temperature=1.0, top_k=10, top_p=0.9,
+                             max_tokens=8)
+    outs = eng.generate_many(prompts, [greedy, sampled, greedy])
+    assert trace_counts().get("serving_step", 0) - before <= 1
+    assert outs[0] == _ref(model, params, prompts[0], 8)
+    assert outs[2] == _ref(model, params, prompts[2], 8)
+    assert all(0 <= t < cfg.vocab_size for t in outs[1])
+    # EOS: pick the greedy run's first token as eos — request finishes
+    # after exactly one token
+    eos = outs[0][0]
+    out = eng.generate_many([prompts[0]],
+                            SamplingParams(max_tokens=8, eos_id=eos))[0]
+    assert out == [eos]
+
+
+def test_generate_many_rejection_raises(gpt):
+    """Offline API: a request that can never fit a slot fails FAST and
+    loud (not a silent empty output), and queued siblings are cleaned
+    up so the engine stays drained."""
+    cfg, model, params = gpt
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK)
+    ok, too_long = _prompts(cfg, [4, MAX_LEN + 1], seed=9)
+    with pytest.raises(ValueError, match="rejected at admission"):
+        eng.generate_many([ok, too_long], SamplingParams(max_tokens=4))
+    assert not eng.has_work()                # sibling was un-queued
+    # the engine still serves fine afterwards
+    assert eng.generate_many([ok], SamplingParams(max_tokens=4)) \
+        == [_ref(model, params, ok, 4)]
+
+
+def test_llama_engine_smoke():
+    """The engine is model-agnostic: Llama (RoPE + GQA) greedy parity."""
+    cfg = LlamaConfig.tiny()
+    model = LlamaLMHeadModel(cfg)
+    params = model.init(jax.random.key(0), dtype=jnp.float32)
+    prompts = _prompts(cfg, [5, 9], seed=4)
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK)
+    outs = eng.generate_many(prompts, SamplingParams(max_tokens=4))
+    assert outs == [_ref(model, params, p, 4) for p in prompts]
+
+
+def test_scheduler_fcfs_and_hbm_gating(gpt):
+    """Pure-scheduler logic: FCFS order, slot recycling, and the
+    max_len (= HBM budget) admission gate."""
+    cfg, model, params = gpt
+    sched = Scheduler(slots=2, max_len=16)
+
+    def mk(i, plen, max_tokens=4):
+        return Request(id=i, prompt=np.arange(1, plen + 1, dtype=np.int32),
+                       sampling=SamplingParams(max_tokens=max_tokens),
+                       submit_s=0.0)
+
+    too_long = mk(0, 14, max_tokens=4)        # 14 + 4 > 16
+    assert not sched.submit(too_long)
+    assert too_long.status == "rejected" and "HBM" in too_long.error
+    assert not sched.submit(mk(1, 0))         # empty prompt
+    a, b, c = mk(2, 4), mk(3, 4), mk(4, 4)
+    assert all(sched.submit(r) for r in (a, b, c))
+    r1 = sched.next_admission()
+    r2 = sched.next_admission()
+    assert (r1[0].id, r2[0].id) == (2, 3)     # FCFS
+    assert sched.next_admission() is None     # no free slot
+    assert sched.depth == 1 and sched.occupancy == 1.0
+    sched.release(r1[1])
+    r3 = sched.next_admission()
+    assert r3[0].id == 4 and r3[1] == r1[1]   # recycled slot
+
+    # pool sizing from the memory ledger: budget -> slots, and the
+    # engine accepts the ledger-sized pool end to end
+    from hetu_tpu.engine.memory import kv_bytes_per_slot, size_kv_pool
+    from hetu_tpu.tools.galvatron.cost_model import ModelDims
+    per = kv_bytes_per_slot(cfg, max_len=MAX_LEN)
+    weights = ModelDims.from_config(
+        cfg, seq_len=MAX_LEN, global_batch=1).total_params() * 4
+    budget = (weights + 5.2 * per) / 0.9
+    assert size_kv_pool(cfg, hbm_budget_bytes=budget,
+                        max_len=MAX_LEN) == 5
+    with pytest.raises(ValueError, match="does not fit"):
+        size_kv_pool(cfg, hbm_budget_bytes=weights, max_len=MAX_LEN)
+    pool = KVPool.sized_for(model, hbm_budget_bytes=budget,
+                            max_len=MAX_LEN)
+    assert pool.slots == 5
+    # int8 pool: >2x the slots of fp32 in the same budget
+    assert size_kv_pool(cfg, hbm_budget_bytes=budget, max_len=MAX_LEN,
+                        cache_dtype="int8") > 5
+
+
+def test_serving_telemetry_and_trace_summary(gpt, tmp_path):
+    """Request-level telemetry: token/request counters, TTFT/TPOT
+    histograms, queue/occupancy gauges — and the trace_summary
+    'serving plane' section renders them from the exported artifact."""
+    cfg, model, params = gpt
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                            prefill_chunk=CHUNK, counter_sample_every=2)
+        prompts = _prompts(cfg, [5, 11, 3, 8], seed=5)
+        eng.generate_many(prompts, SamplingParams(max_tokens=4))
+        reg = telemetry.get_registry()
+        assert reg.counter("serving_requests_total").value(
+            outcome="submitted") == 4
+        assert reg.counter("serving_requests_total").value(
+            outcome="completed") == 4
+        assert reg.counter("serving_tokens_total").value(
+            kind="prompt") == sum(len(p) for p in prompts)
+        assert reg.counter("serving_tokens_total").value(
+            kind="generated") == 16
+        assert reg.histogram("serving_ttft_seconds").summary()["count"] \
+            == 4
+        assert reg.histogram("serving_tpot_seconds").summary()["count"] \
+            == 4
+        assert reg.gauge("serving_slot_occupancy").value() == 0.0
+        # Perfetto counter tracks sampled serving_* series
+        assert any(s[0].startswith("serving_")
+                   for s in telemetry.get_tracer().counter_samples())
+
+        paths = telemetry.export_dir(str(tmp_path))
+        from hetu_tpu.tools.trace_summary import summarize
+        text = summarize(paths["jsonl"])
+        assert "== serving plane ==" in text
+        assert "ttft" in text and "tokens" in text
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()
+
+
+def test_rpc_serving_roundtrip(gpt):
+    """The line-protocol front end: SUBMIT/RESULT/GENERATE over the
+    coordinator, engine loop running in the background."""
+    import socket
+
+    from hetu_tpu.rpc.client import CoordinatorClient
+    from hetu_tpu.serving.server import ServingServer
+
+    cfg, model, params = gpt
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    srv = ServingServer(eng, port)
+    srv.start()
+    srv.wait_ready()
+    try:
+        cli = CoordinatorClient(port)
+        assert cli.ping()                     # coordinator role intact
+        prompt = _prompts(cfg, [6], seed=6)[0]
+        want = _ref(model, params, prompt, 5)
+        # blocking GENERATE
+        r = cli.serving_generate(prompt, max_tokens=5)
+        assert r["status"] == "done" and r["tokens"] == want
+        # SUBMIT + RESULT poll
+        rid = cli.serving_submit(prompt, max_tokens=5)
+        for _ in range(200):
+            r = cli.serving_result(rid, timeout_ms=100)
+            if r is not None:
+                break
+        assert r is not None and r["tokens"] == want
+        # admission gate surfaces as a protocol error
+        with pytest.raises(RuntimeError, match="rejected"):
+            cli.serving_submit(list(range(1, MAX_LEN + 2)), max_tokens=4)
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_online_submit_during_decode(gpt):
+    """Continuous batching, not batch-boundary batching: a request
+    submitted WHILE the engine decodes joins the running batch and
+    still reproduces its one-shot tokens."""
+    cfg, model, params = gpt
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK)
+    p1, p2 = _prompts(cfg, [9, 5], seed=7)
+    sp = SamplingParams(max_tokens=8)
+    r1 = eng.submit(p1, sp)
+    for _ in range(3):                        # p1 mid-flight
+        eng.step()
+    r2 = eng.submit(p2, sp)
+    eng.run_until_drained()
+    assert list(r1.tokens) == _ref(model, params, p1, 8)
+    assert list(r2.tokens) == _ref(model, params, p2, 8)
+
+
+@pytest.mark.slow
+def test_serving_under_tp2_mesh_matches_single_device(gpt):
+    """ACCEPTANCE (degree-2 mesh): TP-sharded serving via the existing
+    Strategy/make_plan path produces the single-device tokens."""
+    from hetu_tpu import optim
+    from hetu_tpu.engine import make_plan
+    from hetu_tpu.parallel.sharding import shard_params
+    from hetu_tpu.parallel.strategy import Strategy
+
+    cfg, model, params = gpt
+    prompts = _prompts(cfg, [5, 11, 3, 8], seed=8)
+    sp = SamplingParams(max_tokens=6)
+    ref_eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                            prefill_chunk=CHUNK)
+    want = ref_eng.generate_many(prompts, sp)
+
+    plan = make_plan(model, optim.adamw(1e-3), Strategy(tp=2))
+    sp_params = shard_params(params, plan.mesh, plan.param_specs)
+    eng = ServingEngine(model, sp_params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, plan=plan)
+    assert eng.generate_many(prompts, sp) == want
+    # and every request still matches its one-shot generate
+    assert want == [_ref(model, params, p, 6) for p in prompts]

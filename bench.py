@@ -218,8 +218,10 @@ def serving_main():
 
     model = GPTLMHeadModel(cfg)
     params = model.init(jax.random.key(0), dtype=jnp.float32)
+    # slo=True: the default TTFT/TPOT burn-rate rules ride the sweep so
+    # the bench artifact carries an SLO verdict alongside the latencies
     engine = ServingEngine(model, params, slots=slots, max_len=max_len,
-                           prefill_chunk=chunk)
+                           prefill_chunk=chunk, slo=True)
     rng = np.random.default_rng(0)
     sp = SamplingParams(max_tokens=max_tokens)
     reg = telemetry.get_registry()
@@ -242,6 +244,8 @@ def serving_main():
             engine.step()
             occ.append(engine.scheduler.occupancy)
         wall = time.perf_counter() - t0
+        engine.slo.evaluate()   # bench drives step() itself, so the
+                                # loop-cadence SLO pass runs here
         ttft = reg.histogram("serving_ttft_seconds").summary()
         tpot = reg.histogram("serving_tpot_seconds").summary()
         gen = reg.counter("serving_tokens_total").value(kind="generated")
@@ -255,6 +259,13 @@ def serving_main():
             else 0.0,
         })
     best = max(s["tokens_per_sec"] for s in sweep)
+    # production-observability verdicts + the flight-record artifact
+    # (the postmortem a failed bench run leaves behind)
+    from hetu_tpu.telemetry import get_flight_recorder, health_status
+    health = health_status(serving=engine, slo=engine.slo)
+    flight_path = os.path.join(
+        os.path.dirname(_BENCH_SERVING_PATH), "BENCH_flight.jsonl")
+    get_flight_recorder().dump(flight_path, reason="bench")
     result = {
         "metric": "serving_tokens_per_sec"
         if on_tpu else "serving_tokens_per_sec_cpu_smoke",
@@ -262,6 +273,10 @@ def serving_main():
         "device": getattr(dev, "device_kind", dev.platform),
         "slots": slots, "max_len": max_len, "prefill_chunk": chunk,
         "max_tokens": max_tokens, "sweep": sweep,
+        "health": {"status": health["status"],
+                   "slo": health["slo"],
+                   "watchdog_trips": health["watchdog_trips"]},
+        "flight_record": os.path.basename(flight_path),
     }
     with open(_BENCH_SERVING_PATH, "w") as f:
         json.dump(result, f, indent=1)

@@ -95,6 +95,24 @@ class TrainerConfig:
                                  # reduce locally). 0 = off. Aggregates
                                  # land in telemetry.jsonl as
                                  # kind=cluster_aggregate records.
+    watchdog: bool = False       # hang watchdog around train(): a
+                                 # monitor thread trips when no step
+                                 # completes within watchdog_factor x
+                                 # the rolling median step interval,
+                                 # dumps the flight record + all-thread
+                                 # stacks to trace_dir (or cwd) and
+                                 # bumps watchdog_trips_total
+                                 # (docs/OBSERVABILITY.md "Flight
+                                 # recorder & watchdog")
+    watchdog_factor: float = 8.0
+    watchdog_min_timeout_s: float = 30.0
+    slo: bool = False            # SLO/anomaly engine on the log
+                                 # cadence: step-time regression, loss
+                                 # spike, grad-norm spike against
+                                 # rolling baselines; alerts are logged,
+                                 # counted (slo_alerts_total) and
+                                 # written to telemetry.jsonl as
+                                 # kind=slo_alert records
 
     def policy(self) -> Policy:
         return BF16_COMPUTE if self.precision == "bf16" else FP32
@@ -134,6 +152,14 @@ class Trainer:
             telemetry.enable(True)
         self.tracer = telemetry.get_tracer()
         self.registry = telemetry.get_registry()
+        # production-observability side-band (telemetry/flight.py,
+        # telemetry/slo.py): the flight recorder is always on; the
+        # watchdog and SLO engine are created on demand by train()
+        self.flight = telemetry.get_flight_recorder()
+        self.slo: Optional[telemetry.SLOEngine] = None
+        if self.config.slo:
+            self.slo = telemetry.default_training_rules(
+                telemetry.SLOEngine(self.registry))
         self.goodput: Optional[GoodputAccountant] = None
         # JSONL export high-water mark; keyed to the tracer epoch so a
         # telemetry.reset() between runs restarts the window instead of
@@ -210,7 +236,10 @@ class Trainer:
                         self.model, self.opt, strategy,
                         devices=self.devices,
                         attn_impl=self.config.attn_impl)
-            self._note("compile", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._note("compile", dt)
+            self.flight.record("compile", hetero=hetero,
+                               seconds=round(dt, 3))
             return entry
 
         if self.config.step_cache:
@@ -230,7 +259,10 @@ class Trainer:
                 # cross-topology + volume attrs); only the ledger lives
                 # here
                 self.state = switch_strategy(to_homo_state(), entry.plan)
-            self._note("switch", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._note("switch", dt)
+            self.flight.record("switch", hetero=hetero,
+                               seconds=round(dt, 3))
             get_logger().info(
                 f"hot-switched to {'hetero ' if hetero else ''}"
                 f"{strategy.to_json()} at step "
@@ -360,7 +392,10 @@ class Trainer:
         # the span/ledger cover what BLOCKED the loop (previous writer
         # drain + device→host gather + sync write); an async write's own
         # latency is tracked by checkpoint_write_seconds on its thread
-        self._note("checkpoint", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._note("checkpoint", dt)
+        self.flight.record("checkpoint", path=path,
+                           blocked_s=round(dt, 3))
         return path
 
     # -- training ----------------------------------------------------------
@@ -401,7 +436,19 @@ class Trainer:
         t_last = time.perf_counter()
         tokens_since = 0
         tokens_total = 0
+        slo_blocked_s = 0.0   # eval/checkpoint time inside the current
+                              # log interval — excluded from the SLO
+                              # step-time observation
         host_step = int(jax.device_get(self.state.step))
+        # hang watchdog for THIS run: fed once per completed step; trips
+        # dump the flight record + thread stacks to trace_dir (or cwd)
+        watchdog = None
+        if self.config.watchdog:
+            watchdog = telemetry.HangWatchdog(
+                name="train", factor=self.config.watchdog_factor,
+                min_timeout_s=self.config.watchdog_min_timeout_s,
+                dump_dir=self.config.trace_dir or ".",
+                registry=self.registry).start()
         prefetcher = None
         if self.config.prefetch > 0:
             from hetu_tpu.data.prefetch import DevicePrefetcher
@@ -414,6 +461,7 @@ class Trainer:
             it: Iterator[dict] = prefetcher
         else:
             it = (self.plan.shard_batch(b) for b in batches)
+        failed: Optional[str] = None   # exception name when train() dies
         try:
             for _ in range(steps):
                 t_iter = time.perf_counter()
@@ -432,6 +480,11 @@ class Trainer:
                 self.state, metrics = self._step_fn(self.state, sbatch)
                 host_step += 1
                 acct.add_step()
+                # step boundary into the black box; one beat per
+                # completed step feeds the watchdog's rolling median
+                self.flight.record("step", step=host_step)
+                if watchdog is not None:
+                    watchdog.beat()
                 ntok = int(sbatch["input_ids"].size)
                 tokens_since += ntok
                 tokens_total += ntok
@@ -439,15 +492,33 @@ class Trainer:
                 if self.config.log_every and \
                         host_step % self.config.log_every == 0:
                     loss = float(jax.device_get(metrics["loss"]))
+                    grad_norm = float(
+                        jax.device_get(metrics["grad_norm"]))
                     now = time.perf_counter()
                     rec = self.metrics.log(
                         host_step, loss=loss,
-                        grad_norm=float(
-                            jax.device_get(metrics["grad_norm"])),
+                        grad_norm=grad_norm,
                         tokens_per_sec=round(
                             tokens_since / (now - t_last), 1),
                         tokens_total=tokens_total)
                     history.append(rec)
+                    if self.slo is not None:
+                        # one observation per log interval, then run
+                        # every detector (burn rates + regressions).
+                        # Known blocking work (eval, checkpoint drain)
+                        # is subtracted — it is accounted overhead, not
+                        # a step-time regression
+                        self.slo.observe("loss", loss)
+                        self.slo.observe("grad_norm", grad_norm)
+                        self.slo.observe(
+                            "step_time_s",
+                            max(now - t_last - slo_blocked_s, 0.0)
+                            / self.config.log_every)
+                        slo_blocked_s = 0.0
+                        for a in self.slo.evaluate():
+                            get_logger().warning(f"SLO alert: "
+                                                 f"{a.message}")
+                            self.metrics.write_record(a.to_record())
                     t_last, tokens_since = now, 0
                     if tel:
                         # sample the mem_*/comm_* registry series into
@@ -469,21 +540,47 @@ class Trainer:
                     acct.record("compute", step_s)
                 if self.config.eval_every and eval_batches is not None \
                         and host_step % self.config.eval_every == 0:
+                    # eval/checkpoint are legitimately long blocking
+                    # operations, not hangs: suspend trip checks so a
+                    # slow eval pass or writer drain never produces a
+                    # false "the run HUNG" flight dump
+                    if watchdog is not None:
+                        watchdog.pause()
                     t0 = time.perf_counter()
                     with telemetry.span("eval", step=host_step):
                         ev = self.evaluate(eval_batches())
-                    acct.record("eval", time.perf_counter() - t0)
+                    ev_s = time.perf_counter() - t0
+                    acct.record("eval", ev_s)
+                    slo_blocked_s += ev_s
                     history.append(self.metrics.log(host_step,
                                                     eval_loss=ev))
+                    if watchdog is not None:
+                        watchdog.resume()
                 if self.config.aggregate_every and telemetry.enabled() \
                         and host_step % self.config.aggregate_every == 0:
                     self._aggregate_cluster(host_step)
                 if self.config.ckpt_every and self.config.ckpt_dir and \
                         host_step % self.config.ckpt_every == 0:
+                    if watchdog is not None:
+                        watchdog.pause()
+                    t0 = time.perf_counter()
                     self.save()   # notes "checkpoint" in the ledger
+                    slo_blocked_s += time.perf_counter() - t0
+                    if watchdog is not None:
+                        watchdog.resume()
             if self.config.ckpt_dir:
+                if watchdog is not None:
+                    watchdog.pause()
                 self.save(wait=True)
+        except BaseException as e:
+            # explicit capture, NOT sys.exc_info() in the finally: that
+            # would also see a CALLER's in-flight handled exception and
+            # overwrite the flight postmortem after a successful run
+            failed = type(e).__name__
+            raise
         finally:
+            if watchdog is not None:
+                watchdog.stop()
             if prefetcher is not None:
                 self._live_prefetcher = None
                 prefetcher.close()
@@ -491,6 +588,14 @@ class Trainer:
             # export in the failure path too: a crashed run is exactly
             # when the operator needs the trace (best-effort — an export
             # problem must not mask the training error)
+            if failed is not None:
+                try:
+                    self.flight.record("train_error", error=failed)
+                    self.flight.dump(
+                        self.flight.default_path(self.config.trace_dir),
+                        reason="train_error", stacks=True)
+                except Exception:
+                    pass
             if tel:
                 try:
                     self.export_telemetry()

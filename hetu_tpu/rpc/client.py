@@ -106,6 +106,24 @@ class CoordinatorClient:
             raise RuntimeError(f"serving generate failed: {resp}")
         return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
 
+    # -- live observability (HEALTHZ / METRICS verbs) -----------------------
+    def healthz(self) -> dict:
+        """Live health document: overall status, watchdog trips, SLO
+        alerting state, serving queue/occupancy (telemetry.health_status
+        evaluated on the coordinator process)."""
+        resp = self._cmd("HEALTHZ")
+        if not resp.startswith("VAL "):
+            raise RuntimeError(f"healthz failed: {resp}")
+        return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the coordinator process's
+        metric registry (scrape-through for a sidecar exporter)."""
+        resp = self._cmd("METRICS")
+        if not resp.startswith("VAL "):
+            raise RuntimeError(f"metrics failed: {resp}")
+        return urllib.parse.unquote(resp.split(" ", 1)[1])
+
     def ping(self) -> bool:
         return self._cmd("PING") == "PONG"
 

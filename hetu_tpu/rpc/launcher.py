@@ -114,8 +114,16 @@ def bootstrap_distributed(*, coord_port: Optional[int] = None,
                 addr = client.get(key)
         import jax
         from hetu_tpu.core.compat import enable_cpu_collectives
+        from hetu_tpu.telemetry.flight import flight_record
         enable_cpu_collectives()   # old-jax CPU default is "none"
+        # collective bootstraps are the classic distributed-hang site:
+        # bracket the blocking initialize in the black box so a wedged
+        # rendezvous is attributable post-mortem
+        flight_record("collective_bootstrap", phase="start", rank=rank,
+                      num_processes=n, addr=addr)
         jax.distributed.initialize(addr, num_processes=n, process_id=rank)
+        flight_record("collective_bootstrap", phase="done", rank=rank,
+                      num_processes=n)
 
     if heartbeat:
         from hetu_tpu.engine.elastic import HeartbeatSender

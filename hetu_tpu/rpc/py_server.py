@@ -5,6 +5,7 @@ toolchain exists. Reference analogue: ``rpc/heturpc_polling_server.py``.
 
 from __future__ import annotations
 
+import json
 import socket
 import socketserver
 import threading
@@ -95,6 +96,26 @@ class _Handler(socketserver.StreamRequestHandler):
                 resp = handle_serving_command(
                     getattr(self.server, "serving", None), cmd, args)
                 self._send(resp or "ERR unknown command")
+            elif cmd == "HEALTHZ":
+                # live health document: SLO state, watchdog trips,
+                # serving queue/occupancy (telemetry/slo.health_status)
+                import urllib.parse
+
+                from hetu_tpu.telemetry.slo import health_status
+                serving = getattr(self.server, "serving", None)
+                doc = health_status(
+                    serving=serving,
+                    slo=getattr(serving, "slo", None))
+                self._send("VAL " + urllib.parse.quote(
+                    json.dumps(doc, separators=(",", ":")), safe=""))
+            elif cmd == "METRICS":
+                # Prometheus text exposition of the process-global
+                # registry (URL-quoted onto the one-line protocol)
+                import urllib.parse
+
+                from hetu_tpu import telemetry
+                self._send("VAL " + urllib.parse.quote(
+                    telemetry.get_registry().to_prometheus(), safe=""))
             elif cmd == "PING":
                 self._send("PONG")
             elif cmd == "SHUTDOWN":

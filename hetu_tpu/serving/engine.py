@@ -45,6 +45,12 @@ from hetu_tpu.engine.train_step import record_trace
 from hetu_tpu.models import generation
 from hetu_tpu.serving.kv_pool import KVPool
 from hetu_tpu.serving.scheduler import Request, SamplingParams, Scheduler
+from hetu_tpu.telemetry.flight import HangWatchdog, flight_record
+from hetu_tpu.telemetry.slo import SLOEngine, default_serving_rules
+
+#: per-request Perfetto tracks: synthetic tids offset far above real
+#: thread ids so request timelines never collide with thread tracks
+REQ_TRACK_BASE = 1 << 40
 
 
 def sample_slots(logits, temperature, top_k, top_p, rng):
@@ -92,7 +98,11 @@ class ServingEngine:
                  cache_dtype=jnp.float32,
                  hbm_budget_bytes: Optional[float] = None,
                  plan=None, seed: int = 0,
-                 counter_sample_every: int = 32):
+                 counter_sample_every: int = 32,
+                 watchdog: bool = False, watchdog_factor: float = 8.0,
+                 watchdog_min_timeout_s: float = 30.0,
+                 slo: Union[bool, SLOEngine, None] = None,
+                 slo_every_s: float = 1.0):
         if slots is None:
             if hbm_budget_bytes is None:
                 raise ValueError("pass slots= or hbm_budget_bytes=")
@@ -137,6 +147,21 @@ class ServingEngine:
         self._step_lock = threading.Lock()
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
+        # production-observability side-band: a hang watchdog fed by the
+        # background loop, and an SLO engine evaluated on its cadence
+        # (slo=True installs the default TTFT/TPOT/step rules; pass a
+        # pre-configured SLOEngine for custom objectives)
+        self.watchdog: Optional[HangWatchdog] = HangWatchdog(
+            name="serving", factor=watchdog_factor,
+            min_timeout_s=watchdog_min_timeout_s,
+            registry=telemetry.get_registry()) if watchdog else None
+        if slo is True:
+            self.slo: Optional[SLOEngine] = default_serving_rules(
+                SLOEngine(telemetry.get_registry()))
+        else:
+            self.slo = slo or None
+        self._slo_every_s = float(slo_every_s)
+        self._slo_last_eval = 0.0
         self._fn = self._build_step()
 
     # -- the jit-once fused step --------------------------------------------
@@ -225,6 +250,9 @@ class ServingEngine:
         reg.counter("serving_requests_total",
                     "serving requests by outcome").inc(
             outcome="submitted" if admitted else "rejected")
+        flight_record("serving_submit", req=req.id, trace=req.trace_id,
+                      prompt_len=len(req.prompt),
+                      outcome="queued" if admitted else "rejected")
         self._record_gauges()
         return req
 
@@ -261,6 +289,10 @@ class ServingEngine:
                     self._topp[slot] = sp.top_p
                     self._slot_req[slot] = req
                     self._prefill = {"req": req, "slot": slot, "off": 0}
+                    flight_record("serving_admit", req=req.id,
+                                  trace=req.trace_id, slot=slot,
+                                  queued_s=round(
+                                      time.monotonic() - req.submit_s, 4))
             pf_host = self._prefill
             active_prev = np.nonzero(self._active)[0]
             if pf_host is None and active_prev.size == 0:
@@ -310,6 +342,8 @@ class ServingEngine:
             # prefill progress
             if pf_host is not None:
                 pf_host["off"] += pf_valid
+                pf_host["req"].mark("prefill_chunk", dur_s=now - t0,
+                                    ts_s=t0)
                 reg.counter("serving_tokens_total",
                             "serving tokens by kind").inc(
                     pf_valid, kind="prompt")
@@ -319,16 +353,21 @@ class ServingEngine:
                     self._active[slot] = True
                     req.status = "decode"
                     req.first_token_s = now
+                    req.mark("first_token", ts_s=now)
+                    ttft = now - req.submit_s
                     reg.histogram(
                         "serving_ttft_seconds",
-                        "time submit -> first token").observe(
-                        now - req.submit_s)
+                        "time submit -> first token").observe(ttft)
+                    if self.slo is not None:
+                        self.slo.observe("serving_ttft_seconds", ttft)
                     self._on_token(slot, int(first_tok), now, reg)
                     self._prefill = None
             self._record_gauges()
+        step_s = time.monotonic() - t0
         reg.histogram("serving_step_seconds",
-                      "one fused engine iteration").observe(
-            time.monotonic() - t0)
+                      "one fused engine iteration").observe(step_s)
+        if self.slo is not None:
+            self.slo.observe("serving_step_seconds", step_s)
         if self._counter_sample_every and \
                 self._iter % self._counter_sample_every == 0:
             telemetry.get_tracer().record_counters(reg.snapshot())
@@ -355,6 +394,7 @@ class ServingEngine:
         req = self._slot_req[slot]
         req.status = "done"
         req.finish_s = now
+        req.mark("finish", ts_s=now)
         self._active[slot] = False
         self._slot_req[slot] = None
         self.scheduler.release(slot)
@@ -363,10 +403,47 @@ class ServingEngine:
             outcome="completed")
         n = len(req.tokens)
         if n > 1 and req.first_token_s is not None:
+            tpot = (now - req.first_token_s) / (n - 1)
             reg.histogram("serving_tpot_seconds",
                           "per-output-token time after the first").observe(
-                (now - req.first_token_s) / (n - 1))
+                tpot)
+            if self.slo is not None:
+                self.slo.observe("serving_tpot_seconds", tpot)
+        flight_record("serving_finish", req=req.id, trace=req.trace_id,
+                      slot=slot, tokens=n)
+        self._emit_request_trace(req)
         req.done.set()
+
+    def _emit_request_trace(self, req: Request) -> None:
+        """Render the request's lifecycle as its own Perfetto track:
+        one span per phase (queued / prefill chunks / decode), on a
+        synthetic tid named after the ``trace_id``. Host-side, only
+        when the tracer is on — the fused step never sees any of it."""
+        tracer = telemetry.get_tracer()
+        if not tracer.enabled:
+            return
+        # request events use time.monotonic; the tracer epoch is
+        # perf_counter-based — bridge via the current offset (both are
+        # monotonic clocks, so the offset is constant)
+        off = (time.perf_counter() - tracer.epoch) - time.monotonic()
+        tid = REQ_TRACK_BASE + req.id
+        tracer.name_track(tid, f"req {req.trace_id}")
+
+        def span(name, start, dur, **attrs):
+            tracer.complete(name, max(dur, 0.0), cat="request",
+                            ts_s=max(start + off, 0.0), tid=tid,
+                            trace_id=req.trace_id, req=req.id, **attrs)
+
+        admit = next((t for p, t, _ in req.events if p == "admit"), None)
+        if admit is not None:
+            span("queued", req.submit_s, admit - req.submit_s)
+        for phase, ts, dur in req.events:
+            if phase == "prefill_chunk":
+                span("prefill_chunk", ts, dur)
+        if req.first_token_s is not None and req.finish_s is not None:
+            span("decode", req.first_token_s,
+                 req.finish_s - req.first_token_s,
+                 tokens=len(req.tokens))
 
     def _record_gauges(self) -> None:
         reg = telemetry.get_registry()
@@ -426,10 +503,25 @@ class ServingEngine:
         if self._thread is not None:
             return
         self._stop = threading.Event()
+        if self.watchdog is not None:
+            self.watchdog.start()
 
         def loop():
             while not self._stop.is_set():
-                if not self.step():
+                busy = self.step()
+                # a beat per loop turn (idle included): the watchdog
+                # watches for a WEDGED iteration, not an empty queue
+                if self.watchdog is not None:
+                    self.watchdog.beat()
+                if self.slo is not None:
+                    now = time.monotonic()
+                    if now - self._slo_last_eval >= self._slo_every_s:
+                        self._slo_last_eval = now
+                        for a in self.slo.evaluate():
+                            from hetu_tpu.utils.logging import get_logger
+                            get_logger().warning(
+                                f"SLO alert: {a.message}")
+                if not busy:
                     self._stop.wait(idle_sleep_s)
 
         self._thread = threading.Thread(target=loop, daemon=True)
@@ -441,3 +533,5 @@ class ServingEngine:
         self._stop.set()
         self._thread.join(timeout=10.0)
         self._thread = None
+        if self.watchdog is not None:
+            self.watchdog.stop()

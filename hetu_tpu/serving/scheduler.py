@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
+import uuid
 from collections import deque
 from typing import Optional
 
@@ -40,7 +42,13 @@ class SamplingParams:
 @dataclasses.dataclass
 class Request:
     """One request's full lifecycle: queued → prefill → decode → done
-    (or rejected at admission)."""
+    (or rejected at admission).
+
+    ``trace_id`` + ``events`` make the lifecycle reconstructable after
+    the fact: every phase transition appends ``(phase, ts_s, dur_s)``
+    (``mark``), the engine renders them as a per-request Perfetto track,
+    and :meth:`timing` folds them into the breakdown the ``RESULT``
+    protocol verb returns."""
 
     id: int
     prompt: np.ndarray                 # (P,) int32
@@ -52,12 +60,49 @@ class Request:
     first_token_s: Optional[float] = None
     finish_s: Optional[float] = None
     error: Optional[str] = None
+    trace_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:12])
+    events: list = dataclasses.field(default_factory=list,
+                                     repr=False, compare=False)
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False, compare=False)
 
+    def mark(self, phase: str, dur_s: float = 0.0,
+             ts_s: Optional[float] = None) -> None:
+        """Append one lifecycle event (``ts_s`` defaults to now; the
+        clock is ``time.monotonic`` — the same one ``submit_s`` uses)."""
+        self.events.append(
+            (phase, time.monotonic() if ts_s is None else ts_s,
+             float(dur_s)))
+
+    def timing(self) -> dict:
+        """Phase breakdown in milliseconds for the RESULT verb: queued
+        (submit → admit), prefill (admit → first token), decode (first
+        token → finish), total, plus per-prefill-chunk count."""
+        out = {"trace_id": self.trace_id}
+        admit_s = next((t for p, t, _ in self.events if p == "admit"),
+                       None)
+        if admit_s is not None:
+            out["queued_ms"] = round((admit_s - self.submit_s) * 1e3, 3)
+        if self.first_token_s is not None and admit_s is not None:
+            out["prefill_ms"] = round(
+                (self.first_token_s - admit_s) * 1e3, 3)
+            out["ttft_ms"] = round(
+                (self.first_token_s - self.submit_s) * 1e3, 3)
+        if self.finish_s is not None and self.first_token_s is not None:
+            out["decode_ms"] = round(
+                (self.finish_s - self.first_token_s) * 1e3, 3)
+        if self.finish_s is not None:
+            out["total_ms"] = round(
+                (self.finish_s - self.submit_s) * 1e3, 3)
+        out["prefill_chunks"] = sum(
+            1 for p, _, _ in self.events if p == "prefill_chunk")
+        return out
+
     def result(self) -> dict:
         return {"id": self.id, "status": self.status,
-                "tokens": list(self.tokens), "error": self.error}
+                "tokens": list(self.tokens), "error": self.error,
+                "timing": self.timing()}
 
 
 class Scheduler:
@@ -88,6 +133,7 @@ class Scheduler:
         if req.status == "rejected":
             req.done.set()
             return False
+        req.mark("queued")
         self.queue.append(req)
         return True
 
@@ -99,6 +145,7 @@ class Scheduler:
         slot = self.free.pop(0)
         req.slot = slot
         req.status = "prefill"
+        req.mark("admit")
         return req, slot
 
     def release(self, slot: int) -> None:

@@ -108,7 +108,9 @@ def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
                 return f"ERR rejected: {req.error}"
             engine._requests_by_id[req.id] = req
             _prune_request_map(engine._requests_by_id)
-            return f"ID {req.id}"
+            # id + trace_id: the trace id keys the request's Perfetto
+            # track and the RESULT timing breakdown (docs/SERVING.md)
+            return f"ID {req.id} {req.trace_id}"
         if cmd == "RESULT":
             req = engine._requests_by_id.get(int(args[0]))
             if req is None:

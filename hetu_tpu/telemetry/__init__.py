@@ -29,12 +29,20 @@ from hetu_tpu.telemetry.aggregate import (
     aggregate_snapshots, cluster_aggregate, collect_snapshots,
     publish_snapshot,
 )
+from hetu_tpu.telemetry.flight import (
+    FlightRecorder, HangWatchdog, atomic_write_text, flight_record,
+    get_flight_recorder, install_crash_handlers,
+)
 from hetu_tpu.telemetry.goodput import (
     CATEGORIES, GoodputAccountant, GoodputReport, format_goodput_table,
     model_flops_per_token, report_from_records,
 )
 from hetu_tpu.telemetry.metrics import (
     Counter, Gauge, Histogram, MetricRegistry, percentile,
+)
+from hetu_tpu.telemetry.slo import (
+    Alert, SLOEngine, default_serving_rules, default_training_rules,
+    health_status,
 )
 from hetu_tpu.telemetry.spans import (
     DEFAULT_COUNTER_TRACK_PREFIXES, NULL_SPAN, SpanEvent, Tracer,
@@ -67,9 +75,14 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    """Drop all recorded events and metrics (tests / between runs)."""
+    """Drop all recorded events and metrics (tests / between runs) —
+    including the flight recorder's ring (it stays enabled; it is the
+    always-on black box, not part of the opt-in switch)."""
     _TRACER.clear()
     _REGISTRY.clear()
+    get_flight_recorder().clear()
+    from hetu_tpu.telemetry.flight import _clear_trip_totals
+    _clear_trip_totals()
 
 
 def span(name: str, cat: str = "span", **attrs):
@@ -86,6 +99,11 @@ def export_dir(path: str, *, extra_records=(),
     - ``telemetry.jsonl`` — span records + a metrics snapshot +
       ``extra_records`` (e.g. a goodput report), one JSON object/line.
 
+    Both artifacts are written to a temp file and ``os.replace``d into
+    place, so a process dying mid-export never leaves a truncated
+    ``trace.json``/``telemetry.jsonl`` (the reader sees either the
+    previous complete artifact or the new one).
+
     Returns ``{"trace": ..., "jsonl": ...}`` with the written paths."""
     tracer = tracer if tracer is not None else _TRACER
     registry = registry if registry is not None else _REGISTRY
@@ -95,15 +113,13 @@ def export_dir(path: str, *, extra_records=(),
     # final counter-track sample so every exported trace carries at
     # least one point per mem_*/comm_* series (Perfetto counter tracks)
     tracer.record_counters(registry.snapshot())
-    tracer.export_chrome(trace_path)
-    with open(jsonl_path, "w") as f:
-        for rec in tracer.records():
-            f.write(json.dumps(rec) + "\n")
-        snap_rec = registry.to_record()
-        if snap_rec["metrics"]:
-            f.write(json.dumps(snap_rec) + "\n")
-        for rec in extra_records:
-            f.write(json.dumps(rec) + "\n")
+    tracer.export_chrome(trace_path)          # atomic (temp + replace)
+    lines = [json.dumps(rec) for rec in tracer.records()]
+    snap_rec = registry.to_record()
+    if snap_rec["metrics"]:
+        lines.append(json.dumps(snap_rec))
+    lines.extend(json.dumps(rec) for rec in extra_records)
+    atomic_write_text(jsonl_path, "".join(ln + "\n" for ln in lines))
     return {"trace": trace_path, "jsonl": jsonl_path}
 
 
@@ -116,6 +132,10 @@ __all__ = [
     "report_from_records",
     "publish_snapshot", "collect_snapshots", "aggregate_snapshots",
     "cluster_aggregate",
+    "FlightRecorder", "HangWatchdog", "atomic_write_text",
+    "flight_record", "get_flight_recorder", "install_crash_handlers",
+    "SLOEngine", "Alert", "default_training_rules",
+    "default_serving_rules", "health_status",
     "get_tracer", "get_registry", "enable", "enabled", "reset", "span",
     "export_dir",
 ]

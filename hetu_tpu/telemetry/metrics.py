@@ -47,6 +47,27 @@ def _series_name(name: str, key: tuple) -> str:
     return f"{name}{{{inner}}}"
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text exposition: label values escape backslash, the
+    double quote and newline (in that order — backslash first)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_series(name: str, key: tuple) -> str:
+    """Exposition-format series: like :func:`_series_name` but with the
+    label values escaped (the snapshot keys keep the raw form — they are
+    an internal schema, not the scrape surface)."""
+    if not key:
+        return name
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
+    return f"{name}{{{inner}}}"
+
+
+def _escape_help(s: str) -> str:
+    """# HELP text escapes backslash and newline (not quotes)."""
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class _Metric:
     kind = "metric"
 
@@ -233,30 +254,34 @@ class MetricRegistry:
         return {"kind": "metrics_snapshot", "metrics": self.snapshot()}
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition (histograms as summary quantiles)."""
+        """Prometheus text exposition (histograms as summary quantiles).
+        Exposition-format correct: label values are escaped
+        (backslash/quote/newline) and ``quantile`` labels are the string
+        forms ("0.5", "0.9", "0.99") the format requires."""
         lines: list[str] = []
         with self._lock:
             for m in self._metrics.values():
                 if m.help:
-                    lines.append(f"# HELP {m.name} {m.help}")
+                    lines.append(
+                        f"# HELP {m.name} {_escape_help(m.help)}")
                 lines.append(f"# TYPE {m.name} "
                              f"{'summary' if m.kind == 'histogram' else m.kind}")
                 if isinstance(m, Histogram):
                     for key in m._series:
                         base = dict(key)
                         s = m.summary(**base)
-                        for q, field in ((0.5, "p50"), (0.9, "p90"),
-                                         (0.99, "p99")):
+                        for q, field in (("0.5", "p50"), ("0.9", "p90"),
+                                         ("0.99", "p99")):
+                            qkey = _label_key({**base, "quantile": q})
                             lines.append(
-                                f"{_series_name(m.name, _label_key({**base, 'quantile': q}))}"
-                                f" {s[field]}")
+                                f"{_prom_series(m.name, qkey)} {s[field]}")
                         lines.append(
-                            f"{_series_name(m.name + '_count', key)} "
+                            f"{_prom_series(m.name + '_count', key)} "
                             f"{s['count']}")
                         lines.append(
-                            f"{_series_name(m.name + '_sum', key)} "
+                            f"{_prom_series(m.name + '_sum', key)} "
                             f"{s['sum']}")
                 else:
-                    for series, v in m._snapshot().items():
-                        lines.append(f"{series} {v}")
+                    for key, v in m._values.items():
+                        lines.append(f"{_prom_series(m.name, key)} {v}")
         return "\n".join(lines) + ("\n" if lines else "")

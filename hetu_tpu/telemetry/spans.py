@@ -72,7 +72,7 @@ NULL_SPAN = _NullSpan()
 DEFAULT_COUNTER_TRACK_PREFIXES = (
     "mem_", "comm_", "dp_grad_syncs_total", "optimizer_updates_total",
     "step_cache_", "tp_ring_fallback_total", "data_stall_seconds",
-    "serving_",
+    "serving_", "slo_", "watchdog_",
 )
 
 
@@ -123,6 +123,7 @@ class Tracer:
         self.dropped = 0
         self._events: list[SpanEvent] = []
         self._counters: list[tuple] = []   # (name, ts_s, value) samples
+        self._track_names: dict[int, str] = {}   # synthetic-track labels
         self._lock = threading.Lock()
         self._local = threading.local()
 
@@ -140,14 +141,28 @@ class Tracer:
         return _Span(self, name, cat, attrs)
 
     def complete(self, name: str, dur_s: float, *, cat: str = "span",
-                 ts_s: Optional[float] = None, **attrs) -> None:
-        """Record an already-measured duration (caller held the clock)."""
+                 ts_s: Optional[float] = None, tid: Optional[int] = None,
+                 **attrs) -> None:
+        """Record an already-measured duration (caller held the clock).
+        ``tid`` overrides the thread id — synthetic track ids let logical
+        timelines (e.g. one serving request) render as their own
+        Perfetto track; pair with :meth:`name_track`."""
         if not self.enabled:
             return
         now = time.perf_counter() - self.epoch
         ts = max(0.0, now - dur_s) if ts_s is None else ts_s
-        self._record(SpanEvent(name, ts, dur_s, threading.get_ident(),
-                               len(self._stack()), cat, attrs))
+        self._record(SpanEvent(
+            name, ts, dur_s,
+            threading.get_ident() if tid is None else int(tid),
+            len(self._stack()), cat, attrs))
+
+    def name_track(self, tid: int, name: str) -> None:
+        """Label a (synthetic) track id — becomes the Perfetto
+        ``thread_name`` metadata row for that tid."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._track_names[int(tid)] = name
 
     def instant(self, name: str, cat: str = "event", **attrs) -> None:
         """Zero-duration marker event."""
@@ -211,6 +226,7 @@ class Tracer:
         with self._lock:
             self._events.clear()
             self._counters.clear()
+            self._track_names.clear()
             self.dropped = 0
         self.epoch = time.perf_counter()
         self.epoch_unix = time.time()
@@ -244,25 +260,35 @@ class Tracer:
                 "args": {"value": value},
             })
         # thread-name metadata rows so Perfetto labels the tracks
+        # (synthetic tracks — per-request timelines — carry their
+        # registered names)
+        with self._lock:
+            track_names = dict(self._track_names)
         meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
                  "args": {"name": "hetu_tpu"}}]
-        for tid in sorted(tids):
+        for tid in sorted(tids | set(track_names)):
             meta.append({"name": "thread_name", "ph": "M", "pid": pid,
-                         "tid": tid, "args": {"name": f"thread-{tid}"}})
+                         "tid": tid,
+                         "args": {"name": track_names.get(
+                             tid, f"thread-{tid}")}})
         return {"traceEvents": meta + trace_events,
                 "displayTimeUnit": "ms",
                 "otherData": {"epoch_unix": self.epoch_unix,
                               "dropped_events": self.dropped}}
 
     def export_chrome(self, path: str) -> str:
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(self.to_chrome(), f)
-        return path
+        # temp + os.replace: a crash mid-export leaves the previous
+        # complete trace, never a truncated JSON (telemetry.flight)
+        from hetu_tpu.telemetry.flight import atomic_write_text
+        return atomic_write_text(path, json.dumps(self.to_chrome()))
 
     def export_jsonl(self, path: str, *, append: bool = False) -> str:
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "a" if append else "w") as f:
-            for rec in self.records():
-                f.write(json.dumps(rec) + "\n")
-        return path
+        from hetu_tpu.telemetry.flight import atomic_write_text
+        lines = "".join(json.dumps(rec) + "\n" for rec in self.records())
+        if append:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            with open(path, "a") as f:
+                f.write(lines)
+            return path
+        return atomic_write_text(path, lines)

@@ -1,0 +1,83 @@
+"""check_metrics_docs: every registered metric must be documented.
+
+``docs/OBSERVABILITY.md`` is the operator's contract for what the
+registry emits; a metric that exists in code but not in the doc is
+invisible at exactly the moment someone greps the doc for it. This lint
+extracts every *literal* metric name passed to
+``registry.counter/gauge/histogram(...)`` anywhere under ``hetu_tpu/``
+and asserts it appears in the doc. Dynamic names (f-strings like
+``f"{category}_seconds_total"``) cannot be resolved statically and are
+skipped — document their families by hand.
+
+Run as a quick-tier test (``tests/test_observability.py``) or::
+
+    python -m hetu_tpu.tools.check_metrics_docs
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Optional
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DOC = os.path.join(os.path.dirname(_ROOT), "docs", "OBSERVABILITY.md")
+
+#: .counter("name" | .gauge('name' | .histogram("name"  — literal first
+#: args only (an f-prefix right before the quote marks a dynamic name)
+_PATTERN = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*(f?)([\"'])([A-Za-z0-9_]+)\2")
+
+
+def registered_metric_names(root: str = _ROOT) -> dict[str, list[str]]:
+    """``{metric_name: [file:line, ...]}`` for every literal
+    registration site under ``root``."""
+    out: dict[str, list[str]] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                text = f.read()
+            # whole-file scan: registration calls regularly wrap the
+            # name onto the next line
+            for m in _PATTERN.finditer(text):
+                if m.group(1):               # f-string: dynamic name
+                    continue
+                line_no = text.count("\n", 0, m.start()) + 1
+                rel = os.path.relpath(path, os.path.dirname(root))
+                out.setdefault(m.group(3), []).append(
+                    f"{rel}:{line_no}")
+    return out
+
+
+def missing_from_docs(doc_path: str = _DOC,
+                      root: str = _ROOT) -> dict[str, list[str]]:
+    """Registered names absent from the doc text (substring match — the
+    doc tables write names with label suffixes and escapes)."""
+    with open(doc_path) as f:
+        doc = f.read()
+    names = registered_metric_names(root)
+    return {name: sites for name, sites in sorted(names.items())
+            if name not in doc}
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    missing = missing_from_docs()
+    if not missing:
+        print(f"check_metrics_docs: all "
+              f"{len(registered_metric_names())} registered metric "
+              f"names documented in docs/OBSERVABILITY.md")
+        return 0
+    print("check_metrics_docs: metrics registered in code but missing "
+          "from docs/OBSERVABILITY.md:", file=sys.stderr)
+    for name, sites in missing.items():
+        print(f"  {name}  ({', '.join(sites[:3])})", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -341,6 +341,51 @@ def serving_plane_summary(records: list[dict]) -> Optional[list[str]]:
     return lines or None
 
 
+#: health series (telemetry/flight.py watchdog, telemetry/slo.py): the
+#: run's production-health verdict — did anything hang, which SLO rules
+#: fired, and is anything still breached (docs/OBSERVABILITY.md).
+_HEALTH_SERIES = (
+    "watchdog_trips_total", "slo_alerts_total", "slo_alerting",
+)
+
+
+def health_summary(records: list[dict]) -> Optional[list[str]]:
+    """Lines for the watchdog/SLO health section, or None when neither
+    a health series nor an ``slo_alert`` record is present. Counters
+    from the LAST snapshot; alert records counted over the stream."""
+    snap: Optional[dict] = None
+    for rec in records:
+        cand = rec.get("metrics") if rec.get("kind") == "metrics_snapshot" \
+            else rec.get("telemetry")
+        if isinstance(cand, dict) and any(
+                k.split("{")[0] in _HEALTH_SERIES for k in cand):
+            snap = cand
+    alerts = [r for r in records if r.get("kind") == "slo_alert"]
+    if snap is None and not alerts:
+        return None
+    from hetu_tpu.telemetry.slo import health_from_snapshot
+    hs = health_from_snapshot(snap or {})
+    trips = hs["watchdog_trips"]
+    fired = sum(hs["alerts_by_rule"].values())
+    alerting = hs["alerting_rules"]
+    lines = []
+    width = 18
+    lines.append("watchdog trips".ljust(width)
+                 + (f"{int(trips)} — the run HUNG; see the "
+                    f"flight_<rank>.jsonl dump (obs_report)"
+                    if trips else "0"))
+    if fired or alerts:
+        lines.append("slo alerts".ljust(width)
+                     + f"{int(max(fired, len(alerts)))} fired")
+    for a in alerts[-5:]:
+        lines.append(f"  [{a.get('rule', '?')}]".ljust(width)
+                     + a.get("message", "")[:100])
+    if alerting:
+        lines.append("still breached".ljust(width)
+                     + ", ".join(sorted(alerting)))
+    return lines
+
+
 def summarize(path: str, *, wall_s: Optional[float] = None,
               top: int = 10) -> str:
     records = load_records(path)
@@ -371,6 +416,12 @@ def summarize(path: str, *, wall_s: Optional[float] = None,
         parts.append("")
         parts.append("== serving plane ==")
         parts.extend(sv)
+
+    hl = health_summary(records)
+    if hl:
+        parts.append("")
+        parts.append("== health ==")
+        parts.extend(hl)
 
     rows = span_rollup(records, top=top)
     if rows:

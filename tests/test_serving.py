@@ -280,6 +280,85 @@ def test_rpc_serving_roundtrip(gpt):
         srv.stop()
 
 
+def test_ttft_once_and_gauges_drain_under_churn(gpt):
+    """SATELLITE (ISSUE 6): serving telemetry under churn — TTFT/TPOT
+    observed exactly once per request even when requests queue behind 2
+    slots and recycle them, queue/occupancy gauges return to zero after
+    drain, and the fused step stays at <= 1 compile with per-request
+    tracing enabled."""
+    cfg, model, params = gpt
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                            prefill_chunk=CHUNK)
+        before = trace_counts().get("serving_step", 0)
+        prompts = _prompts(cfg, [5, 11, 3, 8, 17, 2, 9, 6], seed=11)
+        reqs = [eng.submit(p, SamplingParams(max_tokens=4))
+                for p in prompts]
+        eng.run_until_drained()
+        reg = telemetry.get_registry()
+        # exactly once per request — churn (queueing + slot recycling)
+        # must not re-observe
+        assert reg.histogram("serving_ttft_seconds").summary()["count"] \
+            == len(prompts)
+        assert reg.histogram("serving_tpot_seconds").summary()["count"] \
+            == len(prompts)
+        # gauges drain to zero with the pool empty
+        assert reg.gauge("serving_queue_depth").value() == 0.0
+        assert reg.gauge("serving_slot_occupancy").value() == 0.0
+        # per-request tracing is host-side only: still one compile
+        assert trace_counts().get("serving_step", 0) - before <= 1
+        # every request rendered its own Perfetto track with the
+        # lifecycle spans
+        req_spans = [e for e in telemetry.get_tracer().events()
+                     if e.cat == "request"]
+        by_trace = {}
+        for e in req_spans:
+            by_trace.setdefault(e.attrs["trace_id"], set()).add(e.name)
+        assert len(by_trace) == len(prompts)
+        for names in by_trace.values():
+            assert {"queued", "prefill_chunk", "decode"} <= names
+        # and the RESULT-style timing breakdown is complete + ordered
+        for r in reqs:
+            t = r.result()["timing"]
+            assert t["trace_id"] == r.trace_id
+            assert 0 <= t["queued_ms"] <= t["ttft_ms"] <= t["total_ms"]
+            assert t["prefill_chunks"] == -(-len(r.prompt) // CHUNK)
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()
+
+
+def test_result_verb_returns_timing_breakdown(gpt):
+    """The RESULT/SUBMIT protocol verbs carry the trace id + timing
+    breakdown (no sockets: the handler is driven directly)."""
+    from hetu_tpu.serving.server import (
+        decode_payload, encode_payload, handle_serving_command,
+    )
+    cfg, model, params = gpt
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK)
+    prompt = _prompts(cfg, [6], seed=12)[0]
+    resp = handle_serving_command(
+        eng, "SUBMIT", [encode_payload({"prompt": prompt,
+                                        "max_tokens": 4})])
+    assert resp.startswith("ID ")
+    _, rid, trace_id = resp.split()
+    eng.run_until_drained()
+    resp = handle_serving_command(eng, "RESULT", [rid, "0"])
+    assert resp.startswith("VAL ")
+    r = decode_payload(resp.split(" ", 1)[1])
+    assert r["status"] == "done" and len(r["tokens"]) == 4
+    t = r["timing"]
+    assert t["trace_id"] == trace_id
+    for key in ("queued_ms", "prefill_ms", "ttft_ms", "decode_ms",
+                "total_ms", "prefill_chunks"):
+        assert key in t, key
+    assert t["total_ms"] >= t["decode_ms"] >= 0
+    assert t["ttft_ms"] >= t["prefill_ms"] >= 0
+
+
 def test_online_submit_during_decode(gpt):
     """Continuous batching, not batch-boundary batching: a request
     submitted WHILE the engine decodes joins the running batch and

@@ -456,13 +456,27 @@ def test_trainer_telemetry_smoke(tmp_path, telem):
         TrainerConfig(total_steps=4, log_every=2, precision="fp32",
                       telemetry=True, trace_dir=trace_dir,
                       ckpt_dir=str(tmp_path / "ck"), ckpt_every=2,
-                      prefetch=2))
+                      prefetch=2,
+                      # production-observability side-band rides the
+                      # same run (no extra compiles): watchdog beats
+                      # per step, SLO rules on the log cadence
+                      watchdog=True, watchdog_min_timeout_s=300.0,
+                      slo=True))
     tr.train(_batches(4, delay_s=0.004))
     # hot switch mid-run, then continue: compile (new plan) + switch spans
     tr.set_strategy(Strategy(dp=4))
     tr.config.total_steps = 6
     tr.train(_batches(2, seed=4, delay_s=0.004), steps=2)
     tr.close()
+
+    # a healthy run: the watchdog never tripped, no SLO alerts, and the
+    # black box saw the full lifecycle (step/compile/switch/checkpoint)
+    assert tr.registry.counter("watchdog_trips_total").value(
+        name="train") == 0
+    assert telemetry.health_status(tr.registry)["status"] == "ok"
+    flight_kinds = {e["event"]
+                    for e in telemetry.get_flight_recorder().events()}
+    assert {"step", "compile", "switch", "checkpoint"} <= flight_kinds
 
     # (a) Chrome trace: valid traceEvents schema
     with open(os.path.join(trace_dir, "trace.json")) as f:
@@ -568,11 +582,13 @@ def test_telemetry_off_overhead_under_1pct():
     assert step_s > 0
 
     # per-step instrumentation pattern, x2000 for a stable mean: two
-    # spans, two enabled() checks, two counter updates — more than any
-    # single loop iteration actually executes
+    # spans, two enabled() checks, two counter updates, plus one
+    # ALWAYS-ON flight-recorder event (the black box never turns off —
+    # its ring append must ride inside the same <1% bound)
+    flight = telemetry.get_flight_recorder()
     n = 2000
     t0 = time.perf_counter()
-    for _ in range(n):
+    for i in range(n):
         with tracer.span("a", x=1):
             pass
         with tracer.span("b"):
@@ -583,6 +599,7 @@ def test_telemetry_off_overhead_under_1pct():
             c.inc(1.0)
         c.inc(1.0)
         c.inc(1.0)
+        flight.record("step", step=i)
     per_step_overhead = (time.perf_counter() - t0) / n
     assert per_step_overhead < 0.01 * step_s, \
         f"disabled-telemetry overhead {per_step_overhead * 1e6:.1f}us " \

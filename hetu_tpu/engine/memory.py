@@ -245,17 +245,18 @@ def derive_remat_mask(dims, strategy: Strategy, *,
 KV_CACHE_BYTES_PER_EL = {"fp32": 4.0, "bf16": 2.0, "int8": 1.0}
 
 
-def kv_bytes_per_slot(cfg, *, max_len: int, cache_dtype: str = "fp32",
-                      tp: int = 1) -> float:
-    """Per-slot bytes of one request's K+V rows across every layer
-    (the unit the serving scheduler admits in)."""
+def kv_bytes_per_block(cfg, *, block_size: int,
+                       cache_dtype: str = "fp32", tp: int = 1) -> float:
+    """Bytes of one ``block_size``-token K+V page across every layer —
+    the allocation unit of the PAGED serving pool (the scheduler's
+    free-block admission gate prices requests in these)."""
     if cache_dtype not in KV_CACHE_BYTES_PER_EL:
         raise ValueError(f"cache_dtype must be one of "
                          f"{sorted(KV_CACHE_BYTES_PER_EL)}, "
                          f"got {cache_dtype!r}")
     hkv = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
     d = getattr(cfg, "head_dim", None) or cfg.hidden_size // cfg.num_heads
-    rows = cfg.num_layers * max_len * (hkv / max(tp, 1))
+    rows = cfg.num_layers * block_size * (hkv / max(tp, 1))
     per_el = KV_CACHE_BYTES_PER_EL[cache_dtype]
     bytes_kv = 2.0 * rows * d * per_el          # K and V
     if cache_dtype == "int8":
@@ -263,29 +264,51 @@ def kv_bytes_per_slot(cfg, *, max_len: int, cache_dtype: str = "fp32",
     return bytes_kv
 
 
+def kv_bytes_per_slot(cfg, *, max_len: int, cache_dtype: str = "fp32",
+                      tp: int = 1) -> float:
+    """Per-slot bytes of one request's worst-case K+V rows across every
+    layer — a ``max_len``-token page (back-compat unit; the paged pool
+    allocates :func:`kv_bytes_per_block` at a time)."""
+    return kv_bytes_per_block(cfg, block_size=max_len,
+                              cache_dtype=cache_dtype, tp=tp)
+
+
+def size_kv_blocks(cfg, *, hbm_budget_bytes: float, block_size: int,
+                   cache_dtype: str = "fp32", tp: int = 1,
+                   param_bytes_per_el: float = 4.0,
+                   headroom: float = 0.1) -> int:
+    """How many KV blocks fit in ``hbm_budget_bytes`` next to the
+    weights (``param_bytes_per_el`` per parameter, sharded over tp).
+
+    Raises ``ValueError`` when not even one block fits — the caller
+    must shrink ``block_size``, quantize the cache, or raise tp."""
+    from hetu_tpu.tools.galvatron.cost_model import ModelDims
+    dims = ModelDims.from_config(cfg, seq_len=block_size, global_batch=1)
+    weights = dims.total_params() * param_bytes_per_el / max(tp, 1)
+    avail = hbm_budget_bytes * (1.0 - headroom) - weights
+    per_block = kv_bytes_per_block(cfg, block_size=block_size,
+                                   cache_dtype=cache_dtype, tp=tp)
+    blocks = int(avail // per_block)
+    if blocks < 1:
+        raise ValueError(
+            f"KV pool does not fit: weights {weights / 1e9:.2f}GB + one "
+            f"{per_block / 1e6:.1f}MB block exceed the "
+            f"{hbm_budget_bytes / 1e9:.2f}GB budget — shrink the "
+            f"block/slot size, use an int8 cache, or raise tp")
+    return blocks
+
+
 def size_kv_pool(cfg, *, hbm_budget_bytes: float, max_len: int,
                  cache_dtype: str = "fp32", tp: int = 1,
                  param_bytes_per_el: float = 4.0,
                  headroom: float = 0.1) -> int:
     """How many serving slots fit in ``hbm_budget_bytes`` next to the
-    weights (``param_bytes_per_el`` per parameter, sharded over tp).
-
-    Raises ``ValueError`` when not even one slot fits — the caller must
-    shrink ``max_len``, quantize the cache, or raise tp."""
-    from hetu_tpu.tools.galvatron.cost_model import ModelDims
-    dims = ModelDims.from_config(cfg, seq_len=max_len, global_batch=1)
-    weights = dims.total_params() * param_bytes_per_el / max(tp, 1)
-    avail = hbm_budget_bytes * (1.0 - headroom) - weights
-    per_slot = kv_bytes_per_slot(cfg, max_len=max_len,
-                                 cache_dtype=cache_dtype, tp=tp)
-    slots = int(avail // per_slot)
-    if slots < 1:
-        raise ValueError(
-            f"KV pool does not fit: weights {weights / 1e9:.2f}GB + one "
-            f"{per_slot / 1e6:.1f}MB slot exceed the "
-            f"{hbm_budget_bytes / 1e9:.2f}GB budget — shrink max_len, "
-            f"use an int8 cache, or raise tp")
-    return slots
+    weights — :func:`size_kv_blocks` with one ``max_len``-token block
+    per slot (back-compat wrapper; the paged pool sizes in blocks)."""
+    return size_kv_blocks(cfg, hbm_budget_bytes=hbm_budget_bytes,
+                          block_size=max_len, cache_dtype=cache_dtype,
+                          tp=tp, param_bytes_per_el=param_bytes_per_el,
+                          headroom=headroom)
 
 
 # -- runtime ledger ----------------------------------------------------------

@@ -41,19 +41,23 @@ def init_kv_caches(model, batch: int, max_len: int, dtype=jnp.float32):
 
 
 def decode(model, params, input_ids, positions, caches, *,
-           slot_mask=None):
+           slot_mask=None, block_tables=None):
     """Run a chunk through the model in decode mode.
 
     ``positions`` (b, s) absolute positions. Without ``slot_mask`` they
     must be identical across the batch (batched decode, one shared write
     index). With ``slot_mask`` (b,) bool every row decodes at ITS OWN
     ``positions[r, 0]`` — the serving engine's slot-pooled path — and
-    masked-off rows leave their KV rows untouched. Returns
-    (logits (b, s, V), new caches)."""
+    masked-off rows leave their KV rows untouched. ``block_tables``
+    (b, W) switches the caches to the block-paged arena layout
+    (``(L, n_blocks, block_size, hkv, d)`` leaves; see
+    ``ParallelAttention._decode``). Returns (logits (b, s, V), new
+    caches)."""
     h = model.embed(params, input_ids, positions=positions)
     h, caches = model.blocks.decode(params["blocks"], h, caches,
                                     positions=positions,
-                                    slot_mask=slot_mask)
+                                    slot_mask=slot_mask,
+                                    block_tables=block_tables)
     h = model.hidden_norm(params, h)
     w = _head_weight(model, params)
     logits = jnp.einsum("bse,ve->bsv", h.astype(jnp.float32),

@@ -100,14 +100,15 @@ class LlamaBlock(Module):
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
-                 dropout_key=None):
+                 block_tables=None, dropout_key=None):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
                                      self.input_norm(
                                          params["input_norm"], x),
                                      positions=positions,
                                      kv_cache=kv_cache,
-                                     slot_mask=slot_mask)
+                                     slot_mask=slot_mask,
+                                     block_tables=block_tables)
             x = x + a
             h = self.mlp(params["mlp"],
                          self.post_attn_norm(params["post_attn_norm"], x))

@@ -294,10 +294,12 @@ class ParallelAttention(Module):
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl: str = "auto", kv_cache=None, slot_mask=None,
+                 block_tables=None,
                  dropout_rate: float = 0.0, dropout_key=None):
         if kv_cache is not None:
             return self._decode(params, x, kv_cache, positions=positions,
-                                slot_mask=slot_mask)
+                                slot_mask=slot_mask,
+                                block_tables=block_tables)
         b, s, _ = x.shape
         q = self.q_proj(params["q_proj"], x).reshape(
             b, s, self.num_heads, self.head_dim)
@@ -367,7 +369,7 @@ class ParallelAttention(Module):
         return self.out_proj(params["out_proj"], out)
 
     def _decode(self, params, x, kv_cache, *, positions=None,
-                slot_mask=None):
+                slot_mask=None, block_tables=None):
         """Incremental decoding with a KV cache.
 
         ``kv_cache``: (k_buf, v_buf) of shape (b, max_len, hkv, d); the
@@ -388,10 +390,24 @@ class ParallelAttention(Module):
         offsets, so requests at different depths decode in one batched
         call. Rows with ``slot_mask=False`` (free / prefilling slots)
         leave their cache rows untouched (their compute is discarded by
-        the caller)."""
+        the caller).
+
+        ``block_tables`` (b, W) switches the cache to the PAGED layout:
+        leaves are ``(n_blocks, block_size, hkv, d)`` arenas shared by
+        every row, and row ``r``'s position ``p`` lives at arena row
+        ``block_tables[r, p // bs] * bs + p % bs``. Writes become flat
+        scatters (rows with ``slot_mask=False`` scatter out of bounds
+        and are dropped), reads gather through the table
+        (:func:`~hetu_tpu.ops.attention.gather_block_rows`). Requires
+        ``slot_mask`` (per-row positions are the only meaningful paged
+        mode)."""
         quant = len(kv_cache) == 4
         b, s, _ = x.shape
         per_row = slot_mask is not None
+        paged = block_tables is not None
+        if paged and not per_row:
+            raise ValueError("block_tables requires slot_mask "
+                             "(per-row paged decode)")
         if per_row:
             index = positions[:, 0]                     # (b,) per-slot
         else:
@@ -409,7 +425,24 @@ class ParallelAttention(Module):
             q = apply_rotary(q, cos, sin, positions=pos)
             k = apply_rotary(k, cos, sin, positions=pos)
 
+        if paged:
+            n_blk, blk = kv_cache[0].shape[0], kv_cache[0].shape[1]
+            pos_rows = index[:, None] + jnp.arange(s)[None, :]  # (b, s)
+            blk_ids = jnp.take_along_axis(block_tables,
+                                          pos_rows // blk, axis=1)
+            rows = blk_ids * blk + pos_rows % blk
+            # masked-off rows scatter out of bounds → dropped (the
+            # paged analogue of the jnp.where keep-mask below)
+            rows = jnp.where(slot_mask[:, None], rows,
+                             n_blk * blk).reshape(-1)
+
         def upd(buf, new):
+            if paged:
+                flat = buf.reshape((n_blk * blk,) + buf.shape[2:])
+                flat = flat.at[rows].set(
+                    new.reshape((-1,) + new.shape[2:]).astype(buf.dtype),
+                    mode="drop")
+                return flat.reshape(buf.shape)
             if per_row:
                 # per-slot scatter: row r writes its s new entries at
                 # index[r]; inactive slots select their old rows back
@@ -437,8 +470,19 @@ class ParallelAttention(Module):
             vnew_q, vnew_s = quantize_int8(v, axis=-1)
             kq_b, ks_b = upd(kq_b, knew_q), upd(ks_b, knew_s)
             vq_b, vs_b = upd(vq_b, vnew_q), upd(vs_b, vnew_s)
-            k_buf = dequantize_int8(kq_b, ks_b, q.dtype)
-            v_buf = dequantize_int8(vq_b, vs_b, q.dtype)
+            if paged:
+                # gather the int8 rows + scales (1/4 the bytes of the
+                # dequantized view), dequantize only the gathered rows
+                from hetu_tpu.ops.attention import gather_block_rows
+                k_buf = dequantize_int8(
+                    gather_block_rows(kq_b, block_tables),
+                    gather_block_rows(ks_b, block_tables), q.dtype)
+                v_buf = dequantize_int8(
+                    gather_block_rows(vq_b, block_tables),
+                    gather_block_rows(vs_b, block_tables), q.dtype)
+            else:
+                k_buf = dequantize_int8(kq_b, ks_b, q.dtype)
+                v_buf = dequantize_int8(vq_b, vs_b, q.dtype)
             new_cache = (kq_b, ks_b, vq_b, vs_b)
         else:
             k_buf, v_buf = kv_cache
@@ -447,7 +491,9 @@ class ParallelAttention(Module):
         # causal offsets mask both the future and never-written slots
         # (their positions exceed every live q position)
         out = attention_reference(q, k_buf, v_buf, causal=self.causal,
-                                  q_offset=index, kv_offset=0)
+                                  q_offset=index, kv_offset=0,
+                                  block_tables=block_tables
+                                  if paged and not quant else None)
         out = out.reshape(b, s, self.num_heads * self.head_dim)
         return self.out_proj(params["out_proj"], out), new_cache
 

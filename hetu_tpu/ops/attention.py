@@ -32,6 +32,24 @@ def _expand_kv(k, num_q_heads):
     return jnp.repeat(k, rep, axis=-2)
 
 
+def gather_block_rows(buf, block_tables):
+    """Paged-KV gather: ``(n_blocks, block_size, ...)`` arena + ``(b, W)``
+    block tables → the contiguous ``(b, W*block_size, ...)`` per-row view.
+
+    Row ``r``'s position ``p`` lives at arena row
+    ``block_tables[r, p // block_size] * block_size + p % block_size`` —
+    the PagedAttention indirection (vLLM, SOSP'23) expressed as one XLA
+    gather, so a paged cache reads like a dense one. Table entries are
+    data, never shapes: any block remap (prefix sharing, CoW,
+    reallocation) re-runs the same compiled program."""
+    n_blocks, block_size = buf.shape[0], buf.shape[1]
+    flat = buf.reshape((n_blocks * block_size,) + buf.shape[2:])
+    rows = (block_tables[:, :, None] * block_size
+            + jnp.arange(block_size)[None, None, :])
+    rows = rows.reshape(block_tables.shape[0], -1)
+    return jnp.take(flat, rows, axis=0)
+
+
 def attention_reference(q, k, v, *, causal: bool = False,
                         segment_ids: Optional[jnp.ndarray] = None,
                         kv_segment_ids: Optional[jnp.ndarray] = None,
@@ -40,7 +58,8 @@ def attention_reference(q, k, v, *, causal: bool = False,
                         q_offset: int | jnp.ndarray = 0,
                         kv_offset: int | jnp.ndarray = 0,
                         dropout_rate: float = 0.0,
-                        dropout_key: Optional[jax.Array] = None):
+                        dropout_key: Optional[jax.Array] = None,
+                        block_tables: Optional[jnp.ndarray] = None):
     """Pure-jnp attention oracle, fp32 softmax.
 
     ``q_offset``/``kv_offset`` shift the absolute positions used by the causal
@@ -51,7 +70,15 @@ def attention_reference(q, k, v, *, causal: bool = False,
     ``hetu/impl/kernel/FlashAttention.cu:1-50``); a None key (eval) is
     the identity. The LSE is computed on the UN-dropped distribution —
     dropout perturbs the value mix, not the normalizer.
+
+    ``block_tables`` (b, W) switches k/v to the PAGED layout
+    ``(n_blocks, block_size, h, d)``: each batch row's KV is gathered
+    through its table (:func:`gather_block_rows`) before the dense math,
+    so the serving engine's block-pooled cache shares this oracle.
     """
+    if block_tables is not None:
+        k = gather_block_rows(k, block_tables)
+        v = gather_block_rows(v, block_tables)
     b, sq, hq, d = q.shape
     sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)

@@ -1,25 +1,33 @@
-"""Serving plane: continuous-batching inference over a slot-pooled KV
-cache.
+"""Serving plane: continuous-batching inference over a block-paged KV
+cache with radix-tree prefix sharing.
 
-- :mod:`~hetu_tpu.serving.kv_pool` — the fixed-shape KV arena + sizing
-  from the memory-plane ledger;
-- :mod:`~hetu_tpu.serving.engine` — the jit-once fused step (chunked
-  prefill + all-slot decode, per-slot SamplingParams as traced
-  operands) and the :class:`ServingEngine` host loop;
-- :mod:`~hetu_tpu.serving.scheduler` — FCFS admission, slot gating,
-  completion/eviction;
+- :mod:`~hetu_tpu.serving.kv_pool` — the paged KV arena
+  (``(layers, n_blocks, block_size, hkv, d)``), the refcounting
+  :class:`BlockManager`, and sizing from the memory-plane ledger;
+- :mod:`~hetu_tpu.serving.prefix_cache` — the radix-tree prompt-prefix
+  cache (whole-block sharing, CoW partial tails, LRU leaf eviction);
+- :mod:`~hetu_tpu.serving.engine` — the jit-once fused step (packed
+  multi-request prefill + all-slot decode through block tables,
+  per-slot SamplingParams as traced operands) and the
+  :class:`ServingEngine` host loop;
+- :mod:`~hetu_tpu.serving.scheduler` — FCFS admission, cache-aware
+  free-block gating, completion/eviction;
 - :mod:`~hetu_tpu.serving.server` — the line-protocol front end over
   ``rpc/py_server.py`` plus payload codecs.
 
-``docs/SERVING.md`` documents the architecture and slot lifecycle.
+``docs/SERVING.md`` documents the architecture and block lifecycle.
 """
 
 from hetu_tpu.serving.engine import ServingEngine, sample_slots
-from hetu_tpu.serving.kv_pool import KVPool, cache_dtype_name
+from hetu_tpu.serving.kv_pool import (
+    NULL_BLOCK, BlockManager, KVPool, cache_dtype_name,
+)
+from hetu_tpu.serving.prefix_cache import PrefixCache
 from hetu_tpu.serving.scheduler import Request, SamplingParams, Scheduler
 
 __all__ = [
     "ServingEngine", "sample_slots",
-    "KVPool", "cache_dtype_name",
+    "KVPool", "BlockManager", "NULL_BLOCK", "cache_dtype_name",
+    "PrefixCache",
     "Request", "SamplingParams", "Scheduler",
 ]

@@ -3,26 +3,38 @@
 The static-batch ``models.generation.generate`` compiles one program per
 (batch, prompt length) — admitting a request means retracing, the exact
 control-plane tax PR 2 spent a subsystem killing on the training side.
-This engine is the serving-plane answer, built from the two techniques
-that turn a decode loop into a serving engine, mapped onto TPU idioms:
+This engine is the serving-plane answer, built from the techniques that
+turn a decode loop into a serving engine, mapped onto TPU idioms:
 
 - **iteration-level scheduling** (Orca, OSDI'22): the unit of work is
-  ONE engine iteration — one decode token for every active slot plus
-  one chunk of prefill for the admitting request — so new requests join
-  and finished ones leave between iterations, never mid-batch;
-- **slot-pooled KV** (the fixed-shape cousin of vLLM's PagedAttention,
-  SOSP'23): requests of any length live in one preallocated arena
-  (:class:`~hetu_tpu.serving.kv_pool.KVPool`) indexed by per-slot
-  control vectors, so the compiled step sees ONE signature forever.
+  ONE engine iteration — one decode token for every active slot plus a
+  fixed token budget of prefill — so new requests join and finished
+  ones leave between iterations, never mid-batch;
+- **block-paged KV** (vLLM's PagedAttention, SOSP'23): requests live in
+  a ``(layers, n_blocks, block_size, hkv, d)`` arena indexed through
+  per-slot BLOCK TABLES (:class:`~hetu_tpu.serving.kv_pool.KVPool`),
+  so bytes are allocated per block, not per worst-case slot;
+- **radix-tree prefix caching** (SGLang's RadixAttention): admission
+  maps a cached prompt prefix's blocks into the new slot's table
+  (refcounted, CoW for a partial tail block —
+  :mod:`~hetu_tpu.serving.prefix_cache`) and prefill starts at the
+  first uncached token — a fleet-wide system prompt is prefilled once;
+- **packed multi-request prefill**: the prefill lane carries a fixed
+  ``prefill_chunk``-token budget PACKED from every admitting request
+  (cu_seqlens-style per-token slot/position operands), so a burst of
+  arrivals shares each iteration's prefill bandwidth instead of
+  serializing one admission per iteration — TTFT p99 stops growing
+  linearly with queue depth.
 
-The fused step is jitted once: chunked prefill (``lax.cond``-gated, a
-fixed-size chunk written into the admitting slot via dynamic slices)
-and the all-slot decode (per-row KV writes + per-row causal offsets —
-``ParallelAttention._decode``'s slot mode) run in the same program, with
-per-slot ``SamplingParams`` as traced operands. Request churn therefore
-never recompiles — audited with the PR 2 ``record_trace`` counter
+The fused step is jitted once: CoW block copies, the all-slot decode
+(per-row KV writes + per-row causal offsets —
+``ParallelAttention._decode``'s paged slot mode) and the packed prefill
+lane run in the same program, with per-slot ``SamplingParams``, block
+tables, pack layouts and prefix offsets all as traced operands — DATA,
+never shapes. Request churn, cache hits and evictions therefore never
+recompile — audited with the PR 2 ``record_trace`` counter
 (``trace_counts()["serving_step"]`` stays at its initial compile count,
-asserted in ``tests/test_serving.py``).
+asserted in ``tests/test_serving.py`` / ``tests/test_paged_serving.py``).
 
 TP-sharded serving rides the existing ``Strategy``/``make_plan`` path:
 pass ``plan=`` and the step traces under ``plan.act`` against sharded
@@ -43,7 +55,8 @@ import numpy as np
 from hetu_tpu import telemetry
 from hetu_tpu.engine.train_step import record_trace
 from hetu_tpu.models import generation
-from hetu_tpu.serving.kv_pool import KVPool
+from hetu_tpu.serving.kv_pool import BlockManager, KVPool
+from hetu_tpu.serving.prefix_cache import PrefixCache
 from hetu_tpu.serving.scheduler import Request, SamplingParams, Scheduler
 from hetu_tpu.telemetry.flight import HangWatchdog, flight_record
 from hetu_tpu.telemetry.slo import SLOEngine, default_serving_rules
@@ -97,44 +110,80 @@ class ServingEngine:
                  max_len: int = 256, prefill_chunk: int = 16,
                  cache_dtype=jnp.float32,
                  hbm_budget_bytes: Optional[float] = None,
+                 block_size: Optional[int] = None,
+                 kv_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
                  plan=None, seed: int = 0,
                  counter_sample_every: int = 32,
                  watchdog: bool = False, watchdog_factor: float = 8.0,
                  watchdog_min_timeout_s: float = 30.0,
                  slo: Union[bool, SLOEngine, None] = None,
                  slo_every_s: float = 1.0):
+        if block_size is None:
+            # default paging: 16-token blocks when they divide max_len,
+            # else one block per slot (degenerate = PR 5 slot arena)
+            block_size = 16 if max_len % 16 == 0 else max_len
         if slots is None:
             if hbm_budget_bytes is None:
                 raise ValueError("pass slots= or hbm_budget_bytes=")
+            if kv_blocks is not None:
+                raise ValueError(
+                    "kv_blocks= conflicts with hbm_budget_bytes= "
+                    "sizing (the budget already fixes the arena) — "
+                    "pass slots= alongside kv_blocks=")
             tp = plan.strategy.tp if plan is not None else 1
             self.pool = KVPool.sized_for(
                 model, hbm_budget_bytes=hbm_budget_bytes,
-                max_len=max_len, cache_dtype=cache_dtype, tp=tp)
+                max_len=max_len, cache_dtype=cache_dtype, tp=tp,
+                block_size=block_size)
         else:
-            self.pool = KVPool(model, slots, max_len, cache_dtype)
+            # kv_blocks decouples CONCURRENCY from worst-case memory:
+            # slots is how many requests decode in parallel (cheap —
+            # control vectors + table rows), kv_blocks is the arena's
+            # actual byte budget. Oversubscribed slots (slots *
+            # blocks_per_slot > kv_blocks - 1) are the PagedAttention
+            # win: short requests reserve only their own ceil((P +
+            # max_tokens)/block_size) blocks, so the same bytes that
+            # held S worst-case slots run more than S live requests —
+            # admission's free-block gate keeps it sound.
+            self.pool = KVPool(model, slots, max_len, cache_dtype,
+                               block_size=block_size, n_blocks=kv_blocks)
         self.model = model
         self.params = params
-        self.prefill_chunk = int(prefill_chunk)
-        if self.pool.max_len % self.prefill_chunk != 0:
-            # a final chunk may only run past the prompt, never past the
-            # arena — dynamic_update_slice would CLAMP the start index
-            # and silently corrupt the preceding rows otherwise
-            raise ValueError(
-                f"max_len {self.pool.max_len} must be a multiple of "
-                f"prefill_chunk {self.prefill_chunk}")
-        self.scheduler = Scheduler(self.pool.slots, self.pool.max_len)
+        self.prefill_chunk = int(prefill_chunk)  # PACK budget/iteration
+        self.blocks = BlockManager(self.pool.n_blocks)
+        self.prefix_cache: Optional[PrefixCache] = PrefixCache(
+            self.pool.block_size, self.blocks) if prefix_cache else None
+        self.scheduler = Scheduler(
+            self.pool.slots, self.pool.max_len, blocks=self.blocks,
+            prefix_cache=self.prefix_cache,
+            block_size=self.pool.block_size)
         self._plan = plan
         self._counter_sample_every = counter_sample_every
 
         S = self.pool.slots
+        W = self.pool.blocks_per_slot
         self._pos = np.zeros(S, np.int32)        # next KV write index
         self._last_tok = np.zeros(S, np.int32)   # sampled, not yet fed
         self._active = np.zeros(S, bool)         # decoding slots
         self._temp = np.zeros(S, np.float32)
         self._topk = np.zeros(S, np.int32)
         self._topp = np.zeros(S, np.float32)
+        self._bt = np.zeros((S, W), np.int32)    # per-slot block tables
+        # device-resident mirrors of the control vectors + block tables:
+        # rebuilt from the np mirrors only when an admission / prefill
+        # completion / finish dirtied them — steady decode iterations
+        # reuse the compiled step's own (pos, last_tok) outputs and
+        # upload NOTHING
+        self._ctl_dev: Optional[dict] = None
+        self._bt_dev = None
+        self._ctl_dirty = True
         self._slot_req: list[Optional[Request]] = [None] * S
-        self._prefill: Optional[dict] = None     # the admitting request
+        self._prefilling: list[dict] = []        # FCFS in-flight prefills
+        #: max requests that can FINISH prefill in one iteration (each
+        #: needs >= 1 pack token) — the prefill lane's head/sample width
+        self._fin_cap = max(1, min(S, self.prefill_chunk))
+        self._evictions_synced = 0               # scheduler ledger → ctr
         self._key = jax.random.key(seed)
         self._iter = 0
         self._next_id = 0
@@ -167,12 +216,26 @@ class ServingEngine:
     # -- the jit-once fused step --------------------------------------------
     def _build_step(self):
         model = self.model
-        C = self.prefill_chunk
+        R = self._fin_cap
 
-        def step(params, caches, ctl, pf, key, it):
+        def step(params, caches, ctl, pf, bt, cow, key, it):
             record_trace("serving_step")    # churn must never re-enter
             rng = jax.random.fold_in(key, it)
             rng_dec, rng_pf = jax.random.split(rng)
+
+            # copy-on-write block copies for this iteration's partial
+            # prefix hits: dst indexes are the arena size (dropped) on
+            # unused lanes, and the whole pass is cond-gated — the
+            # common decode-only iteration never pays the per-leaf
+            # gather/scatter. The copies land BEFORE any lane writes.
+            def apply_cow(cs):
+                def one(c):
+                    src = jnp.take(c, cow["src"], axis=1)
+                    return c.at[:, cow["dst"]].set(src, mode="drop")
+                return jax.tree.map(one, cs)
+
+            caches = jax.lax.cond(cow["run"], apply_cow,
+                                  lambda cs: cs, caches)
 
             # one decode token for EVERY slot; free/prefilling slots
             # compute garbage that the slot mask keeps out of the pool
@@ -182,7 +245,7 @@ class ServingEngine:
                 logits, caches = generation.decode(
                     model, params, ctl["last_tok"][:, None],
                     ctl["pos"][:, None], caches,
-                    slot_mask=ctl["active"])
+                    slot_mask=ctl["active"], block_tables=bt)
                 return caches, sample_slots(
                     logits[:, 0], ctl["temp"], ctl["topk"],
                     ctl["topp"], rng_dec)
@@ -194,43 +257,51 @@ class ServingEngine:
             caches, emitted = jax.lax.cond(
                 ctl["active"].any(), do_decode, no_decode, caches)
 
-            # one chunk of prefill for the admitting slot (cond keeps
-            # idle iterations from paying the chunk's compute)
+            # packed prefill: a C-token budget shared by every
+            # admitting request — per-token (slot, position) operands
+            # are the cu_seqlens of this lane. Each pack token is one
+            # batch row of the per-row paged decode: layer l writes
+            # every row's K/V before attending, so rows of the same
+            # request see their in-pack predecessors exactly like a
+            # dense chunk. (cond keeps idle iterations free.)
             def do_prefill(caches):
-                slot = pf["slot"]
-                sc = jax.tree.map(
-                    lambda c: jax.lax.dynamic_slice_in_dim(
-                        c, slot, 1, axis=1), caches)
-                pos = (pf["start"]
-                       + jnp.arange(C, dtype=jnp.int32))[None]
-                h = model.embed(params, pf["tokens"][None],
+                pos = pf["pos"][:, None]                     # (C, 1)
+                h = model.embed(params, pf["tokens"][:, None],
                                 positions=pos)
-                h, sc = model.blocks.decode(params["blocks"], h, sc,
-                                            positions=pos)
-                caches = jax.tree.map(
-                    lambda c, s_: jax.lax.dynamic_update_slice_in_dim(
-                        c, s_, slot, axis=1), caches, sc)
-                # request's FIRST token: head on the last REAL row only
-                # (pad rows of a partial final chunk sit beyond it)
-                h_last = jax.lax.dynamic_slice_in_dim(
-                    h, pf["valid"] - 1, 1, axis=1)
-                h_last = model.hidden_norm(params, h_last)
+                h, caches = model.blocks.decode(
+                    params["blocks"], h, caches, positions=pos,
+                    slot_mask=pf["valid"],
+                    block_tables=jnp.take(bt, pf["slot"], axis=0))
+                # FIRST tokens for the <= R requests whose prefill
+                # completes this iteration: head only on their last
+                # real rows (never the full pack's vocab projection)
+                hf = jnp.take(h[:, 0], pf["fin_row"], axis=0)[:, None]
+                hf = model.hidden_norm(params, hf)
                 w = generation._head_weight(model, params)
-                lg = jnp.einsum("bse,ve->bsv",
-                                h_last.astype(jnp.float32),
+                lg = jnp.einsum("bse,ve->bsv", hf.astype(jnp.float32),
                                 w.astype(jnp.float32))[:, 0]
-                first = sample_slots(
-                    lg, ctl["temp"][slot][None],
-                    ctl["topk"][slot][None], ctl["topp"][slot][None],
-                    rng_pf)[0]
-                return caches, first
+                fs = pf["fin_slot"]
+                firsts = sample_slots(
+                    lg, jnp.take(ctl["temp"], fs),
+                    jnp.take(ctl["topk"], fs),
+                    jnp.take(ctl["topp"], fs), rng_pf)
+                return caches, firsts
 
             def no_prefill(caches):
-                return caches, jnp.int32(0)
+                return caches, jnp.zeros((R,), jnp.int32)
 
-            caches, first_tok = jax.lax.cond(
+            caches, first_toks = jax.lax.cond(
                 pf["run"], do_prefill, no_prefill, caches)
-            return caches, emitted, first_tok
+            # device-resident control advance: every active slot fed a
+            # token this iteration (its KV landed at pos), so pos+1 /
+            # last_tok=emitted — returned so the host can reuse the
+            # control vectors NEXT iteration without re-uploading them
+            # (it falls back to a host rebuild only when an admission /
+            # prefill completion / finish rewrote control state)
+            new_pos = ctl["pos"] + ctl["active"].astype(jnp.int32)
+            new_last = jnp.where(ctl["active"], emitted,
+                                 ctl["last_tok"])
+            return caches, emitted, first_toks, new_pos, new_last
 
         return jax.jit(step, donate_argnums=(1,))
 
@@ -267,7 +338,7 @@ class ServingEngine:
     def has_work(self) -> bool:
         with self._lock:
             return bool(self.scheduler.queue) or self._active.any() \
-                or self._prefill is not None
+                or bool(self._prefilling)
 
     def step(self) -> bool:
         """One engine iteration; False when there was nothing to do.
@@ -276,92 +347,165 @@ class ServingEngine:
         with self._step_lock:
             return self._step_locked()
 
+    def _admit_locked(self, now: float, reg) -> list[tuple[int, int]]:
+        """Admit every admissible queued request (slots + free blocks
+        permitting): map its prefix-cache plan into the slot's block
+        table and queue its prefill. Returns this iteration's CoW
+        (src, dst) block pairs."""
+        cows: list[tuple[int, int]] = []
+        while True:
+            adm = self.scheduler.next_admission()
+            if adm is None:
+                break
+            req, slot = adm
+            sp = req.sampling
+            self._temp[slot] = sp.temperature
+            self._topk[slot] = sp.top_k
+            self._topp[slot] = sp.top_p
+            self._slot_req[slot] = req
+            plan = req.admit
+            self._bt[slot, :] = 0
+            self._bt[slot, :len(plan["table"])] = plan["table"]
+            if plan["cow"] is not None:
+                cows.append(plan["cow"])
+            self._prefilling.append(
+                {"req": req, "slot": slot, "off": plan["first_uncached"]})
+            self._ctl_dirty = True           # new sampling params + bt
+            hit = req.cached_tokens
+            if hit:
+                reg.counter("serving_prefix_hit_tokens_total",
+                            "prompt tokens served from the prefix "
+                            "cache (prefill skipped)").inc(hit)
+            reg.counter("serving_prefix_miss_tokens_total",
+                        "prompt tokens that had to be prefilled").inc(
+                len(req.prompt) - hit)
+            flight_record("serving_admit", req=req.id,
+                          trace=req.trace_id, slot=slot,
+                          cached_tokens=hit,
+                          queued_s=round(now - req.submit_s, 4))
+        ev = self.scheduler.evictions_total
+        if ev > self._evictions_synced:
+            reg.counter("serving_block_evictions_total",
+                        "prefix-cache blocks LRU-evicted to refill the "
+                        "free list").inc(ev - self._evictions_synced)
+            self._evictions_synced = ev
+        return cows
+
     def _step_locked(self) -> bool:
         t0 = time.monotonic()
+        reg = telemetry.get_registry()
+        C = self.prefill_chunk
+        R = self._fin_cap
+        S = self.pool.slots
         with self._lock:
-            if self._prefill is None:
-                adm = self.scheduler.next_admission()
-                if adm is not None:
-                    req, slot = adm
-                    sp = req.sampling
-                    self._temp[slot] = sp.temperature
-                    self._topk[slot] = sp.top_k
-                    self._topp[slot] = sp.top_p
-                    self._slot_req[slot] = req
-                    self._prefill = {"req": req, "slot": slot, "off": 0}
-                    flight_record("serving_admit", req=req.id,
-                                  trace=req.trace_id, slot=slot,
-                                  queued_s=round(
-                                      time.monotonic() - req.submit_s, 4))
-            pf_host = self._prefill
+            cows = self._admit_locked(t0, reg)
             active_prev = np.nonzero(self._active)[0]
-            if pf_host is None and active_prev.size == 0:
+            if not self._prefilling and active_prev.size == 0 \
+                    and not cows:
                 return False
-            ctl = {"pos": jnp.asarray(self._pos),
-                   "last_tok": jnp.asarray(self._last_tok),
-                   "active": jnp.asarray(self._active),
-                   "temp": jnp.asarray(self._temp),
-                   "topk": jnp.asarray(self._topk),
-                   "topp": jnp.asarray(self._topp)}
-            C = self.prefill_chunk
-            chunk = np.zeros(C, np.int32)
-            if pf_host is not None:
-                req, off = pf_host["req"], pf_host["off"]
-                part = req.prompt[off:off + C]
-                chunk[:len(part)] = part
-                pf = {"run": np.True_,
-                      "slot": np.int32(pf_host["slot"]),
-                      "start": np.int32(off),
-                      "valid": np.int32(len(part)),
-                      "tokens": chunk}
-                pf_last = off + len(part) >= len(req.prompt)
-                pf_valid = len(part)
-            else:
-                pf = {"run": np.False_, "slot": np.int32(0),
-                      "start": np.int32(0), "valid": np.int32(1),
-                      "tokens": chunk}
-                pf_last = False
-                pf_valid = 0
+            if self._ctl_dirty:
+                self._ctl_dev = {"pos": jnp.asarray(self._pos),
+                                 "last_tok": jnp.asarray(self._last_tok),
+                                 "active": jnp.asarray(self._active),
+                                 "temp": jnp.asarray(self._temp),
+                                 "topk": jnp.asarray(self._topk),
+                                 "topp": jnp.asarray(self._topp)}
+                self._bt_dev = jnp.asarray(self._bt)
+                self._ctl_dirty = False
+            ctl = self._ctl_dev
+            # pack the prefill budget FCFS over in-flight prefills: the
+            # oldest request fills first (so a lone request's chunk
+            # count matches the PR 5 single-admission engine), the rest
+            # share what remains — a burst's TTFT now scales with total
+            # prompt tokens / C, not with queue depth
+            tokens = np.zeros(C, np.int32)
+            tpos = np.zeros(C, np.int32)
+            tslot = np.zeros(C, np.int32)
+            tvalid = np.zeros(C, bool)
+            fin_row = np.zeros(R, np.int32)
+            fin_slot = np.zeros(R, np.int32)
+            fills: list[tuple[dict, int]] = []   # (entry, n) this iter
+            fin_ents: list[dict] = []            # completes this iter
+            used = 0
+            for ent in self._prefilling:         # empty on the common
+                if used >= C:                    # decode-only iteration
+                    break
+                req, off = ent["req"], ent["off"]
+                n = int(min(C - used, len(req.prompt) - off))
+                tokens[used:used + n] = req.prompt[off:off + n]
+                tpos[used:used + n] = np.arange(off, off + n)
+                tslot[used:used + n] = ent["slot"]
+                tvalid[used:used + n] = True
+                if off + n >= len(req.prompt):
+                    fin_row[len(fin_ents)] = used + n - 1
+                    fin_slot[len(fin_ents)] = ent["slot"]
+                    fin_ents.append(ent)
+                fills.append((ent, n))
+                used += n
+            pf = {"run": np.bool_(used > 0), "tokens": tokens,
+                  "pos": tpos, "slot": tslot, "valid": tvalid,
+                  "fin_row": fin_row, "fin_slot": fin_slot}
+            # CoW lanes: unused dst = n_blocks scatters out of bounds
+            cow_src = np.zeros(S, np.int32)
+            cow_dst = np.full(S, self.pool.n_blocks, np.int32)
+            for i, (src, dst) in enumerate(cows):
+                cow_src[i], cow_dst[i] = src, dst
+            cow = {"run": np.bool_(bool(cows)), "src": cow_src,
+                   "dst": cow_dst}
+            bt = self._bt_dev
 
         ctx = self._plan.act if self._plan is not None \
             else contextlib.nullcontext()
         with ctx:
-            caches, emitted, first_tok = self._fn(
-                self.params, self.pool.caches, ctl, pf, self._key,
-                np.int32(self._iter))
+            caches, emitted, first_toks, pos_dev, last_dev = self._fn(
+                self.params, self.pool.caches, ctl, pf, bt, cow,
+                self._key, np.int32(self._iter))
         self.pool.caches = caches
         em = np.asarray(emitted)
+        ft = np.asarray(first_toks)
         now = time.monotonic()
 
-        reg = telemetry.get_registry()
         with self._lock:
             self._iter += 1
             # decode results for the slots that were active going in
             for r in active_prev:
                 self._on_token(int(r), int(em[r]), now, reg)
-            # prefill progress
-            if pf_host is not None:
-                pf_host["off"] += pf_valid
-                pf_host["req"].mark("prefill_chunk", dur_s=now - t0,
-                                    ts_s=t0)
+            # prefill progress for every request that got pack tokens
+            for ent, n in fills:
+                ent["off"] += n
+                ent["req"].mark("prefill_chunk", dur_s=now - t0,
+                                ts_s=t0)
+            if used:
                 reg.counter("serving_tokens_total",
                             "serving tokens by kind").inc(
-                    pf_valid, kind="prompt")
-                if pf_last:
-                    req, slot = pf_host["req"], pf_host["slot"]
-                    self._pos[slot] = len(req.prompt)
-                    self._active[slot] = True
-                    req.status = "decode"
-                    req.first_token_s = now
-                    req.mark("first_token", ts_s=now)
-                    ttft = now - req.submit_s
-                    reg.histogram(
-                        "serving_ttft_seconds",
-                        "time submit -> first token").observe(ttft)
-                    if self.slo is not None:
-                        self.slo.observe("serving_ttft_seconds", ttft)
-                    self._on_token(slot, int(first_tok), now, reg)
-                    self._prefill = None
+                    used, kind="prompt")
+            for i, ent in enumerate(fin_ents):
+                req, slot = ent["req"], ent["slot"]
+                self._pos[slot] = len(req.prompt)
+                self._active[slot] = True
+                self._ctl_dirty = True       # slot turned on mid-flight
+                req.status = "decode"
+                req.first_token_s = now
+                req.mark("first_token", ts_s=now)
+                ttft = now - req.submit_s
+                reg.histogram(
+                    "serving_ttft_seconds",
+                    "time submit -> first token").observe(ttft)
+                if self.slo is not None:
+                    self.slo.observe("serving_ttft_seconds", ttft)
+                # the finished prompt's whole blocks enter the radix
+                # cache (the trie takes refs, so they outlive the slot)
+                if self.prefix_cache is not None:
+                    self.prefix_cache.insert(req.prompt.tolist(),
+                                             self._bt[slot])
+                self._on_token(slot, int(ft[i]), now, reg)
+                self._prefilling.remove(ent)
+            # steady decode: adopt the step's own control advance (no
+            # host→device upload next iteration). Any event above set
+            # _ctl_dirty, which forces a rebuild from the np mirrors.
+            if not self._ctl_dirty:
+                self._ctl_dev = dict(self._ctl_dev, pos=pos_dev,
+                                     last_tok=last_dev)
             self._record_gauges()
         step_s = time.monotonic() - t0
         reg.histogram("serving_step_seconds",
@@ -396,8 +540,12 @@ class ServingEngine:
         req.finish_s = now
         req.mark("finish", ts_s=now)
         self._active[slot] = False
+        self._ctl_dirty = True               # slot turned off
         self._slot_req[slot] = None
-        self.scheduler.release(slot)
+        # drop this slot's hold on every block it mapped; blocks the
+        # prefix cache adopted stay resident (trie refs), the rest free
+        self.scheduler.release(slot, table=self._bt[slot])
+        self._bt[slot, :] = 0
         reg.counter("serving_requests_total",
                     "serving requests by outcome").inc(
             outcome="completed")
@@ -452,6 +600,9 @@ class ServingEngine:
         reg.gauge("serving_slot_occupancy",
                   "fraction of KV-pool slots in use").set(
             self.scheduler.occupancy)
+        reg.gauge("serving_kv_blocks_in_use",
+                  "live KV blocks (slot tables + prefix cache)").set(
+            self.blocks.blocks_in_use)
 
     def run_until_drained(self, max_steps: int = 1_000_000) -> int:
         """Drive :meth:`step` until queue + slots are empty; returns the
@@ -472,8 +623,13 @@ class ServingEngine:
             sampling: Union[SamplingParams, Sequence[SamplingParams],
                             None] = None) -> list[list[int]]:
         """Submit every prompt, run to drain, return per-request tokens
-        (continuous batching under the hood — arrival order and slot
-        assignment do not change any request's tokens)."""
+        **in submission order** — requests routinely FINISH out of order
+        (short decodes overtake long ones across slot recycling), so
+        results are keyed by the submitted Request, never by completion
+        order. Continuous batching under the hood: arrival order and
+        slot assignment do not change any request's tokens. When the
+        :meth:`start` background loop is running, this waits on each
+        request instead of stepping the engine from a second thread."""
         if sampling is None or isinstance(sampling, SamplingParams):
             sampling = [sampling or SamplingParams()] * len(prompts)
         reqs = [self.submit(p, sp) for p, sp in zip(prompts, sampling)]
@@ -495,7 +651,11 @@ class ServingEngine:
             raise ValueError(
                 f"{len(bad)} request(s) rejected at admission: "
                 + "; ".join(f"#{r.id}: {r.error}" for r in bad[:3]))
-        self.run_until_drained()
+        if self._thread is not None:
+            for r in reqs:          # loop thread owns the iterations
+                r.done.wait()
+        else:
+            self.run_until_drained()
         return [list(r.tokens) for r in reqs]
 
     # -- background loop (online front ends) --------------------------------

@@ -1,36 +1,48 @@
-"""Slot-pooled KV cache: one fixed-shape arena for request churn.
+"""Block-paged KV cache: one fixed-shape arena, indexed through block
+tables.
 
-The training side already solved "dynamic work on static shapes" twice
-(fixed KV buffers + ``dynamic_update_slice`` in ``models/generation``,
-fixed-capacity expert buffers in MoE); this module applies the same idiom
-to SERVING. Instead of one cache per request (vLLM allocates pages, the
-reference dynamically concats KV), the pool is a single
-``(layers, slots, max_len, kv_heads, head_dim)`` arena allocated once:
+PR 5's slot arena ((layers, slots, max_len, hkv, d)) solved "dynamic
+work on static shapes" but allocated every slot its WORST CASE: a
+10-token request in a 2048-token slot wastes 99.5% of its bytes, and a
+shared system prompt is stored once per slot. This module is the
+PagedAttention answer (vLLM, SOSP'23) mapped onto the jit-once TPU
+discipline:
 
-- a request of ANY length maps onto one free slot — admission is a host
-  bookkeeping operation, never an allocation, so the engine step keeps
-  one compiled signature across arbitrary request churn;
-- per-slot depth lives in the engine's control vectors (``pos``), and
-  the per-row causal mask guarantees a reused slot never attends a
-  previous tenant's stale rows (every attended position was written by
-  the current request first);
-- the fp32/bf16/int8 layouts are exactly
-  ``generation.init_kv_caches`` — the int8 pool quarters decode's HBM
-  bandwidth (the serving bottleneck) with per-(position, head) scales.
+- the arena is ``(layers, n_blocks, block_size, kv_heads, head_dim)``,
+  allocated once; a request maps onto a per-slot BLOCK TABLE (fixed
+  ``max_len/block_size`` width, padded with the null block 0), and the
+  compiled step indexes KV through a gather on the table
+  (``ops.attention.gather_block_rows``) — tables are DATA, never
+  shapes, so block churn never recompiles;
+- blocks are refcounted (:class:`BlockManager`): the radix-tree prefix
+  cache (``serving/prefix_cache.py``) maps one physical block into many
+  slots' tables, so a fleet-wide system prompt is prefilled once and
+  costs one set of pages total;
+- the fp32/bf16/int8 layouts are exactly ``generation.init_kv_caches``
+  with (batch, max_len) := (n_blocks, block_size) — the int8 pool
+  quarters decode's HBM bandwidth with per-(position, head) scales, and
+  quantized blocks are shared bit-for-bit like fp blocks.
 
 Sizing is delegated to the memory-plane ledger
-(:func:`hetu_tpu.engine.memory.size_kv_pool`): slots are whatever HBM
-remains next to the weights, so the scheduler's admission gate and the
-planner price bytes with the same arithmetic.
+(:func:`hetu_tpu.engine.memory.size_kv_blocks`): blocks are whatever
+HBM remains next to the weights, so the scheduler's free-block
+admission gate and the planner price bytes with the same arithmetic.
 """
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from hetu_tpu.models.generation import init_kv_caches
+
+#: block table entries point here when a position is unallocated; the
+#: null block is never handed out and never written, so its rows stay
+#: exact zeros (masked by every live row's causal offset anyway)
+NULL_BLOCK = 0
 
 
 def cache_dtype_name(dtype) -> str:
@@ -42,12 +54,75 @@ def cache_dtype_name(dtype) -> str:
     return "fp32"
 
 
+class BlockManager:
+    """Host-side free list + refcounts over the paged arena.
+
+    Pure bookkeeping (no jax): the device only ever sees block ids as
+    traced table entries. A block's refcount is the number of HOLDERS —
+    slots whose table maps it, plus the prefix-cache trie when a node
+    caches it. ``release`` returns it to the free list at zero; blocks
+    are never zeroed on reuse (the per-row causal mask guarantees a
+    reused block is written before it is attended).
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("need at least one non-null block")
+        self.n_blocks = int(n_blocks)
+        self.free: deque[int] = deque(range(1, self.n_blocks))
+        self.refs = np.zeros(self.n_blocks, np.int32)
+
+    def alloc(self) -> Optional[int]:
+        """Pop a free block (refcount 1), or None when the pool is dry
+        (the caller evicts prefix-cache leaves and retries)."""
+        if not self.free:
+            return None
+        b = self.free.popleft()
+        self.refs[b] = 1
+        return b
+
+    def share(self, block: int) -> None:
+        """Add a holder to an already-live block (prefix hit / trie)."""
+        if block == NULL_BLOCK:
+            raise ValueError("cannot share the null block")
+        if self.refs[block] <= 0:
+            raise ValueError(f"share of dead block {block}")
+        self.refs[block] += 1
+
+    def release(self, block: int) -> None:
+        """Drop one holder; the block frees when the last one leaves."""
+        if block == NULL_BLOCK:
+            return
+        self.refs[block] -= 1
+        if self.refs[block] < 0:
+            raise ValueError(f"double release of block {block}")
+        if self.refs[block] == 0:
+            self.free.append(block)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self.free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.n_blocks - 1 - len(self.free)
+
+
 class KVPool:
-    """The slot arena plus its shape metadata (free-slot bookkeeping
-    belongs to the scheduler; the pool is just bytes)."""
+    """The block-paged arena plus its shape metadata (block/refcount
+    bookkeeping belongs to :class:`BlockManager` and the scheduler; the
+    pool is just bytes).
+
+    ``slots`` remains the engine's max CONCURRENCY (the width of the
+    control vectors and block tables); capacity in bytes is now
+    ``n_blocks`` — by default one null block plus ``slots`` worst-case
+    requests' worth, but prefix sharing means the effective capacity in
+    requests is higher.
+    """
 
     def __init__(self, model, slots: int, max_len: int,
-                 cache_dtype=jnp.float32):
+                 cache_dtype=jnp.float32, block_size: Optional[int] = None,
+                 n_blocks: Optional[int] = None):
         max_positions = getattr(getattr(model, "cfg", None),
                                 "max_positions", None)
         if max_positions is not None and max_len > max_positions:
@@ -56,15 +131,34 @@ class KVPool:
                 f"max_positions {max_positions}")
         self.slots = int(slots)
         self.max_len = int(max_len)
+        self.block_size = int(block_size) if block_size else self.max_len
+        if self.max_len % self.block_size != 0:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of block_size "
+                f"{self.block_size} (block tables have a fixed "
+                f"max_len/block_size width)")
+        self.blocks_per_slot = self.max_len // self.block_size
+        self.n_blocks = int(n_blocks) if n_blocks else (
+            1 + self.slots * self.blocks_per_slot)
+        if self.n_blocks <= self.blocks_per_slot:
+            raise ValueError(
+                f"n_blocks {self.n_blocks} cannot hold even one "
+                f"worst-case request ({self.blocks_per_slot} blocks "
+                f"+ the null block)")
         self.cache_dtype = cache_dtype
-        self.caches = init_kv_caches(model, self.slots, self.max_len,
-                                     cache_dtype)
+        # the paged arena reuses the generation layouts with
+        # (batch, max_len) := (n_blocks, block_size)
+        self.caches = init_kv_caches(model, self.n_blocks,
+                                     self.block_size, cache_dtype)
 
     @classmethod
     def sized_for(cls, model, *, hbm_budget_bytes: float, max_len: int,
                   cache_dtype=jnp.float32, tp: int = 1,
-                  max_slots: Optional[int] = None) -> "KVPool":
-        """Build the largest pool the HBM budget allows (ledger-sized)."""
+                  max_slots: Optional[int] = None,
+                  block_size: Optional[int] = None) -> "KVPool":
+        """Build the largest pool the HBM budget allows (ledger-sized:
+        whole worst-case slots, so admission can never strand a request
+        that passed the budget gate)."""
         from hetu_tpu.engine.memory import size_kv_pool
         slots = size_kv_pool(model.cfg,
                              hbm_budget_bytes=hbm_budget_bytes,
@@ -73,7 +167,8 @@ class KVPool:
                              tp=tp)
         if max_slots is not None:
             slots = min(slots, max_slots)
-        return cls(model, slots, max_len, cache_dtype)
+        return cls(model, slots, max_len, cache_dtype,
+                   block_size=block_size)
 
     @property
     def quantized(self) -> bool:

@@ -60,6 +60,10 @@ class Request:
     first_token_s: Optional[float] = None
     finish_s: Optional[float] = None
     error: Optional[str] = None
+    cached_tokens: int = 0             # prompt tokens served by the
+    #                                    prefix cache (skipped prefill)
+    admit: Optional[dict] = dataclasses.field(
+        default=None, repr=False, compare=False)  # paged admission plan
     trace_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex[:12])
     events: list = dataclasses.field(default_factory=list,
@@ -97,6 +101,7 @@ class Request:
                 (self.finish_s - self.submit_s) * 1e3, 3)
         out["prefill_chunks"] = sum(
             1 for p, _, _ in self.events if p == "prefill_chunk")
+        out["cached_tokens"] = self.cached_tokens
         return out
 
     def result(self) -> dict:
@@ -111,13 +116,30 @@ class Scheduler:
     ``max_len`` gating is the HBM-budget gate in disguise: the pool was
     sized so ``slots * max_len`` rows fit the budget
     (``engine.memory.size_kv_pool``), so "fits a slot" == "fits HBM".
+
+    With a paged pool (``blocks=`` a BlockManager, ``block_size=``),
+    admission moves from slot-count to FREE-BLOCK accounting: a request
+    is admitted when a control slot is free AND its worst case fits in
+    NEW blocks — where "new" is net of the prefix cache
+    (``prefix_cache=``), so a full-prefix hit costs ~0 blocks and
+    admits even into a nearly-full pool. When blocks run short the
+    scheduler first LRU-evicts unpinned cache leaves; if still short,
+    the head of the queue WAITS (head-of-line, preserving FCFS — a
+    later cheaper request never jumps it, which is what keeps
+    ``generate_many`` outputs in submission order under churn).
     """
 
-    def __init__(self, slots: int, max_len: int):
+    def __init__(self, slots: int, max_len: int, *, blocks=None,
+                 prefix_cache=None, block_size: Optional[int] = None):
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.queue: deque[Request] = deque()
         self.free: list[int] = list(range(self.slots))
+        self.blocks = blocks              # BlockManager | None (legacy)
+        self.cache = prefix_cache         # PrefixCache | None
+        self.block_size = int(block_size) if block_size else None
+        self.evictions_total = 0          # host ledger (engine syncs
+        #                                   the telemetry counter)
 
     # -- admission ----------------------------------------------------------
     def submit(self, req: Request) -> bool:
@@ -138,18 +160,89 @@ class Scheduler:
         return True
 
     def next_admission(self) -> Optional[tuple[Request, int]]:
-        """Pop the oldest queued request into a free slot, or None."""
+        """Pop the oldest queued request into a free slot, or None
+        (no queue, no slot, or — paged — not enough free blocks even
+        after cache eviction: the head waits).
+
+        Paged pools attach the admission plan as ``req.admit``:
+        ``{"table": [block ids], "first_uncached": int,
+        "cow": (src, dst) | None}`` — blocks already allocated/shared,
+        so the engine only maps them into control vectors."""
         if not self.queue or not self.free:
             return None
-        req = self.queue.popleft()
+        req = self.queue[0]
+        plan = None
+        if self.blocks is not None:
+            plan = self._page_plan(req)
+            if plan is None:
+                return None
+        self.queue.popleft()
         slot = self.free.pop(0)
         req.slot = slot
         req.status = "prefill"
+        req.admit = plan
         req.mark("admit")
         return req, slot
 
-    def release(self, slot: int) -> None:
+    def _page_plan(self, req: Request) -> Optional[dict]:
+        """Price ``req`` in blocks net of the prefix cache, evicting
+        LRU cache leaves if the free list is short; None = cannot fit
+        yet. On success every table block is live (shared or freshly
+        allocated) and charged to this request."""
+        bs = self.block_size
+        P = len(req.prompt)
+        total = -(-(P + req.sampling.max_tokens) // bs)   # worst case
+        shared: list[int] = []
+        partial = None
+        if self.cache is not None:
+            shared, partial = self.cache.match(req.prompt.tolist())
+            shared = shared[:total]
+        matched = len(shared) * bs + (partial[1] if partial else 0)
+        # a FULL-prompt hit still recomputes the last token (its logits
+        # seed decoding); the rewrite of position P-1 into a possibly
+        # shared block is benign — same tokens, same values
+        first_uncached = min(matched, P - 1)
+        if partial is not None and first_uncached <= len(shared) * bs:
+            partial = None                 # tail match buys nothing
+            first_uncached = min(len(shared) * bs, P - 1)
+        n_new = total - len(shared)        # incl. the CoW destination
+        # pin the matched path BEFORE evicting: evict() reclaims any
+        # refcount-1 trie leaf, and peeling a cached chain tail-first
+        # can reach the very blocks we just matched — unpinned, they
+        # would be freed (and possibly re-allocated) out from under
+        # this request's table
+        pins = list(shared)
+        if partial is not None:
+            pins.append(partial[0])
+        for b in pins:
+            self.blocks.share(b)
+        if n_new > self.blocks.free_blocks and self.cache is not None:
+            self.evictions_total += self.cache.evict(
+                n_new - self.blocks.free_blocks)
+        if n_new > self.blocks.free_blocks:
+            for b in pins:                 # unwind; the trie ref remains
+                self.blocks.release(b)
+            return None
+        fresh = [self.blocks.alloc() for _ in range(n_new)]
+        if partial is not None:
+            # the src pin only guarded eviction: the table never maps
+            # the src (the engine copies it into fresh[0] this step)
+            self.blocks.release(partial[0])
+        table = shared + fresh
+        cow = (partial[0], fresh[0]) if partial is not None else None
+        req.cached_tokens = first_uncached
+        return {"table": table, "first_uncached": first_uncached,
+                "cow": cow}
+
+    def release(self, slot: int, table=None) -> None:
+        """Return a slot (and, paged, every block its table maps —
+        shared blocks just drop a holder; blocks the prefix cache
+        adopted at insert stay cached)."""
         self.free.append(slot)
+        if self.blocks is not None and table is not None:
+            for b in table:
+                if b:
+                    self.blocks.release(int(b))
 
     # -- introspection ------------------------------------------------------
     @property

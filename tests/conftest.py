@@ -115,6 +115,12 @@ SLOW_TESTS = {
     # driver artifacts
     "test_bench_emits_json_contract",
     "test_bench_serving_emits_json_contract",
+    # paged serving (ISSUE 7): compile-heavy parity matrices — the
+    # acceptance-critical eviction-churn one-compile test, the
+    # shared-system-prompt shrink test and the submission-order
+    # regression stay in the quick tier
+    "test_cache_on_off_identical_across_arrival_permutations",
+    "test_int8_paged_pool_matches_and_hits",
     "test_graft_entry_fn_runs",
     "test_dryrun_multichip_smoke",
     # example-script smoke

@@ -600,12 +600,14 @@ def test_serving_loop_watchdog_trips_on_stalled_step(telem, tmp_path):
     eng.watchdog.poll_s = 0.02
     eng.watchdog.dump_dir = str(tmp_path)   # keep dumps out of the cwd
     S = eng.pool.slots
+    R = eng._fin_cap
     hang = threading.Event()
 
-    def fake_fn(params, caches, ctl, pf, key, it):
+    def fake_fn(params, caches, ctl, pf, bt, cow, key, it):
         if hang.is_set():
             time.sleep(1.2)          # the stalled fake step
-        return caches, np.zeros(S, np.int32), np.int32(0)
+        return (caches, np.zeros(S, np.int32), np.zeros(R, np.int32),
+                ctl["pos"], ctl["last_tok"])
 
     eng._fn = fake_fn
     eng.start(idle_sleep_s=0.001)

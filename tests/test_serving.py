@@ -319,12 +319,18 @@ def test_ttft_once_and_gauges_drain_under_churn(gpt):
         assert len(by_trace) == len(prompts)
         for names in by_trace.values():
             assert {"queued", "prefill_chunk", "decode"} <= names
-        # and the RESULT-style timing breakdown is complete + ordered
+        # and the RESULT-style timing breakdown is complete + ordered.
+        # Packed prefill (ISSUE 7) shares the chunk budget across
+        # admitting requests, so a request's iteration count is no
+        # longer exactly ceil(P/C): it floors there (FCFS fill) and can
+        # gain one partial leading chunk when it joins a busy pack.
         for r in reqs:
             t = r.result()["timing"]
             assert t["trace_id"] == r.trace_id
             assert 0 <= t["queued_ms"] <= t["ttft_ms"] <= t["total_ms"]
-            assert t["prefill_chunks"] == -(-len(r.prompt) // CHUNK)
+            lo = -(-len(r.prompt) // CHUNK)
+            assert lo <= t["prefill_chunks"] <= lo + 1
+            assert t["cached_tokens"] == 0       # all prompts distinct
     finally:
         telemetry.enable(False)
         telemetry.reset()

@@ -90,23 +90,30 @@ def _shardings_compatible(src, dst, shape) -> bool:
         return False
 
 
-def cross_topology_switch(state: TrainState, new_plan) -> TrainState:
-    """Reshard onto a (possibly disjoint or differently-sized) device
-    set: destination shards are assembled via
-    ``jax.make_array_from_callback`` reading slices of the source shards
-    from host memory — the in-memory analogue of the sharded checkpoint's
-    restore path (same :func:`assemble_window` intersection core).
+def reshard_tree(tree, shardings, *, force_copy: bool = False):
+    """Move an arbitrary pytree onto ``shardings`` (a matching pytree of
+    ``Sharding``) — the per-leaf mover of :func:`cross_topology_switch`,
+    exposed for non-TrainState consumers (the serving fleet's live
+    weight push moves bare param pytrees onto each replica's plan).
 
-    Fast path: leaves whose destination shard layout matches the source
-    (per :func:`_shardings_compatible`) skip the numpy round trip and go
+    Destination shards are assembled via
+    ``jax.make_array_from_callback`` reading slices of the source shards
+    from host memory — the in-memory analogue of the sharded
+    checkpoint's restore path (same :func:`assemble_window` intersection
+    core). Leaves whose destination layout matches the source (per
+    :func:`_shardings_compatible`) skip the numpy round trip and go
     through ``jax.device_put`` directly — whole-shard copies the runtime
-    executes without host-side slicing. On a typical shrink most of the
-    optimizer state (replicated or identically-sharded leaves) takes
-    this path; only genuinely re-sliced leaves pay reassembly.
+    executes without host-side slicing.
+
+    ``force_copy=True`` disables that fast path for device arrays so the
+    result NEVER aliases a source buffer: a weight publisher hands the
+    resharded tree to serving replicas while the trainer keeps stepping,
+    and the train step DONATES its state buffers — an aliased leaf would
+    be deleted out from under the replica on the trainer's next step.
 
     Sources must be fully addressable to this process (single-controller
-    flows); volume accounting raises otherwise — multi-process elastic
-    resharding goes through the sharded checkpoint instead.
+    flows) — multi-process elastic resharding goes through the sharded
+    checkpoint instead.
     """
     from hetu_tpu.utils.windows import assemble_window
 
@@ -115,7 +122,8 @@ def cross_topology_switch(state: TrainState, new_plan) -> TrainState:
     def move(leaf, sharding):
         if not isinstance(leaf, jax.Array):
             return jax.device_put(leaf, sharding)
-        if _shardings_compatible(leaf.sharding, sharding, leaf.shape):
+        if not force_copy and _shardings_compatible(
+                leaf.sharding, sharding, leaf.shape):
             counts["fast"] += 1
             return jax.device_put(leaf, sharding)
         counts["reassembled"] += 1
@@ -136,7 +144,7 @@ def cross_topology_switch(state: TrainState, new_plan) -> TrainState:
 
         return jax.make_array_from_callback(leaf.shape, sharding, window)
 
-    out = jax.tree.map(move, state, new_plan.state_shardings)
+    out = jax.tree.map(move, tree, shardings)
     if telemetry.enabled():
         reg = telemetry.get_registry()
         reg.counter("switch_fastpath_leaves_total",
@@ -146,3 +154,14 @@ def cross_topology_switch(state: TrainState, new_plan) -> TrainState:
                     "cross-topology leaves rebuilt from host shards"
                     ).inc(counts["reassembled"])
     return out
+
+
+def cross_topology_switch(state: TrainState, new_plan) -> TrainState:
+    """Reshard onto a (possibly disjoint or differently-sized) device
+    set: per-leaf host-side reassembly with a whole-shard ``device_put``
+    fast path — see :func:`reshard_tree` (this is its TrainState/plan
+    entry point). On a typical shrink most of the optimizer state
+    (replicated or identically-sharded leaves) takes the fast path; only
+    genuinely re-sliced leaves pay reassembly.
+    """
+    return reshard_tree(state, new_plan.state_shardings)

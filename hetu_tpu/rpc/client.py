@@ -9,26 +9,59 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
+import time
 import urllib.parse
 from typing import Any, Optional
 
 
 class CoordinatorClient:
+    """Line-protocol client.
+
+    The serving-plane verbs carry ``retries`` + jittered exponential
+    backoff (reconnect between attempts) instead of blocking forever on
+    a dead replica socket: the socket ``timeout`` bounds every recv, a
+    connection failure reconnects and retries, and a TIMEOUT on a
+    non-idempotent verb (SUBMIT/GENERATE — the command may already have
+    reached the engine) raises instead of risking a duplicate request.
+    Training-plane verbs (RANK/KV/BARRIER) keep their original
+    semantics — BARRIER is *supposed* to block.
+    """
+
     def __init__(self, port: int, host: str = "127.0.0.1",
                  timeout: float = 30.0,
-                 token: Optional[str] = None):
-        self._sock = socket.create_connection((host, port),
-                                              timeout=timeout)
-        self._buf = b""
+                 token: Optional[str] = None,
+                 retries: int = 2, backoff_s: float = 0.05,
+                 backoff_max_s: float = 2.0):
+        self._host, self._port, self._timeout = host, port, timeout
+        self._retries = int(retries)
+        self._backoff_s = float(backoff_s)
+        self._backoff_max_s = float(backoff_max_s)
         # auth-enabled coordinators require AUTH first on every
         # connection; workers inherit the pool's token via env
-        token = token if token is not None \
+        self._token = token if token is not None \
             else os.environ.get("HETU_COORD_TOKEN")
-        if token:
-            resp = self._cmd(f"AUTH {token}")
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout)
+        self._buf = b""
+        if self._token:
+            resp = self._cmd(f"AUTH {self._token}")
             if resp != "OK":
                 raise ConnectionError(f"coordinator auth failed: {resp}")
+
+    def _reconnect(self) -> None:
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._connect()
 
     def _cmd(self, line: str) -> str:
         self._sock.sendall(line.encode() + b"\n")
@@ -39,6 +72,53 @@ class CoordinatorClient:
             self._buf += chunk
         resp, self._buf = self._buf.split(b"\n", 1)
         return resp.decode()
+
+    def _drop_sock(self) -> None:
+        """Close and forget the connection. Mandatory on any failed
+        command whose response may still arrive: a late response left
+        in the socket would be read as the NEXT command's reply and
+        desync every call after it — the next verb reconnects clean."""
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+        self._buf = b""
+
+    def _cmd_retry(self, line: str, *, idempotent: bool = True) -> str:
+        """``_cmd`` with bounded retries + jittered exponential backoff.
+
+        At-most-once for non-idempotent verbs (SUBMIT/GENERATE): once
+        the command has been handed to a socket, ANY failure — timeout,
+        reset, close — may mean it was already delivered and processed,
+        so only failures during connection establishment (nothing sent
+        yet) are retried. Idempotent verbs retry through a fresh socket
+        regardless. Every raise path drops the connection so a late
+        response can never poison the next command."""
+        attempt = 0
+        while True:
+            sent = False
+            try:
+                if self._sock is None:       # prior reconnect failed
+                    self._connect()
+                sent = True        # past here the line may be delivered
+                return self._cmd(line)
+            except (TimeoutError, ConnectionError, OSError):
+                attempt += 1
+                if attempt > self._retries \
+                        or (sent and not idempotent):
+                    self._drop_sock()
+                    raise
+                delay = min(self._backoff_max_s,
+                            self._backoff_s * (2 ** (attempt - 1)))
+                time.sleep(delay * (0.5 + random.random()))  # jitter
+                try:
+                    self._reconnect()
+                except OSError:
+                    # burn this attempt; the next loop turn re-tries
+                    # the connect itself (bounded by the same budget)
+                    self._sock = None
 
     # -- rank / membership --------------------------------------------------
     def rank(self, name: str) -> int:
@@ -81,16 +161,22 @@ class CoordinatorClient:
             json.dumps(obj, separators=(",", ":")), safe="")
 
     def serving_submit(self, prompt, **sampling) -> int:
-        """Queue a generation request; returns its id (FCFS)."""
-        resp = self._cmd(f"SUBMIT {self._serving_payload(prompt, **sampling)}")
+        """Queue a generation request; returns its id (FCFS).
+        Retries only across CONNECTION failures — a response timeout
+        may mean the engine already queued it (at-most-once)."""
+        resp = self._cmd_retry(
+            f"SUBMIT {self._serving_payload(prompt, **sampling)}",
+            idempotent=False)
         if not resp.startswith("ID "):
             raise RuntimeError(f"serving submit failed: {resp}")
         return int(resp.split()[1])
 
     def serving_result(self, req_id: int,
                        timeout_ms: int = 0) -> Optional[dict]:
-        """Poll a queued request: dict result, or None while pending."""
-        resp = self._cmd(f"RESULT {req_id} {timeout_ms}")
+        """Poll a queued request: dict result, or None while pending.
+        Safe to retry (and retried) across timeouts — polling twice is
+        harmless."""
+        resp = self._cmd_retry(f"RESULT {req_id} {timeout_ms}")
         if resp == "PEND":
             return None
         if not resp.startswith("VAL "):
@@ -100,18 +186,42 @@ class CoordinatorClient:
     def serving_generate(self, prompt, **sampling) -> dict:
         """Blocking generate over the line protocol (engine loop must
         be running server-side, e.g. ``ServingServer.start()``)."""
-        resp = self._cmd(
-            f"GENERATE {self._serving_payload(prompt, **sampling)}")
+        resp = self._cmd_retry(
+            f"GENERATE {self._serving_payload(prompt, **sampling)}",
+            idempotent=False)
         if not resp.startswith("VAL "):
             raise RuntimeError(f"serving generate failed: {resp}")
         return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
+
+    # -- fleet verbs (coordinator with a serving.router.Router) -------------
+    def fleet_status(self) -> dict:
+        """Fleet-wide aggregation: per-replica state/load/version,
+        pending + requeue counters (``Router.fleet_status``)."""
+        resp = self._cmd_retry("FLEET")
+        if not resp.startswith("VAL "):
+            raise RuntimeError(f"fleet status failed: {resp}")
+        return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
+
+    def fleet_drain(self, name: str) -> dict:
+        """Drain one replica (requests re-dispatch to peers); returns
+        ``{"requeued": n}``. NOT retried on timeout: drain blocks
+        server-side until the replica runs dry."""
+        resp = self._cmd_retry(f"DRAIN {name}", idempotent=False)
+        if not resp.startswith("VAL "):
+            raise RuntimeError(f"fleet drain failed: {resp}")
+        return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
+
+    def fleet_resume(self, name: str) -> None:
+        resp = self._cmd_retry(f"RESUME {name}", idempotent=False)
+        if resp != "OK":
+            raise RuntimeError(f"fleet resume failed: {resp}")
 
     # -- live observability (HEALTHZ / METRICS verbs) -----------------------
     def healthz(self) -> dict:
         """Live health document: overall status, watchdog trips, SLO
         alerting state, serving queue/occupancy (telemetry.health_status
         evaluated on the coordinator process)."""
-        resp = self._cmd("HEALTHZ")
+        resp = self._cmd_retry("HEALTHZ")
         if not resp.startswith("VAL "):
             raise RuntimeError(f"healthz failed: {resp}")
         return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
@@ -119,7 +229,7 @@ class CoordinatorClient:
     def metrics_text(self) -> str:
         """Prometheus text exposition of the coordinator process's
         metric registry (scrape-through for a sidecar exporter)."""
-        resp = self._cmd("METRICS")
+        resp = self._cmd_retry("METRICS")
         if not resp.startswith("VAL "):
             raise RuntimeError(f"metrics failed: {resp}")
         return urllib.parse.unquote(resp.split(" ", 1)[1])
@@ -131,4 +241,5 @@ class CoordinatorClient:
         self._cmd("SHUTDOWN")
 
     def close(self):
-        self._sock.close()
+        if self._sock is not None:
+            self._sock.close()

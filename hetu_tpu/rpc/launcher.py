@@ -339,3 +339,68 @@ class ElasticWorkerPool:
                 self.generation += 1
                 self._spawn_all()
             time.sleep(self.poll_s)
+
+
+@dataclasses.dataclass
+class FleetHandle:
+    """A launched serving fleet: the router, its replica names, and the
+    optional coordinator front door. ``stop()`` tears down front door →
+    router → every replica loop (reverse launch order)."""
+
+    router: object                   # serving.router.Router
+    replicas: list
+    coordinator: Optional[object] = None   # PyCoordinatorServer | None
+    port: Optional[int] = None
+
+    def stop(self):
+        if self.coordinator is not None:
+            self.coordinator.stop()
+        self.router.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def launch_serving_fleet(build_engine, n_replicas: int, *,
+                         names: Optional[Sequence[str]] = None,
+                         port: Optional[int] = None,
+                         bind: str = "127.0.0.1", token: str = "",
+                         **router_kw) -> FleetHandle:
+    """Bring up an in-process serving fleet: N replicas (each built by
+    ``build_engine(i)`` — a fresh ServingEngine per call, its background
+    loop started by registration), one load-aware Router over them, and
+    — when ``port`` is given — a coordinator speaking the full verb set
+    (SUBMIT/RESULT/GENERATE routed fleet-wide, FLEET/DRAIN/RESUME,
+    HEALTHZ/METRICS) as the fleet's front door.
+
+    This is the single-host deployment shape (threads share one
+    process's devices) used by ``workloads/rollout_loop.py``, ``bench.py
+    --router`` and the router tests; a multi-host fleet runs one replica
+    per accelerator host and registers through the same Router API.
+    Lazy imports keep the launcher importable without jax.
+    """
+    from hetu_tpu.serving.router import Router
+
+    router = Router(**router_kw)
+    names = list(names) if names is not None \
+        else [f"r{i}" for i in range(n_replicas)]
+    if len(names) != n_replicas:
+        raise ValueError(f"{len(names)} names for {n_replicas} replicas")
+    for i, name in enumerate(names):
+        router.register(name, build_engine(i))
+    coordinator = None
+    if port is not None:
+        from hetu_tpu.rpc.py_server import PyCoordinatorServer
+        coordinator = PyCoordinatorServer(port, bind=bind, token=token,
+                                          serving=router)
+        coordinator.start()
+        coordinator.wait_ready()
+    get_logger().info(
+        f"serving fleet up: {n_replicas} replicas ({', '.join(names)})"
+        + (f", coordinator :{port}" if port is not None else ""))
+    return FleetHandle(router=router, replicas=names,
+                       coordinator=coordinator, port=port)

@@ -89,9 +89,12 @@ class _Handler(socketserver.StreamRequestHandler):
                         ev = b["event"]
                 ev.wait()
                 self._send("OK")
-            elif cmd in ("SUBMIT", "RESULT", "GENERATE"):
+            elif cmd in ("SUBMIT", "RESULT", "GENERATE",
+                         "FLEET", "DRAIN", "RESUME"):
                 # serving-plane verbs (hetu_tpu/serving/server.py) —
-                # lazy import keeps the bare coordinator jax-free
+                # lazy import keeps the bare coordinator jax-free.
+                # ``serving`` may be one ServingEngine or a fleet
+                # Router (FLEET/DRAIN/RESUME are router-only).
                 from hetu_tpu.serving.server import handle_serving_command
                 resp = handle_serving_command(
                     getattr(self.server, "serving", None), cmd, args)
@@ -137,7 +140,8 @@ class PyCoordinatorServer:
         self.bind = bind
         self.port = port
         self.token = token
-        self.serving = serving   # optional ServingEngine (SUBMIT/...)
+        self.serving = serving   # optional ServingEngine or fleet
+        #                          Router (SUBMIT/.../FLEET verbs)
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._ready = threading.Event()

@@ -13,9 +13,14 @@ cache with radix-tree prefix sharing.
 - :mod:`~hetu_tpu.serving.scheduler` — FCFS admission, cache-aware
   free-block gating, completion/eviction;
 - :mod:`~hetu_tpu.serving.server` — the line-protocol front end over
-  ``rpc/py_server.py`` plus payload codecs.
+  ``rpc/py_server.py`` plus payload codecs;
+- :mod:`~hetu_tpu.serving.router` — the FLEET plane: load-aware +
+  prefix-sticky dispatch over N replicas, drain/death requeue, and the
+  :class:`WeightPublisher` live train→serve weight push (rolling
+  drain → swap → resume through the HotSPa reshard core).
 
-``docs/SERVING.md`` documents the architecture and block lifecycle.
+``docs/SERVING.md`` documents the architecture, block lifecycle, and
+the fleet state machines.
 """
 
 from hetu_tpu.serving.engine import ServingEngine, sample_slots
@@ -23,6 +28,10 @@ from hetu_tpu.serving.kv_pool import (
     NULL_BLOCK, BlockManager, KVPool, cache_dtype_name,
 )
 from hetu_tpu.serving.prefix_cache import PrefixCache
+from hetu_tpu.serving.router import (
+    ReplicaHandle, Router, RouterRequest, WeightPublisher,
+    materialize_params,
+)
 from hetu_tpu.serving.scheduler import Request, SamplingParams, Scheduler
 
 __all__ = [
@@ -30,4 +39,6 @@ __all__ = [
     "KVPool", "BlockManager", "NULL_BLOCK", "cache_dtype_name",
     "PrefixCache",
     "Request", "SamplingParams", "Scheduler",
+    "Router", "RouterRequest", "ReplicaHandle", "WeightPublisher",
+    "materialize_params",
 ]

@@ -150,6 +150,12 @@ class ServingEngine:
                                block_size=block_size, n_blocks=kv_blocks)
         self.model = model
         self.params = params
+        #: weight generation currently loaded — bumped by
+        #: :meth:`swap_params` (the HotSPa train→serve push path);
+        #: every request is tagged with the version it was admitted
+        #: under, and the KV pool / prefix cache carry the same tag so
+        #: stale prefills can never survive a swap
+        self.weight_version = 0
         self.prefill_chunk = int(prefill_chunk)  # PACK budget/iteration
         self.blocks = BlockManager(self.pool.n_blocks)
         self.prefix_cache: Optional[PrefixCache] = PrefixCache(
@@ -340,6 +346,77 @@ class ServingEngine:
             return bool(self.scheduler.queue) or self._active.any() \
                 or bool(self._prefilling)
 
+    @property
+    def load(self) -> int:
+        """Instantaneous work on this engine — queued + prefilling +
+        decoding requests. The router's least-loaded dispatch reads
+        exactly this (it is what the ``serving_queue_depth`` /
+        ``serving_slot_occupancy`` gauges sample, as one number)."""
+        with self._lock:
+            return self.scheduler.depth + len(self._prefilling) \
+                + int(self._active.sum())
+
+    # -- fleet lifecycle (router drain / live weight push) ------------------
+    def cancel_queued(self, ids=None) -> list[Request]:
+        """Pull QUEUED (not yet admitted) requests out of the scheduler
+        and return them — the router's drain path re-dispatches them
+        onto peer replicas. ``ids`` restricts the pull to those request
+        ids (the router passes the set it owns, so a request submitted
+        DIRECTLY to this engine is never orphaned — it stays queued and
+        drains through normal admission). Admitted requests are always
+        untouched: their KV is resident, so finishing them here is
+        strictly cheaper than regenerating elsewhere."""
+        with self._lock:
+            if ids is None:
+                out = list(self.scheduler.queue)
+                self.scheduler.queue.clear()
+            else:
+                out = [r for r in self.scheduler.queue if r.id in ids]
+                for r in out:
+                    self.scheduler.queue.remove(r)
+        return out
+
+    def swap_params(self, params, *, version: Optional[int] = None) -> dict:
+        """Install a new parameter pytree on a DRAINED engine — the
+        replica-local leg of a zero-downtime fleet weight push.
+
+        Grabs the iteration lock (so a live :meth:`start` loop is
+        between iterations — it never stops), requires no in-flight
+        work (drain first: queued work was re-dispatched by the router,
+        admitted work ran out under the old weights), bumps the weight
+        generation on the engine + KV pool, and flushes the prefix
+        cache's now-stale residents. The caller owns ``params``'s
+        placement: pass buffers that nothing will donate later
+        (``serving.router.materialize_params``)."""
+        with self._step_lock:
+            with self._lock:
+                if self.scheduler.queue or self._prefilling \
+                        or self._active.any():
+                    raise RuntimeError(
+                        "swap_params on a busy engine — drain first "
+                        "(cancel_queued + wait for has_work() to clear)"
+                        ": in-flight KV was prefilled under the old "
+                        "weights")
+                self.params = params
+                self.weight_version = int(version) \
+                    if version is not None else self.weight_version + 1
+                self.pool.weight_version = self.weight_version
+                flushed = 0
+                if self.prefix_cache is not None:
+                    flushed = self.prefix_cache.set_version(
+                        self.weight_version)
+                if flushed:
+                    telemetry.get_registry().counter(
+                        "serving_prefix_flushed_total",
+                        "prefix-cache blocks flushed because their KV "
+                        "was computed under superseded weights").inc(
+                        flushed)
+                self._record_gauges()
+        flight_record("weight_swap", version=self.weight_version,
+                      flushed_blocks=flushed)
+        return {"version": self.weight_version,
+                "flushed_blocks": flushed}
+
     def step(self) -> bool:
         """One engine iteration; False when there was nothing to do.
         Safe to call while the :meth:`start` loop runs (iterations are
@@ -358,6 +435,7 @@ class ServingEngine:
             if adm is None:
                 break
             req, slot = adm
+            req.weight_version = self.weight_version
             sp = req.sampling
             self._temp[slot] = sp.temperature
             self._topk[slot] = sp.top_k

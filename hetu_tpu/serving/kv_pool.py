@@ -146,6 +146,14 @@ class KVPool:
                 f"worst-case request ({self.blocks_per_slot} blocks "
                 f"+ the null block)")
         self.cache_dtype = cache_dtype
+        #: weight generation whose forward wrote the arena's live
+        #: blocks. Bumped by ``ServingEngine.swap_params`` on a live
+        #: weight push (HotSPa train→serve): the engine only swaps
+        #: drained (no slot holds blocks), and the prefix cache flushes
+        #: its stale residents, so every block written after the bump
+        #: belongs to the new generation — the tag is how audits (and
+        #: the version-tagged prefix trie) tell the two apart.
+        self.weight_version = 0
         # the paged arena reuses the generation layouts with
         # (batch, max_len) := (n_blocks, block_size)
         self.caches = init_kv_caches(model, self.n_blocks,

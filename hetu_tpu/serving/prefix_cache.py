@@ -37,17 +37,21 @@ from hetu_tpu.serving.kv_pool import NULL_BLOCK, BlockManager
 
 class _Node:
     """One cached whole block: edge label ``tokens`` (block_size ids),
-    payload ``block`` (arena id), LRU stamp ``last_use``."""
+    payload ``block`` (arena id), LRU stamp ``last_use``, and the
+    ``version`` of the weights whose forward wrote the block's KV."""
 
-    __slots__ = ("tokens", "block", "parent", "children", "last_use")
+    __slots__ = ("tokens", "block", "parent", "children", "last_use",
+                 "version")
 
     def __init__(self, tokens: tuple, block: int,
-                 parent: Optional["_Node"], last_use: int):
+                 parent: Optional["_Node"], last_use: int,
+                 version: int = 0):
         self.tokens = tokens
         self.block = block
         self.parent = parent
         self.children: list[_Node] = []
         self.last_use = last_use
+        self.version = version
 
 
 def _common_prefix_len(a: Sequence[int], b: Sequence[int]) -> int:
@@ -69,6 +73,13 @@ class PrefixCache:
         self._clock = 0
         self.hits = 0            # host ledgers (telemetry reads deltas)
         self.evictions = 0
+        #: weight generation the cached KV was computed under. A live
+        #: weight push bumps this via :meth:`set_version`, which flushes
+        #: every stale node — and :meth:`match` ALSO refuses stale nodes
+        #: (defense in depth: a missed flush must degrade to a cache
+        #: miss, never to serving tokens prefilled under old weights).
+        self.weight_version = 0
+        self.flushes = 0
 
     # -- lookup -------------------------------------------------------------
     def match(self, tokens: Sequence[int]) -> tuple[
@@ -91,8 +102,9 @@ class PrefixCache:
             key = tuple(tokens[i:i + bs])
             child = None
             if len(key) == bs:
-                child = next((c for c in node.children
-                              if c.tokens == key), None)
+                child = next(
+                    (c for c in node.children if c.tokens == key
+                     and c.version == self.weight_version), None)
             if child is not None:
                 child.last_use = self._clock
                 shared.append(child.block)
@@ -100,8 +112,12 @@ class PrefixCache:
                 i += bs
                 continue
             # partial tail: the child sharing the longest token prefix
+            # (stale-version nodes hold KV from old weights — never
+            # matchable, whole or partial)
             best, best_len = None, 0
             for c in node.children:
+                if c.version != self.weight_version:
+                    continue
                 n = _common_prefix_len(c.tokens, key)
                 if n > best_len:
                     best, best_len = c, n
@@ -124,13 +140,15 @@ class PrefixCache:
         added = 0
         for j in range(len(tokens) // bs):
             key = tuple(tokens[j * bs:(j + 1) * bs])
-            child = next((c for c in node.children if c.tokens == key),
-                         None)
+            child = next(
+                (c for c in node.children if c.tokens == key
+                 and c.version == self.weight_version), None)
             if child is None:
                 blk = int(table[j])
                 if blk == NULL_BLOCK:
                     break
-                child = _Node(key, blk, node, self._clock)
+                child = _Node(key, blk, node, self._clock,
+                              self.weight_version)
                 node.children.append(child)
                 self.blocks.share(blk)      # the trie now holds it too
                 added += 1
@@ -170,6 +188,49 @@ class PrefixCache:
                 heapq.heappush(heap, (parent.last_use, id(parent),
                                       parent))
         self.evictions += freed
+        return freed
+
+    # -- weight-version lifecycle -------------------------------------------
+    def set_version(self, version: int) -> int:
+        """Adopt a new weight generation and flush every node cached
+        under an older one (their KV encodes the OLD weights' forward —
+        mapping them after a live weight push would silently serve
+        tokens prefilled under stale parameters). Returns the number of
+        blocks released back to the free list. No-op at the current
+        version."""
+        version = int(version)
+        if version == self.weight_version:
+            return 0
+        self.weight_version = version
+        return self.flush_stale()
+
+    def flush_stale(self) -> int:
+        """Drop every node whose ``version`` predates the current one,
+        releasing the trie's ref on each block (a block still mapped by
+        a live slot stays resident for that holder — refcounts make the
+        flush safe at any moment, drained or not). Stale interior nodes
+        take their whole subtree with them: a child's KV attends into
+        its parent's positions, so a fresh-version child under a stale
+        parent is unreachable anyway (match walks from the root)."""
+        freed = 0
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            keep: list[_Node] = []
+            for c in node.children:
+                if c.version == self.weight_version:
+                    keep.append(c)
+                    stack.append(c)
+                else:
+                    # release the subtree rooted here (DFS, trie refs)
+                    sub = [c]
+                    while sub:
+                        v = sub.pop()
+                        sub.extend(v.children)
+                        self.blocks.release(v.block)
+                        freed += 1
+            node.children = keep
+        self.flushes += freed
         return freed
 
     # -- introspection ------------------------------------------------------

@@ -1,0 +1,608 @@
+"""Serving fleet plane: a router over N ServingEngine replicas, plus
+the live train→serve weight-push path.
+
+One :class:`~hetu_tpu.serving.engine.ServingEngine` replica is a
+correct serving plane — it is not a FLEET. The ROADMAP's north star
+(heavy traffic from millions of users) needs N replicas behind one
+front door, and the reference's signature capability (SOSP'24 HotSPa
+hot parameter switching, SURVEY §3.4) needs a path for a live Trainer
+to push new weights INTO that fleet without dropping a request. This
+module is both, composed from machinery earlier PRs built:
+
+- :class:`Router` — replica registration / heartbeat / drain / death
+  lifecycle, **load-aware dispatch** (least-loaded by the same
+  queue-depth + occupancy signal the ``serving_*`` gauges sample, TTFT
+  EWMA as the tiebreak) with **prefix-affinity sticky routing**
+  (rendezvous hashing on the prompt's first block of tokens, so
+  requests sharing a system prompt land where the radix prefix cache
+  already holds it — taken only when the sticky replica is within
+  ``affinity_slack`` of the least-loaded, so a hot prefix cannot
+  starve the fleet), **retry-and-requeue** when a replica dies
+  mid-request (undelivered requests are re-dispatched to peers; greedy
+  decoding makes the retry token-identical), and fleet-wide
+  HEALTHZ/METRICS aggregation (:meth:`Router.fleet_status`);
+- :class:`WeightPublisher` — the Trainer-side push: per-replica
+  **drain → swap → resume**, rolling across the fleet so capacity
+  never reaches zero. The swap leg is
+  ``ServingEngine.swap_params``: weight generation bumped on the
+  engine + KV pool, version-stale prefix-cache entries flushed
+  (``prefix_cache.set_version``), so no token is ever decoded against
+  KV prefilled under superseded weights. Parameters move onto each
+  replica's topology through the HotSPa reshard core
+  (:func:`~hetu_tpu.parallel.switch.reshard_tree` — the same
+  ParamSlice-intersection machinery that does training-side hot
+  switches), force-copied so a trainer's later donated step can never
+  delete a replica's buffers.
+
+Everything here is host-side control plane: no jax in the dispatch
+path, the replicas' compiled steps never see the router. The line
+protocol grows matching verbs (``FLEET`` / ``DRAIN`` / ``RESUME`` in
+``rpc/py_server.py``; SUBMIT/RESULT/GENERATE accept a Router wherever
+they accepted an engine), and ``workloads/rollout_loop.py`` drives the
+closed loop: router-fanned rollouts → SFT trainer → publish → serve
+on, uninterrupted. ``docs/SERVING.md`` ("Fleet") has the state
+machines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Optional, Sequence, Union
+
+from hetu_tpu import telemetry
+from hetu_tpu.serving.engine import ServingEngine
+from hetu_tpu.serving.scheduler import Request, SamplingParams
+from hetu_tpu.telemetry.flight import flight_record
+
+
+@dataclasses.dataclass
+class RouterRequest:
+    """One request's fleet-level lifecycle: dispatched to a replica,
+    possibly re-dispatched after a replica death, finished exactly
+    once. Mirrors the engine's :class:`Request` surface (``id`` /
+    ``status`` / ``done`` / ``result()``) so the line-protocol front
+    end serves a Router and an engine through the same verbs."""
+
+    id: int
+    prompt: list
+    sampling: SamplingParams
+    submit_s: float
+    status: str = "queued"       # queued|dispatched|done|rejected|failed
+    replica: Optional[str] = None        # current / last assignment
+    attempts: int = 0                    # dispatches (1 = never requeued)
+    tokens: list = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    weight_version: Optional[int] = None
+    finish_s: Optional[float] = None
+    trace_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:12])
+    inner: Optional[Request] = dataclasses.field(
+        default=None, repr=False, compare=False)
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False, compare=False)
+
+    def result(self) -> dict:
+        timing: dict = {"trace_id": self.trace_id,
+                        "attempts": self.attempts}
+        if self.inner is not None:
+            timing.update(self.inner.timing())
+            timing["trace_id"] = self.trace_id   # router id wins
+        if self.finish_s is not None:
+            timing["router_total_ms"] = round(
+                (self.finish_s - self.submit_s) * 1e3, 3)
+        return {"id": self.id, "status": self.status,
+                "tokens": list(self.tokens), "error": self.error,
+                "replica": self.replica,
+                "weight_version": self.weight_version,
+                "timing": timing}
+
+
+class ReplicaHandle:
+    """Router-side view of one registered replica."""
+
+    def __init__(self, name: str, engine: ServingEngine):
+        self.name = name
+        self.engine = engine
+        self.state = "live"          # live | draining | dead
+        self.registered_s = time.monotonic()
+        self.last_beat: Optional[float] = None   # external heartbeats
+        self.inflight: dict[int, RouterRequest] = {}   # inner id → rreq
+        self.dispatched = 0
+        self.ttft_ewma_s: Optional[float] = None
+
+    def loop_alive(self) -> bool:
+        t = self.engine._thread
+        return t is not None and t.is_alive()
+
+    def loop_died(self) -> bool:
+        """True only for a loop that RAN and exited — a replica
+        registered with ``start=False`` (caller drives the engine, e.g.
+        tests stepping by hand) is not dead, just externally driven."""
+        t = self.engine._thread
+        return t is not None and not t.is_alive()
+
+    @property
+    def load(self) -> int:
+        return self.engine.load
+
+    @property
+    def weight_version(self) -> int:
+        return self.engine.weight_version
+
+    def status(self) -> dict:
+        return {"state": self.state, "load": self.load,
+                "queue_depth": self.engine.scheduler.depth,
+                "occupancy": round(self.engine.scheduler.occupancy, 4),
+                "loop_running": self.loop_alive(),
+                "weight_version": self.weight_version,
+                "dispatched": self.dispatched,
+                "inflight": len(self.inflight),
+                "ttft_ewma_ms": None if self.ttft_ewma_s is None
+                else round(self.ttft_ewma_s * 1e3, 3)}
+
+
+class Router:
+    """Load-aware, prefix-sticky dispatch over registered replicas.
+
+    In-process fleet: replicas are live :class:`ServingEngine` objects
+    whose background loops this process runs (threads — the suite's and
+    the rollout workload's deployment shape; one engine per accelerator
+    process reaches the same Router through the coordinator verbs).
+    Death is detected from the replica's loop thread (and, for
+    externally-driven replicas, heartbeat staleness once
+    :meth:`heartbeat` has been seen); a monitor thread finalizes
+    completions, requeues the dead replica's undelivered requests onto
+    peers, and keeps the fleet gauges fresh.
+    """
+
+    def __init__(self, *, affinity_tokens: int = 16,
+                 affinity_slack: int = 2,
+                 beat_timeout_s: float = 2.0,
+                 max_attempts: int = 5,
+                 poll_s: float = 0.002):
+        self.affinity_tokens = int(affinity_tokens)
+        #: a sticky (prefix-affinity) pick is honored only while its
+        #: load is within this many requests of the least-loaded
+        #: replica — past that, cache locality loses to balance
+        self.affinity_slack = int(affinity_slack)
+        self.beat_timeout_s = float(beat_timeout_s)
+        self.max_attempts = int(max_attempts)
+        self.poll_s = float(poll_s)
+        self._replicas: dict[str, ReplicaHandle] = {}
+        self._pending: deque[RouterRequest] = deque()
+        self._lock = threading.RLock()
+        self._next_id = 0
+        self._requests_by_id: dict[int, RouterRequest] = {}  # RPC poll
+        self.requeues_total = 0              # host ledger (tests read)
+        self._monitor: Optional[threading.Thread] = None
+        self._stop_ev: Optional[threading.Event] = None
+        self.slo = None          # HEALTHZ duck-type parity with engines
+
+    # -- replica lifecycle --------------------------------------------------
+    def register(self, name: str, engine: ServingEngine, *,
+                 start: bool = True) -> ReplicaHandle:
+        """Add a replica (its engine loop is started unless it already
+        runs or ``start=False``) and ensure the monitor is running."""
+        with self._lock:
+            if name in self._replicas \
+                    and self._replicas[name].state != "dead":
+                raise ValueError(f"replica {name!r} already registered")
+            if start:
+                # start BEFORE the handle is visible: the monitor marks
+                # replicas whose loop thread died as dead, and a handle
+                # published with the thread not yet up would race it
+                engine.start()
+            h = ReplicaHandle(name, engine)
+            self._replicas[name] = h
+        flight_record("router_replica", replica=name, state="live",
+                      event="register")
+        self.start()
+        return h
+
+    def heartbeat(self, name: str) -> None:
+        with self._lock:
+            self._replicas[name].last_beat = time.monotonic()
+
+    def drain(self, name: str, *, timeout_s: float = 30.0) -> int:
+        """Stop dispatching to ``name``, re-dispatch its queued (not
+        yet admitted) requests onto peers, and wait for its admitted
+        work to run out. Returns how many requests were re-dispatched.
+        The engine's loop keeps running throughout — drain is a routing
+        state, not a process state."""
+        with self._lock:
+            h = self._replicas[name]
+            if h.state == "dead":
+                raise ValueError(f"replica {name!r} is dead")
+            h.state = "draining"
+            # pull only the queued requests the ROUTER owns: one
+            # submitted directly to the engine stays queued and drains
+            # through normal admission (orphaning it would leave its
+            # done event unset forever)
+            moved = h.engine.cancel_queued(set(h.inflight.keys()))
+            n = 0
+            for inner in moved:
+                rreq = h.inflight.pop(inner.id, None)
+                if rreq is not None:
+                    self._requeue_locked(rreq, from_replica=name,
+                                         reason="drain")
+                    n += 1
+        flight_record("router_replica", replica=name, state="draining",
+                      event="drain", requeued=n)
+        deadline = time.monotonic() + timeout_s
+        while h.engine.has_work():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replica {name!r} still busy after {timeout_s}s "
+                    f"drain (load={h.load})")
+            time.sleep(self.poll_s)
+        return n
+
+    def resume(self, name: str) -> None:
+        """Return a drained replica to the dispatch pool."""
+        with self._lock:
+            h = self._replicas[name]
+            if h.state == "dead":
+                raise ValueError(f"replica {name!r} is dead")
+            h.state = "live"
+        flight_record("router_replica", replica=name, state="live",
+                      event="resume")
+
+    def kill_replica(self, name: str) -> int:
+        """Chaos hook: treat ``name`` as crashed RIGHT NOW — halt its
+        loop without waiting, mark it dead, and requeue every
+        undelivered in-flight request onto peers. Returns the number
+        requeued."""
+        with self._lock:
+            h = self._replicas[name]
+            if h.engine._stop is not None:
+                h.engine._stop.set()     # crash semantics: no join
+            return self._mark_dead_locked(h, reason="killed")
+
+    def _mark_dead_locked(self, h: ReplicaHandle, *, reason: str) -> int:
+        if h.state == "dead":
+            return 0
+        h.state = "dead"
+        n = 0
+        for inner_id, rreq in list(h.inflight.items()):
+            h.inflight.pop(inner_id)
+            if rreq.inner is not None and rreq.inner.done.is_set():
+                self._finalize_locked(h, rreq)   # it DID finish — keep
+            else:
+                self._requeue_locked(rreq, from_replica=h.name,
+                                     reason=reason)
+                n += 1
+        flight_record("router_replica", replica=h.name, state="dead",
+                      event=reason, requeued=n)
+        return n
+
+    # -- dispatch -----------------------------------------------------------
+    def _affinity_pick(self, prompt: Sequence[int],
+                       live: list[ReplicaHandle]) -> ReplicaHandle:
+        """Rendezvous (highest-random-weight) hash of the prompt's
+        first ``affinity_tokens`` ids over the LIVE replica names:
+        requests sharing a prefix agree on a replica, and replica
+        arrival/death reshuffles only the keys that hashed to the
+        changed member — the prefix cache keeps hitting through fleet
+        churn."""
+        key = ",".join(str(int(t))
+                       for t in prompt[:self.affinity_tokens])
+        return max(live, key=lambda h: hashlib.blake2b(
+            f"{h.name}|{key}".encode(), digest_size=8).digest())
+
+    def _pick_locked(self, prompt) -> Optional[tuple[ReplicaHandle, str]]:
+        live = [h for h in self._replicas.values() if h.state == "live"]
+        if not live:
+            return None
+        loads = {h.name: h.load for h in live}
+        least = min(live, key=lambda h: (
+            loads[h.name],
+            h.ttft_ewma_s if h.ttft_ewma_s is not None else 0.0,
+            h.name))
+        sticky = self._affinity_pick(prompt, live)
+        if loads[sticky.name] <= loads[least.name] + self.affinity_slack:
+            return sticky, "affinity"
+        return least, "least_loaded"
+
+    def _dispatch_locked(self, rreq: RouterRequest) -> bool:
+        """Place ``rreq`` on a live replica; False parks it pending."""
+        if rreq.attempts >= self.max_attempts:
+            rreq.status = "failed"
+            rreq.error = (f"gave up after {rreq.attempts} dispatch "
+                          f"attempts (replicas kept dying)")
+            rreq.finish_s = time.monotonic()
+            rreq.done.set()
+            return True                      # terminal — not pending
+        picked = self._pick_locked(rreq.prompt)
+        if picked is None:
+            return False
+        h, reason = picked
+        inner = h.engine.submit(rreq.prompt, rreq.sampling)
+        rreq.attempts += 1
+        rreq.replica = h.name
+        rreq.inner = inner
+        if inner.status == "rejected":       # admission gate: terminal
+            rreq.status = "rejected"
+            rreq.error = inner.error
+            rreq.finish_s = time.monotonic()
+            rreq.done.set()
+            return True
+        rreq.status = "dispatched"
+        h.inflight[inner.id] = rreq
+        h.dispatched += 1
+        reg = telemetry.get_registry()
+        reg.counter("router_requests_total",
+                    "requests dispatched by the fleet router, by "
+                    "replica").inc(replica=h.name)
+        reg.counter("router_dispatch_reason_total",
+                    "why the router picked the replica it picked").inc(
+            reason=reason)
+        flight_record("router_dispatch", req=rreq.id,
+                      trace=rreq.trace_id, replica=h.name,
+                      reason=reason, attempt=rreq.attempts,
+                      load=h.load)
+        return True
+
+    def _requeue_locked(self, rreq: RouterRequest, *,
+                        from_replica: str, reason: str) -> None:
+        rreq.inner = None                    # old replica's work is void
+        rreq.status = "queued"
+        self.requeues_total += 1
+        telemetry.get_registry().counter(
+            "router_requeues_total",
+            "in-flight requests re-dispatched after a replica "
+            "drain/death").inc()
+        flight_record("router_requeue", req=rreq.id,
+                      trace=rreq.trace_id, from_replica=from_replica,
+                      reason=reason)
+        if not self._dispatch_locked(rreq):
+            self._pending.append(rreq)
+
+    # -- request surface (same shape as ServingEngine's) --------------------
+    def submit(self, prompt: Sequence[int],
+               sampling: Optional[SamplingParams] = None) -> RouterRequest:
+        """Dispatch one request to the fleet; parks it pending when no
+        replica is live (the monitor places it as soon as one is)."""
+        sampling = sampling or SamplingParams()
+        with self._lock:
+            rreq = RouterRequest(
+                id=self._next_id, prompt=[int(t) for t in prompt],
+                sampling=sampling, submit_s=time.monotonic())
+            self._next_id += 1
+            if not self._dispatch_locked(rreq):
+                self._pending.append(rreq)
+        return rreq
+
+    def result(self, req: RouterRequest,
+               timeout: Optional[float] = None) -> Optional[dict]:
+        if not req.done.wait(timeout):
+            return None
+        return req.result()
+
+    def generate_many(
+            self, prompts: Sequence[Sequence[int]],
+            sampling: Union[SamplingParams, Sequence[SamplingParams],
+                            None] = None) -> list[list[int]]:
+        """Fleet analogue of ``ServingEngine.generate_many``: submit
+        every prompt, wait, return per-request tokens in submission
+        order — which replica served each request never changes its
+        tokens (greedy; asserted in tests). Raises on any admission
+        rejection, like the engine."""
+        if sampling is None or isinstance(sampling, SamplingParams):
+            sampling = [sampling or SamplingParams()] * len(prompts)
+        reqs = [self.submit(p, sp) for p, sp in zip(prompts, sampling)]
+        bad = [r for r in reqs if r.status == "rejected"]
+        if bad:
+            raise ValueError(
+                f"{len(bad)} request(s) rejected at admission: "
+                + "; ".join(f"#{r.id}: {r.error}" for r in bad[:3]))
+        for r in reqs:
+            r.done.wait()
+            if r.status != "done":
+                raise RuntimeError(
+                    f"request #{r.id} {r.status}: {r.error}")
+        return [list(r.tokens) for r in reqs]
+
+    # -- the monitor --------------------------------------------------------
+    def _finalize_locked(self, h: ReplicaHandle,
+                         rreq: RouterRequest) -> None:
+        inner = rreq.inner
+        rreq.tokens = list(inner.tokens)
+        rreq.status = inner.status
+        rreq.error = inner.error
+        rreq.weight_version = inner.weight_version
+        rreq.finish_s = time.monotonic()
+        if inner.first_token_s is not None:
+            ttft = inner.first_token_s - inner.submit_s
+            h.ttft_ewma_s = ttft if h.ttft_ewma_s is None \
+                else 0.8 * h.ttft_ewma_s + 0.2 * ttft
+        rreq.done.set()
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        reg = telemetry.get_registry()
+        with self._lock:
+            for h in list(self._replicas.values()):
+                if h.state == "dead":
+                    continue
+                # heartbeat staleness is the liveness signal only for
+                # EXTERNALLY-driven replicas: when this process runs
+                # the loop thread, a verifiably-alive thread outranks a
+                # stale beat (an ops probe that beats once must not
+                # doom a healthy replica 2s later)
+                beat_stale = h.last_beat is not None \
+                    and now - h.last_beat > self.beat_timeout_s \
+                    and not h.loop_alive()
+                if h.loop_died() or beat_stale:
+                    self._mark_dead_locked(
+                        h, reason="beat_timeout" if beat_stale
+                        else "loop_dead")
+                    continue
+                for inner_id, rreq in list(h.inflight.items()):
+                    if rreq.inner is not None \
+                            and rreq.inner.done.is_set():
+                        h.inflight.pop(inner_id)
+                        self._finalize_locked(h, rreq)
+            # place parked requests as capacity (re)appears
+            still: deque[RouterRequest] = deque()
+            while self._pending:
+                rreq = self._pending.popleft()
+                if not self._dispatch_locked(rreq):
+                    still.append(rreq)
+                    break                    # no live replica: stop
+            still.extend(self._pending)
+            self._pending = still
+            live = sum(1 for h in self._replicas.values()
+                       if h.state == "live")
+            reg.gauge("router_replicas_live",
+                      "replicas currently accepting dispatch").set(live)
+            for h in self._replicas.values():
+                reg.gauge("router_replica_load",
+                          "per-replica queued+prefilling+decoding "
+                          "requests, as dispatch sees it").set(
+                    0 if h.state == "dead" else h.load,
+                    replica=h.name)
+
+    def start(self) -> None:
+        with self._lock:
+            if self._monitor is not None and self._monitor.is_alive():
+                return
+            self._stop_ev = threading.Event()
+
+            def loop():
+                while not self._stop_ev.is_set():
+                    self._tick()
+                    self._stop_ev.wait(self.poll_s)
+
+            self._monitor = threading.Thread(target=loop, daemon=True,
+                                             name="router-monitor")
+            self._monitor.start()
+
+    def stop(self) -> None:
+        """Stop the monitor and every live replica's engine loop."""
+        if self._stop_ev is not None:
+            self._stop_ev.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10.0)
+            self._monitor = None
+        with self._lock:
+            handles = list(self._replicas.values())
+        for h in handles:
+            if h.state != "dead":
+                h.engine.stop()
+
+    # -- fleet observability ------------------------------------------------
+    def fleet_status(self) -> dict:
+        """One aggregation of the whole fleet — what the ``FLEET`` verb
+        returns and what ``HEALTHZ`` embeds when a Router (not a bare
+        engine) is attached to the coordinator."""
+        with self._lock:
+            reps = {name: h.status()
+                    for name, h in self._replicas.items()}
+            return {
+                "replicas": reps,
+                "live": sum(1 for r in reps.values()
+                            if r["state"] == "live"),
+                "pending": len(self._pending),
+                "requests_total": self._next_id,
+                "requeues_total": self.requeues_total,
+                "weight_versions": sorted(
+                    {r["weight_version"] for r in reps.values()
+                     if r["state"] != "dead"}),
+            }
+
+
+def materialize_params(params, engine: ServingEngine):
+    """Copy ``params`` onto ``engine``'s topology for a swap.
+
+    Planned (sharded) replica: the HotSPa reshard core moves every leaf
+    onto the replica plan's param shardings — ``force_copy`` because
+    the publisher's source is typically a live TrainState whose buffers
+    the next train step will DONATE; an aliased fast-path leaf would be
+    deleted out from under the replica. Unplanned replica: a plain
+    forced device copy, same reasoning."""
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_tpu.parallel.switch import reshard_tree
+
+    plan = engine._plan
+    if plan is not None:
+        return reshard_tree(params, plan.state_shardings.params,
+                            force_copy=True)
+    return jax.tree.map(
+        lambda x: jnp.array(x, copy=True)
+        if isinstance(x, jax.Array) else x, params)
+
+
+class WeightPublisher:
+    """Trainer-side live weight push: rolling drain → swap → resume.
+
+    One :meth:`publish` call walks the fleet one replica at a time;
+    while a replica drains, the router's dispatch (plus the requeue of
+    its not-yet-admitted requests) moves its traffic to peers, so with
+    ≥ 2 replicas fleet capacity never reaches zero and serving sees no
+    downtime — the acceptance bar. Requests admitted before the swap
+    finish under the old weights (their tokens are tagged with that
+    generation); everything admitted after decodes under the new one.
+    A replica that cannot drain within ``drain_timeout_s`` is declared
+    dead (its work requeues) rather than blocking the push.
+    """
+
+    def __init__(self, router: Router, *,
+                 drain_timeout_s: float = 60.0):
+        self.router = router
+        self.drain_timeout_s = float(drain_timeout_s)
+
+    def publish(self, state_or_params, *,
+                version: Optional[int] = None) -> dict:
+        """Push ``state_or_params`` (a TrainState or a bare param
+        pytree) to every non-dead replica. Returns the push report
+        (per-replica durations + flush counts)."""
+        params = getattr(state_or_params, "params", state_or_params)
+        t0 = time.perf_counter()
+        with self.router._lock:
+            names = sorted(n for n, h in self.router._replicas.items()
+                           if h.state != "dead")
+            if version is None:
+                version = 1 + max(
+                    (self.router._replicas[n].weight_version
+                     for n in names), default=0)
+        per = []
+        for name in names:
+            h = self.router._replicas.get(name)
+            if h is None or h.state == "dead":
+                continue
+            t1 = time.perf_counter()
+            try:
+                requeued = self.router.drain(
+                    name, timeout_s=self.drain_timeout_s)
+            except TimeoutError:
+                with self.router._lock:
+                    self.router._mark_dead_locked(
+                        h, reason="drain_timeout")
+                per.append({"replica": name, "skipped": "drain_timeout"})
+                continue
+            local = materialize_params(params, h.engine)
+            info = h.engine.swap_params(local, version=version)
+            self.router.resume(name)
+            per.append({"replica": name, "requeued": requeued,
+                        "flushed_blocks": info["flushed_blocks"],
+                        "ms": round((time.perf_counter() - t1) * 1e3,
+                                    3)})
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        reg = telemetry.get_registry()
+        reg.histogram("weight_push_duration_ms",
+                      "one rolling fleet weight push, end to end "
+                      "(drain + reshard + swap, all replicas)").observe(
+            dur_ms)
+        reg.counter("weight_pushes_total",
+                    "rolling fleet weight pushes completed").inc()
+        flight_record("weight_push", version=version,
+                      replicas=len(per), ms=round(dur_ms, 3))
+        return {"version": version, "replicas": per,
+                "duration_ms": round(dur_ms, 3)}

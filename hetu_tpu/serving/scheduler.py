@@ -62,6 +62,11 @@ class Request:
     error: Optional[str] = None
     cached_tokens: int = 0             # prompt tokens served by the
     #                                    prefix cache (skipped prefill)
+    weight_version: int = 0            # weight generation the request
+    #                                    was admitted (and decoded) under
+    #                                    — swaps only land on drained
+    #                                    engines, so one request is one
+    #                                    version, end to end
     admit: Optional[dict] = dataclasses.field(
         default=None, repr=False, compare=False)  # paged admission plan
     trace_id: str = dataclasses.field(
@@ -107,6 +112,7 @@ class Request:
     def result(self) -> dict:
         return {"id": self.id, "status": self.status,
                 "tokens": list(self.tokens), "error": self.error,
+                "weight_version": self.weight_version,
                 "timing": self.timing()}
 
 

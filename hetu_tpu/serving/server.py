@@ -89,6 +89,14 @@ def _prune_request_map(m: dict) -> None:
         m.pop(rid, None)
 
 
+#: serving verbs the coordinator forwards here. SUBMIT/RESULT/GENERATE
+#: accept EITHER a ServingEngine or a fleet Router (same duck-typed
+#: surface: submit()/result()/_requests_by_id); FLEET/DRAIN/RESUME are
+#: router-only (fleet lifecycle over the wire).
+SERVING_COMMANDS = ("SUBMIT", "RESULT", "GENERATE",
+                    "FLEET", "DRAIN", "RESUME")
+
+
 def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
                            args: list) -> Optional[str]:
     """Dispatch one serving line-protocol command; None = not ours.
@@ -97,10 +105,23 @@ def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
     importable without jax — it only calls in when an engine was
     attached and a serving verb arrives.
     """
-    if cmd not in ("SUBMIT", "RESULT", "GENERATE"):
+    if cmd not in SERVING_COMMANDS:
         return None
     if engine is None:
         return "ERR serving disabled"
+    if cmd in ("FLEET", "DRAIN", "RESUME"):
+        if not hasattr(engine, "fleet_status"):
+            return "ERR not a fleet (attach a serving.router.Router)"
+        try:
+            if cmd == "FLEET":
+                return f"VAL {encode_payload(engine.fleet_status())}"
+            if cmd == "DRAIN":
+                n = engine.drain(args[0])
+                return f"VAL {encode_payload({'requeued': n})}"
+            engine.resume(args[0])
+            return "OK"
+        except Exception as e:                    # noqa: BLE001
+            return f"ERR {type(e).__name__}: {e}"
     try:
         if cmd == "SUBMIT":
             req = submit_payload(engine, args[0])

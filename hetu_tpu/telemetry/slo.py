@@ -398,15 +398,25 @@ def health_status(registry: Optional[MetricRegistry] = None, *,
         out["slo"]["rules"] = slo.status()["rules"]
     if serving is not None:
         try:
-            out["serving"] = {
-                "queue_depth": serving.scheduler.depth,
-                "slot_occupancy": round(serving.scheduler.occupancy, 4),
-                "iterations": serving._iter,
-                # is_alive(): a loop thread that died from an unhandled
-                # exception must read as down, not merely "was started"
-                "loop_running": serving._thread is not None
-                and serving._thread.is_alive(),
-            }
+            if hasattr(serving, "fleet_status"):
+                # a fleet Router: per-replica states + fleet counters;
+                # a fleet with ZERO live replicas is degraded outright
+                fleet = serving.fleet_status()
+                out["serving"] = fleet
+                if fleet.get("live", 0) == 0 and fleet["replicas"]:
+                    out["status"] = "degraded"
+            else:
+                out["serving"] = {
+                    "queue_depth": serving.scheduler.depth,
+                    "slot_occupancy": round(
+                        serving.scheduler.occupancy, 4),
+                    "iterations": serving._iter,
+                    # is_alive(): a loop thread that died from an
+                    # unhandled exception must read as down, not merely
+                    # "was started"
+                    "loop_running": serving._thread is not None
+                    and serving._thread.is_alive(),
+                }
         except Exception:
             out["serving"] = {"error": "unavailable"}
     return out

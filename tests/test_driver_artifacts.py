@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -43,6 +45,35 @@ def test_bench_serving_emits_json_contract(tmp_path):
                     "ttft_p99_ms", "occupancy_mean"):
             assert key in row, (key, row)
     with open(os.path.join(_ROOT, "BENCH_serving.json")) as f:
+        assert json.load(f) == rec
+
+
+@pytest.mark.slow
+def test_bench_router_emits_json_contract():
+    """``bench.py --router`` must emit the fleet sweep headline and
+    write BENCH_router.json with the zero-downtime weight-push
+    evidence (the fleet-plane round artifact)."""
+    env = dict(os.environ)
+    env["HETU_TPU_BENCH_PLATFORM"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--router"],
+        capture_output=True, text=True, timeout=500, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "replicas", "sweep",
+                "weight_push"):
+        assert key in rec, (key, rec)
+    assert rec["value"] > 0 and rec["replicas"] >= 2
+    for row in rec["sweep"]:
+        for key in ("offered", "tokens_per_sec", "ttft_p50_ms",
+                    "dispatch", "dispatch_balance"):
+            assert key in row, (key, row)
+    push = rec["weight_push"]
+    assert push["trickle_rejected"] == 0
+    assert push["trickle_completed"] == push["trickle_submitted"]
+    assert push["capacity_floor"] >= 1      # peers absorbed the drain
+    assert push["downtime_steps"] == 0
+    with open(os.path.join(_ROOT, "BENCH_router.json")) as f:
         assert json.load(f) == rec
 
 

@@ -502,6 +502,146 @@ def router_main():
     print(json.dumps(result))
 
 
+_BENCH_MOE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_moe.json")
+
+
+def moe_main():
+    """``bench.py --moe``: expert-plane smoke sweep. Measures (1)
+    serialized vs chunked-overlap (``Strategy(ep_overlap="chunk")``) MoE
+    train-step time under dp×ep, (2) eager vs delayed grad sync with
+    ``ep > 1`` (the lifted strategy restriction) incl. the
+    syncs-per-update audit, (3) per-expert balance / capacity-drop
+    stats from the expert-plane telemetry. CPU-mesh ratios are
+    meaningful (the a2as are real collectives on the 8-virtual-device
+    mesh); absolute times only matter on TPU."""
+    on_tpu = probe_tpu()
+    if not on_tpu:
+        # ep > 1 needs a mesh: force virtual CPU devices BEFORE the
+        # backend initializes (first jax.devices() call below)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+        jax.config.update("jax_platforms", "cpu")
+    telemetry.enable(True)
+    dev = jax.devices()[0]
+    n_dev = len(jax.devices())
+
+    from hetu_tpu.engine import build_train_step
+    from hetu_tpu.parallel import overlap as _ov
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=8192, max_positions=1024,
+                        hidden_size=512, num_layers=8, num_heads=8,
+                        num_experts=8)
+        batch, seq, steps = 16, 512, 10
+    else:   # CPU smoke: tiny MoE, real a2as on the virtual mesh
+        # batch must split into dp×ep groups per microbatch (nm=2)
+        cfg = GPTConfig.tiny_moe(num_experts=4)
+        batch, seq, steps = 16, 16, 5
+    ep = 1
+    for cand in range(min(cfg.num_experts, n_dev), 0, -1):
+        if cfg.num_experts % cand == 0 and n_dev % cand == 0:
+            ep = cand
+            break
+    dp = max(1, n_dev // ep)
+    model = GPTLMHeadModel(cfg)
+    opt = optim.adamw(3e-4)
+    ids = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
+                             cfg.vocab_size)
+    raw = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    def run(strategy, steps=steps):
+        _ov.reset_comm_stats()
+        plan = make_plan(model, opt, strategy)
+        state = init_state(model, opt, plan, jax.random.key(0),
+                           dtype=jnp.float32)
+        step = build_train_step(model, opt, plan)
+        batch_dev = plan.shard_batch(raw)
+        state, m = step(state, batch_dev)          # compile + warm
+        jax.block_until_ready(m["loss"])
+        trace_stats = _ov.comm_stats()   # a2a bytes record at trace time
+        _ov.reset_comm_stats()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, batch_dev)
+        jax.block_until_ready(m["loss"])
+        dt_ms = (time.perf_counter() - t0) / steps * 1e3
+        run_stats = _ov.comm_stats()
+        return dt_ms, float(m["loss"]), {
+            "bytes_by_kind": trace_stats["bytes_by_kind"],
+            "bytes_overlapped_by_kind":
+                trace_stats["bytes_overlapped_by_kind"],
+            "dp_sync_per_step": run_stats["dp_sync_per_step"],
+        }
+
+    # (1) serialized vs chunked a2a/FFN overlap
+    base = Strategy(dp=dp, ep=ep).validate(n_dev)
+    ser_ms, ser_loss, ser_stats = run(base)
+    chunk_ms, chunk_loss, chunk_stats = run(
+        Strategy(dp=dp, ep=ep, ep_overlap="chunk").validate(n_dev))
+    a2a = chunk_stats["bytes_by_kind"].get("ep_a2a", 0)
+    a2a_olap = chunk_stats["bytes_overlapped_by_kind"].get("ep_a2a", 0)
+    overlap = {
+        "serialized_ms": round(ser_ms, 3),
+        "chunked_ms": round(chunk_ms, 3),
+        "speedup": round(ser_ms / max(chunk_ms, 1e-9), 3),
+        "loss_bitwise_equal": ser_loss == chunk_loss,
+        "ep_a2a_bytes_per_trace": a2a,
+        "ep_a2a_overlapped_frac": round(a2a_olap / max(a2a, 1), 3),
+    }
+
+    # (2) eager vs delayed grad sync under dp×ep (nm microbatches)
+    nm = 2
+    eager_ms, eager_loss, eager_stats = run(
+        Strategy(dp=dp, ep=ep, num_microbatches=nm).validate(n_dev))
+    del_ms, del_loss, del_stats = run(
+        Strategy(dp=dp, ep=ep, num_microbatches=nm,
+                 delay_grad_sync=True).validate(n_dev))
+    delayed_sync = {
+        "eager_ms": round(eager_ms, 3),
+        "delayed_ms": round(del_ms, 3),
+        "speedup": round(eager_ms / max(del_ms, 1e-9), 3),
+        "eager_syncs_per_update": round(
+            eager_stats["dp_sync_per_step"], 2),
+        "delayed_syncs_per_update": round(
+            del_stats["dp_sync_per_step"], 2),
+        "loss_delta": round(abs(eager_loss - del_loss), 6),
+    }
+
+    # (3) per-expert balance from the expert-plane telemetry (gauges
+    # are last-write-wins: the last executed MoE layer call)
+    reg = telemetry.get_registry()
+    gauge = reg.gauge("moe_expert_tokens")
+    load = [gauge.value(expert=str(e)) for e in range(cfg.num_experts)]
+    mean_load = sum(load) / max(len(load), 1)
+    balance = {
+        "expert_load": load,
+        "load_imbalance": round(max(load) / mean_load, 3)
+        if mean_load else 0.0,
+        "dropped_tokens_total": reg.counter(
+            "moe_dropped_tokens_total").value(),
+        "capacity_factor": cfg.moe_capacity_factor,
+    }
+
+    tokens_step = batch * seq
+    result = {
+        "metric": "moe_tokens_per_sec"
+        if on_tpu else "moe_tokens_per_sec_cpu_smoke",
+        "value": round(tokens_step / (min(ser_ms, chunk_ms) / 1e3), 1),
+        "unit": "tokens/sec", "vs_baseline": 0.0,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "dp": dp, "ep": ep, "experts": cfg.num_experts,
+        "batch": batch, "seq": seq, "steps": steps,
+        "overlap": overlap,
+        "delayed_sync": delayed_sync,
+        "expert_balance": balance,
+    }
+    with open(_BENCH_MOE_PATH, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
 def main():
     telemetry.enable(True)
     if not probe_tpu():
@@ -785,5 +925,7 @@ if __name__ == "__main__":
         serving_main()
     elif "--router" in sys.argv:
         router_main()
+    elif "--moe" in sys.argv:
+        moe_main()
     else:
         main()

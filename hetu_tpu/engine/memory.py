@@ -109,7 +109,16 @@ def estimate_breakdown(dims, strategy: Strategy, *,
     bytes_per_el, ...).
     """
     s = strategy
-    p_shard = dims.total_params() / (s.tp * s.pp * max(s.ep, 1))
+    # expert params (rule "expert" → "ep") shard over ep on top of
+    # tp·pp; dense params do NOT — the historical formula divided the
+    # whole model by ep, under-pricing dense weights on MoE strategies
+    # exactly when the planner compares ep against tp/fsdp
+    expert_fn = getattr(dims, "layer_expert_params", None)
+    expert_total = dims.num_layers * expert_fn() if callable(expert_fn) \
+        else 0.0
+    dense_total = dims.total_params() - expert_total
+    p_shard = dense_total / (s.tp * s.pp) \
+        + expert_total / (s.tp * s.pp * max(s.ep, 1))
     dp_shard = s.dp if (s.fsdp or s.zero) else 1
     opt_div = s.dp if s.zero else 1
     # weights bf16 + fp32 grads; fsdp shards the grad copy over dp
@@ -124,6 +133,18 @@ def estimate_breakdown(dims, strategy: Strategy, *,
     layers_per_stage = dims.num_layers / s.pp
     act_mb = b_loc / nm * seq_loc * dims.hidden * act_factor(s.remat) \
         * layers_per_stage * dims.bytes_per_el / s.tp
+    if getattr(dims, "num_experts", 0) > 0:
+        # MoE dispatch liveness: the fp32 capacity buffers (pre- and
+        # post-a2a views, capacity_factor·T_loc·k·d each) are saved
+        # residuals of the dispatch einsums — not tp-sharded, scaled by
+        # the residual-stream remat ratio like everything else the
+        # policy can free
+        cf = getattr(dims, "moe_capacity_factor", 1.25)
+        k = max(getattr(dims, "moe_top_k", 2), 1)
+        moe_buf = 2.0 * cf * (b_loc / nm) * seq_loc * k \
+            * dims.hidden * 4.0
+        act_mb += moe_buf * layers_per_stage \
+            * act_factor(s.remat) / act_factor("none")
     # the scan-flush pipeline keeps every microbatch's residuals live
     # until its backward REGARDLESS of remat (validated against XLA
     # memory_analysis — see cost_model history); plain accumulation

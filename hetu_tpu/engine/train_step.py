@@ -159,7 +159,9 @@ def _make_plan(model: Module, opt: Transform, strategy: Strategy,
         cp_impl=strategy.cp_impl, sp=strategy.sp,
         tp_overlap=strategy.tp_overlap,
         fsdp_overlap=strategy.fsdp_overlap if strategy.fsdp else "off",
-        fsdp_specs=fsdp_gather_specs)
+        fsdp_specs=fsdp_gather_specs,
+        ep_overlap=strategy.ep_overlap if strategy.ep > 1 else "off",
+        ep_chunks=strategy.ep_chunks)
     return TrainPlan(strategy, mesh, param_specs, state_specs,
                      named_shardings(mesh, state_specs), act)
 
@@ -500,30 +502,113 @@ def default_loss_fn(model: Module, strategy: Strategy,
     return loss_fn
 
 
-def build_local_grad_fn(base_loss, mesh: Mesh, ndp: int) -> Callable:
-    """Per-dp-group ``(loss, grads)`` with a leading dp dim and ZERO
-    cross-dp traffic: a partial-manual ``shard_map`` over dp — each
-    group differentiates its local batch shard against the full
-    (dp-replicated) params; tp/cp collectives stay GSPMD-auto exactly
-    as in the pipeline executor's manual region. Shared by the
-    split-phase path (``build_grad_accum_steps(delay_grad_sync=True)``)
-    and the in-scan path (``Strategy(delay_grad_sync=True)`` with
+def _spec_has_axis(spec: P, axis: str) -> bool:
+    return any(p == axis or (isinstance(p, (tuple, list)) and axis in p)
+               for p in spec)
+
+
+def _manual_projection(spec: P, manual: tuple) -> P:
+    """Project a param PartitionSpec onto ``manual`` axes: entries keep
+    only the components bound by the partial-manual region (the rest —
+    tp, cp — ride GSPMD-auto, which in_specs must not name)."""
+    parts = []
+    for p in spec:
+        if isinstance(p, (tuple, list)):
+            kept = tuple(a for a in p if a in manual)
+            parts.append(kept[0] if len(kept) == 1 else (kept or None))
+        else:
+            parts.append(p if p in manual else None)
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def _delayed_acc_layout(plan: "TrainPlan", ndp: int, nep: int):
+    """Lane layout of the delayed-sync grad accumulator, shared by the
+    in-scan (``build_train_step``) and split-phase
+    (``build_grad_accum_steps``) paths: dense leaves carry a
+    ``("dp","ep")``-sharded lane dim of ``ndp·nep`` local grads, expert
+    leaves (an "ep" component in their spec) a dp-sharded one of
+    ``ndp`` — their ep sum already happened through the backward
+    all_to_all. Returns ``(acc_specs, acc_shardings, acc_leads)``."""
+    def spec(s):
+        if nep > 1 and not _spec_has_axis(s, "ep"):
+            return P(("dp", "ep"), *tuple(s))
+        return P("dp", *tuple(s))
+
+    leaf = lambda x: isinstance(x, P)
+    acc_specs = jax.tree.map(spec, plan.state_specs.params, is_leaf=leaf)
+    acc_leads = jax.tree.map(
+        lambda s: ndp if (nep > 1 and _spec_has_axis(s, "ep"))
+        else ndp * nep,
+        plan.state_specs.params, is_leaf=leaf)
+    return acc_specs, named_shardings(plan.mesh, acc_specs), acc_leads
+
+
+def build_local_grad_fn(base_loss, mesh: Mesh, ndp: int, *,
+                        nep: int = 1, param_specs=None,
+                        ep_overlap: str = "off",
+                        ep_chunks: int = 2) -> Callable:
+    """Per-group ``(loss, grads)`` with a leading group dim and ZERO
+    cross-group gradient traffic: a partial-manual ``shard_map`` over
+    the group axes — each group differentiates its local batch shard;
+    tp/cp collectives stay GSPMD-auto exactly as in the pipeline
+    executor's manual region. Shared by the split-phase path
+    (``build_grad_accum_steps(delay_grad_sync=True)``) and the in-scan
+    path (``Strategy(delay_grad_sync=True)`` with
     ``num_microbatches > 1``). Returns ``local_grads(params, batch,
     key)``; the key-vs-keyless shard_map variant is picked at trace
-    time from ``key is None``."""
+    time from ``key is None``.
+
+    With ``nep > 1`` the group is **dp×ep** (the batch dim is sharded
+    over both): "ep" joins the manual set so the MoE layers run the real
+    all_to_all dispatch on the bound axis (``nn.moe`` consults
+    ``current_manual_axes``), and the param handling splits by spec —
+
+    - **dense leaves** enter replicated over the whole group (``P()``
+      projection) and come back with a ``("dp","ep")``-sharded leading
+      lane dim: every group holds its own local grad;
+    - **expert leaves** (an "ep" component in ``param_specs``) enter
+      ep-SHARDED on their expert dim — each rank differentiates only
+      its local experts, and the backward ``all_to_all`` already sums
+      their grads over ep — so their leading lane dim is sharded over
+      dp only.
+
+    Either way ONE post-scan sum over the leading dim divided by
+    ``ndp·nep`` (per microbatch) reproduces the eager gradient."""
     from hetu_tpu.parallel.sharding import ManualAxes, no_act_sharding
+    group = ("dp", "ep") if nep > 1 else ("dp",)
+    manual = frozenset(group)
+    ngroups = ndp * nep
+    if nep > 1 and param_specs is None:
+        raise ValueError(
+            "param_specs is required for ep-aware delayed grad sync "
+            "(the dense/expert spec split drives the lane layout)")
+
+    def param_in_spec(spec: P) -> P:
+        # expert leaves keep their ep shard inside the region; dense
+        # leaves replicate over the group
+        if nep > 1 and _spec_has_axis(spec, "ep"):
+            return _manual_projection(spec, ("ep",))
+        return P()
+
+    def grad_out_spec(spec: P) -> P:
+        if nep > 1 and _spec_has_axis(spec, "ep"):
+            return P("dp", *tuple(_manual_projection(spec, ("ep",))))
+        return P(group if nep > 1 else "dp")
 
     def local_grads(params, batch, key):
         def body(params, batch_l, gid, *key_arg):
             def lloss(p):
                 k = None
                 if key_arg:
-                    # decorrelate dp groups via the explicit group-id
+                    # decorrelate groups via the explicit group-id
                     # operand (axis_index would lower to PartitionId,
                     # which SPMD partitioning of the auto axes rejects)
                     k = jax.random.fold_in(key_arg[0], gid[0])
                 with no_act_sharding(), \
-                        ManualAxes(mesh, frozenset({"dp"})):
+                        ManualAxes(mesh, manual, ep_overlap=ep_overlap,
+                                   ep_chunks=ep_chunks):
                     if k is not None:
                         return base_loss(p, batch_l, dropout_key=k)
                     return base_loss(p, batch_l)
@@ -531,24 +616,31 @@ def build_local_grad_fn(base_loss, mesh: Mesh, ndp: int) -> Callable:
             loss, g = jax.value_and_grad(lloss)(params)
             return loss.reshape(1), jax.tree.map(lambda v: v[None], g)
 
-        in_b = {k: P("dp") for k in batch}
-        in_p = jax.tree.map(lambda _: P(), params)
-        gids = jnp.arange(ndp, dtype=jnp.int32)
-        out_g = jax.tree.map(lambda _: P("dp"), params)
+        in_b = {k: P(group if nep > 1 else "dp") for k in batch}
+        if param_specs is not None:
+            in_p = jax.tree.map(param_in_spec, param_specs,
+                                is_leaf=lambda x: isinstance(x, P))
+            out_g = jax.tree.map(grad_out_spec, param_specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        else:
+            in_p = jax.tree.map(lambda _: P(), params)
+            out_g = jax.tree.map(lambda _: P("dp"), params)
+        gids = jnp.arange(ngroups, dtype=jnp.int32)
+        lane = P(group if nep > 1 else "dp")
         if key is None:
             f = shard_map(lambda p, b, g: body(p, b, g), mesh=mesh,
-                          in_specs=(in_p, in_b, P("dp")),
-                          out_specs=(P("dp"), out_g),
-                          axis_names={"dp"}, check_vma=False)
+                          in_specs=(in_p, in_b, lane),
+                          out_specs=(lane, out_g),
+                          axis_names=manual, check_vma=False)
             losses, grads = f(params, batch, gids)
         else:
             f = shard_map(body, mesh=mesh,
-                          in_specs=(in_p, in_b, P("dp"), P()),
-                          out_specs=(P("dp"), out_g),
-                          axis_names={"dp"}, check_vma=False)
+                          in_specs=(in_p, in_b, lane, P()),
+                          out_specs=(lane, out_g),
+                          axis_names=manual, check_vma=False)
             losses, grads = f(params, batch, gids, key)
-        # scalarizing the per-group loss vector moves 4·dp bytes — a
-        # metric read, not a gradient sync
+        # scalarizing the per-group loss vector moves 4·ngroups bytes —
+        # a metric read, not a gradient sync
         return jnp.mean(losses), grads
 
     return local_grads
@@ -651,24 +743,26 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
 
     grad_fn = jax.value_and_grad(compute_loss)
     ndp = plan.mesh.shape.get("dp", 1)
+    nep = plan.mesh.shape.get("ep", 1)
+    ngroups = ndp * nep
     if strategy.delay_grad_sync and strategy.fsdp:
         raise ValueError(
             "delay_grad_sync=True is incompatible with fsdp: params are "
             "dp-sharded, so group-local gradients would require the "
             "param all-gather the delay is meant to avoid")
-    if strategy.delay_grad_sync and strategy.ep > 1:
-        raise ValueError(
-            "delay_grad_sync=True is incompatible with ep > 1 (the "
-            "batch dim is sharded over dp×ep)")
-    delayed = strategy.delay_grad_sync and ndp > 1 and nm > 1
+    delayed = strategy.delay_grad_sync and ngroups > 1 and nm > 1
     if delayed:
         # group-local grads need the RAW loss fn (no GSPMD activation
-        # constraints inside the manual-dp region)
-        local_grad_fn = build_local_grad_fn(base_loss, plan.mesh, ndp)
-        acc_specs = jax.tree.map(
-            lambda s: P("dp", *tuple(s)), plan.state_specs.params,
-            is_leaf=lambda x: isinstance(x, P))
-        acc_shardings = named_shardings(plan.mesh, acc_specs)
+        # constraints inside the manual region). With ep > 1 the group
+        # is dp×ep: dense grads carry a ("dp","ep")-sharded lane dim,
+        # expert grads a dp-sharded one (their ep sum already happened
+        # through the backward all_to_all) — ONE post-scan reduction
+        # per update either way.
+        local_grad_fn = build_local_grad_fn(
+            base_loss, plan.mesh, ndp, nep=nep,
+            param_specs=plan.state_specs.params,
+            ep_overlap=strategy.ep_overlap, ep_chunks=strategy.ep_chunks)
+        _, acc_shardings, acc_leads = _delayed_acc_layout(plan, ndp, nep)
 
     from hetu_tpu.parallel import overlap as _overlap
     fsdp_gspmd_bytes = 0
@@ -709,19 +803,21 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
 
                 zeros = jax.lax.with_sharding_constraint(
                     jax.tree.map(
-                        lambda p: jnp.zeros((ndp,) + p.shape,
-                                            jnp.float32), state.params),
+                        lambda p, lead: jnp.zeros((lead,) + p.shape,
+                                                  jnp.float32),
+                        state.params, acc_leads),
                     acc_shardings)
                 (loss, acc_g), _ = jax.lax.scan(
                     body, (jnp.zeros([], jnp.float32), zeros),
                     (mbs, jnp.arange(nm)))
                 loss = loss / nm
-                # THE one DP gradient reduction of the whole update:
-                # summing the leading (dp-sharded) dim down to the
-                # synced grad — under ZeRO it becomes the
+                # THE one gradient reduction of the whole update:
+                # summing the leading (group-sharded) lane dim down to
+                # the synced grad — dense lanes sum over dp×ep, expert
+                # lanes over dp; under ZeRO it becomes the
                 # reduce-scatter → update → all-gather triplet, once
                 grads = jax.tree.map(
-                    lambda g: jnp.sum(g, axis=0) / (ndp * nm), acc_g)
+                    lambda g: jnp.sum(g, axis=0) / (ngroups * nm), acc_g)
             else:
                 def body(acc, xs):
                     mb, i = xs
@@ -761,7 +857,8 @@ def build_train_step(model: Module, opt: Transform, plan: TrainPlan, *,
     # reduction per microbatch when eager, exactly one per update when
     # delayed (or when nm == 1 — nothing to delay). First call also
     # seeds the memory-plane ledger from the model config + batch shape.
-    syncs_per_call = 0 if ndp <= 1 else (1 if (nm == 1 or delayed) else nm)
+    syncs_per_call = 0 if ngroups <= 1 \
+        else (1 if (nm == 1 or delayed) else nm)
     grad_bytes = 4 * int(sum(
         functools.reduce(lambda a, b: a * b, l.shape, 1)
         for l in jax.tree.leaves(model.abstract_params())))
@@ -850,9 +947,13 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
     The per-call ``dp_grad_syncs_total`` / ``optimizer_updates_total``
     counters (``parallel.overlap``) make the rate auditable:
     eager = ``accum_steps`` syncs/update, delayed = exactly 1.
-    Unsupported with ``fsdp`` (params are dp-sharded — group-local
-    grads of a sharded param would need the very gather being delayed)
-    and ``ep > 1`` (the batch dim carries ep); both raise.
+    With ``ep > 1`` the group is dp×ep: "ep" joins the manual region so
+    MoE layers run the real all_to_all dispatch, dense grads carry a
+    ``("dp","ep")``-sharded lane dim, and expert grads (ep-sharded
+    specs) a dp-sharded one — their ep sum already happened through the
+    backward all_to_all. Unsupported with ``fsdp`` (params are
+    dp-sharded — group-local grads of a sharded param would need the
+    very gather being delayed); raises.
     """
     strategy = plan.strategy
     if strategy.pp > 1:
@@ -864,10 +965,6 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
             "delay_grad_sync=True is incompatible with fsdp: params are "
             "dp-sharded, so group-local gradients would require the "
             "param all-gather the delay is meant to avoid")
-    if delay_grad_sync and strategy.ep > 1:
-        raise ValueError(
-            "delay_grad_sync=True is incompatible with ep > 1 (the "
-            "batch dim is sharded over dp×ep)")
     base_loss = loss_fn or default_loss_fn(model, strategy, attn_impl)
 
     def compute_loss(params, batch, key):
@@ -879,7 +976,9 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
     grad_fn = jax.value_and_grad(compute_loss)
     param_shardings = plan.state_shardings.params
     ndp = plan.mesh.shape.get("dp", 1)
-    delayed = delay_grad_sync and ndp > 1   # dp=1 has nothing to delay
+    nep = plan.mesh.shape.get("ep", 1)
+    ngroups = ndp * nep
+    delayed = delay_grad_sync and ngroups > 1  # one group: nothing to delay
     # same dropout contract as build_train_step: thread keys when the
     # model wants dropout AND the loss fn can take them; warn otherwise
     import inspect
@@ -898,23 +997,21 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
             "enable it", stacklevel=2)
 
     if delayed:
-        # the accumulator gains a leading dp dim (one local grad shard
-        # per dp group) — P("dp", *param_spec) keeps each group's shard
-        # on its own devices, so accumulation is comm-free
-        acc_specs = jax.tree.map(
-            lambda s: P("dp", *tuple(s)), plan.state_specs.params,
-            is_leaf=lambda x: isinstance(x, P))
-        acc_shardings = named_shardings(plan.mesh, acc_specs)
-        acc_lead = (ndp,)
+        # the accumulator gains a leading lane dim (one local grad
+        # shard per group) — group-sharded specs keep each group's
+        # shard on its own devices, so accumulation is comm-free
+        _, acc_shardings, acc_leads = _delayed_acc_layout(plan, ndp, nep)
     else:
         acc_shardings = param_shardings
-        acc_lead = ()
+        acc_leads = jax.tree.map(lambda s: 0, plan.state_specs.params,
+                                 is_leaf=lambda x: isinstance(x, P))
 
     @functools.partial(jax.jit, out_shardings=acc_shardings)
     def _fresh_acc():
         return jax.tree.map(
-            lambda s: jnp.zeros(acc_lead + tuple(s.shape), jnp.float32),
-            model.abstract_params())
+            lambda s, lead: jnp.zeros(
+                ((lead,) if lead else ()) + tuple(s.shape), jnp.float32),
+            model.abstract_params(), acc_leads)
 
     # zero-fill INTO the donated previous accumulator: XLA rewrites this
     # to an in-place memset of the existing buffer — no allocation
@@ -949,8 +1046,12 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
                             acc, grads), loss
 
     # shared with the in-scan path (Strategy(delay_grad_sync=True)):
-    # partial-manual shard_map over dp, group-local grads, leading dp dim
-    _local_grads = build_local_grad_fn(base_loss, plan.mesh, ndp) \
+    # partial-manual shard_map over the group axes, group-local grads,
+    # leading lane dim
+    _local_grads = build_local_grad_fn(
+        base_loss, plan.mesh, ndp, nep=nep,
+        param_specs=plan.state_specs.params,
+        ep_overlap=strategy.ep_overlap, ep_chunks=strategy.ep_chunks) \
         if delayed else None
 
     # delayed acc buffers ((ndp, ...) leaves) can never alias the
@@ -961,12 +1062,13 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
                        out_shardings=(plan.state_shardings, None))
     def apply_step(state: TrainState, acc, n_accum):
         if delayed:
-            # THE one DP gradient reduction of the whole update: the
-            # leading (dp-sharded) dim sums down to the synced grad —
+            # THE one gradient reduction of the whole update: the
+            # leading (group-sharded) lane dim sums down to the synced
+            # grad (dense lanes over dp×ep, expert lanes over dp) —
             # under ZeRO the sharded moment specs turn it into the
             # reduce-scatter → update → all-gather triplet, once
             grads = jax.tree.map(
-                lambda g: jnp.sum(g, axis=0) / (ndp * n_accum), acc)
+                lambda g: jnp.sum(g, axis=0) / (ngroups * n_accum), acc)
         else:
             grads = jax.tree.map(lambda g: g / n_accum, acc)
         gnorm = global_norm(grads)
@@ -983,7 +1085,7 @@ def build_grad_accum_steps(model: Module, opt: Transform, plan: TrainPlan,
         for l in jax.tree.leaves(model.abstract_params())))
 
     def grad_step_fn(state, acc, batch, accum_index=0):
-        if ndp > 1 and not delayed:
+        if ngroups > 1 and not delayed:
             _overlap.record_dp_sync(1, grad_bytes=grad_bytes)
         return grad_step(state, acc, batch, accum_index)
 

@@ -115,9 +115,17 @@ class GPTBlock(Module):
                                      slot_mask=slot_mask,
                                      block_tables=block_tables)
             x = x + a
-            h = self.mlp(params["mlp"], self.ln_2(params["ln_2"], x))
+            mlp_in = self.ln_2(params["ln_2"], x)
             if self.returns_aux:
-                h = h[0]  # aux is train-only
+                # MoE decode: per-row top-k through the gathered
+                # local-expert einsums (MoEMLP.decode — O(rows·k)
+                # expert FFNs instead of the dense oracle's O(rows·E));
+                # aux is train-only. One-shot generate and the serving
+                # engine's fused step both land here, so their tokens
+                # match by construction.
+                h = self.mlp.decode(params["mlp"], mlp_in)
+            else:
+                h = self.mlp(params["mlp"], mlp_in)
             return x + h, new_cache
         # positions only matter for decode (GPT's learned position
         # embedding is applied in embed(), not per block)

@@ -110,10 +110,13 @@ class LlamaBlock(Module):
                                      slot_mask=slot_mask,
                                      block_tables=block_tables)
             x = x + a
-            h = self.mlp(params["mlp"],
-                         self.post_attn_norm(params["post_attn_norm"], x))
+            mlp_in = self.post_attn_norm(params["post_attn_norm"], x)
             if self.returns_aux:
-                h = h[0]  # aux is train-only
+                # MoE decode: per-row top-k through gathered local-
+                # expert einsums (MoEMLP.decode); aux is train-only
+                h = self.mlp.decode(params["mlp"], mlp_in)
+            else:
+                h = self.mlp(params["mlp"], mlp_in)
             return x + h, new_cache
         ka = k1 = k2 = None
         if dropout_key is not None and self.attn_pdrop > 0:

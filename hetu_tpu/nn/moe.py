@@ -17,6 +17,21 @@ has no MoE — this module is the capability re-designed TPU-first:
 - Expert params are stacked on a leading ``expert`` axis (rule
   ``"expert" → "ep"``), so checkpoint/resharding treat them like any other
   param.
+- ``Strategy(ep_overlap="chunk")`` decomposes the dispatch-a2a → expert
+  FFN → combine-a2a chain into ``ep_chunks`` capacity slices: chunk *i*'s
+  combine-a2a (and chunk *i+1*'s dispatch-a2a) share no data with chunk
+  *i*'s expert matmul, so the scheduler (and the TPU's async all_to_all)
+  hides the exchanges behind compute — the EP twin of the PR 3/4 tp/fsdp
+  rings, bitwise-identical to the serialized path (capacity slices are
+  disjoint and the combine consumes the re-concatenated buffer). The
+  analytic ledger audits it as ``comm_bytes_total{kind="ep_a2a"}`` with
+  the overlapped split.
+- The expert plane is observable: per-expert load gauges
+  (``moe_expert_tokens{expert}``), the capacity-overflow counter
+  (``moe_dropped_tokens_total`` — tokens past the capacity buffer used
+  to vanish silently), and aux-loss/overflow-fraction histograms are
+  emitted through a trace-time-gated ``jax.debug.callback`` when
+  telemetry is enabled.
 """
 
 from __future__ import annotations
@@ -177,6 +192,11 @@ class BalanceGate(Module):
     transport plan; weight = sigmoid(affinity) as in BASE. k = 1, aux = 0
     (balance is enforced by construction, approximately under Sinkhorn)."""
 
+    #: routing depends on the WHOLE co-batched row set (the Sinkhorn
+    #: column marginal couples tokens) — decode paths that pack rows
+    #: from unrelated requests must refuse this gate (MoEMLP.decode)
+    batch_coupled = True
+
     def __init__(self, features: int, num_experts: int, *,
                  n_iters: int = 24, temperature: float = 0.02, init=None):
         # defaults measured (CPU sweep, r4): τ=0.02/24 iters → ~0.8%
@@ -256,6 +276,97 @@ def gate_drop_stats(idx, num_experts: int, k: int,
         "load_imbalance": load.max() / jnp.maximum(1, load.mean()),
         "capacity": C,
     }
+
+
+def _emit_expert_plane(load, dropped, aux):
+    """Host side of the expert-plane telemetry callback (values arrive
+    as numpy arrays via ``jax.debug.callback``)."""
+    from hetu_tpu import telemetry
+    if not telemetry.enabled():
+        return
+    import numpy as np
+    reg = telemetry.get_registry()
+    load = np.asarray(load)
+    gauge = reg.gauge(
+        "moe_expert_tokens",
+        "tokens routed to each expert on the last observed MoE layer "
+        "call (pre-capacity, global batch)")
+    for e, n in enumerate(load.tolist()):
+        gauge.set(float(n), expert=str(e))
+    d = float(dropped)
+    if d:
+        reg.counter(
+            "moe_dropped_tokens_total",
+            "(token, choice) slots dropped by the EP capacity limit "
+            "— contributions that silently vanish from the combine").inc(d)
+    total = float(load.sum())
+    reg.histogram(
+        "moe_overflow_fraction",
+        "fraction of (token, choice) slots dropped by the capacity "
+        "limit, per MoE layer call").observe(d / max(total, 1.0))
+    reg.histogram(
+        "moe_aux_loss",
+        "MoE load-balance aux loss per layer call").observe(float(aux))
+
+
+@jax.custom_vjp
+def _expert_plane_probe(out, load, dropped, aux):
+    """Identity on ``out`` that emits the expert-plane stats exactly
+    once per executed layer call, in BOTH execution modes:
+
+    - un-differentiated traces (eval, the dense decode oracle, bench
+      forwards) run the primal — the ``jax.debug.callback`` here fires;
+    - differentiated traces replace the primal with the fwd/bwd pair,
+      and the emission moves to the BACKWARD: under jax 0.4.37 an
+      effect inside a scan body is silently dropped by partial-eval
+      when the scan is differentiated (the train step's layer scan!),
+      but the transposed backward scan executes its own effects — so
+      the bwd is where training-step stats must be emitted. A remat
+      forward replay runs the (emission-free) fwd, never the primal,
+      so recompute cannot double-count.
+
+    ``load``/``dropped``/``aux`` must be float arrays (their zero
+    cotangents are returned as-is)."""
+    jax.debug.callback(_emit_expert_plane, load, dropped, aux)
+    return out
+
+
+def _probe_fwd(out, load, dropped, aux):
+    return out, (load, dropped, aux)
+
+
+def _probe_bwd(res, ct):
+    load, dropped, aux = res
+    jax.debug.callback(_emit_expert_plane, load, dropped, aux)
+    return (ct, jnp.zeros_like(load), jnp.zeros_like(dropped),
+            jnp.zeros_like(aux))
+
+
+_expert_plane_probe.defvjp(_probe_fwd, _probe_bwd)
+
+
+def _expert_plane_stats(idx, *, num_experts: int, k: int,
+                        capacity_factor: float, n_shards: int):
+    """Traced expert-plane stats for one MoE layer call: global
+    per-expert load plus the EXACT dropped-slot count of the EP dispatch
+    — the position computation of :func:`_ep_dispatch` replayed per
+    batch shard (the token dim is contiguously sharded over dp×ep, so
+    shard r's rows are ``idx[r*Tl:(r+1)*Tl]``). ``n_shards=0`` marks the
+    capacity-free dense-oracle path (nothing drops)."""
+    T = idx.shape[0]
+    E = num_experts
+    oh_flat = jax.nn.one_hot(idx.reshape(T * k), E, dtype=jnp.int32)
+    load = jnp.sum(oh_flat, axis=0)
+    if n_shards <= 0 or T % n_shards:
+        return load, jnp.zeros([], jnp.int32)
+    Tl = T // n_shards
+    C = max(1, math.ceil(capacity_factor * Tl * k / E))
+    idx_s = idx.reshape(n_shards, Tl * k)
+    oh = jax.nn.one_hot(idx_s, E, dtype=jnp.int32)   # (S, Tlk, E)
+    pos = jnp.take_along_axis(jnp.cumsum(oh, axis=1) - oh,
+                              idx_s[..., None], axis=2)[..., 0]
+    dropped = jnp.sum((pos >= C).astype(jnp.int32))
+    return load, dropped
 
 
 class HashGate(Module):
@@ -343,9 +454,12 @@ class MoEMLP(Module):
         xf = x.reshape(b * s, d)
         idx, wgt, aux = self.gate(params["gate"], xf)
 
-        # inside a manual region (the pipeline executor) with a manual ep
-        # axis: run the dispatch body directly on the bound axis — the
-        # EP x PP composition (no nested shard_map allowed)
+        # inside a manual region (the pipeline executor, or the delayed
+        # grad-sync body) with a manual ep axis: run the dispatch body
+        # directly on the bound axis — the EP x PP / EP x delayed-sync
+        # composition (no nested shard_map allowed). Telemetry callbacks
+        # stay out of manual regions (SPMD partitioning of the auto axes
+        # rejects the callback custom-call under jax 0.4.37).
         man = current_manual_axes()
         if man is not None:
             axes = self._ep_axes_of(man.mesh)
@@ -356,12 +470,15 @@ class MoEMLP(Module):
                     xf, idx, wgt, self._expert_params(params),
                     ep=ep, num_experts=self.num_experts,
                     k=self.k, capacity_factor=self.capacity_factor,
-                    apply_experts=self._apply_experts, ep_axes=axes)
+                    apply_experts=self._apply_experts, ep_axes=axes,
+                    ep_overlap=getattr(man, "ep_overlap", "off"),
+                    ep_chunks=getattr(man, "ep_chunks", 2))
                 aux = jax.lax.pmean(aux, axes)
                 return out.reshape(b, s, d).astype(x.dtype), aux
 
         ctx = current_act_sharding()
         ep_deg = 0
+        axes = ()
         if ctx is not None:
             axes = self._ep_axes_of(ctx.mesh)
             ep_deg = self._ep_degree(ctx.mesh, axes) if axes else 0
@@ -372,8 +489,66 @@ class MoEMLP(Module):
             out = self._ep_forward(params, xf, idx, wgt, ctx, axes, ep_deg)
         else:
             out = self._dense_forward(params, xf, idx, wgt)
+
+        from hetu_tpu import telemetry
+        if telemetry.enabled():
+            # expert-plane observability: per-expert load + the EXACT
+            # dropped-slot count of the EP dispatch (0 on the capacity-
+            # free dense oracle). Trace-time gated; emission routed
+            # through the custom_vjp probe so differentiated layer
+            # scans still fire it (and remat cannot double-count).
+            n_shards = 0
+            if ep_deg > 1:
+                n_shards = ep_deg * ctx.mesh.shape.get("dp", 1)
+            load, dropped = _expert_plane_stats(
+                idx, num_experts=self.num_experts, k=self.k,
+                capacity_factor=self.capacity_factor, n_shards=n_shards)
+            out = _expert_plane_probe(
+                out, load.astype(jnp.float32),
+                dropped.astype(jnp.float32), aux)
+
         out = act_constrain(out.reshape(b, s, d).astype(x.dtype), "tokens")
         return out, aux
+
+    # -- decode path (serving / autoregressive generation) ------------------
+    def decode(self, params, x):
+        """Per-row top-k through GATHERED local-expert einsums — the
+        decode-mode twin of the dense oracle that computes only the k
+        selected experts per token (O(T·k) FFNs instead of O(T·E)).
+
+        The serving engine's fused step (and one-shot ``generate``) call
+        the transformer blocks in kv-cache mode with a handful of slot
+        rows; experts are stacked params on the leading ``expert`` axis,
+        so per-row routing is a ``jnp.take`` of (k, d, h) weight slices
+        plus batched einsums. The combine accumulates the same
+        ``Σ_j w_j·expert_{idx_j}(x)`` the dense oracle produces (k ≤ 2
+        keeps fp addition commutative), so greedy serving tokens match
+        one-shot generation. Returns the output only — aux is
+        train-only."""
+        if getattr(self.gate, "batch_coupled", False):
+            raise NotImplementedError(
+                f"MoEMLP.decode needs a per-token gate; "
+                f"{type(self.gate).__name__} routes over the whole "
+                "co-batched row set, so serving outputs would depend on "
+                "which requests share the fused step and could never "
+                "match one-shot generate")
+        b, s, d = x.shape
+        xf = x.reshape(b * s, d)
+        idx, wgt, _ = self.gate(params["gate"], xf)
+        dt = self.compute_dtype()
+        xc = xf.astype(dt)
+        wi = jnp.take(params["wi"], idx, axis=0).astype(dt)   # (T,k,d,H)
+        h = jnp.einsum("td,tkdh->tkh", xc, wi)
+        if self.gated:
+            wg = jnp.take(params["wg"], idx, axis=0).astype(dt)
+            g = jnp.einsum("td,tkdh->tkh", xc, wg)
+            h = self.activation(g, h)
+        else:
+            h = self.activation(h)
+        wo = jnp.take(params["wo"], idx, axis=0).astype(dt)   # (T,k,H,d)
+        y = jnp.einsum("tkh,tkhd->tkd", h, wo)
+        out = jnp.sum(wgt[..., None] * y.astype(jnp.float32), axis=1)
+        return out.reshape(b, s, d).astype(x.dtype)
 
     # -- dense oracle (single device / no ep axis): every expert computes
     # every token, combine by gate weights — capacity-free ------------------
@@ -396,7 +571,9 @@ class MoEMLP(Module):
             _ep_dispatch, ep=ep_deg,
             num_experts=self.num_experts, k=self.k,
             capacity_factor=self.capacity_factor,
-            apply_experts=self._apply_experts, ep_axes=ep_axes)
+            apply_experts=self._apply_experts, ep_axes=ep_axes,
+            ep_overlap=getattr(ctx, "ep_overlap", "off"),
+            ep_chunks=getattr(ctx, "ep_chunks", 2))
 
         fn = shard_map(
             body, mesh=ctx.mesh,
@@ -404,6 +581,17 @@ class MoEMLP(Module):
             out_specs=tok_spec, axis_names={"dp", *ep_axes},
             check_vma=False)
         return fn(xf, idx, wgt, expert_params)
+
+
+def _bound_axis_size(name: str) -> int:
+    """Size of a bound manual axis. ``jax.lax.axis_size`` only exists
+    on jax >= 0.6 (the tree's target); under the 0.4.37 container the
+    ``psum(1, axis)`` idiom returns the same static int — this gap made
+    the factored-ep (multi-slice) path raise AttributeError until the
+    ISSUE 9 quick-tier unit test caught it."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    return jax.lax.psum(1, name)
 
 
 def hierarchical_all_to_all(buf, outer_axis: str, inner_axis: str):
@@ -418,8 +606,8 @@ def hierarchical_all_to_all(buf, outer_axis: str, inner_axis: str):
     r = outer * inner_size + inner. Returns the same shape with the
     leading dim indexing sources."""
     ep = buf.shape[0]
-    O = jax.lax.axis_size(outer_axis)
-    I = jax.lax.axis_size(inner_axis)
+    O = _bound_axis_size(outer_axis)
+    I = _bound_axis_size(inner_axis)
     assert O * I == ep, (O, I, ep)
     b = buf.reshape((O, I) + buf.shape[1:])
     # inner exchange delivers each (outer-dest, inner-dest) block to the
@@ -430,13 +618,47 @@ def hierarchical_all_to_all(buf, outer_axis: str, inner_axis: str):
     return b.reshape((ep,) + buf.shape[1:])
 
 
+@jax.custom_vjp
+def _pin_buffer(x):
+    """Differentiable ``optimization_barrier``: identity that stops XLA
+    fusing/splitting ops across the pinned value (0.4.37 ships no
+    differentiation rule for the primitive, hence the custom_vjp). The
+    cotangent is pinned too, so the mirrored backward dots see the same
+    materialized layout."""
+    return jax.lax.optimization_barrier(x)
+
+
+def _pin_fwd(x):
+    return jax.lax.optimization_barrier(x), None
+
+
+def _pin_bwd(_, ct):
+    return (jax.lax.optimization_barrier(ct),)
+
+
+_pin_buffer.defvjp(_pin_fwd, _pin_bwd)
+
+
 def _ep_dispatch(x, idx, wgt, eparams, *, ep, num_experts, k,
-                 capacity_factor, apply_experts, ep_axes=("ep",)):
+                 capacity_factor, apply_experts, ep_axes=("ep",),
+                 ep_overlap: str = "off", ep_chunks: int = 2):
     """Per-rank EP dispatch body: capacity scatter → all_to_all → local
     experts → all_to_all → weighted combine. Requires a bound manual
     ``"ep"`` axis (from ``_ep_forward``'s shard_map or the pipeline's
     manual region). ``ep_axes``: one axis name, or (outer, inner) for the
-    hierarchical two-stage exchange on multi-slice meshes."""
+    hierarchical two-stage exchange on multi-slice meshes.
+
+    ``ep_overlap="chunk"`` slices the capacity dim into ``ep_chunks``
+    pieces and runs dispatch-a2a → FFN → combine-a2a per slice. Slices
+    are disjoint and rows independent, so the re-concatenated combine
+    buffer is bitwise-identical to the serialized path — but chunk
+    *i+1*'s dispatch-a2a and chunk *i*'s combine-a2a share no data with
+    chunk *i*'s expert matmul, so the scheduler overlaps them (the same
+    no-data-dependency contract the tp/fsdp rings rely on). The backward
+    inherits the chunk structure through linearization: the transpose of
+    ``all_to_all`` is an ``all_to_all``, so the mirrored exchanges of
+    chunk *i* overlap chunk *i±1*'s FFN backward the same way — no
+    custom_vjp needed to keep the overlap shape."""
 
     def a2a(buf):
         if len(ep_axes) == 2:
@@ -459,12 +681,48 @@ def _ep_dispatch(x, idx, wgt, eparams, *, ep, num_experts, k,
     buf = jnp.einsum("ts,td->sd", disp,
                      xk.astype(jnp.float32))   # (E*C, d)
     buf = buf.reshape(ep, El, C, -1)
-    # send each expert block to its owner rank
-    buf = a2a(buf)                             # (ep, El, C, d)
-    xe = jnp.swapaxes(buf, 0, 1).reshape(El, ep * C, -1)
-    ye = apply_experts(eparams, xe)            # (El, ep*C, d)
-    ye = jnp.swapaxes(ye.reshape(El, ep, C, -1), 0, 1)
-    ye = a2a(ye)                               # (ep, El, C, d)
+    n_chunks = min(int(ep_chunks), C) if ep_overlap == "chunk" else 1
+    if ep > 1:
+        # analytic ledger (trace time, like the tp/fsdp rings): two
+        # a2as per forward, each moving the (ep-1)/ep remote share of
+        # the local capacity buffer; the backward mirrors them (a2a
+        # transposes to a2a) — accounted where the bwd traces
+        from hetu_tpu.parallel.overlap import record_comm_bytes
+        record_comm_bytes(
+            "ep_a2a",
+            2 * buf.size * buf.dtype.itemsize * (ep - 1) // ep,
+            overlapped=n_chunks > 1)
+    if n_chunks <= 1:
+        # serialized: one dispatch exchange, all experts, one combine
+        buf = a2a(buf)                             # (ep, El, C, d)
+        xe = jnp.swapaxes(buf, 0, 1).reshape(El, ep * C, -1)
+        ye = apply_experts(eparams, xe)            # (El, ep*C, d)
+        ye = jnp.swapaxes(ye.reshape(El, ep, C, -1), 0, 1)
+        ye = a2a(ye)                               # (ep, El, C, d)
+    else:
+        # pin the dispatch buffer before slicing: otherwise XLA fuses
+        # the capacity slices back into the dispatch einsum and
+        # computes each row subset with its own reduction blocking —
+        # 1-ulp drift vs the serialized path's single full-buffer
+        # einsum. Pinned, chunks are pure memory slices.
+        buf = _pin_buffer(buf)
+        bounds = [i * C // n_chunks for i in range(n_chunks + 1)]
+        outs = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            c = hi - lo
+            bi = a2a(buf[:, :, lo:hi])             # (ep, El, c, d)
+            xi = jnp.swapaxes(bi, 0, 1).reshape(El, ep * c, -1)
+            yi = apply_experts(eparams, xi)
+            yi = jnp.swapaxes(yi.reshape(El, ep, c, -1), 0, 1)
+            outs.append(a2a(yi))
+        ye = jnp.concatenate(outs, axis=2)         # (ep, El, C, d)
+        # pin the re-concatenated buffer: without the barrier XLA
+        # splits the combine dot across the concat (dot(disp, concat)
+        # → Σ per-chunk partial dots), re-associating the s-reduction
+        # by 1 ulp — the barrier makes the combine consume the same
+        # materialized layout the serialized a2a output has, keeping
+        # the bitwise contract while the chunk a2as still overlap
+        ye = _pin_buffer(ye)
     ye = ye.reshape(E * C, -1)
     outk = jnp.einsum("ts,sd->td", disp,
                       ye.astype(jnp.float32))  # (Tk, d)

@@ -44,6 +44,8 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
                     manual_cp: bool = False,
                     cp_layout: str = "contiguous",
                     cp_impl: str = "ring",
+                    ep_overlap: str = "off",
+                    ep_chunks: int = 2,
                     unroll: bool = False,
                     param_manual_specs: Any = None,
                     double_buffer: bool = False):
@@ -233,7 +235,9 @@ def pipeline_blocks(block_fn: Callable, stacked_params: Any, payload: dict,
     # attention) which axes are bound so they use direct collectives
     with no_act_sharding(), ManualAxes(mesh, frozenset(manual),
                                        cp_layout=cp_layout,
-                                       cp_impl=cp_impl):
+                                       cp_impl=cp_impl,
+                                       ep_overlap=ep_overlap,
+                                       ep_chunks=ep_chunks):
         out = fn(stacked_params, payload)
     if block_returns_aux:
         return out["x"], out["aux"]
@@ -374,6 +378,8 @@ def build_pipeline_train_step(model, opt, plan, *, attn_impl: str = "auto",
                 manual_ep=manual_ep, manual_cp=manual_cp,
                 cp_layout=strategy.effective_cp_layout,
                 cp_impl=strategy.cp_impl,
+                ep_overlap=strategy.ep_overlap,
+                ep_chunks=strategy.ep_chunks,
                 unroll=strategy.unroll,
                 param_manual_specs=param_manual_specs,
                 double_buffer=strategy.pp_overlap)

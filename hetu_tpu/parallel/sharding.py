@@ -175,6 +175,11 @@ class ActivationSharding:
                             # block params (parallel.overlap.
                             # per_layer_gather_specs output); None =
                             # no per-layer gather (GSPMD fallback)
+    ep_overlap: str = "off"  # "chunk": MoE dispatch/combine all_to_alls
+                            # decompose into ep_chunks capacity slices
+                            # so each a2a hides behind the neighbouring
+                            # chunk's expert FFN (nn.moe._ep_dispatch)
+    ep_chunks: int = 2      # capacity slices for ep_overlap="chunk"
 
     def spec(self, kind: str) -> Optional[P]:
         if kind == "tokens":        # (batch, seq, embed)
@@ -218,12 +223,16 @@ class ManualAxes:
     ``cp_layout`` describes how the global sequence was laid out when
     "cp" is one of the bound axes (ring attention needs it to pick the
     per-hop masks); ``cp_impl`` selects ring vs ulysses for attention
-    inside the region."""
+    inside the region. ``ep_overlap``/``ep_chunks`` carry the MoE
+    chunked-a2a setting into regions where "ep" is bound (the delayed
+    grad-sync body; the pipeline executor leaves the default)."""
 
     mesh: Mesh
     axes: frozenset
     cp_layout: str = "contiguous"
     cp_impl: str = "ring"
+    ep_overlap: str = "off"
+    ep_chunks: int = 2
 
     def __enter__(self):
         _MANUAL_CTX.append(self)

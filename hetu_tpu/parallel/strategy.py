@@ -81,13 +81,29 @@ class Strategy:
                                  # models without a stacked block list)
     delay_grad_sync: bool = False  # in-jit grad accumulation
                                  # (num_microbatches>1, pp=1): keep
-                                 # per-microbatch grads dp-group-local
-                                 # in the lax.scan (leading dp-sharded
-                                 # accumulator dim) and reduce ONCE per
-                                 # optimizer update instead of once per
-                                 # microbatch — the scan-path twin of
-                                 # build_grad_accum_steps(
-                                 # delay_grad_sync=True)
+                                 # per-microbatch grads group-local
+                                 # in the lax.scan (leading group-
+                                 # sharded accumulator dim) and reduce
+                                 # ONCE per optimizer update instead of
+                                 # once per microbatch — the scan-path
+                                 # twin of build_grad_accum_steps(
+                                 # delay_grad_sync=True). With ep > 1
+                                 # the group is dp×ep: dense grads
+                                 # reduce over dp×ep lanes, expert
+                                 # grads over dp lanes only (their ep
+                                 # sum already happened through the
+                                 # backward all_to_all)
+    ep_overlap: str = "off"      # "chunk": decompose the MoE
+                                 # dispatch-a2a → expert FFN →
+                                 # combine-a2a into ep_chunks capacity
+                                 # slices inside the manual shard_map,
+                                 # so chunk i's combine-a2a (and chunk
+                                 # i+1's dispatch-a2a) hide behind
+                                 # chunk i's expert matmul (the EP twin
+                                 # of tp_overlap/fsdp_overlap;
+                                 # bitwise-identical to "off")
+    ep_chunks: int = 2           # capacity slices for ep_overlap=
+                                 # "chunk" (clamped to the capacity)
 
     # -- derived -----------------------------------------------------------
     @property
@@ -160,10 +176,10 @@ class Strategy:
                 "delay_grad_sync=True is incompatible with fsdp: params "
                 "are dp-sharded, so group-local gradients would require "
                 "the param all-gather the delay is meant to avoid")
-        if self.delay_grad_sync and self.ep > 1:
-            raise ValueError(
-                "delay_grad_sync=True is incompatible with ep > 1 (the "
-                "batch dim is sharded over dp×ep)")
+        if self.ep_overlap not in ("off", "chunk"):
+            raise ValueError(f"unknown ep_overlap {self.ep_overlap!r}")
+        if self.ep_chunks < 1:
+            raise ValueError("ep_chunks must be >= 1")
         if self.pp > 1 and self.num_microbatches % self.pp != 0:
             raise ValueError(
                 f"num_microbatches ({self.num_microbatches}) must be a "

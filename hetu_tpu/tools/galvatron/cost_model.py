@@ -122,6 +122,7 @@ class ModelDims:
     bytes_per_el: int = 2             # bf16 activations/weights on the wire
     num_experts: int = 0
     moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     # per-layer relative attention intensity (len = num_layers), e.g.
     # 1.0 for full attention, window/seq_len for sliding-window layers.
     # None = homogeneous stack. Consumed by the memory-plane remat
@@ -141,7 +142,8 @@ class ModelDims:
             vocab=cfg.vocab_size, seq_len=seq_len,
             global_batch=global_batch,
             num_experts=getattr(cfg, "num_experts", 0),
-            moe_top_k=getattr(cfg, "moe_top_k", 2))
+            moe_top_k=getattr(cfg, "moe_top_k", 2),
+            moe_capacity_factor=getattr(cfg, "moe_capacity_factor", 1.25))
 
     # params of one block (attention + dense or expert MLP)
     def layer_params(self) -> float:
@@ -153,6 +155,18 @@ class ModelDims:
         if self.num_experts > 0:
             mlp_dense *= self.num_experts
         return attn + mlp_dense
+
+    def layer_expert_params(self) -> float:
+        """Params of one layer's EXPERT MLP stack (0 for dense models)
+        — the share the ``"expert" → "ep"`` rule shards over ep, which
+        the memory ledger must divide by ep where everything else
+        divides by tp·pp alone."""
+        if self.num_experts <= 0:
+            return 0.0
+        h = self.hidden
+        mlp_one = 3 * h * self.intermediate if self.intermediate \
+            != 4 * h else 2 * h * self.intermediate
+        return mlp_one * self.num_experts
 
     def attn_param_share(self) -> float:
         """Attention's fraction of one block's params — the proxy the
@@ -182,6 +196,10 @@ class CostBreakdown:
     mem_params: float = 0.0
     mem_opt: float = 0.0
     mem_act_per_microbatch: float = 0.0
+    # MoE dispatch/combine all_to_all time (0 for dense models or
+    # ep=1); priced serialized — Strategy(ep_overlap="chunk") hides a
+    # large share of it behind the expert matmuls at runtime
+    ep_comm: float = 0.0
 
     def fits(self, topo: TPUTopology) -> bool:
         return self.mem_per_device <= topo.hbm_bytes
@@ -242,9 +260,26 @@ def estimate(dims: ModelDims, strategy: Strategy,
     t_cp = 3.0 * (s.cp - 1) * kv_bytes / topo.ici_bw * layers_per_stage \
         if s.cp > 1 else 0.0
 
+    # ---- ep a2a (MoE dispatch + combine) ----------------------------------
+    # two fp32 capacity-buffer exchanges forward + the mirrored pair in
+    # backward (a2a transposes to a2a), each moving the (ep-1)/ep
+    # remote share of capacity_factor·tokens·k·h per device per layer
+    t_ep = 0.0
+    if s.ep > 1 and dims.num_experts > 0:
+        buf_bytes = dims.moe_capacity_factor * tokens_loc \
+            * max(dims.moe_top_k, 1) * h * 4.0
+        t_ep = 4.0 * (s.ep - 1) / s.ep * buf_bytes / topo.ici_bw \
+            * layers_per_stage
+
     # ---- dp grad sync -----------------------------------------------------
-    param_bytes_dev = dims.total_params() * dims.bytes_per_el \
-        / (s.tp * s.pp)
+    # expert params are ep-sharded (rule "expert" → "ep"): their grads
+    # reduce over dp from a 1/ep shard per device; dense params carry
+    # the full tp·pp shard
+    expert_bytes = dims.num_layers * dims.layer_expert_params() \
+        * dims.bytes_per_el
+    dense_bytes = dims.total_params() * dims.bytes_per_el - expert_bytes
+    param_bytes_dev = dense_bytes / (s.tp * s.pp) \
+        + expert_bytes / (s.tp * s.pp * max(s.ep, 1))
     t_dp = _ring_allreduce_time(param_bytes_dev, s.dp, topo.ici_bw) \
         * (1.0 - topo.dp_overlap) if s.dp > 1 else 0.0
 
@@ -252,7 +287,7 @@ def estimate(dims: ModelDims, strategy: Strategy,
     nm = max(s.num_microbatches, 1)
     bubble = (nm + s.pp - 1) / nm if s.pp > 1 else 1.0
 
-    step = (t_compute + t_tp + t_cp) * bubble + t_dp
+    step = (t_compute + t_tp + t_cp + t_ep) * bubble + t_dp
 
     # ---- memory -----------------------------------------------------------
     # one formula for planner and runtime: the memory-plane ledger
@@ -266,4 +301,5 @@ def estimate(dims: ModelDims, strategy: Strategy,
                          t_cp * bubble, t_dp, bubble, bd.peak_bytes,
                          mem_params=bd.params_bytes + bd.grads_bytes,
                          mem_opt=bd.opt_bytes,
-                         mem_act_per_microbatch=bd.act_bytes_per_microbatch)
+                         mem_act_per_microbatch=bd.act_bytes_per_microbatch,
+                         ep_comm=t_ep * bubble)

@@ -341,6 +341,83 @@ def serving_plane_summary(records: list[dict]) -> Optional[list[str]]:
     return lines or None
 
 
+#: expert-plane series (nn/moe.py): per-expert load balance, the
+#: capacity-overflow drop rate, aux-loss drift, and the ep all_to_all
+#: byte accounting — the direct evidence expert parallelism is (or is
+#: not) balanced and its exchanges overlapped (docs/PERFORMANCE.md
+#: "Expert plane").
+_EXPERT_PLANE_SERIES = (
+    "moe_expert_tokens", "moe_dropped_tokens_total",
+    "moe_overflow_fraction", "moe_aux_loss",
+)
+
+
+def expert_plane_summary(records: list[dict]) -> Optional[list[str]]:
+    """Lines for the MoE expert-plane section, or None when no snapshot
+    carries ``moe_*`` series. Reads the LAST snapshot; the ep_a2a byte
+    split comes from the data-plane counters in the same snapshot."""
+    snap: Optional[dict] = None
+    for rec in records:
+        cand = rec.get("metrics") if rec.get("kind") == "metrics_snapshot" \
+            else rec.get("telemetry")
+        if isinstance(cand, dict) and any(
+                k.split("{")[0] in _EXPERT_PLANE_SERIES for k in cand):
+            snap = cand
+    if snap is None:
+        return None
+    loads: dict[int, float] = {}
+    dropped = 0.0
+    hists: dict[str, dict] = {}
+    a2a_bytes = a2a_overlapped = 0.0
+    for series, v in snap.items():
+        base = series.split("{")[0]
+        if base == "moe_expert_tokens" and isinstance(v, (int, float)):
+            try:
+                e = int(series.split('expert="', 1)[1].split('"', 1)[0])
+            except (IndexError, ValueError):
+                continue
+            loads[e] = float(v)
+        elif base == "moe_dropped_tokens_total" \
+                and isinstance(v, (int, float)):
+            dropped += v
+        elif base in ("moe_overflow_fraction", "moe_aux_loss") \
+                and isinstance(v, dict):
+            hists[base] = v
+        elif base == "comm_bytes_total" and 'kind="ep_a2a"' in series \
+                and isinstance(v, (int, float)):
+            a2a_bytes += v
+        elif base == "comm_overlapped_bytes_total" \
+                and 'kind="ep_a2a"' in series and isinstance(v, (int, float)):
+            a2a_overlapped += v
+    lines = []
+    width = 18
+    if loads:
+        vals = [loads[e] for e in sorted(loads)]
+        mean = sum(vals) / len(vals)
+        imbalance = max(vals) / mean if mean else 0.0
+        lines.append("expert load".ljust(width)
+                     + " ".join(f"{int(v)}" for v in vals)
+                     + f"  (max/mean {imbalance:.2f})")
+    lines.append("dropped tokens".ljust(width)
+                 + (f"{int(dropped)} (token, choice) slots past capacity"
+                    if dropped else "0"))
+    h = hists.get("moe_overflow_fraction")
+    if h and h.get("count"):
+        lines.append("overflow frac".ljust(width)
+                     + f"p50 {h['p50']:.4f}  p99 {h['p99']:.4f}  "
+                     f"(n={int(h['count'])})")
+    h = hists.get("moe_aux_loss")
+    if h and h.get("count"):
+        lines.append("aux loss".ljust(width)
+                     + f"p50 {h['p50']:.4f}  p99 {h['p99']:.4f}")
+    if a2a_bytes:
+        lines.append("ep a2a".ljust(width)
+                     + f"{_fmt_bytes(a2a_bytes)} cumulative "
+                     f"({100.0 * a2a_overlapped / a2a_bytes:.0f}% on the "
+                     f"chunked-overlap path)")
+    return lines
+
+
 #: health series (telemetry/flight.py watchdog, telemetry/slo.py): the
 #: run's production-health verdict — did anything hang, which SLO rules
 #: fired, and is anything still breached (docs/OBSERVABILITY.md).
@@ -416,6 +493,12 @@ def summarize(path: str, *, wall_s: Optional[float] = None,
         parts.append("")
         parts.append("== serving plane ==")
         parts.extend(sv)
+
+    xp = expert_plane_summary(records)
+    if xp:
+        parts.append("")
+        parts.append("== expert plane ==")
+        parts.extend(xp)
 
     hl = health_summary(records)
     if hl:

@@ -77,6 +77,35 @@ def test_bench_router_emits_json_contract():
         assert json.load(f) == rec
 
 
+@pytest.mark.slow
+def test_bench_moe_emits_json_contract():
+    """``bench.py --moe`` must emit the expert-plane headline and write
+    BENCH_moe.json with the serialized-vs-chunked and eager-vs-delayed
+    evidence (the expert-plane round artifact)."""
+    env = dict(os.environ)
+    env["HETU_TPU_BENCH_PLATFORM"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--moe"],
+        capture_output=True, text=True, timeout=500, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "overlap", "delayed_sync",
+                "expert_balance"):
+        assert key in rec, (key, rec)
+    assert rec["value"] > 0 and rec["ep"] > 1
+    ov = rec["overlap"]
+    assert ov["loss_bitwise_equal"] is True
+    assert ov["ep_a2a_bytes_per_trace"] > 0
+    assert ov["ep_a2a_overlapped_frac"] == 1.0
+    ds = rec["delayed_sync"]
+    assert ds["eager_syncs_per_update"] > 1.0   # nm per update
+    assert ds["delayed_syncs_per_update"] == 1.0
+    bal = rec["expert_balance"]
+    assert sum(bal["expert_load"]) > 0
+    with open(os.path.join(_ROOT, "BENCH_moe.json")) as f:
+        assert json.load(f) == rec
+
+
 def test_graft_entry_fn_runs():
     import jax
     sys.path.insert(0, _ROOT)

@@ -6,7 +6,9 @@ Parity target: ``python/hetu/data`` — ``JsonDataset``, packing buckets
 """
 
 from hetu_tpu.data.packing import PackedBatch, pack_sequences
-from hetu_tpu.data.bucket import SeqLenBuckets
+from hetu_tpu.data.bucket import (
+    BucketStats, SeqLenBuckets, ShapeBucketer,
+)
 from hetu_tpu.data.dataset import JsonDataset, SyntheticLMDataset
 from hetu_tpu.data.loader import (
     build_data_loader, sample_batches, token_batches,
@@ -26,4 +28,5 @@ __all__ = [
     "ByteLevelBPETokenizer", "HFTokenizer", "SentencePieceTokenizer",
     "TiktokenTokenizer", "train_bpe",
     "BucketPlan", "DynamicDispatcher", "plan_buckets",
+    "ShapeBucketer", "BucketStats",
 ]

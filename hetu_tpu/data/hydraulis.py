@@ -117,7 +117,8 @@ def plan_buckets(lengths: Iterable[int], *,
                  max_cp: int = 1,
                  base_strategy: Optional[Strategy] = None,
                  row_multiple: int = 1,
-                 cp_impl: Optional[str] = None
+                 cp_impl: Optional[str] = None,
+                 hbm_budget_bytes: Optional[float] = None
                  ) -> dict[int, BucketPlan]:
     """Choose per-bucket rows + strategy for a roughly constant token
     budget per dispatch.
@@ -131,6 +132,12 @@ def plan_buckets(lengths: Iterable[int], *,
     None (default) selects per bucket via :func:`preferred_cp_impl`
     (an explicit pin is the only way to express intent — the dataclass
     default on ``base_strategy`` is indistinguishable from unset).
+    ``hbm_budget_bytes``: per-device HBM ceiling — every candidate is
+    ALSO priced through the memory ledger at ITS bucket's seq-len
+    (``engine.memory.estimate_breakdown``), so a long bucket cannot
+    select a (cp, remat) pair whose activations only fit at the short
+    buckets' lengths (the admission gate and the planner read the same
+    arithmetic).
     """
     lengths = list(lengths)
     present = sorted(buckets.group(lengths))
@@ -164,6 +171,12 @@ def plan_buckets(lengths: Iterable[int], *,
                         dims_base, seq_len=L,
                         global_batch=max(rows, cand.dp))
                     c = estimate(dims, cand, topo)
+                    if hbm_budget_bytes is not None:
+                        from hetu_tpu.engine.memory import (
+                            estimate_breakdown)
+                        if estimate_breakdown(dims, cand).peak_bytes \
+                                > hbm_budget_bytes:
+                            continue
                     if c.fits(topo) and (best is None
                                          or c.step_time < best[0]):
                         best = (c.step_time, cand)
@@ -193,13 +206,33 @@ class DynamicDispatcher:
     carries its :class:`BucketPlan` so the trainer can route it to the
     right (bucket, strategy) jit. Rows shorter than the bucket are padded
     with ``pad_id`` and label ``ignore_index``.
+
+    ``pack=True`` adds sequence PACKING on top of bucketing: documents
+    short enough for the ``pack_len`` bucket (default: the largest
+    planned bucket) are first-fit packed into its rows
+    (``data.packing.pack_sequences`` — per-token segment ids + reset
+    positions, loss masks at segment boundaries, so the packed batch
+    trains identically to the same docs padded separately), cutting pad
+    waste below what per-doc bucketing can reach — a row holds many
+    docs, so only fill inefficiency pads. Docs longer than ``pack_len``
+    still dispatch through their own (unpacked) buckets. Packed batches
+    carry ``positions`` + ``segment_ids``; the emitted shapes stay fixed
+    per bucket, so the compile bound is unchanged.
     """
 
     def __init__(self, plans: dict[int, BucketPlan], *,
-                 pad_id: int = 0, ignore_index: int = -100):
+                 pad_id: int = 0, ignore_index: int = -100,
+                 pack: bool = False, pack_len: Optional[int] = None):
         self.plans = plans
         self.pad_id = pad_id
         self.ignore_index = ignore_index
+        self.pack = pack
+        self.pack_len = int(pack_len) if pack_len else \
+            (max(plans) if plans else 0)
+        if pack and self.pack_len not in plans:
+            raise ValueError(
+                f"pack_len {self.pack_len} has no BucketPlan "
+                f"(available: {sorted(plans)})")
         self.stats = DispatchStats()
 
     def batches(self, seqs: Sequence[np.ndarray], *,
@@ -209,10 +242,14 @@ class DynamicDispatcher:
         matters)."""
         buckets = SeqLenBuckets(sizes=sorted(self.plans))
         by_bucket: dict[int, list[int]] = {}
+        packable: list[int] = []
         for i, s in enumerate(seqs):
             # +1: LM shift consumes one token
-            by_bucket.setdefault(
-                buckets.bucket_for(max(0, len(s) - 1)), []).append(i)
+            L = buckets.bucket_for(max(0, len(s) - 1))
+            if self.pack and len(s) <= self.pack_len:
+                packable.append(i)
+            else:
+                by_bucket.setdefault(L, []).append(i)
         for L in sorted(by_bucket, reverse=True):
             plan = self.plans[L]
             idxs = by_bucket[L]
@@ -221,6 +258,40 @@ class DynamicDispatcher:
                 if len(group) < plan.batch_rows and drop_remainder:
                     break
                 yield self._emit(seqs, group, plan), plan
+        if packable:
+            yield from self._emit_packed(seqs, packable,
+                                         self.plans[self.pack_len],
+                                         drop_remainder=drop_remainder)
+
+    def _emit_packed(self, seqs, idxs, plan: BucketPlan, *,
+                     drop_remainder: bool = False):
+        """First-fit pack the docs into ``plan.bucket_len`` rows, then
+        chunk the packed rows into fixed (batch_rows, bucket_len)
+        batches (short final chunks pad with all-ignored rows unless
+        ``drop_remainder``)."""
+        from hetu_tpu.data.packing import pack_sequences
+        L, R = plan.bucket_len, plan.batch_rows
+        packed = pack_sequences([np.asarray(seqs[i])[:L] for i in idxs],
+                                L, pad_id=self.pad_id,
+                                ignore_index=self.ignore_index)
+        n = packed.input_ids.shape[0]
+        for k in range(0, n, R):
+            rows = min(R, n - k)
+            if rows < R and drop_remainder:
+                break
+            batch = {}
+            pads = {"input_ids": self.pad_id,
+                    "labels": self.ignore_index,
+                    "positions": 0, "segment_ids": 0}
+            for key, arr in packed.as_batch().items():
+                out = np.full((R, L), pads[key], arr.dtype)
+                out[:rows] = arr[k:k + rows]
+                batch[key] = out
+            self.stats.batches += 1
+            self.stats.real_tokens += int(
+                (batch["labels"] != self.ignore_index).sum())
+            self.stats.padded_tokens += R * L
+            yield batch, plan
 
     def _emit(self, seqs, group, plan: BucketPlan) -> dict:
         L = plan.bucket_len

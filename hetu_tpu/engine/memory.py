@@ -253,6 +253,52 @@ def derive_remat_mask(dims, strategy: Strategy, *,
     return tuple(i in chosen for i in range(n))
 
 
+# -- shape plane: per-bucket pricing -----------------------------------------
+#
+# The bucket planner (data/hydraulis.plan_buckets) and the trainer's
+# bucketed dispatch feed DIFFERENT seq-lens through one strategy; these
+# helpers price each bucket with the same estimate_breakdown arithmetic
+# so the planner's HBM gate and the runtime gauges can never disagree
+# about what a long bucket costs.
+
+
+def bucket_act_bytes(dims_base, strategy: Strategy, bucket_len: int,
+                     rows: int, *, act_scale: float = 1.0) -> float:
+    """Live activation bytes of one (bucket_len, rows) dispatch under
+    ``strategy`` — ``estimate_breakdown`` at the bucket's own seq-len."""
+    dims = dataclasses.replace(dims_base, seq_len=int(bucket_len),
+                               global_batch=max(int(rows), 1))
+    return estimate_breakdown(dims, strategy,
+                              act_scale=act_scale).act_bytes
+
+
+def bucket_peak_bytes(dims_base, strategy: Strategy,
+                      plans: dict) -> dict[int, float]:
+    """Ledger peak per bucket for a ``plan_buckets`` output
+    (``{bucket_len: BucketPlan}``) — each bucket priced under ITS OWN
+    strategy and row count. The honest per-bucket view the shape-plane
+    bench and trace_summary report."""
+    out: dict[int, float] = {}
+    for L, plan in plans.items():
+        dims = dataclasses.replace(dims_base, seq_len=int(L),
+                                   global_batch=max(plan.batch_rows, 1))
+        out[int(L)] = estimate_breakdown(dims, plan.strategy).peak_bytes
+    return out
+
+
+def cp_prefill_act_bytes(cfg, *, seq_len: int, cp: int = 1) -> float:
+    """Activation bytes of ONE cp-sharded long-prompt prefill forward
+    (the serving CP lane, ``ServingEngine(long_max_len=)``): per-device
+    residuals of a no-remat, batch-1 forward at ``seq_len``, divided
+    over the cp axis. The serving admission gate uses this to refuse a
+    ``long_max_len`` whose prefill could not fit next to the arena."""
+    from hetu_tpu.tools.galvatron.cost_model import ModelDims
+    dims = ModelDims.from_config(cfg, seq_len=int(seq_len),
+                                 global_batch=1)
+    bd = estimate_breakdown(dims, Strategy(cp=max(int(cp), 1)))
+    return bd.act_bytes_per_microbatch
+
+
 # -- serving plane: KV-pool sizing -------------------------------------------
 #
 # The serving engine's admission control is a BYTES question — how many

@@ -55,6 +55,7 @@ class PrecompileResult:
     aot: bool                      # an AOT executable was compiled
     cached: bool = False           # entry already existed (cache hit)
     error: Optional[str] = None
+    bucket: int = 0                # seq-len bucket (0 = unbucketed)
 
 
 class PrecompileHandle:
@@ -84,12 +85,15 @@ class PrecompileHandle:
 def _precompile_one(model, opt, strategy: Strategy, *, devices, attn_impl,
                     donate, policy: Optional[Policy], policy_key,
                     batch_shape, batch_keys,
-                    cache: StepCache) -> PrecompileResult:
+                    cache: StepCache, bucket: int = 0) -> PrecompileResult:
     from hetu_tpu import telemetry
     t0 = time.perf_counter()
+    # EVERY key-bearing field must be forwarded here (the shape-plane
+    # lint asserts it): a field the enumeration drops would silently
+    # compile into the wrong entry and the runtime would re-trace
     key = cache.key_for(model, opt, strategy, attn_impl=attn_impl,
                         donate=donate, policy_key=policy_key,
-                        devices=devices)
+                        devices=devices, bucket=bucket)
     with telemetry.span("precompile", strategy=strategy.to_json()) as sp:
         existed = cache.lookup(key) is not None
 
@@ -128,7 +132,7 @@ def _precompile_one(model, opt, strategy: Strategy, *, devices, attn_impl,
                     "strategies compiled ahead of time").inc()
     return PrecompileResult(strategy, ok=True,
                             seconds=time.perf_counter() - t0,
-                            aot=did_aot, cached=existed)
+                            aot=did_aot, cached=existed, bucket=bucket)
 
 
 class _nullctx:
@@ -143,6 +147,8 @@ def precompile_strategies(model, opt, strategies: Iterable[Strategy], *,
                           batch_shape: Optional[tuple] = None,
                           batch_keys: Sequence[str] = ("input_ids",
                                                        "labels"),
+                          buckets: Optional[Sequence[int]] = None,
+                          bucket_rows: Optional[dict] = None,
                           devices=None, attn_impl: str = "auto",
                           donate: bool = True,
                           policy: Optional[Policy] = None,
@@ -156,6 +162,15 @@ def precompile_strategies(model, opt, strategies: Iterable[Strategy], *,
     (``lower().compile()``) so the first post-switch step dispatches a
     ready executable. Without it only the plan + jitted step are built
     (the first step after a switch still traces once).
+
+    ``buckets`` — the seq-len bucket ladder of a shape-plane run
+    (``TrainerConfig(seq_buckets=...)``): candidates become the full
+    (strategy x bucket) product, each keyed with its bucket in the
+    StepCache (``key_for(bucket=)``) and AOT-compiled at
+    ``(rows, bucket)`` where ``rows`` comes from ``bucket_rows[bucket]``
+    (falling back to ``batch_shape[0]``). Without it the bucket ladder's
+    variants would silently miss AOT coverage and the first step at each
+    new bucket would trace on the critical path.
 
     ``batch_keys`` must name EXACTLY the keys the real (post
     ``shard_batch``) batches carry — the AOT executable is selected by
@@ -171,18 +186,31 @@ def precompile_strategies(model, opt, strategies: Iterable[Strategy], *,
     cache = cache if cache is not None else get_step_cache()
     strategies = list(strategies)
     handle = PrecompileHandle()
+    rows0 = batch_shape[0] if batch_shape is not None else None
+    if buckets is not None:
+        cands = [(s, int(L)) for s in strategies
+                 for L in sorted(set(int(b) for b in buckets))]
+    else:
+        cands = [(s, 0) for s in strategies]
+
+    def _shape_for(bucket: int) -> Optional[tuple]:
+        if bucket == 0:
+            return batch_shape
+        rows = (bucket_rows or {}).get(bucket, rows0)
+        return None if rows is None else (int(rows), bucket)
 
     def work():
-        for s in strategies:
+        for s, bkt in cands:
             try:
                 res = _precompile_one(
                     model, opt, s, devices=devices, attn_impl=attn_impl,
                     donate=donate, policy=policy, policy_key=policy_key,
-                    batch_shape=batch_shape, batch_keys=batch_keys,
-                    cache=cache)
+                    batch_shape=_shape_for(bkt), batch_keys=batch_keys,
+                    cache=cache, bucket=bkt)
             except Exception as e:   # noqa: BLE001 — per-candidate
                 res = PrecompileResult(s, ok=False, seconds=0.0,
-                                       aot=False, error=str(e)[:500])
+                                       aot=False, error=str(e)[:500],
+                                       bucket=bkt)
             handle._results.append(res)
         handle._done.set()
 

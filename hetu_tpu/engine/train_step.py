@@ -317,11 +317,19 @@ class StepCache:
     @staticmethod
     def key_for(model, opt, strategy, *, attn_impl: str = "auto",
                 donate: bool = True, policy_key: str = "",
-                devices=None) -> tuple:
+                devices=None, bucket: int = 0) -> tuple:
+        """``bucket``: the seq-len bucket this entry serves (0 = the
+        unbucketed entry). Bucketed training (``TrainerConfig(
+        seq_buckets=...)``) keeps one CachedStep per (strategy, bucket)
+        so each entry's jit/AOT caches hold exactly one shape and the
+        AOT pre-compiler (``engine.precompile``) can enumerate bucketed
+        variants addressably — every key-bearing field here must
+        round-trip through its candidate enumeration (quick-tier lint
+        in tests/test_shape_plane.py)."""
         dev_key = None if devices is None else \
             tuple(getattr(d, "id", d) for d in devices)
         return (id(model), id(opt), strategy, attn_impl, donate,
-                policy_key, dev_key)
+                policy_key, dev_key, int(bucket))
 
     def _count(self, hit: bool) -> None:
         from hetu_tpu import telemetry

@@ -113,6 +113,21 @@ class TrainerConfig:
                                  # counted (slo_alerts_total) and
                                  # written to telemetry.jsonl as
                                  # kind=slo_alert records
+    seq_buckets: Optional[tuple] = None
+                                 # seq-len bucket ladder (shape plane,
+                                 # docs/PERFORMANCE.md): each host batch
+                                 # is snapped to the smallest bucket >=
+                                 # its max REAL length
+                                 # (data.bucket.ShapeBucketer) and
+                                 # routed through a per-(strategy,
+                                 # bucket) StepCache entry — a ragged
+                                 # epoch compiles at most len(buckets)
+                                 # step programs instead of one per
+                                 # distinct width, and pad FLOPs drop
+                                 # from pad-to-max to pad-to-bucket
+                                 # (counters data_padding_tokens_total /
+                                 # data_bucket_hits_total). None = off
+                                 # (exact historical behavior).
 
     def policy(self) -> Policy:
         return BF16_COMPUTE if self.precision == "bf16" else FP32
@@ -185,14 +200,24 @@ class Trainer:
             else get_step_cache()
         # kept as an alias: tests / callers may inspect the pool size
         self._plan_cache = self.cache
+        # shape plane: bucketed steps (config.seq_buckets) — host batches
+        # are snapped to the ladder and each bucket gets its own
+        # StepCache entry (cleared on strategy change)
+        self.bucketer = None
+        if self.config.seq_buckets:
+            from hetu_tpu.data.bucket import SeqLenBuckets, ShapeBucketer
+            self.bucketer = ShapeBucketer(
+                SeqLenBuckets(sizes=self.config.seq_buckets))
+        self._bucket_entries: dict = {}
         self.set_strategy(strategy)
 
     # -- strategy / hot switching ------------------------------------------
-    def _cache_key(self, strategy):
+    def _cache_key(self, strategy, bucket: int = 0):
         return self.cache.key_for(
             self.model, self.opt, strategy,
             attn_impl=self.config.attn_impl, donate=True,
-            policy_key=self.config.precision, devices=self.devices)
+            policy_key=self.config.precision, devices=self.devices,
+            bucket=bucket)
 
     def set_strategy(self, strategy):
         """Compile the plan for ``strategy`` (a :class:`Strategy` or a
@@ -270,6 +295,7 @@ class Trainer:
         self.plan = entry.plan
         self._step_fn = entry
         self._eval_fn = entry.eval_fn  # None under hetero: switch back
+        self._bucket_entries.clear()   # per-(strategy, bucket) entries
         if self._live_prefetcher is not None:
             # a mid-run switch re-points the input pipeline: batches
             # staged under the old plan are re-placed lazily on fetch
@@ -278,6 +304,7 @@ class Trainer:
 
     def precompile(self, strategies, *, batch_shape=None,
                    batch_keys=("input_ids", "labels"),
+                   buckets=None, bucket_rows=None,
                    block: bool = False):
         """Warm the step cache for candidate ``strategies`` (e.g. the
         Galvatron search's top-k) on a background thread — see
@@ -285,11 +312,17 @@ class Trainer:
         ``batch_shape`` each candidate is AOT-compiled for it, making a
         later ``set_strategy`` + first step completely compile-free;
         ``batch_keys`` must match the run's real batch dict (packed
-        loaders carry positions + segment_ids)."""
+        loaders carry positions + segment_ids). ``buckets`` defaults to
+        this Trainer's ``config.seq_buckets`` ladder so a bucketed run's
+        AOT coverage automatically spans every (strategy, bucket)
+        variant."""
         from hetu_tpu.engine.precompile import precompile_strategies
+        if buckets is None and self.config.seq_buckets:
+            buckets = self.config.seq_buckets
         handle = precompile_strategies(
             self.model, self.opt, strategies, batch_shape=batch_shape,
-            batch_keys=batch_keys,
+            batch_keys=batch_keys, buckets=buckets,
+            bucket_rows=bucket_rows,
             devices=self.devices, attn_impl=self.config.attn_impl,
             policy=self.config.policy(),
             policy_key=self.config.precision, cache=self.cache,
@@ -297,6 +330,53 @@ class Trainer:
         if block:
             handle.wait()
         return handle
+
+    # -- shape plane (bucketed steps) --------------------------------------
+    def _bucket_entry(self, bucket: int) -> CachedStep:
+        """CachedStep for (current strategy, ``bucket``) — one entry per
+        bucket so each holds exactly one shape in its jit/AOT caches and
+        the ragged-epoch compile count is bounded by the ladder size."""
+        entry = self._bucket_entries.get(bucket)
+        if entry is not None:
+            return entry
+        strategy = self.strategy
+        key = self._cache_key(strategy, bucket=bucket)
+        first_build = self.cache.lookup(key) is None
+
+        def build() -> CachedStep:
+            t0 = time.perf_counter()
+            with telemetry.span("compile", bucket=bucket,
+                                strategy=strategy.to_json()), \
+                    autocast(self.config.policy()):
+                e = compile_strategy(
+                    self.model, self.opt, strategy,
+                    devices=self.devices,
+                    attn_impl=self.config.attn_impl)
+            dt = time.perf_counter() - t0
+            self._note("compile", dt)
+            self.flight.record("compile", bucket=bucket,
+                               seconds=round(dt, 3))
+            return e
+
+        entry = self.cache.get_or_build(key, build) \
+            if self.config.step_cache else build()
+        if first_build and telemetry.enabled():
+            self.registry.counter(
+                "data_bucket_compiles_total",
+                "step entries built per seq-len bucket (the re-trace "
+                "audit's per-bucket view)").inc(bucket=str(bucket))
+        self._bucket_entries[bucket] = entry
+        return entry
+
+    def _step_entry_for(self, sbatch: dict) -> CachedStep:
+        """Pick the step entry for an (already fitted, already sharded)
+        batch: the per-bucket entry when bucketing is on and the batch
+        carries a seq dim, else the strategy's base entry. Hetero plans
+        keep the base entry (the hetero executor owns its own shapes)."""
+        if self.bucketer is None or self._eval_fn is None \
+                or "input_ids" not in sbatch:
+            return self._step_fn
+        return self._bucket_entry(int(sbatch["input_ids"].shape[1]))
 
     def _note(self, category: str, seconds: float) -> None:
         """Goodput ledger + cumulative counter for an overhead event."""
@@ -402,8 +482,11 @@ class Trainer:
     def train_step(self, batch: dict) -> dict:
         if self.state is None:
             self.initialize()
+        if self.bucketer is not None and self._eval_fn is not None:
+            batch = self.bucketer.fit(batch)
         sbatch = self.plan.shard_batch(batch)
-        self.state, metrics = self._step_fn(self.state, sbatch)
+        self.state, metrics = self._step_entry_for(sbatch)(self.state,
+                                                           sbatch)
         return metrics
 
     def train(self, batches: Iterable[dict],
@@ -436,6 +519,12 @@ class Trainer:
         t_last = time.perf_counter()
         tokens_since = 0
         tokens_total = 0
+        # MFU pricing under varying widths (bucketed ragged epochs): the
+        # attention FLOPs/token depend on seq width, so the accountant's
+        # single flops_per_token is kept as the running FLOPS-WEIGHTED
+        # mean — tokens_total * flops_per_token stays exact per batch
+        fpt_by_width: dict[int, Optional[float]] = {}
+        flops_sum = 0.0
         slo_blocked_s = 0.0   # eval/checkpoint time inside the current
                               # log interval — excluded from the SLO
                               # step-time observation
@@ -449,6 +538,13 @@ class Trainer:
                 min_timeout_s=self.config.watchdog_min_timeout_s,
                 dump_dir=self.config.trace_dir or ".",
                 registry=self.registry).start()
+        if self.bucketer is not None and self._eval_fn is not None:
+            # snap every host batch to its bucket BEFORE placement: the
+            # prefetcher stages the fitted (bucket-wide) arrays, so the
+            # step entry picked at dispatch time sees exactly one shape
+            # per bucket
+            fit = self.bucketer.fit
+            batches = (fit(b) for b in batches)
         prefetcher = None
         if self.config.prefetch > 0:
             from hetu_tpu.data.prefetch import DevicePrefetcher
@@ -473,11 +569,13 @@ class Trainer:
                 # waiting on the data path is a stall (the prefetcher
                 # additionally emits a "stall" span + counter itself)
                 acct.record("stall", t_fetch - t_iter)
-                if acct.flops_per_token is None and "input_ids" in sbatch:
-                    acct.flops_per_token = self._flops_per_token(
-                        int(sbatch["input_ids"].shape[-1]))
+                width = int(sbatch["input_ids"].shape[-1]) \
+                    if "input_ids" in sbatch else None
+                if width is not None and width not in fpt_by_width:
+                    fpt_by_width[width] = self._flops_per_token(width)
                 n_traces = trace_total()
-                self.state, metrics = self._step_fn(self.state, sbatch)
+                self.state, metrics = self._step_entry_for(sbatch)(
+                    self.state, sbatch)
                 host_step += 1
                 acct.add_step()
                 # step boundary into the black box; one beat per
@@ -489,6 +587,11 @@ class Trainer:
                 tokens_since += ntok
                 tokens_total += ntok
                 acct.add_tokens(ntok)
+                fpt = fpt_by_width.get(width) if width is not None \
+                    else None
+                if fpt:
+                    flops_sum += fpt * ntok
+                    acct.flops_per_token = flops_sum / tokens_total
                 if self.config.log_every and \
                         host_step % self.config.log_every == 0:
                     loss = float(jax.device_get(metrics["loss"]))
@@ -625,6 +728,10 @@ class Trainer:
         acct = GoodputAccountant(peak_flops=self.config.peak_flops)
         self.goodput = acct   # set_strategy switches/compiles feed it
         host_step = int(jax.device_get(self.state.step))
+        # per-bucket FLOP pricing, same running weighted mean as train()
+        fpt_by_width: dict[int, Optional[float]] = {}
+        flops_sum = 0.0
+        tokens_sum = 0
         try:
             for _ in range(epochs):
                 for batch, plan in dispatcher.batches(seqs):
@@ -632,15 +739,20 @@ class Trainer:
                             and plan.strategy != self.strategy:
                         self.set_strategy(plan.strategy)
                     t0 = time.perf_counter()
-                    if acct.flops_per_token is None \
-                            and "input_ids" in batch:
-                        acct.flops_per_token = self._flops_per_token(
-                            int(batch["input_ids"].shape[-1]))
+                    width = int(batch["input_ids"].shape[-1])
+                    if width not in fpt_by_width:
+                        fpt_by_width[width] = self._flops_per_token(
+                            width)
                     n_traces = trace_total()
                     metrics = self.train_step(batch)
                     host_step += 1   # host-side: no per-step device sync
                     acct.add_step()
-                    acct.add_tokens(int(batch["input_ids"].size))
+                    ntok = int(batch["input_ids"].size)
+                    acct.add_tokens(ntok)
+                    tokens_sum += ntok
+                    if fpt_by_width.get(width):
+                        flops_sum += fpt_by_width[width] * ntok
+                        acct.flops_per_token = flops_sum / tokens_sum
                     if self.config.log_every and \
                             host_step % self.config.log_every == 0:
                         extra = {"strategy": plan.strategy.to_json()} \

@@ -16,6 +16,36 @@ import jax
 import jax.numpy as jnp
 
 
+class PromptTooLongError(ValueError):
+    """A prompt (plus its decode budget) exceeds a hard length limit.
+
+    Structured so callers (the serving scheduler's admission gate,
+    :func:`generate`) can report WHICH limit was hit and what would
+    lift it, instead of a bare refusal: ``prompt_len`` + ``max_tokens``
+    against ``limit`` (the per-slot / cache budget) and — where a
+    serving CP-prefill lane exists — ``cp_limit`` (its larger budget).
+    """
+
+    def __init__(self, *, prompt_len: int, max_tokens: int, limit: int,
+                 cp_limit: Optional[int] = None, source: str = "decode",
+                 hint: Optional[str] = None):
+        self.prompt_len = int(prompt_len)
+        self.max_tokens = int(max_tokens)
+        self.limit = int(limit)
+        self.cp_limit = int(cp_limit) if cp_limit is not None else None
+        self.source = source
+        worst = self.prompt_len + self.max_tokens
+        msg = (f"prompt of {self.prompt_len} tokens + {self.max_tokens} "
+               f"decode tokens = {worst} exceeds the {self.limit}-token "
+               f"{source} budget")
+        if self.cp_limit is not None:
+            msg += (f" and the {self.cp_limit}-token CP-prefill lane "
+                    f"budget")
+        if hint:
+            msg += f" ({hint})"
+        super().__init__(msg)
+
+
 def _head_weight(model, params):
     if hasattr(model, "_head_weight"):
         return model._head_weight(params)
@@ -111,6 +141,23 @@ def generate(model, params, input_ids, *, max_new_tokens: int,
     """
     b, s = input_ids.shape
     total = max_len or (s + max_new_tokens)
+    # fail with a structured error instead of the cryptic downstream
+    # gather/embed failure: either the caller's own cache budget
+    # (max_len) or the model's positional capacity bounds the request
+    if s + max_new_tokens > total:
+        raise PromptTooLongError(
+            prompt_len=s, max_tokens=max_new_tokens, limit=total,
+            source="generate KV-cache (max_len)",
+            hint="raise max_len or trim the prompt")
+    max_positions = getattr(getattr(model, "cfg", None),
+                            "max_positions", None)
+    if max_positions is not None and total > max_positions:
+        raise PromptTooLongError(
+            prompt_len=s, max_tokens=max_new_tokens,
+            limit=int(max_positions),
+            source="model max_positions",
+            hint="the model cannot address positions past its trained "
+                 "context window")
     caches = init_kv_caches(model, b, total, cache_dtype)
     rng = rng if rng is not None else jax.random.key(0)
     ragged = prompt_lens is not None

@@ -106,7 +106,8 @@ class GPTBlock(Module):
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
-                 block_tables=None, dropout_key=None):
+                 block_tables=None, dropout_key=None,
+                 return_kv=False):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
                                      self.ln_1(params["ln_1"], x),
@@ -137,15 +138,23 @@ class GPTBlock(Module):
             # reproduce their pre-attn-dropout mask streams across resume
             k1, k2 = jax.random.split(dropout_key)
         a = self.attn(params["attn"], self.ln_1(params["ln_1"], x),
+                      positions=positions,
                       segment_ids=segment_ids, attn_impl=attn_impl,
-                      dropout_rate=self.attn_pdrop, dropout_key=ka)
+                      dropout_rate=self.attn_pdrop, dropout_key=ka,
+                      return_kv=return_kv)
+        kv = None
+        if return_kv:
+            a, kv = a
         x = x + dropout(a, self.resid_pdrop, k1)
         h = self.mlp(params["mlp"], self.ln_2(params["ln_2"], x))
         if self.returns_aux:
             h, aux = h
-            return act_constrain(
-                x + dropout(h, self.resid_pdrop, k2), "tokens"), aux
-        return act_constrain(x + dropout(h, self.resid_pdrop, k2), "tokens")
+            out = (act_constrain(
+                x + dropout(h, self.resid_pdrop, k2), "tokens"), aux)
+        else:
+            out = act_constrain(x + dropout(h, self.resid_pdrop, k2),
+                                "tokens")
+        return (out, kv) if return_kv else out
 
 
 class GPTLMHeadModel(Module):

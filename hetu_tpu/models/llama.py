@@ -100,7 +100,8 @@ class LlamaBlock(Module):
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
-                 block_tables=None, dropout_key=None):
+                 block_tables=None, dropout_key=None,
+                 return_kv=False):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
                                      self.input_norm(
@@ -129,16 +130,22 @@ class LlamaBlock(Module):
                       self.input_norm(params["input_norm"], x),
                       positions=positions, segment_ids=segment_ids,
                       attn_impl=attn_impl,
-                      dropout_rate=self.attn_pdrop, dropout_key=ka)
+                      dropout_rate=self.attn_pdrop, dropout_key=ka,
+                      return_kv=return_kv)
+        kv = None
+        if return_kv:
+            a, kv = a
         x = x + dropout(a, self.resid_pdrop, k1)
         h = self.mlp(params["mlp"],
                      self.post_attn_norm(params["post_attn_norm"], x))
         if self.returns_aux:
             h, aux = h
-            return act_constrain(
-                x + dropout(h, self.resid_pdrop, k2), "tokens"), aux
-        return act_constrain(x + dropout(h, self.resid_pdrop, k2),
-                             "tokens")
+            out = (act_constrain(
+                x + dropout(h, self.resid_pdrop, k2), "tokens"), aux)
+        else:
+            out = act_constrain(x + dropout(h, self.resid_pdrop, k2),
+                                "tokens")
+        return (out, kv) if return_kv else out
 
 
 class LlamaLMHeadModel(Module):

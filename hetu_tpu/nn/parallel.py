@@ -295,8 +295,20 @@ class ParallelAttention(Module):
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl: str = "auto", kv_cache=None, slot_mask=None,
                  block_tables=None,
-                 dropout_rate: float = 0.0, dropout_key=None):
+                 dropout_rate: float = 0.0, dropout_key=None,
+                 return_kv: bool = False):
+        """``return_kv=True`` (train path only) additionally returns the
+        rotary-applied per-head ``(k, v)`` of this call — the exact
+        values the decode path would have written to a KV cache — as
+        ``(out, (k, v))``. The serving CP-prefill lane uses this to run
+        a long prompt through the TRAINING forward (ring/ulysses over
+        the cp axis) and scatter the resulting KV into the paged arena
+        (``StackedBlocks.prefill``)."""
         if kv_cache is not None:
+            if return_kv:
+                raise ValueError(
+                    "return_kv applies to the training forward only "
+                    "(decode already threads its cache)")
             return self._decode(params, x, kv_cache, positions=positions,
                                 slot_mask=slot_mask,
                                 block_tables=block_tables)
@@ -366,7 +378,10 @@ class ParallelAttention(Module):
                                   dropout_key=dropout_key)
         out = act_constrain(out, "heads")
         out = out.reshape(b, s, self.num_heads * self.head_dim)
-        return self.out_proj(params["out_proj"], out)
+        out = self.out_proj(params["out_proj"], out)
+        if return_kv:
+            return out, (k, v)
+        return out
 
     def _decode(self, params, x, kv_cache, *, positions=None,
                 slot_mask=None, block_tables=None):
@@ -794,3 +809,27 @@ class StackedBlocks(Module):
 
         x, new_caches = jax.lax.scan(body, x, (params, caches))
         return x, new_caches
+
+    def prefill(self, params, x, *, positions=None, segment_ids=None,
+                attn_impl: str = "auto"):
+        """Training-mode forward that ALSO returns every layer's
+        rotary-applied ``(k, v)``: ``(h, (k, v))`` with k/v shaped
+        ``(layers, b, s, hkv, d)``.
+
+        The serving CP-prefill lane's core: a long prompt runs through
+        the SAME attention path training uses — under a cp-sharded
+        activation context that means ring/ulysses attention over the
+        mesh's cp axis — and the stacked KV is what the caller scatters
+        into the paged serving arena. Inference-only by construction
+        (no dropout, no remat; MoE aux losses are discarded)."""
+        def body(h, layer_params):
+            out = self._block(layer_params, h, positions=positions,
+                              segment_ids=segment_ids,
+                              attn_impl=attn_impl, return_kv=True)
+            out, kv = out
+            if self._block.returns_aux:
+                out, _ = out
+            return out, kv
+
+        x, kvs = jax.lax.scan(body, x, params)
+        return x, kvs

@@ -32,13 +32,15 @@ from hetu_tpu.serving.router import (
     ReplicaHandle, Router, RouterRequest, WeightPublisher,
     materialize_params,
 )
-from hetu_tpu.serving.scheduler import Request, SamplingParams, Scheduler
+from hetu_tpu.serving.scheduler import (
+    PromptTooLongError, Request, SamplingParams, Scheduler,
+)
 
 __all__ = [
     "ServingEngine", "sample_slots",
     "KVPool", "BlockManager", "NULL_BLOCK", "cache_dtype_name",
     "PrefixCache",
-    "Request", "SamplingParams", "Scheduler",
+    "Request", "SamplingParams", "Scheduler", "PromptTooLongError",
     "Router", "RouterRequest", "ReplicaHandle", "WeightPublisher",
     "materialize_params",
 ]

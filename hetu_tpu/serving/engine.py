@@ -24,7 +24,18 @@ turn a decode loop into a serving engine, mapped onto TPU idioms:
   (cu_seqlens-style per-token slot/position operands), so a burst of
   arrivals shares each iteration's prefill bandwidth instead of
   serializing one admission per iteration — TTFT p99 stops growing
-  linearly with queue depth.
+  linearly with queue depth;
+- **CP-sharded long-prompt prefill** (``long_max_len=``, the shape
+  plane's serving half): prompts whose worst case exceeds one slot's
+  ``max_len`` budget stop being rejected — they admit into a
+  wide-block-table slot and prefill as ONE training-mode forward
+  (ring/ulysses over the plan's cp axis when ``cp > 1``,
+  ``StackedBlocks.prefill``) whose per-layer KV scatters straight into
+  the paged arena; decode then rides the normal fused step. Lane
+  prompt lengths snap to a geometric bucket ladder, so the lane owns
+  at most ``n_buckets`` executables
+  (``record_trace("serving_cp_prefill")``) while the fused step keeps
+  its single compile.
 
 The fused step is jitted once: CoW block copies, the all-slot decode
 (per-row KV writes + per-row causal offsets —
@@ -113,6 +124,7 @@ class ServingEngine:
                  block_size: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
+                 long_max_len: Optional[int] = None,
                  plan=None, seed: int = 0,
                  counter_sample_every: int = 32,
                  watchdog: bool = False, watchdog_factor: float = 8.0,
@@ -123,6 +135,39 @@ class ServingEngine:
             # default paging: 16-token blocks when they divide max_len,
             # else one block per slot (degenerate = PR 5 slot arena)
             block_size = 16 if max_len % 16 == 0 else max_len
+        # CP-prefill lane (long_max_len): prompts whose worst case
+        # exceeds one slot's max_len budget stop being rejected — they
+        # admit into a wide-table slot and their prefill runs as ONE
+        # training-mode forward (ring/ulysses over the plan's cp axis
+        # when cp > 1) whose per-layer KV scatters straight into the
+        # paged arena; decode then proceeds in the normal fused step.
+        # The lane's prompt lengths snap to a small geometric bucket
+        # ladder so its executable count is bounded (the
+        # record_trace("serving_cp_prefill") audit: <= n lane buckets).
+        self._cp = plan.strategy.cp if plan is not None else 1
+        self._cp_zigzag = (
+            plan is not None and self._cp > 1
+            and plan.strategy.effective_cp_layout == "zigzag")
+        self._cp_buckets = None
+        if long_max_len is not None:
+            long_max_len = int(long_max_len)
+            mult = (2 * self._cp) if self._cp_zigzag \
+                else max(self._cp, 1)
+            if long_max_len % mult != 0:
+                raise ValueError(
+                    f"long_max_len {long_max_len} must be a multiple "
+                    f"of {mult} (cp sharding alignment: cp={self._cp}, "
+                    f"{'zigzag' if self._cp_zigzag else 'contiguous'})")
+            from hetu_tpu.data.bucket import SeqLenBuckets
+            start = -(-int(max_len) // mult) * mult
+            sizes = []
+            v = max(start, mult)
+            while v < long_max_len:
+                sizes.append(v)
+                v *= 2
+            sizes.append(long_max_len)
+            self._cp_buckets = SeqLenBuckets(sizes=sizes,
+                                             multiple_of=mult)
         if slots is None:
             if hbm_budget_bytes is None:
                 raise ValueError("pass slots= or hbm_budget_bytes=")
@@ -135,7 +180,24 @@ class ServingEngine:
             self.pool = KVPool.sized_for(
                 model, hbm_budget_bytes=hbm_budget_bytes,
                 max_len=max_len, cache_dtype=cache_dtype, tp=tp,
-                block_size=block_size)
+                block_size=block_size, table_len=long_max_len)
+            if long_max_len is not None:
+                # admission-gate honesty: the lane's one-pass prefill
+                # carries real activation bytes the slot arithmetic
+                # never priced — the ledger must confirm they fit in
+                # the budget's headroom next to the arena
+                from hetu_tpu.engine.memory import cp_prefill_act_bytes
+                act = cp_prefill_act_bytes(model.cfg,
+                                           seq_len=long_max_len,
+                                           cp=self._cp)
+                if act > 0.1 * hbm_budget_bytes:
+                    raise ValueError(
+                        f"CP-prefill activations at long_max_len="
+                        f"{long_max_len} need ~{act / 1e9:.2f}GB — more "
+                        f"than the {0.1 * hbm_budget_bytes / 1e9:.2f}GB "
+                        f"headroom the {hbm_budget_bytes / 1e9:.2f}GB "
+                        f"budget leaves next to the KV arena; raise cp, "
+                        f"shrink long_max_len, or raise the budget")
         else:
             # kv_blocks decouples CONCURRENCY from worst-case memory:
             # slots is how many requests decode in parallel (cheap —
@@ -147,7 +209,8 @@ class ServingEngine:
             # held S worst-case slots run more than S live requests —
             # admission's free-block gate keeps it sound.
             self.pool = KVPool(model, slots, max_len, cache_dtype,
-                               block_size=block_size, n_blocks=kv_blocks)
+                               block_size=block_size, n_blocks=kv_blocks,
+                               table_len=long_max_len)
         self.model = model
         self.params = params
         #: weight generation currently loaded — bumped by
@@ -163,12 +226,13 @@ class ServingEngine:
         self.scheduler = Scheduler(
             self.pool.slots, self.pool.max_len, blocks=self.blocks,
             prefix_cache=self.prefix_cache,
-            block_size=self.pool.block_size)
+            block_size=self.pool.block_size,
+            long_max_len=long_max_len)
         self._plan = plan
         self._counter_sample_every = counter_sample_every
 
         S = self.pool.slots
-        W = self.pool.blocks_per_slot
+        W = self.pool.table_width
         self._pos = np.zeros(S, np.int32)        # next KV write index
         self._last_tok = np.zeros(S, np.int32)   # sampled, not yet fed
         self._active = np.zeros(S, bool)         # decoding slots
@@ -186,6 +250,8 @@ class ServingEngine:
         self._ctl_dirty = True
         self._slot_req: list[Optional[Request]] = [None] * S
         self._prefilling: list[dict] = []        # FCFS in-flight prefills
+        self._cp_pending: list[dict] = []        # admitted CP-lane reqs
+        self._cp_seed = 0                        # lane sampling stream
         #: max requests that can FINISH prefill in one iteration (each
         #: needs >= 1 pack token) — the prefill lane's head/sample width
         self._fin_cap = max(1, min(S, self.prefill_chunk))
@@ -218,6 +284,8 @@ class ServingEngine:
         self._slo_every_s = float(slo_every_s)
         self._slo_last_eval = 0.0
         self._fn = self._build_step()
+        self._cp_fn = self._build_cp_prefill() \
+            if self._cp_buckets is not None else None
 
     # -- the jit-once fused step --------------------------------------------
     def _build_step(self):
@@ -311,6 +379,144 @@ class ServingEngine:
 
         return jax.jit(step, donate_argnums=(1,))
 
+    # -- the CP-prefill lane ------------------------------------------------
+    def _build_cp_prefill(self):
+        """jit of the long-prompt one-pass prefill: a TRAINING-mode
+        forward (so attention routes through ring/ulysses when the
+        plan's cp axis is live) whose per-layer rotary-applied KV
+        (``StackedBlocks.prefill``) scatters into the paged arena
+        through the slot's wide block table, plus the first sampled
+        token from the prompt's last real row.
+
+        Prompt length is a BUCKETED shape (``self._cp_buckets``); the
+        real length ``fin_pos + 1`` is data, so one executable per lane
+        bucket serves any prompt in it —
+        ``record_trace("serving_cp_prefill")`` audits exactly that.
+        """
+        model = self.model
+        n_blk, blk = self.pool.n_blocks, self.pool.block_size
+        quant = self.pool.quantized
+
+        def cp_prefill(params, caches, tokens, positions, table,
+                       fin_pos, temp, topk, topp, key):
+            record_trace("serving_cp_prefill")   # <= n lane buckets
+            h = model.embed(params, tokens, positions=positions)
+            h, (ks, vs) = model.blocks.prefill(params["blocks"], h,
+                                               positions=positions)
+            # scatter each layer's (L,) prompt rows into the arena at
+            # the rows the slot's table maps; pad rows (beyond the real
+            # prompt) target n_blk*blk and drop. Zigzag cp layouts feed
+            # PERMUTED rows — positions ride along, so every row still
+            # lands at its own absolute index.
+            pos = positions[0]
+            blk_ids = jnp.take(table[0], pos // blk)
+            rows = jnp.where(pos <= fin_pos,
+                             blk_ids * blk + pos % blk, n_blk * blk)
+
+            def scat(buf, new):
+                flat = buf.reshape((buf.shape[0], n_blk * blk)
+                                   + buf.shape[3:])
+                flat = flat.at[:, rows].set(new.astype(buf.dtype),
+                                            mode="drop")
+                return flat.reshape(buf.shape)
+
+            k_new, v_new = ks[:, 0], vs[:, 0]    # (layers, L, hkv, d)
+            if quant:
+                from hetu_tpu.ops.quantization import quantize_int8
+                kq, ksc = quantize_int8(k_new, axis=-1)
+                vq, vsc = quantize_int8(v_new, axis=-1)
+                caches = (scat(caches[0], kq), scat(caches[1], ksc),
+                          scat(caches[2], vq), scat(caches[3], vsc))
+            else:
+                caches = (scat(caches[0], k_new),
+                          scat(caches[1], v_new))
+            # first token: head only on the last REAL row (found by
+            # position match — layout-permutation proof)
+            fin_row = jnp.argmax(pos == fin_pos)
+            hf = model.hidden_norm(params, h[:, fin_row][:, None])
+            w = generation._head_weight(model, params)
+            lg = jnp.einsum("bse,ve->bsv", hf.astype(jnp.float32),
+                            w.astype(jnp.float32))[:, 0]
+            tok = sample_slots(lg, temp, topk, topp, key)
+            return caches, tok[0]
+
+        return jax.jit(cp_prefill, donate_argnums=(1,))
+
+    def _prep_cp_prefill_locked(self) -> Optional[dict]:
+        """Pop ONE pending CP-lane request and build its host operands
+        (caller holds ``self._lock``). One per engine iteration: a
+        burst of long prompts interleaves with decode iterations
+        instead of starving every active slot back-to-back — the lane's
+        analogue of the packed lane's per-iteration chunk budget."""
+        if not self._cp_pending:
+            return None
+        ent = self._cp_pending.pop(0)
+        req, slot = ent["req"], ent["slot"]
+        P = len(req.prompt)
+        L = self._cp_buckets.bucket_for(P)
+        tokens = np.zeros((1, L), np.int32)
+        tokens[0, :P] = req.prompt
+        positions = np.arange(L, dtype=np.int32)[None, :]
+        if self._cp_zigzag:
+            from hetu_tpu.data.packing import zigzag_permute
+            tokens = zigzag_permute(tokens, self._cp, axis=1)
+            positions = zigzag_permute(positions, self._cp, axis=1)
+        self._cp_seed += 1
+        return {"req": req, "slot": slot, "P": P, "bucket": L,
+                "tokens": tokens, "positions": positions,
+                "table": self._bt[slot:slot + 1].copy(),
+                "key": jax.random.fold_in(self._key,
+                                          0x7CF00000 + self._cp_seed)}
+
+    def _exec_cp_prefill(self, job: dict, t0: float, reg) -> None:
+        """Run one prepared CP-lane prefill. The device call happens
+        WITHOUT ``self._lock`` (submit()/load stay responsive through a
+        multi-second cold-bucket compile or a 100k-token forward; the
+        operands were snapshotted under the lock, and everything the
+        call touches — arena, params, tables — is only ever mutated by
+        ``_step_lock`` holders, which we are)."""
+        req, slot, P = job["req"], job["slot"], job["P"]
+        sp = req.sampling
+        ctx = self._plan.act if self._plan is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            caches, tok = self._cp_fn(
+                self.params, self.pool.caches, job["tokens"],
+                job["positions"], job["table"], np.int32(P - 1),
+                np.asarray([sp.temperature], np.float32),
+                np.asarray([sp.top_k], np.int32),
+                np.asarray([sp.top_p], np.float32), job["key"])
+        self.pool.caches = caches
+        now = time.monotonic()
+        with self._lock:
+            self._pos[slot] = P
+            self._active[slot] = True
+            self._ctl_dirty = True
+            req.status = "decode"
+            req.first_token_s = now
+            req.mark("prefill_chunk", dur_s=now - t0, ts_s=t0)
+            req.mark("first_token", ts_s=now)
+            ttft = now - req.submit_s
+            reg.histogram("serving_ttft_seconds",
+                          "time submit -> first token").observe(ttft)
+            if self.slo is not None:
+                self.slo.observe("serving_ttft_seconds", ttft)
+            reg.counter("serving_tokens_total",
+                        "serving tokens by kind").inc(P, kind="prompt")
+            reg.counter(
+                "serving_cp_prefill_requests_total",
+                "long prompts prefilled through the CP lane (one "
+                "cp-sharded pass instead of rejection)").inc()
+            reg.counter(
+                "serving_cp_prefill_tokens_total",
+                "prompt tokens prefilled through the CP lane").inc(P)
+            flight_record("serving_cp_prefill", req=req.id,
+                          trace=req.trace_id, slot=slot, tokens=P,
+                          bucket=job["bucket"])
+            # no prefix-cache insert: lane blocks stay private to the
+            # slot (long-prompt prefix sharing is future work)
+            self._on_token(slot, int(tok), now, reg)
+
     # -- submission ---------------------------------------------------------
     def submit(self, prompt: Sequence[int],
                sampling: Optional[SamplingParams] = None) -> Request:
@@ -344,7 +550,7 @@ class ServingEngine:
     def has_work(self) -> bool:
         with self._lock:
             return bool(self.scheduler.queue) or self._active.any() \
-                or bool(self._prefilling)
+                or bool(self._prefilling) or bool(self._cp_pending)
 
     @property
     def load(self) -> int:
@@ -354,7 +560,7 @@ class ServingEngine:
         ``serving_slot_occupancy`` gauges sample, as one number)."""
         with self._lock:
             return self.scheduler.depth + len(self._prefilling) \
-                + int(self._active.sum())
+                + len(self._cp_pending) + int(self._active.sum())
 
     # -- fleet lifecycle (router drain / live weight push) ------------------
     def cancel_queued(self, ids=None) -> list[Request]:
@@ -391,7 +597,7 @@ class ServingEngine:
         with self._step_lock:
             with self._lock:
                 if self.scheduler.queue or self._prefilling \
-                        or self._active.any():
+                        or self._cp_pending or self._active.any():
                     raise RuntimeError(
                         "swap_params on a busy engine — drain first "
                         "(cancel_queued + wait for has_work() to clear)"
@@ -446,8 +652,14 @@ class ServingEngine:
             self._bt[slot, :len(plan["table"])] = plan["table"]
             if plan["cow"] is not None:
                 cows.append(plan["cow"])
-            self._prefilling.append(
-                {"req": req, "slot": slot, "off": plan["first_uncached"]})
+            if req.cp_lane:
+                # beyond one slot's budget: one cp-sharded prefill pass
+                # instead of the packed chunk loop
+                self._cp_pending.append({"req": req, "slot": slot})
+            else:
+                self._prefilling.append(
+                    {"req": req, "slot": slot,
+                     "off": plan["first_uncached"]})
             self._ctl_dirty = True           # new sampling params + bt
             hit = req.cached_tokens
             if hit:
@@ -459,7 +671,7 @@ class ServingEngine:
                 len(req.prompt) - hit)
             flight_record("serving_admit", req=req.id,
                           trace=req.trace_id, slot=slot,
-                          cached_tokens=hit,
+                          cached_tokens=hit, cp_lane=req.cp_lane,
                           queued_s=round(now - req.submit_s, 4))
         ev = self.scheduler.evictions_total
         if ev > self._evictions_synced:
@@ -477,10 +689,21 @@ class ServingEngine:
         S = self.pool.slots
         with self._lock:
             cows = self._admit_locked(t0, reg)
+            # CP-lane prefills run as their own (bucket-audited)
+            # executables before the fused step — at most ONE per
+            # iteration, device call OUTSIDE the lock
+            cp_job = self._prep_cp_prefill_locked()
+        did_cp = False
+        if cp_job is not None:
+            self._exec_cp_prefill(cp_job, t0, reg)
+            did_cp = True
+        with self._lock:
             active_prev = np.nonzero(self._active)[0]
             if not self._prefilling and active_prev.size == 0 \
                     and not cows:
-                return False
+                if did_cp:
+                    self._record_gauges()
+                return did_cp
             if self._ctl_dirty:
                 self._ctl_dev = {"pos": jnp.asarray(self._pos),
                                  "last_tok": jnp.asarray(self._last_tok),

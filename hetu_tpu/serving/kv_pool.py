@@ -122,7 +122,8 @@ class KVPool:
 
     def __init__(self, model, slots: int, max_len: int,
                  cache_dtype=jnp.float32, block_size: Optional[int] = None,
-                 n_blocks: Optional[int] = None):
+                 n_blocks: Optional[int] = None,
+                 table_len: Optional[int] = None):
         max_positions = getattr(getattr(model, "cfg", None),
                                 "max_positions", None)
         if max_positions is not None and max_len > max_positions:
@@ -137,13 +138,32 @@ class KVPool:
                 f"max_len {max_len} must be a multiple of block_size "
                 f"{self.block_size} (block tables have a fixed "
                 f"max_len/block_size width)")
+        # table_len > max_len widens every slot's BLOCK TABLE (control
+        # ints, not arena bytes) so the CP-prefill lane can map requests
+        # beyond one slot's admission budget (ServingEngine long_max_len)
+        self.table_len = int(table_len) if table_len else self.max_len
+        if self.table_len < self.max_len \
+                or self.table_len % self.block_size != 0:
+            raise ValueError(
+                f"table_len {self.table_len} must be a multiple of "
+                f"block_size {self.block_size} and >= max_len "
+                f"{self.max_len}")
+        if max_positions is not None and self.table_len > max_positions:
+            raise ValueError(
+                f"table_len {self.table_len} exceeds the model's "
+                f"max_positions {max_positions}")
         self.blocks_per_slot = self.max_len // self.block_size
+        self.table_width = self.table_len // self.block_size
+        # default arena: slots worst-case NORMAL requests, plus (when a
+        # wide table enables the long lane) headroom for one worst-case
+        # LONG request beyond a slot's share
         self.n_blocks = int(n_blocks) if n_blocks else (
-            1 + self.slots * self.blocks_per_slot)
-        if self.n_blocks <= self.blocks_per_slot:
+            1 + self.slots * self.blocks_per_slot
+            + (self.table_width - self.blocks_per_slot))
+        if self.n_blocks <= self.table_width:
             raise ValueError(
                 f"n_blocks {self.n_blocks} cannot hold even one "
-                f"worst-case request ({self.blocks_per_slot} blocks "
+                f"worst-case request ({self.table_width} blocks "
                 f"+ the null block)")
         self.cache_dtype = cache_dtype
         #: weight generation whose forward wrote the arena's live
@@ -163,7 +183,8 @@ class KVPool:
     def sized_for(cls, model, *, hbm_budget_bytes: float, max_len: int,
                   cache_dtype=jnp.float32, tp: int = 1,
                   max_slots: Optional[int] = None,
-                  block_size: Optional[int] = None) -> "KVPool":
+                  block_size: Optional[int] = None,
+                  table_len: Optional[int] = None) -> "KVPool":
         """Build the largest pool the HBM budget allows (ledger-sized:
         whole worst-case slots, so admission can never strand a request
         that passed the budget gate)."""
@@ -175,8 +196,15 @@ class KVPool:
                              tp=tp)
         if max_slots is not None:
             slots = min(slots, max_slots)
+        # budget-derived arenas stay exactly budget-sized: a wide table
+        # (long lane) widens the control ints, never the arena bytes —
+        # the long request's blocks come out of the budgeted pool
+        eff_bs = int(block_size) if block_size else int(max_len)
+        n_blocks = (1 + slots * (int(max_len) // eff_bs)) \
+            if table_len else None
         return cls(model, slots, max_len, cache_dtype,
-                   block_size=block_size)
+                   block_size=block_size, table_len=table_len,
+                   n_blocks=n_blocks)
 
     @property
     def quantized(self) -> bool:

@@ -26,6 +26,8 @@ from typing import Optional
 
 import numpy as np
 
+from hetu_tpu.models.generation import PromptTooLongError
+
 
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
@@ -62,6 +64,13 @@ class Request:
     error: Optional[str] = None
     cached_tokens: int = 0             # prompt tokens served by the
     #                                    prefix cache (skipped prefill)
+    cp_lane: bool = False              # admitted into the CP-prefill
+    #                                    lane: worst case exceeds one
+    #                                    slot's budget but fits the
+    #                                    long_max_len lane — prefill
+    #                                    runs cp-sharded in one pass
+    #                                    instead of the packed chunk
+    #                                    loop (docs/SERVING.md)
     weight_version: int = 0            # weight generation the request
     #                                    was admitted (and decoded) under
     #                                    — swaps only land on drained
@@ -136,9 +145,21 @@ class Scheduler:
     """
 
     def __init__(self, slots: int, max_len: int, *, blocks=None,
-                 prefix_cache=None, block_size: Optional[int] = None):
+                 prefix_cache=None, block_size: Optional[int] = None,
+                 long_max_len: Optional[int] = None):
         self.slots = int(slots)
         self.max_len = int(max_len)
+        #: CP-prefill lane budget: requests whose worst case exceeds
+        #: one slot's max_len but fits here are admitted with
+        #: ``cp_lane=True`` instead of rejected (engine runs their
+        #: prefill as one cp-sharded pass). None = lane off (historical
+        #: rejection behavior, now with a structured error).
+        self.long_max_len = int(long_max_len) if long_max_len else None
+        if self.long_max_len is not None \
+                and self.long_max_len <= self.max_len:
+            raise ValueError(
+                f"long_max_len {self.long_max_len} must exceed the "
+                f"per-slot max_len {self.max_len}")
         self.queue: deque[Request] = deque()
         self.free: list[int] = list(range(self.slots))
         self.blocks = blocks              # BlockManager | None (legacy)
@@ -149,15 +170,32 @@ class Scheduler:
 
     # -- admission ----------------------------------------------------------
     def submit(self, req: Request) -> bool:
-        """Queue ``req`` FCFS; False = rejected (can never fit a slot)."""
+        """Queue ``req`` FCFS; False = rejected (can never fit a slot).
+
+        Rejection carries a STRUCTURED :class:`PromptTooLongError`
+        message naming the per-slot budget and — when the CP-prefill
+        lane exists — its larger budget, so a caller knows which knob
+        (max_len / long_max_len / max_tokens) would admit the request.
+        """
         worst = len(req.prompt) + req.sampling.max_tokens
+        limit = self.long_max_len or self.max_len
         if len(req.prompt) == 0:
             req.status, req.error = "rejected", "empty prompt"
+        elif worst > limit:
+            err = PromptTooLongError(
+                prompt_len=len(req.prompt),
+                max_tokens=req.sampling.max_tokens,
+                limit=self.max_len, cp_limit=self.long_max_len,
+                source="serving slot",
+                hint="raise long_max_len (CP-prefill lane) or trim "
+                     "the prompt" if self.long_max_len is not None
+                else "pass long_max_len= to enable the CP-prefill "
+                     "lane for prompts beyond one slot")
+            req.status, req.error = "rejected", str(err)
         elif worst > self.max_len:
-            req.status, req.error = "rejected", (
-                f"prompt {len(req.prompt)} + max_tokens "
-                f"{req.sampling.max_tokens} exceeds the {self.max_len}"
-                f"-token slot (HBM-budget gate)")
+            # beyond one slot's budget but inside the lane: the engine
+            # prefills it cp-sharded in one pass, decode is normal
+            req.cp_lane = True
         if req.status == "rejected":
             req.done.set()
             return False
@@ -200,7 +238,12 @@ class Scheduler:
         total = -(-(P + req.sampling.max_tokens) // bs)   # worst case
         shared: list[int] = []
         partial = None
-        if self.cache is not None:
+        # CP-lane requests skip the prefix cache: their prefill is one
+        # cp-sharded pass over the WHOLE prompt (a partial-skip offset
+        # would re-shape the lane's bucketed executable), and they do
+        # not insert on completion either — long-prompt prefix sharing
+        # is future work (docs/SERVING.md)
+        if self.cache is not None and not req.cp_lane:
             shared, partial = self.cache.match(req.prompt.tolist())
             shared = shared[:total]
         matched = len(shared) * bs + (partial[1] if partial else 0)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from typing import Optional
 
@@ -215,6 +216,91 @@ _MEMORY_PLANE_GAUGES = (
     "mem_params_bytes", "mem_grads_bytes", "mem_opt_bytes",
     "mem_act_bytes", "mem_peak_bytes", "mem_remat_recompute_flops",
 )
+
+
+#: shape-plane series (data/bucket.ShapeBucketer + the serving CP-prefill
+#: lane): the padding-tax view — how many dispatched tokens were real vs
+#: pad, which buckets absorbed the traffic, how many step programs the
+#: ragged epoch actually compiled, and what share of serving prompts
+#: took the CP lane (docs/PERFORMANCE.md "Shape plane").
+_SHAPE_PLANE_SERIES = (
+    "data_real_tokens_total", "data_padding_tokens_total",
+    "data_raw_tokens_total", "data_bucket_hits_total",
+    "data_bucket_compiles_total", "serving_cp_prefill_requests_total",
+    "serving_cp_prefill_tokens_total",
+)
+
+
+def shape_plane_summary(records: list[dict]) -> Optional[list[str]]:
+    """Lines for the shape-plane section, or None when no snapshot
+    carries the bucketing/CP-prefill series. Reads the LAST snapshot
+    (counters are cumulative)."""
+    snap: Optional[dict] = None
+    for rec in records:
+        cand = rec.get("metrics") if rec.get("kind") == "metrics_snapshot" \
+            else rec.get("telemetry")
+        if isinstance(cand, dict) and any(
+                k.split("{")[0] in _SHAPE_PLANE_SERIES for k in cand):
+            snap = cand
+    if snap is None:
+        return None
+    vals: dict[str, float] = {}
+    buckets: dict[str, float] = {}
+    compiles: dict[str, float] = {}
+    traces = 0.0
+    for series, v in snap.items():
+        if not isinstance(v, (int, float)):
+            continue
+        base = series.split("{")[0]
+        if base == "data_bucket_hits_total":
+            m = re.search(r'bucket="([^"]+)"', series)
+            buckets[m.group(1) if m else "?"] = v
+        elif base == "data_bucket_compiles_total":
+            m = re.search(r'bucket="([^"]+)"', series)
+            compiles[m.group(1) if m else "?"] = v
+        elif base == "step_traces_total" \
+                and 'what="train_step"' in series:
+            traces += v
+        elif base in _SHAPE_PLANE_SERIES:
+            vals[base] = v
+    if not vals and not buckets:
+        return None
+    lines = []
+    width = 18
+    real = vals.get("data_real_tokens_total", 0.0)
+    pad = vals.get("data_padding_tokens_total", 0.0)
+    raw = vals.get("data_raw_tokens_total", 0.0)
+    if real or pad:
+        lines.append("pad fraction".ljust(width)
+                     + f"{100.0 * pad / max(real + pad, 1):.1f}% after "
+                     f"bucketing"
+                     + (f" (vs {100.0 * (1 - real / raw):.1f}% as the "
+                        f"loader padded)" if raw else ""))
+        lines.append("real tokens".ljust(width) + f"{real:,.0f}")
+    if buckets:
+        total = sum(buckets.values())
+        for b in sorted(buckets, key=lambda x: int(x)
+                        if x.isdigit() else 0):
+            note = ""
+            if b in compiles:
+                note = f", {compiles[b]:.0f} compile(s)"
+            lines.append(f"  bucket {b}".ljust(width)
+                         + f"{buckets[b]:.0f} batches "
+                         f"({100.0 * buckets[b] / total:.0f}%{note})")
+    if traces:
+        lines.append("train-step traces".ljust(width)
+                     + f"{traces:.0f} total (the <= n_buckets audit)")
+    cp_req = vals.get("serving_cp_prefill_requests_total", 0.0)
+    if cp_req:
+        cp_tok = vals.get("serving_cp_prefill_tokens_total", 0.0)
+        served = snap.get('serving_requests_total{outcome="completed"}',
+                          0.0)
+        share = f" ({100.0 * cp_req / served:.0f}% of completed)" \
+            if served else ""
+        lines.append("cp-prefill lane".ljust(width)
+                     + f"{cp_req:.0f} long prompts{share}, "
+                     f"{cp_tok:,.0f} tokens prefilled cp-sharded")
+    return lines
 
 
 def memory_plane_summary(records: list[dict]) -> Optional[list[str]]:
@@ -481,6 +567,12 @@ def summarize(path: str, *, wall_s: Optional[float] = None,
         parts.append("")
         parts.append("== data plane ==")
         parts.extend(dp)
+
+    shp = shape_plane_summary(records)
+    if shp:
+        parts.append("")
+        parts.append("== shape plane ==")
+        parts.extend(shp)
 
     mp = memory_plane_summary(records)
     if mp:

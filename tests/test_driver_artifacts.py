@@ -78,6 +78,49 @@ def test_bench_router_emits_json_contract():
 
 
 @pytest.mark.slow
+def test_bench_ragged_emits_json_contract():
+    """``bench.py --ragged`` must emit the shape-plane sweep and write
+    BENCH_ragged.json with pad fraction and REAL-token throughput
+    improving monotonically pad-to-max -> bucketed -> bucketed+packed,
+    the per-config compile counts bounded by the ladder, and the
+    long-prompt probe served through the CP lane (the shape-plane round
+    evidence)."""
+    env = dict(os.environ)
+    env["HETU_TPU_BENCH_PLATFORM"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--ragged"],
+        capture_output=True, text=True, timeout=500, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "sweep", "long_prompt_probe",
+                "ladder"):
+        assert key in rec, (key, rec)
+    labels = [s["label"] for s in rec["sweep"]]
+    assert labels == ["pad_to_max", "bucketed", "bucketed_packed"]
+    pads = [s["pad_fraction"] for s in rec["sweep"]]
+    tps = [s["real_tokens_per_sec"] for s in rec["sweep"]]
+    assert pads[0] > pads[1] > pads[2], pads     # padding tax falls...
+    # ...and real-token throughput rises. The pad ordering is
+    # deterministic; the timing comparison needs noise margin (tiny CPU
+    # steps on a loaded CI box), so assert each discipline beats the
+    # pad-to-max baseline by a wide factor (the committed smoke shows
+    # 4.8x / 6.0x) instead of a strict bucketed-vs-packed ordering.
+    assert tps[1] > 1.5 * tps[0], tps
+    assert tps[2] > 1.5 * tps[0], tps
+    assert rec["sweep"][0]["compiles"] == 1      # pad-to-max: 1 shape
+    for s in rec["sweep"][1:]:
+        assert 1 <= s["compiles"] <= len(rec["ladder"]), s
+    probe = rec["long_prompt_probe"]
+    assert probe["status"] == "done"             # served, not rejected
+    assert probe["prompt_len"] > probe["slot_max_len"]
+    assert probe["serving_step_compiles"] == 1
+    assert probe["cp_prefill_compiles"] <= len(probe["lane_buckets"])
+    assert probe["ttft_ms"] is not None and probe["ttft_ms"] > 0
+    with open(os.path.join(_ROOT, "BENCH_ragged.json")) as f:
+        assert json.load(f) == rec
+
+
+@pytest.mark.slow
 def test_bench_moe_emits_json_contract():
     """``bench.py --moe`` must emit the expert-plane headline and write
     BENCH_moe.json with the serialized-vs-chunked and eager-vs-delayed

@@ -167,7 +167,11 @@ def test_scheduler_fcfs_and_hbm_gating(gpt):
 
     too_long = mk(0, 14, max_tokens=4)        # 14 + 4 > 16
     assert not sched.submit(too_long)
-    assert too_long.status == "rejected" and "HBM" in too_long.error
+    # structured rejection (shape plane): names the slot budget and the
+    # knob that would lift it
+    assert too_long.status == "rejected"
+    assert "16-token serving slot budget" in too_long.error
+    assert "long_max_len" in too_long.error
     assert not sched.submit(mk(1, 0))         # empty prompt
     a, b, c = mk(2, 4), mk(3, 4), mk(4, 4)
     assert all(sched.submit(r) for r in (a, b, c))

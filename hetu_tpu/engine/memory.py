@@ -365,6 +365,29 @@ def size_kv_blocks(cfg, *, hbm_budget_bytes: float, block_size: int,
     return blocks
 
 
+def size_spill_arena(cfg, *, host_budget_bytes: float, block_size: int,
+                     cache_dtype: str = "fp32", tp: int = 1) -> int:
+    """How many KV blocks the host spill arena may park in
+    ``host_budget_bytes`` of host memory.
+
+    The resumable-preemption path (``serving/kv_pool.HostSpillArena``)
+    evicts a running request by copying its blocks device→host; this is
+    the pricing that gates those copies, and it is the SAME
+    :func:`kv_bytes_per_block` arithmetic the device pool allocates
+    with — a spilled block costs on the host exactly what it freed on
+    the device (no weights term: the host side holds only KV). Raises
+    when not even one block fits."""
+    per_block = kv_bytes_per_block(cfg, block_size=block_size,
+                                   cache_dtype=cache_dtype, tp=tp)
+    blocks = int(float(host_budget_bytes) // per_block)
+    if blocks < 1:
+        raise ValueError(
+            f"spill arena does not fit: one {per_block / 1e6:.1f}MB "
+            f"block exceeds the {host_budget_bytes / 1e6:.1f}MB host "
+            f"budget — raise the budget or shrink block_size")
+    return blocks
+
+
 def size_kv_pool(cfg, *, hbm_budget_bytes: float, max_len: int,
                  cache_dtype: str = "fp32", tp: int = 1,
                  param_bytes_per_el: float = 4.0,

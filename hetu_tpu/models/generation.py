@@ -71,7 +71,7 @@ def init_kv_caches(model, batch: int, max_len: int, dtype=jnp.float32):
 
 
 def decode(model, params, input_ids, positions, caches, *,
-           slot_mask=None, block_tables=None):
+           slot_mask=None, block_tables=None, row_mask=None):
     """Run a chunk through the model in decode mode.
 
     ``positions`` (b, s) absolute positions. Without ``slot_mask`` they
@@ -81,13 +81,16 @@ def decode(model, params, input_ids, positions, caches, *,
     masked-off rows leave their KV rows untouched. ``block_tables``
     (b, W) switches the caches to the block-paged arena layout
     (``(L, n_blocks, block_size, hkv, d)`` leaves; see
-    ``ParallelAttention._decode``). Returns (logits (b, s, V), new
-    caches)."""
+    ``ParallelAttention._decode``). ``row_mask`` (b, s) bool gates KV
+    writes per CELL within a row (paged mode only) — the speculative
+    verify lane's guard against draft rows beyond a slot's allocated
+    blocks. Returns (logits (b, s, V), new caches)."""
     h = model.embed(params, input_ids, positions=positions)
     h, caches = model.blocks.decode(params["blocks"], h, caches,
                                     positions=positions,
                                     slot_mask=slot_mask,
-                                    block_tables=block_tables)
+                                    block_tables=block_tables,
+                                    row_mask=row_mask)
     h = model.hidden_norm(params, h)
     w = _head_weight(model, params)
     logits = jnp.einsum("bse,ve->bsv", h.astype(jnp.float32),

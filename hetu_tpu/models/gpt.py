@@ -106,7 +106,7 @@ class GPTBlock(Module):
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
-                 block_tables=None, dropout_key=None,
+                 block_tables=None, row_mask=None, dropout_key=None,
                  return_kv=False):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
@@ -114,7 +114,8 @@ class GPTBlock(Module):
                                      positions=positions,
                                      kv_cache=kv_cache,
                                      slot_mask=slot_mask,
-                                     block_tables=block_tables)
+                                     block_tables=block_tables,
+                                     row_mask=row_mask)
             x = x + a
             mlp_in = self.ln_2(params["ln_2"], x)
             if self.returns_aux:

@@ -100,7 +100,7 @@ class LlamaBlock(Module):
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
-                 block_tables=None, dropout_key=None,
+                 block_tables=None, row_mask=None, dropout_key=None,
                  return_kv=False):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
@@ -109,7 +109,8 @@ class LlamaBlock(Module):
                                      positions=positions,
                                      kv_cache=kv_cache,
                                      slot_mask=slot_mask,
-                                     block_tables=block_tables)
+                                     block_tables=block_tables,
+                                     row_mask=row_mask)
             x = x + a
             mlp_in = self.post_attn_norm(params["post_attn_norm"], x)
             if self.returns_aux:

@@ -294,7 +294,7 @@ class ParallelAttention(Module):
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl: str = "auto", kv_cache=None, slot_mask=None,
-                 block_tables=None,
+                 block_tables=None, row_mask=None,
                  dropout_rate: float = 0.0, dropout_key=None,
                  return_kv: bool = False):
         """``return_kv=True`` (train path only) additionally returns the
@@ -311,7 +311,8 @@ class ParallelAttention(Module):
                     "(decode already threads its cache)")
             return self._decode(params, x, kv_cache, positions=positions,
                                 slot_mask=slot_mask,
-                                block_tables=block_tables)
+                                block_tables=block_tables,
+                                row_mask=row_mask)
         b, s, _ = x.shape
         q = self.q_proj(params["q_proj"], x).reshape(
             b, s, self.num_heads, self.head_dim)
@@ -384,7 +385,7 @@ class ParallelAttention(Module):
         return out
 
     def _decode(self, params, x, kv_cache, *, positions=None,
-                slot_mask=None, block_tables=None):
+                slot_mask=None, block_tables=None, row_mask=None):
         """Incremental decoding with a KV cache.
 
         ``kv_cache``: (k_buf, v_buf) of shape (b, max_len, hkv, d); the
@@ -415,7 +416,16 @@ class ParallelAttention(Module):
         and are dropped), reads gather through the table
         (:func:`~hetu_tpu.ops.attention.gather_block_rows`). Requires
         ``slot_mask`` (per-row positions are the only meaningful paged
-        mode)."""
+        mode).
+
+        ``row_mask`` (b, s) bool refines ``slot_mask`` WITHIN a row's
+        ``s`` positions: only masked-true cells write their KV (the
+        rest scatter out of bounds and drop). The speculative-decoding
+        verify lane needs this — a slot verifying fewer than the step's
+        max draft depth must not write the unused trailing rows, whose
+        positions could land beyond the blocks its table owns (a
+        clamped scatter there would corrupt a live block). Paged mode
+        only."""
         quant = len(kv_cache) == 4
         b, s, _ = x.shape
         per_row = slot_mask is not None
@@ -423,6 +433,9 @@ class ParallelAttention(Module):
         if paged and not per_row:
             raise ValueError("block_tables requires slot_mask "
                              "(per-row paged decode)")
+        if row_mask is not None and not paged:
+            raise ValueError("row_mask requires block_tables (the "
+                             "dense cache writes contiguous rows)")
         if per_row:
             index = positions[:, 0]                     # (b,) per-slot
         else:
@@ -448,8 +461,10 @@ class ParallelAttention(Module):
             rows = blk_ids * blk + pos_rows % blk
             # masked-off rows scatter out of bounds → dropped (the
             # paged analogue of the jnp.where keep-mask below)
-            rows = jnp.where(slot_mask[:, None], rows,
-                             n_blk * blk).reshape(-1)
+            keep = slot_mask[:, None]
+            if row_mask is not None:
+                keep = keep & row_mask
+            rows = jnp.where(keep, rows, n_blk * blk).reshape(-1)
 
         def upd(buf, new):
             if paged:

@@ -64,6 +64,12 @@ def attention_reference(q, k, v, *, causal: bool = False,
 
     ``q_offset``/``kv_offset`` shift the absolute positions used by the causal
     mask — needed when q/kv are chunks of a longer sequence (ring attention).
+    An ARRAY ``q_offset`` gives every batch row its own base position, and
+    ``sq > 1`` then spans positions ``q_offset[r]..q_offset[r]+sq-1`` per
+    row: this is the speculative-decoding verify lane (each serving slot
+    checks its k draft tokens in one causal forward — row ``i`` attends
+    exactly the prefix a sequential decode at position ``q_offset[r]+i``
+    would have seen).
 
     ``dropout_rate``/``dropout_key``: inverted dropout on the softmax
     probabilities (the reference flash wrapper's p_dropout,

@@ -10,8 +10,13 @@ cache with radix-tree prefix sharing.
   multi-request prefill + all-slot decode through block tables,
   per-slot SamplingParams as traced operands) and the
   :class:`ServingEngine` host loop;
-- :mod:`~hetu_tpu.serving.scheduler` — FCFS admission, cache-aware
-  free-block gating, completion/eviction;
+- :mod:`~hetu_tpu.serving.scheduler` — priority-class admission
+  (deficit-weighted fairness; exact FCFS for single-class traffic),
+  cache-aware free-block gating, completion/eviction, and resumable
+  preemption planning;
+- :mod:`~hetu_tpu.serving.speculative` — the draft plane for
+  speculative decoding (self-drafting n-gram/prompt-lookup, optional
+  small-model draftsman) behind ``ServingEngine(spec_depth=k)``;
 - :mod:`~hetu_tpu.serving.server` — the line-protocol front end over
   ``rpc/py_server.py`` plus payload codecs;
 - :mod:`~hetu_tpu.serving.router` — the FLEET plane: load-aware +
@@ -25,7 +30,8 @@ the fleet state machines.
 
 from hetu_tpu.serving.engine import ServingEngine, sample_slots
 from hetu_tpu.serving.kv_pool import (
-    NULL_BLOCK, BlockManager, KVPool, cache_dtype_name,
+    NULL_BLOCK, BlockManager, HostSpillArena, KVPool, SpillEntry,
+    cache_dtype_name,
 )
 from hetu_tpu.serving.prefix_cache import PrefixCache
 from hetu_tpu.serving.router import (
@@ -35,12 +41,17 @@ from hetu_tpu.serving.router import (
 from hetu_tpu.serving.scheduler import (
     PromptTooLongError, Request, SamplingParams, Scheduler,
 )
+from hetu_tpu.serving.speculative import (
+    ModelDraftsman, NgramDraftsman, SpeculativeConfigError,
+)
 
 __all__ = [
     "ServingEngine", "sample_slots",
     "KVPool", "BlockManager", "NULL_BLOCK", "cache_dtype_name",
+    "HostSpillArena", "SpillEntry",
     "PrefixCache",
     "Request", "SamplingParams", "Scheduler", "PromptTooLongError",
+    "NgramDraftsman", "ModelDraftsman", "SpeculativeConfigError",
     "Router", "RouterRequest", "ReplicaHandle", "WeightPublisher",
     "materialize_params",
 ]

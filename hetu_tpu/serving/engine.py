@@ -35,7 +35,33 @@ turn a decode loop into a serving engine, mapped onto TPU idioms:
   prompt lengths snap to a geometric bucket ladder, so the lane owns
   at most ``n_buckets`` executables
   (``record_trace("serving_cp_prefill")``) while the fused step keeps
-  its single compile.
+  its single compile;
+- **speculative decoding** (``spec_depth=k``, Leviathan et al.): the
+  decode lane becomes a VERIFY lane — each active slot feeds its last
+  token plus up to k drafted tokens as ``k+1`` q rows spanning
+  positions ``pos..pos+k`` (the per-row causal offsets
+  ``attention_reference(q_offset=array)`` already speaks), so one
+  forward checks k guesses and commits every leading match plus one
+  bonus token. Draft tokens and per-slot depths are DATA (the step
+  compiles once for any draft mix, including depth 0 = classic
+  decode); accepted tokens are ordinary paged writes, rejected
+  suffixes just rewind ``pos`` (blocks are refcounted, nothing is
+  zeroed — the stale rows are overwritten before anything can attend
+  them). Drafts come from :mod:`~hetu_tpu.serving.speculative`: the
+  self-drafting n-gram/prompt-lookup index by default, or a small
+  model from the zoo (``draft_model=``). Greedy output is
+  token-identical to non-speculative decode for EVERY
+  acceptance/rejection pattern — a draftsman can only cost speed;
+- **QoS + resumable preemption**: ``SamplingParams.priority`` classes
+  with deficit-weighted admission (``Scheduler``), and when slots or
+  blocks run dry an urgent arrival PREEMPTS a strictly-lower-priority
+  running request — its KV blocks spill to a host arena
+  (:class:`~hetu_tpu.serving.kv_pool.HostSpillArena`, a table edit
+  plus one device→host gather), and resume maps them back into fresh
+  blocks with ZERO prefill-lane work. The router's death-requeue and
+  the weight publisher's drains ride the same spill entries
+  (``Router``/``WeightPublisher``), so a killed replica's mid-decode
+  requests resume on peers instead of re-prefilling.
 
 The fused step is jitted once: CoW block copies, the all-slot decode
 (per-row KV writes + per-row causal offsets —
@@ -66,9 +92,14 @@ import numpy as np
 from hetu_tpu import telemetry
 from hetu_tpu.engine.train_step import record_trace
 from hetu_tpu.models import generation
-from hetu_tpu.serving.kv_pool import BlockManager, KVPool
+from hetu_tpu.serving.kv_pool import (
+    BlockManager, HostSpillArena, KVPool, SpillEntry,
+)
 from hetu_tpu.serving.prefix_cache import PrefixCache
 from hetu_tpu.serving.scheduler import Request, SamplingParams, Scheduler
+from hetu_tpu.serving.speculative import (
+    ModelDraftsman, NgramDraftsman, check_draft_depth,
+)
 from hetu_tpu.telemetry.flight import HangWatchdog, flight_record
 from hetu_tpu.telemetry.slo import SLOEngine, default_serving_rules
 
@@ -125,6 +156,12 @@ class ServingEngine:
                  kv_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
                  long_max_len: Optional[int] = None,
+                 spec_depth: int = 0, draft: str = "ngram",
+                 draft_ngram: int = 3,
+                 draft_model=None, draft_params=None,
+                 preempt: bool = True,
+                 spill_host_budget_bytes: Optional[float] = None,
+                 class_weights: Optional[dict] = None,
                  plan=None, seed: int = 0,
                  counter_sample_every: int = 32,
                  watchdog: bool = False, watchdog_factor: float = 8.0,
@@ -227,9 +264,44 @@ class ServingEngine:
             self.pool.slots, self.pool.max_len, blocks=self.blocks,
             prefix_cache=self.prefix_cache,
             block_size=self.pool.block_size,
-            long_max_len=long_max_len)
+            long_max_len=long_max_len, class_weights=class_weights)
         self._plan = plan
         self._counter_sample_every = counter_sample_every
+
+        # -- speculation plane (ISSUE 11): draft depth is a SHAPE knob
+        # (the verify lane's width), per-slot effective depth is data —
+        # spec_depth=0 keeps the lane at the classic one-row decode
+        self.spec_depth = check_draft_depth(spec_depth, max_len)
+        self._draftsman = None
+        if draft_model is not None:
+            if self.spec_depth == 0:
+                raise ValueError(
+                    "draft_model without spec_depth — pass spec_depth=k "
+                    "to enable the verify lane")
+            self._draftsman = ModelDraftsman(
+                draft_model, draft_params, slots=self.pool.slots,
+                max_len=max_len, spec_depth=self.spec_depth)
+        elif self.spec_depth:
+            if draft != "ngram":
+                raise ValueError(f"unknown draft source {draft!r} "
+                                 f"(ngram, or pass draft_model=)")
+            self._draftsman = NgramDraftsman(self.pool.slots,
+                                             ngram=draft_ngram)
+        # -- QoS preemption: host spill arena, priced in the same
+        # blocks the device pool allocates (engine/memory ledger)
+        self.preempt = bool(preempt)
+        if spill_host_budget_bytes is not None:
+            from hetu_tpu.engine.memory import size_spill_arena
+            from hetu_tpu.serving.kv_pool import cache_dtype_name
+            max_blocks = size_spill_arena(
+                model.cfg, host_budget_bytes=spill_host_budget_bytes,
+                block_size=self.pool.block_size,
+                cache_dtype=cache_dtype_name(cache_dtype),
+                tp=plan.strategy.tp if plan is not None else 1)
+        else:
+            max_blocks = None
+        self.spill_arena = HostSpillArena(max_blocks)
+        self._resume_pending: list[dict] = []    # admitted spill-resumes
 
         S = self.pool.slots
         W = self.pool.table_width
@@ -286,13 +358,41 @@ class ServingEngine:
         self._fn = self._build_step()
         self._cp_fn = self._build_cp_prefill() \
             if self._cp_buckets is not None else None
+        self._spill_fn, self._resume_fn = self._build_spill_resume()
+
+    # -- KV spill / resume (resumable preemption) ---------------------------
+    def _build_spill_resume(self):
+        """Two tiny jits over the arena, both operating on a fixed
+        ``table_width`` lane of block ids (DATA — one compile each,
+        audited like the fused step):
+
+        - spill: gather a request's blocks ``(L, W, bs, ...)`` for the
+          device→host copy (pad lanes gather the null block and are
+          sliced off host-side);
+        - resume: scatter host-refilled block data into FRESH block
+          ids (pad lanes target ``n_blocks`` → dropped). Donates the
+          arena (the old buffer is dead the moment the new one lands).
+        """
+        def spill(caches, blk_ids):
+            record_trace("serving_kv_spill")
+            return jax.tree.map(
+                lambda c: jnp.take(c, blk_ids, axis=1), caches)
+
+        def resume(caches, data, blk_ids):
+            record_trace("serving_kv_resume")
+            return jax.tree.map(
+                lambda c, d: c.at[:, blk_ids].set(
+                    d.astype(c.dtype), mode="drop"), caches, data)
+
+        return (jax.jit(spill), jax.jit(resume, donate_argnums=(0,)))
 
     # -- the jit-once fused step --------------------------------------------
     def _build_step(self):
         model = self.model
         R = self._fin_cap
+        K = self.spec_depth
 
-        def step(params, caches, ctl, pf, bt, cow, key, it):
+        def step(params, caches, ctl, pf, bt, cow, spec, key, it):
             record_trace("serving_step")    # churn must never re-enter
             rng = jax.random.fold_in(key, it)
             rng_dec, rng_pf = jax.random.split(rng)
@@ -311,24 +411,57 @@ class ServingEngine:
             caches = jax.lax.cond(cow["run"], apply_cow,
                                   lambda cs: cs, caches)
 
-            # one decode token for EVERY slot; free/prefilling slots
-            # compute garbage that the slot mask keeps out of the pool
-            # and the host ignores. cond-gated so prefill-only
-            # iterations (cold admission) skip the discarded forward.
+            # the decode lane is a VERIFY lane (speculative decoding):
+            # every slot feeds its last token plus up to K drafted
+            # tokens as K+1 q rows spanning positions pos..pos+K — one
+            # forward both writes their KV and yields each row's greedy
+            # continuation, so a draft is ACCEPTED iff it equals what
+            # sequential decode would have emitted there. Per-slot
+            # draft depth (spec["len"]) is DATA: depth 0 reduces to the
+            # classic one-token decode, bit for bit. Rows past a slot's
+            # depth are masked from writing (row_mask) — their
+            # positions may lie beyond the blocks its table owns.
+            # Free/prefilling slots compute garbage that the masks keep
+            # out of the pool and the host ignores; cond-gated so
+            # prefill-only iterations skip the discarded forward.
             def do_decode(caches):
+                lane = jnp.arange(K + 1)[None, :]
+                tok_in = jnp.concatenate(
+                    [ctl["last_tok"][:, None], spec["tok"]], axis=1)
+                positions = ctl["pos"][:, None] + lane
+                row_valid = (lane <= spec["len"][:, None]) \
+                    & ctl["active"][:, None]
                 logits, caches = generation.decode(
-                    model, params, ctl["last_tok"][:, None],
-                    ctl["pos"][:, None], caches,
-                    slot_mask=ctl["active"], block_tables=bt)
-                return caches, sample_slots(
-                    logits[:, 0], ctl["temp"], ctl["topk"],
-                    ctl["topp"], rng_dec)
+                    model, params, tok_in, positions, caches,
+                    slot_mask=ctl["active"], block_tables=bt,
+                    row_mask=row_valid)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # leading-match acceptance: draft i commits iff drafts
+                # 1..i all matched (cumprod) and i < depth
+                match = (spec["tok"] == greedy[:, :K]) \
+                    & (lane[:, :K] < spec["len"][:, None])
+                a = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
+                                        axis=1), axis=1)
+                # the bonus token samples from row a's logits — the
+                # first unconfirmed position; at depth 0 this is row 0,
+                # exactly the pre-speculation decode sample
+                lg_bonus = jnp.take_along_axis(
+                    logits, a[:, None, None], axis=1)[:, 0]
+                bonus = sample_slots(lg_bonus, ctl["temp"],
+                                     ctl["topk"], ctl["topp"], rng_dec)
+                cols = jnp.arange(K + 1)[None, :]
+                committed = jnp.where(cols < a[:, None], greedy, 0)
+                committed = jnp.where(cols == a[:, None],
+                                      bonus[:, None], committed)
+                return (caches, committed,
+                        (a + 1).astype(jnp.int32), bonus)
 
             def no_decode(caches):
-                return caches, jnp.zeros(
-                    (ctl["pos"].shape[0],), jnp.int32)
+                S = ctl["pos"].shape[0]
+                z = jnp.zeros((S,), jnp.int32)
+                return caches, jnp.zeros((S, K + 1), jnp.int32), z, z
 
-            caches, emitted = jax.lax.cond(
+            caches, committed, ncommit, bonus = jax.lax.cond(
                 ctl["active"].any(), do_decode, no_decode, caches)
 
             # packed prefill: a C-token budget shared by every
@@ -366,16 +499,18 @@ class ServingEngine:
 
             caches, first_toks = jax.lax.cond(
                 pf["run"], do_prefill, no_prefill, caches)
-            # device-resident control advance: every active slot fed a
-            # token this iteration (its KV landed at pos), so pos+1 /
-            # last_tok=emitted — returned so the host can reuse the
+            # device-resident control advance: every active slot
+            # committed ncommit tokens (accepted drafts + the bonus —
+            # their KV landed at pos..pos+ncommit-1), so pos+ncommit /
+            # last_tok=bonus — returned so the host can reuse the
             # control vectors NEXT iteration without re-uploading them
             # (it falls back to a host rebuild only when an admission /
             # prefill completion / finish rewrote control state)
-            new_pos = ctl["pos"] + ctl["active"].astype(jnp.int32)
-            new_last = jnp.where(ctl["active"], emitted,
+            new_pos = ctl["pos"] + jnp.where(ctl["active"], ncommit, 0)
+            new_last = jnp.where(ctl["active"], bonus,
                                  ctl["last_tok"])
-            return caches, emitted, first_toks, new_pos, new_last
+            return (caches, committed, ncommit, first_toks,
+                    new_pos, new_last)
 
         return jax.jit(step, donate_argnums=(1,))
 
@@ -517,17 +652,268 @@ class ServingEngine:
             # slot (long-prompt prefix sharing is future work)
             self._on_token(slot, int(tok), now, reg)
 
+    # -- resumable preemption (QoS) -----------------------------------------
+    def _plan_preemption_locked(self) -> Optional[dict]:
+        """Decide whether this iteration evicts a running request for a
+        blocked more-urgent one (caller holds ``self._lock``, and the
+        admission pass has ALREADY run — so a head still queued here
+        genuinely could not admit, even net of prefix-cache credit and
+        cache eviction). At most one preemption per iteration; the
+        spill itself (a device→host gather) runs outside the lock.
+        Fires only when (a) that blocked head exists, (b) a STRICTLY
+        lower-priority request is decoding, and (c) the host arena can
+        hold its blocks — so uniform-priority traffic keeps the
+        historical run-to-completion guarantee untouched."""
+        if not self.preempt or not self.scheduler.queue:
+            return None
+        cand = self.scheduler.peek_candidate()
+        if cand is None:
+            return None
+        running = [(s, r) for s, r in enumerate(self._slot_req)
+                   if r is not None and self._active[s]]
+        slot = self.scheduler.preemption_victim(cand, running)
+        if slot is None:
+            return None
+        nb = max(1, -(-int(self._pos[slot]) // self.pool.block_size))
+        if not self.spill_arena.can_fit(nb):
+            return None
+        return {"req": self._slot_req[slot], "slot": slot, "nb": nb,
+                "ids": self._bt[slot].copy()}
+
+    def _spill_blocks(self, ids: np.ndarray, nb: int) -> tuple:
+        """Device→host copy of ``nb`` blocks (the compiled gather runs
+        over the fixed table width; pad lanes read the null block and
+        are sliced off)."""
+        lane_ids = np.zeros(self.pool.table_width, np.int32)
+        lane_ids[:nb] = ids[:nb]
+        ctx = self._plan.act if self._plan is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            gathered = self._spill_fn(self.pool.caches,
+                                      jnp.asarray(lane_ids))
+        return tuple(np.asarray(g)[:, :nb].copy() for g in gathered)
+
+    def _detach_locked(self, req: Request, slot: int) -> None:
+        """Free ``slot`` and everything ``req`` holds on the device
+        (caller holds ``self._lock``); the request's fate — requeue,
+        resume elsewhere, or drop — is the caller's."""
+        self.scheduler.release(slot, table=self._bt[slot])
+        self._bt[slot, :] = 0
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        self._ctl_dirty = True
+
+    def _exec_spill(self, job: dict, reg) -> None:
+        """Evict one running request into the host arena and requeue it
+        at the head of its class — the resumable half of preemption."""
+        req, slot, nb = job["req"], job["slot"], job["nb"]
+        data = self._spill_blocks(job["ids"], nb)
+        now = time.monotonic()
+        with self._lock:
+            entry = SpillEntry(
+                req_id=req.id, data=data, n_blocks=nb,
+                block_size=self.pool.block_size,
+                pos=int(self._pos[slot]),
+                last_tok=int(self._last_tok[slot]),
+                tokens=list(req.tokens),
+                weight_version=req.weight_version)
+            self.spill_arena.put(entry)
+            req.spill = entry
+            req.preemptions += 1
+            req.spilled_blocks += nb
+            req.mark("preempted", ts_s=now)
+            self._detach_locked(req, slot)
+            self.scheduler.requeue_preempted(req)
+            self.scheduler.preemptions_total += 1
+            reg.counter(
+                "serving_kv_spilled_blocks_total",
+                "KV blocks copied device→host when a request was "
+                "preempted (resumable eviction)").inc(nb)
+            reg.counter(
+                "serving_preemptions_total",
+                "running requests evicted for more-urgent arrivals, "
+                "by the VICTIM's priority class").inc(
+                priority=str(req.sampling.priority))
+        flight_record("serving_preempt", req=req.id, trace=req.trace_id,
+                      slot=slot, blocks=nb,
+                      priority=req.sampling.priority)
+
+    def _exec_resume(self, job: dict, reg) -> None:
+        """Map one spilled request's KV back into its freshly allocated
+        blocks and flip its slot live — ZERO prefill-lane work (the
+        acceptance bar for resumable preemption)."""
+        req, slot = job["req"], job["slot"]
+        entry = req.spill
+        nb = entry.n_blocks
+        W = self.pool.table_width
+        lane_ids = np.full(W, self.pool.n_blocks, np.int32)  # pad→drop
+        lane_ids[:nb] = self._bt[slot, :nb]
+        data = []
+        for src in entry.data:
+            pad = np.zeros((src.shape[0], W) + src.shape[2:], src.dtype)
+            pad[:, :nb] = src
+            data.append(pad)
+        ctx = self._plan.act if self._plan is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            self.pool.caches = self._resume_fn(
+                self.pool.caches, tuple(data), jnp.asarray(lane_ids))
+        now = time.monotonic()
+        with self._lock:
+            if self.spill_arena.get(req.id) is entry:
+                self.spill_arena.pop(req.id)
+            req.spill = None
+            req.resumed_blocks += nb
+            self._pos[slot] = entry.pos
+            self._last_tok[slot] = entry.last_tok
+            self._active[slot] = True
+            self._ctl_dirty = True
+            req.status = "decode"
+            if req.first_token_s is None:
+                # a cross-engine resume starts a fresh Request: give it
+                # a first-token stamp so TPOT math stays defined (no
+                # TTFT observation — its real first token happened on
+                # the engine it was spilled from)
+                req.first_token_s = now
+            req.mark("resumed", ts_s=now)
+            if self._draftsman is not None:
+                self._draftsman.reset(
+                    slot, req.prompt.tolist() + list(req.tokens))
+            reg.counter(
+                "serving_kv_resumed_blocks_total",
+                "spilled KV blocks mapped back into fresh arena blocks "
+                "on resume (prefill skipped entirely)").inc(nb)
+        flight_record("serving_resume", req=req.id, trace=req.trace_id,
+                      slot=slot, blocks=nb, pos=entry.pos)
+
+    def evict_request(self, req: Request, *,
+                      lock_timeout_s: Optional[float] = None
+                      ) -> Optional[SpillEntry]:
+        """Force ``req`` out of this engine RIGHT NOW, returning its
+        spill entry when it had resident KV (a decoding slot, or a
+        not-yet-mapped resume) and None otherwise (queued/prefilling —
+        nothing worth moving). The fleet layer's half of resumable
+        requeue: ``Router`` calls this on replica death and on
+        preemptive drains, then re-dispatches the request — with the
+        entry — onto a peer, which resumes it without re-prefilling.
+        The request's ``done`` event is NOT set (the router owns its
+        completion).
+
+        ``lock_timeout_s`` bounds the wait for the engine's iteration
+        lock: a replica declared dead because its step is WEDGED (the
+        watchdog scenario) still holds that lock, and a caller that
+        blocked on it forever would freeze whatever it holds — the
+        router passes a small timeout and degrades to a fresh requeue
+        (the pre-spill behavior) when salvage cannot be had."""
+        got = self._step_lock.acquire(
+            timeout=-1 if lock_timeout_s is None else lock_timeout_s)
+        if not got:
+            return None
+        try:
+            return self._evict_request_steplocked(req)
+        finally:
+            self._step_lock.release()
+
+    def _evict_request_steplocked(self, req: Request
+                                  ) -> Optional[SpillEntry]:
+        spill_plan = None
+        with self._lock:
+            if req.done.is_set():
+                return None
+            if req in self.scheduler.queue:
+                self.scheduler.queue.remove(req)
+                entry = req.spill
+                if entry is not None \
+                        and self.spill_arena.get(req.id) is entry:
+                    self.spill_arena.pop(req.id, resumed=False)
+                req.status = "evicted"
+                return entry
+            for ent in list(self._resume_pending):
+                if ent["req"] is req:
+                    self._resume_pending.remove(ent)
+                    entry = req.spill
+                    if entry is not None and \
+                            self.spill_arena.get(req.id) is entry:
+                        self.spill_arena.pop(req.id, resumed=False)
+                    self._detach_locked(req, ent["slot"])
+                    req.status = "evicted"
+                    return entry
+            for ent in list(self._prefilling):
+                if ent["req"] is req:
+                    self._prefilling.remove(ent)
+                    self._detach_locked(req, ent["slot"])
+                    req.status = "evicted"
+                    return None
+            for ent in list(self._cp_pending):
+                if ent["req"] is req:
+                    self._cp_pending.remove(ent)
+                    self._detach_locked(req, ent["slot"])
+                    req.status = "evicted"
+                    return None
+            slot = req.slot
+            if slot is None or self._slot_req[slot] is not req \
+                    or not self._active[slot]:
+                return None
+            nb = max(1, -(-int(self._pos[slot])
+                          // self.pool.block_size))
+            spill_plan = {"slot": slot, "nb": nb,
+                          "ids": self._bt[slot].copy(),
+                          "pos": int(self._pos[slot]),
+                          "last_tok": int(self._last_tok[slot])}
+        # the device gather runs without self._lock (submit()/load
+        # stay responsive) but under the iteration lock we hold
+        data = self._spill_blocks(spill_plan["ids"],
+                                  spill_plan["nb"])
+        with self._lock:
+            entry = SpillEntry(
+                req_id=req.id, data=data,
+                n_blocks=spill_plan["nb"],
+                block_size=self.pool.block_size,
+                pos=spill_plan["pos"],
+                last_tok=spill_plan["last_tok"],
+                tokens=list(req.tokens),
+                weight_version=req.weight_version)
+            self._detach_locked(req, spill_plan["slot"])
+            req.status = "evicted"
+            req.spilled_blocks += spill_plan["nb"]
+            telemetry.get_registry().counter(
+                "serving_kv_spilled_blocks_total",
+                "KV blocks copied device→host when a request was "
+                "preempted (resumable eviction)").inc(
+                spill_plan["nb"])
+        flight_record("serving_evict", req=req.id,
+                      trace=req.trace_id, slot=spill_plan["slot"],
+                      blocks=spill_plan["nb"])
+        return entry
+
     # -- submission ---------------------------------------------------------
     def submit(self, prompt: Sequence[int],
-               sampling: Optional[SamplingParams] = None) -> Request:
-        """Queue one request (FCFS). Returns the live Request — poll
-        ``req.done`` / :meth:`result`, or drive :meth:`step` yourself."""
+               sampling: Optional[SamplingParams] = None, *,
+               resume: Optional[SpillEntry] = None) -> Request:
+        """Queue one request (deficit-selected by its priority class;
+        pure FCFS when every request shares one class). Returns the
+        live Request — poll ``req.done`` / :meth:`result`, or drive
+        :meth:`step` yourself.
+
+        ``resume`` attaches a KV spill from a peer engine (the
+        router's resumable requeue): when the entry still speaks this
+        pool's layout AND weight version, the request admits through
+        the resume path — already-emitted tokens preloaded, zero
+        prefill-lane work. An incompatible entry (e.g. the fleet
+        swapped weights since the spill) silently degrades to a fresh
+        replay, which under greedy decoding regenerates the same
+        tokens."""
         sampling = sampling or SamplingParams()
         with self._lock:
             req = Request(id=self._next_id,
                           prompt=np.asarray(prompt, np.int32).ravel(),
                           sampling=sampling, submit_s=time.monotonic())
             self._next_id += 1
+            if resume is not None and resume.compatible_with(
+                    self.pool, self.weight_version):
+                req.spill = resume
+                req.tokens = list(resume.tokens)
+                req.weight_version = resume.weight_version
             admitted = self.scheduler.submit(req)
         reg = telemetry.get_registry()
         reg.counter("serving_requests_total",
@@ -550,7 +936,8 @@ class ServingEngine:
     def has_work(self) -> bool:
         with self._lock:
             return bool(self.scheduler.queue) or self._active.any() \
-                or bool(self._prefilling) or bool(self._cp_pending)
+                or bool(self._prefilling) or bool(self._cp_pending) \
+                or bool(self._resume_pending)
 
     @property
     def load(self) -> int:
@@ -560,7 +947,8 @@ class ServingEngine:
         ``serving_slot_occupancy`` gauges sample, as one number)."""
         with self._lock:
             return self.scheduler.depth + len(self._prefilling) \
-                + len(self._cp_pending) + int(self._active.sum())
+                + len(self._cp_pending) + len(self._resume_pending) \
+                + int(self._active.sum())
 
     # -- fleet lifecycle (router drain / live weight push) ------------------
     def cancel_queued(self, ids=None) -> list[Request]:
@@ -580,6 +968,12 @@ class ServingEngine:
                 out = [r for r in self.scheduler.queue if r.id in ids]
                 for r in out:
                     self.scheduler.queue.remove(r)
+            # a preempted request leaving the engine takes its spill
+            # with it (the peer that resumes it counts the map-back)
+            for r in out:
+                if r.spill is not None \
+                        and self.spill_arena.get(r.id) is r.spill:
+                    self.spill_arena.pop(r.id, resumed=False)
         return out
 
     def swap_params(self, params, *, version: Optional[int] = None) -> dict:
@@ -597,7 +991,8 @@ class ServingEngine:
         with self._step_lock:
             with self._lock:
                 if self.scheduler.queue or self._prefilling \
-                        or self._cp_pending or self._active.any():
+                        or self._cp_pending or self._resume_pending \
+                        or self._active.any():
                     raise RuntimeError(
                         "swap_params on a busy engine — drain first "
                         "(cancel_queued + wait for has_work() to clear)"
@@ -652,7 +1047,11 @@ class ServingEngine:
             self._bt[slot, :len(plan["table"])] = plan["table"]
             if plan["cow"] is not None:
                 cows.append(plan["cow"])
-            if req.cp_lane:
+            if plan.get("resume"):
+                # a preempted request coming back: its KV re-maps from
+                # the host spill arena — no prefill lane, no cp lane
+                self._resume_pending.append({"req": req, "slot": slot})
+            elif req.cp_lane:
                 # beyond one slot's budget: one cp-sharded prefill pass
                 # instead of the packed chunk loop
                 self._cp_pending.append({"req": req, "slot": slot})
@@ -660,6 +1059,10 @@ class ServingEngine:
                 self._prefilling.append(
                     {"req": req, "slot": slot,
                      "off": plan["first_uncached"]})
+            if self._draftsman is not None:
+                # the slot's draft state belongs to its NEW occupant
+                # (resumes re-seed with the full history at map-back)
+                self._draftsman.reset(slot, req.prompt.tolist())
             self._ctl_dirty = True           # new sampling params + bt
             hit = req.cached_tokens
             if hit:
@@ -686,24 +1089,94 @@ class ServingEngine:
         reg = telemetry.get_registry()
         C = self.prefill_chunk
         R = self._fin_cap
+        K = self.spec_depth
         S = self.pool.slots
         with self._lock:
             cows = self._admit_locked(t0, reg)
+            # preemption runs AFTER admission, so it fires only when
+            # the deficit-selected head genuinely could not admit —
+            # prefix-cache credit and cache eviction (which _page_plan
+            # already spends) admit for free before anyone is evicted
+            spill_job = self._plan_preemption_locked()
+        if spill_job is not None:
+            self._exec_spill(spill_job, reg)
+        with self._lock:
+            if spill_job is not None:
+                # second admission pass picks up the freed slot/blocks
+                # in THIS iteration (the urgent head does not wait one)
+                cows += self._admit_locked(t0, reg)
             # CP-lane prefills run as their own (bucket-audited)
             # executables before the fused step — at most ONE per
-            # iteration, device call OUTSIDE the lock
+            # iteration, device call OUTSIDE the lock. Spill-resumes
+            # follow the same discipline (one per iteration, upload
+            # outside the lock).
             cp_job = self._prep_cp_prefill_locked()
-        did_cp = False
+            resume_job = self._resume_pending.pop(0) \
+                if self._resume_pending else None
+        did_aux = spill_job is not None
+        if resume_job is not None:
+            self._exec_resume(resume_job, reg)
+            did_aux = True
         if cp_job is not None:
             self._exec_cp_prefill(cp_job, t0, reg)
-            did_cp = True
+            did_aux = True
         with self._lock:
             active_prev = np.nonzero(self._active)[0]
             if not self._prefilling and active_prev.size == 0 \
                     and not cows:
-                if did_cp:
+                if did_aux:
                     self._record_gauges()
-                return did_cp
+                return did_aux
+            # speculative drafts: per-slot depth + tokens are DATA
+            # operands rebuilt every iteration. Depth clamps: never
+            # beyond the request's remaining token budget - 1 (so
+            # commits can't blow past max_tokens or the slot's
+            # allocated blocks), and zero for sampled (temperature > 0)
+            # slots — speculation is a greedy-lane optimization. The
+            # n-gram index is host-only and proposes here; the model
+            # draftsman's DEVICE step runs between the lock windows
+            # below (submit()/load stay responsive through it — the
+            # iteration lock we hold keeps its inputs frozen).
+            d_tok = np.zeros((S, K), np.int32)
+            d_len = np.zeros(S, np.int32)
+            model_draft_in = None
+            if K and active_prev.size:
+                budget = np.zeros(S, np.int32)
+                for r in active_prev:
+                    req = self._slot_req[r]
+                    sp = req.sampling
+                    if sp.temperature == 0.0:
+                        budget[r] = max(0, min(
+                            K, sp.max_tokens - len(req.tokens) - 1))
+                if self._draftsman is not None and budget.any():
+                    if self._draftsman.host_only:
+                        for r in active_prev:
+                            b = int(budget[r])
+                            if b <= 0:
+                                continue
+                            prop = self._draftsman.propose(int(r), b)
+                            if prop:
+                                n = min(len(prop), b)
+                                d_tok[r, :n] = prop[:n]
+                                d_len[r] = n
+                    else:
+                        seqs: list = [None] * S
+                        for r in active_prev:
+                            req = self._slot_req[r]
+                            seqs[r] = req.prompt.tolist() \
+                                + list(req.tokens)
+                        model_draft_in = (seqs, self._pos.copy(),
+                                          self._active.copy(), budget)
+        if model_draft_in is not None:
+            d_tok, d_len = self._draftsman.propose_all(*model_draft_in)
+            d_len = np.minimum(d_len, model_draft_in[3])
+            # a zoo draft model may have a larger vocab than the
+            # target: clamp (a clamped draft that still matches greedy
+            # is by definition the token sequential decode would emit)
+            v = getattr(self.model.cfg, "vocab_size", None)
+            if v:
+                np.clip(d_tok, 0, v - 1, out=d_tok)
+        with self._lock:
             if self._ctl_dirty:
                 self._ctl_dev = {"pos": jnp.asarray(self._pos),
                                  "last_tok": jnp.asarray(self._last_tok),
@@ -757,20 +1230,65 @@ class ServingEngine:
 
         ctx = self._plan.act if self._plan is not None \
             else contextlib.nullcontext()
+        spec = {"tok": d_tok, "len": d_len}
         with ctx:
-            caches, emitted, first_toks, pos_dev, last_dev = self._fn(
-                self.params, self.pool.caches, ctl, pf, bt, cow,
+            (caches, committed, ncommit, first_toks, pos_dev,
+             last_dev) = self._fn(
+                self.params, self.pool.caches, ctl, pf, bt, cow, spec,
                 self._key, np.int32(self._iter))
         self.pool.caches = caches
-        em = np.asarray(emitted)
+        em = np.asarray(committed)               # (S, K+1)
+        nc = np.asarray(ncommit)                 # (S,)
         ft = np.asarray(first_toks)
         now = time.monotonic()
 
         with self._lock:
             self._iter += 1
-            # decode results for the slots that were active going in
+            if active_prev.size:
+                reg.counter(
+                    "serving_decode_slot_steps_total",
+                    "slot×iteration decode opportunities (each active "
+                    "slot in each fused step counts once); 1 + "
+                    "accepted/this is the mean tokens committed per "
+                    "slot-step — the speculation win, 1.0 without "
+                    "drafts").inc(int(active_prev.size))
+            # decode results for the slots that were active going in:
+            # each commits ncommit tokens (accepted drafts + bonus) —
+            # EOS or budget can finish the request mid-commit, in which
+            # case the remaining committed tokens are discarded (the
+            # _finish path marks control state dirty, so the device's
+            # advanced pos is rebuilt from the host mirrors)
             for r in active_prev:
-                self._on_token(int(r), int(em[r]), now, reg)
+                req = self._slot_req[int(r)]
+                n = int(nc[r])
+                if req is None or n == 0:
+                    continue
+                taken = 0
+                for j in range(n):
+                    self._on_token(int(r), int(em[r, j]), now, reg)
+                    taken += 1
+                    if self._slot_req[int(r)] is not req:
+                        break                    # finished mid-commit
+                dr = int(d_len[r])
+                if dr:
+                    # count only what the request KEPT: of the `taken`
+                    # committed tokens, all but the bonus (column
+                    # n-1, landed only when taken == n) were accepted
+                    # drafts — an EOS mid-commit discards the tail,
+                    # and the acceptance ledgers must not claim it
+                    kept = min(taken, n - 1)
+                    req.drafted += dr
+                    req.accepted += kept
+                    reg.counter(
+                        "serving_draft_tokens_total",
+                        "draft tokens proposed to the verify "
+                        "lane").inc(dr)
+                    if kept:
+                        reg.counter(
+                            "serving_accepted_tokens_total",
+                            "draft tokens the verify lane accepted "
+                            "(committed without their own decode "
+                            "iteration)").inc(kept)
             # prefill progress for every request that got pack tokens
             for ent, n in fills:
                 ent["off"] += n
@@ -828,6 +1346,8 @@ class ServingEngine:
         # writes its KV at the current pos) — pos was set by prefill
         if req.status == "decode" and len(req.tokens) > 1:
             self._pos[slot] += 1
+        if self._draftsman is not None and self._draftsman.host_only:
+            self._draftsman.extend(slot, (tok,))
         reg.counter("serving_tokens_total",
                     "serving tokens by kind").inc(kind="generated")
         sp = req.sampling
@@ -858,6 +1378,18 @@ class ServingEngine:
                 tpot)
             if self.slo is not None:
                 self.slo.observe("serving_tpot_seconds", tpot)
+        if req.drafted:
+            reg.histogram(
+                "serving_draft_acceptance_ratio",
+                "per-request accepted/drafted ratio at finish (the "
+                "speculation win tracks this)").observe(
+                req.accepted / req.drafted)
+        # a finished request can still own a spill entry (preempted,
+        # resumed elsewhere... or cancelled paths) — never leak it
+        if req.spill is not None \
+                and self.spill_arena.get(req.id) is req.spill:
+            self.spill_arena.pop(req.id, resumed=False)
+            req.spill = None
         flight_record("serving_finish", req=req.id, trace=req.trace_id,
                       slot=slot, tokens=n)
         self._emit_request_trace(req)
@@ -904,6 +1436,10 @@ class ServingEngine:
         reg.gauge("serving_kv_blocks_in_use",
                   "live KV blocks (slot tables + prefix cache)").set(
             self.blocks.blocks_in_use)
+        reg.gauge("serving_kv_spill_arena_blocks",
+                  "KV blocks parked in the host spill arena "
+                  "(preempted requests awaiting resume)").set(
+            self.spill_arena.blocks_held)
 
     def run_until_drained(self, max_steps: int = 1_000_000) -> int:
         """Drive :meth:`step` until queue + slots are empty; returns the

@@ -31,6 +31,7 @@ admission gate and the planner price bytes with the same arithmetic.
 
 from __future__ import annotations
 
+import dataclasses
 from collections import deque
 from typing import Optional
 
@@ -212,3 +213,102 @@ class KVPool:
 
     def nbytes(self) -> int:
         return sum(int(x.size) * x.dtype.itemsize for x in self.caches)
+
+
+# -- resumable preemption: the host spill arena ------------------------------
+
+
+@dataclasses.dataclass
+class SpillEntry:
+    """One preempted request's KV, parked in host memory.
+
+    ``data`` holds the request's first ``n_blocks`` table blocks per
+    cache leaf (``(layers, n_blocks, block_size, ...)`` numpy — valid
+    rows ``0..pos-1``; the tail block's trailing rows are rewound
+    speculation garbage and ride along harmlessly, the same way they
+    do on-device). Resume maps the data back into freshly allocated
+    arena blocks — zero prefill-lane work — provided the target pool
+    still speaks the same layout AND the same ``weight_version`` (KV
+    encodes the forward of the weights that wrote it; resuming it
+    under swapped weights would splice two models' states)."""
+
+    req_id: int
+    data: tuple                      # per-leaf np arrays (L, nb, bs, ..)
+    n_blocks: int
+    block_size: int
+    pos: int                         # next KV write index at spill time
+    last_tok: int                    # sampled, not yet fed
+    tokens: list                     # emitted so far (replayed on a
+    #                                  cross-engine resume's Request)
+    weight_version: int
+
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.data)
+
+    def compatible_with(self, pool: "KVPool", weight_version: int) -> bool:
+        """Can this spill resume into ``pool`` at ``weight_version``?"""
+        if self.weight_version != int(weight_version) \
+                or self.block_size != pool.block_size:
+            return False
+        if len(self.data) != len(pool.caches):
+            return False
+        return all(a.shape[0] == c.shape[0]
+                   and a.shape[2:] == tuple(c.shape[2:])
+                   and a.dtype == c.dtype
+                   for a, c in zip(self.data, pool.caches))
+
+
+class HostSpillArena:
+    """Bounded host-memory parking lot for preempted requests' KV.
+
+    Capacity is counted in ARENA BLOCKS (the same unit the device pool
+    allocates and :func:`hetu_tpu.engine.memory.size_spill_arena`
+    prices from a host-byte budget), so the scheduler's preemption
+    planner can gate an eviction with the same arithmetic the resume
+    will be charged. ``max_blocks=None`` = unbounded (the default for
+    in-process fleets where host RAM dwarfs the arena)."""
+
+    def __init__(self, max_blocks: Optional[int] = None):
+        self.max_blocks = int(max_blocks) if max_blocks else None
+        self._entries: dict[int, SpillEntry] = {}
+        self.blocks_held = 0
+        self.spilled_total = 0           # host ledgers (telemetry syncs)
+        self.resumed_total = 0
+
+    def can_fit(self, n_blocks: int) -> bool:
+        return self.max_blocks is None \
+            or self.blocks_held + int(n_blocks) <= self.max_blocks
+
+    def put(self, entry: SpillEntry) -> None:
+        if not self.can_fit(entry.n_blocks):
+            raise ValueError(
+                f"spill arena full: {self.blocks_held} + "
+                f"{entry.n_blocks} blocks exceed max_blocks="
+                f"{self.max_blocks}")
+        if entry.req_id in self._entries:
+            raise ValueError(f"request {entry.req_id} already spilled")
+        self._entries[entry.req_id] = entry
+        self.blocks_held += entry.n_blocks
+        self.spilled_total += entry.n_blocks
+
+    def pop(self, req_id: int, *, resumed: bool = True
+            ) -> Optional[SpillEntry]:
+        """Remove an entry: ``resumed=True`` counts it in the resume
+        ledger (a real map-back); ``resumed=False`` is a detach (the
+        router pulled the request to a peer — that engine's resume
+        counts it there)."""
+        entry = self._entries.pop(req_id, None)
+        if entry is not None:
+            self.blocks_held -= entry.n_blocks
+            if resumed:
+                self.resumed_total += entry.n_blocks
+        return entry
+
+    def get(self, req_id: int) -> Optional[SpillEntry]:
+        return self._entries.get(req_id)
+
+    def __contains__(self, req_id: int) -> bool:
+        return req_id in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)

@@ -17,10 +17,15 @@ module is both, composed from machinery earlier PRs built:
   requests sharing a system prompt land where the radix prefix cache
   already holds it — taken only when the sticky replica is within
   ``affinity_slack`` of the least-loaded, so a hot prefix cannot
-  starve the fleet), **retry-and-requeue** when a replica dies
-  mid-request (undelivered requests are re-dispatched to peers; greedy
-  decoding makes the retry token-identical), and fleet-wide
-  HEALTHZ/METRICS aggregation (:meth:`Router.fleet_status`);
+  starve the fleet), **resumable retry-and-requeue** when a replica
+  dies mid-request: undelivered requests are re-dispatched to peers,
+  and a request that was mid-DECODE carries its KV spill
+  (:meth:`ServingEngine.evict_request` →
+  :class:`~hetu_tpu.serving.kv_pool.SpillEntry`) so the peer resumes
+  it with zero prefill-lane work instead of regenerating from scratch
+  (greedy decoding makes the fresh-replay fallback token-identical
+  when the spill cannot travel — e.g. a weight-version mismatch), and
+  fleet-wide HEALTHZ/METRICS aggregation (:meth:`Router.fleet_status`);
 - :class:`WeightPublisher` — the Trainer-side push: per-replica
   **drain → swap → resume**, rolling across the fleet so capacity
   never reaches zero. The swap leg is
@@ -79,6 +84,13 @@ class RouterRequest:
     error: Optional[str] = None
     weight_version: Optional[int] = None
     finish_s: Optional[float] = None
+    spill: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False)  # SpillEntry salvaged
+    #                                    from a dead/draining replica —
+    #                                    rides the next dispatch so the
+    #                                    peer resumes instead of
+    #                                    re-prefilling
+    resumed_dispatches: int = 0          # dispatches that carried KV
     trace_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex[:12])
     inner: Optional[Request] = dataclasses.field(
@@ -208,12 +220,23 @@ class Router:
         with self._lock:
             self._replicas[name].last_beat = time.monotonic()
 
-    def drain(self, name: str, *, timeout_s: float = 30.0) -> int:
+    def drain(self, name: str, *, timeout_s: float = 30.0,
+              preempt: bool = False) -> int:
         """Stop dispatching to ``name``, re-dispatch its queued (not
         yet admitted) requests onto peers, and wait for its admitted
         work to run out. Returns how many requests were re-dispatched.
         The engine's loop keeps running throughout — drain is a routing
-        state, not a process state."""
+        state, not a process state.
+
+        ``preempt=True`` is the RESUMABLE drain (the weight publisher's
+        default): instead of waiting for admitted requests to decode to
+        completion, evict them — mid-decode requests spill their KV and
+        resume on a peer with zero prefill-lane work. Taken only for
+        requests a live SAME-weight-version peer can resume; when the
+        fleet has no such peer (e.g. the last replica of a rolling
+        push, its peers already swapped), the request runs out here
+        under the weights it started with — preempting it onto new
+        weights would splice two models into one output."""
         with self._lock:
             h = self._replicas[name]
             if h.state == "dead":
@@ -228,9 +251,35 @@ class Router:
             for inner in moved:
                 rreq = h.inflight.pop(inner.id, None)
                 if rreq is not None:
+                    rreq.spill = inner.spill     # a preempted-then-
+                    #                              pulled request keeps
+                    #                              its KV
                     self._requeue_locked(rreq, from_replica=name,
                                          reason="drain")
                     n += 1
+            if preempt:
+                version = h.engine.weight_version
+                peer_ok = any(
+                    p.state == "live" and p is not h
+                    and p.engine.weight_version == version
+                    for p in self._replicas.values())
+                if peer_ok:
+                    for inner_id, rreq in list(h.inflight.items()):
+                        if rreq.inner is None \
+                                or rreq.inner.done.is_set():
+                            continue
+                        try:
+                            entry = h.engine.evict_request(
+                                rreq.inner, lock_timeout_s=5.0)
+                        except Exception:
+                            continue             # best-effort: let it run
+                        if rreq.inner.status != "evicted":
+                            continue             # finished under us
+                        h.inflight.pop(inner_id, None)
+                        rreq.spill = entry
+                        self._requeue_locked(rreq, from_replica=name,
+                                             reason="drain_preempt")
+                        n += 1
         flight_record("router_replica", replica=name, state="draining",
                       event="drain", requeued=n)
         deadline = time.monotonic() + timeout_s
@@ -273,6 +322,21 @@ class Router:
             if rreq.inner is not None and rreq.inner.done.is_set():
                 self._finalize_locked(h, rreq)   # it DID finish — keep
             else:
+                # salvage the KV: a killed replica is a stopped loop in
+                # THIS process, so its arena is still readable — a
+                # mid-decode request spills and resumes on a peer
+                # instead of regenerating from scratch. Salvage is
+                # best-effort and BOUNDED: a replica that is dead
+                # because its step is WEDGED still holds its iteration
+                # lock, and this path runs under the router lock — a
+                # timed-out acquire degrades to the pre-spill fresh
+                # requeue instead of freezing the whole fleet
+                if rreq.inner is not None:
+                    try:
+                        rreq.spill = h.engine.evict_request(
+                            rreq.inner, lock_timeout_s=2.0)
+                    except Exception:            # salvage is best-effort
+                        rreq.spill = None
                 self._requeue_locked(rreq, from_replica=h.name,
                                      reason=reason)
                 n += 1
@@ -321,7 +385,17 @@ class Router:
         if picked is None:
             return False
         h, reason = picked
-        inner = h.engine.submit(rreq.prompt, rreq.sampling)
+        inner = h.engine.submit(rreq.prompt, rreq.sampling,
+                                resume=rreq.spill)
+        if rreq.spill is not None:
+            if inner.spill is rreq.spill:     # the peer took the KV
+                rreq.resumed_dispatches += 1
+                telemetry.get_registry().counter(
+                    "router_resumed_requeues_total",
+                    "requeued requests whose KV spill a peer accepted "
+                    "(resumed mid-decode, no re-prefill)").inc()
+            rreq.spill = None      # stale either way once dispatched —
+            #                        a later death re-spills fresh state
         rreq.attempts += 1
         rreq.replica = h.name
         rreq.inner = inner
@@ -551,12 +625,21 @@ class WeightPublisher:
     generation); everything admitted after decodes under the new one.
     A replica that cannot drain within ``drain_timeout_s`` is declared
     dead (its work requeues) rather than blocking the push.
-    """
+
+    Drains route through the RESUMABLE path by default
+    (``preempt=True`` → :meth:`Router.drain` with KV spill): a
+    replica's mid-decode requests move to a same-version peer with
+    their KV instead of pinning the drain to the longest running
+    decode — push latency stops scaling with ``max_tokens``. The last
+    replica of a rolling push (no old-version peer left) falls back to
+    run-to-completion, preserving the one-request-one-version
+    invariant."""
 
     def __init__(self, router: Router, *,
-                 drain_timeout_s: float = 60.0):
+                 drain_timeout_s: float = 60.0, preempt: bool = True):
         self.router = router
         self.drain_timeout_s = float(drain_timeout_s)
+        self.preempt = bool(preempt)
 
     def publish(self, state_or_params, *,
                 version: Optional[int] = None) -> dict:
@@ -580,7 +663,8 @@ class WeightPublisher:
             t1 = time.perf_counter()
             try:
                 requeued = self.router.drain(
-                    name, timeout_s=self.drain_timeout_s)
+                    name, timeout_s=self.drain_timeout_s,
+                    preempt=self.preempt)
             except TimeoutError:
                 with self.router._lock:
                     self.router._mark_dead_locked(

@@ -32,13 +32,23 @@ from hetu_tpu.models.generation import PromptTooLongError
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request decode knobs — traced per-slot operands in the engine
-    step (so changing them across requests never recompiles)."""
+    step (so changing them across requests never recompiles).
+
+    ``priority`` is the request's QoS class: LOWER is more urgent
+    (0 = interactive, 1 = standard/default, 2+ = batch). Admission is
+    deficit-weighted across classes (class ``c`` gets a ``2^-c`` share
+    of admissions when everything is backlogged — urgent traffic goes
+    first but batch traffic never starves), and a queued request may
+    PREEMPT a running strictly-lower-priority one when slots or blocks
+    run dry — the victim's KV spills to the host arena and resumes
+    later without re-running prefill (docs/SERVING.md)."""
 
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 0.0
     eos_id: Optional[int] = None
     max_tokens: int = 16
+    priority: int = 1
 
 
 @dataclasses.dataclass
@@ -78,6 +88,19 @@ class Request:
     #                                    version, end to end
     admit: Optional[dict] = dataclasses.field(
         default=None, repr=False, compare=False)  # paged admission plan
+    # -- speculation + QoS ledgers (ISSUE 11) --
+    drafted: int = 0                   # draft tokens this request saw
+    accepted: int = 0                  # drafts the verify lane accepted
+    preemptions: int = 0               # times evicted mid-decode
+    spilled_blocks: int = 0            # KV blocks copied to the host
+    #                                    spill arena across preemptions
+    resumed_blocks: int = 0            # KV blocks mapped back on resume
+    spill: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False)  # live SpillEntry while
+    #                                    preempted/queued-for-resume —
+    #                                    its presence is what routes
+    #                                    admission through the resume
+    #                                    path instead of prefill
     trace_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex[:12])
     events: list = dataclasses.field(default_factory=list,
@@ -116,6 +139,15 @@ class Request:
         out["prefill_chunks"] = sum(
             1 for p, _, _ in self.events if p == "prefill_chunk")
         out["cached_tokens"] = self.cached_tokens
+        # speculation + QoS breakdown (ISSUE 11): how many tokens the
+        # draft plane proposed/landed for this request, and what the
+        # scheduler did to it under pressure
+        out["priority"] = self.sampling.priority
+        out["drafted"] = self.drafted
+        out["accepted"] = self.accepted
+        out["preemptions"] = self.preemptions
+        out["spilled_blocks"] = self.spilled_blocks
+        out["resumed_blocks"] = self.resumed_blocks
         return out
 
     def result(self) -> dict:
@@ -139,14 +171,30 @@ class Scheduler:
     (``prefix_cache=``), so a full-prefix hit costs ~0 blocks and
     admits even into a nearly-full pool. When blocks run short the
     scheduler first LRU-evicts unpinned cache leaves; if still short,
-    the head of the queue WAITS (head-of-line, preserving FCFS — a
-    later cheaper request never jumps it, which is what keeps
+    the chosen head WAITS (head-of-line within its class — a later
+    cheaper request never jumps it, which is what keeps
     ``generate_many`` outputs in submission order under churn).
+
+    **QoS (ISSUE 11)**: admission is no longer pure FCFS.
+    ``SamplingParams.priority`` names the request's class (lower = more
+    urgent), and the scheduler runs deficit-weighted selection across
+    the classes present in the queue: every selection round each
+    backlogged class earns credits proportional to its weight
+    (``2^-priority`` by default, override via ``class_weights=``), the
+    richest class admits its OLDEST request and pays one credit. With a
+    single class this degenerates to exact FCFS (the historical
+    contract, relied on by ``generate_many``'s submission-order
+    guarantee); with mixed classes, urgent traffic takes a ``2^Δ``
+    share of admissions over batch traffic while the credit accrual
+    makes starvation impossible. A request carrying a KV spill
+    (``req.spill``) is priced and admitted through the RESUME path —
+    fresh blocks, no prefill, no prefix-cache interaction.
     """
 
     def __init__(self, slots: int, max_len: int, *, blocks=None,
                  prefix_cache=None, block_size: Optional[int] = None,
-                 long_max_len: Optional[int] = None):
+                 long_max_len: Optional[int] = None,
+                 class_weights: Optional[dict] = None):
         self.slots = int(slots)
         self.max_len = int(max_len)
         #: CP-prefill lane budget: requests whose worst case exceeds
@@ -167,6 +215,9 @@ class Scheduler:
         self.block_size = int(block_size) if block_size else None
         self.evictions_total = 0          # host ledger (engine syncs
         #                                   the telemetry counter)
+        self.class_weights = dict(class_weights) if class_weights else {}
+        self._credit: dict[int, float] = {}   # deficit counters by class
+        self.preemptions_total = 0        # host ledger by-product
 
     # -- admission ----------------------------------------------------------
     def submit(self, req: Request) -> bool:
@@ -192,9 +243,11 @@ class Scheduler:
                 else "pass long_max_len= to enable the CP-prefill "
                      "lane for prompts beyond one slot")
             req.status, req.error = "rejected", str(err)
-        elif worst > self.max_len:
+        elif worst > self.max_len and req.spill is None:
             # beyond one slot's budget but inside the lane: the engine
-            # prefills it cp-sharded in one pass, decode is normal
+            # prefills it cp-sharded in one pass, decode is normal.
+            # (A resume request never re-routes through the lane — its
+            # KV already exists; admission maps it back in.)
             req.cp_lane = True
         if req.status == "rejected":
             req.done.set()
@@ -203,30 +256,124 @@ class Scheduler:
         self.queue.append(req)
         return True
 
+    def requeue_preempted(self, req: Request) -> None:
+        """Put an evicted request back at the HEAD of the queue (it was
+        already admitted once — it resumes before its class peers; the
+        deficit selection still decides WHEN its class runs again)."""
+        req.status = "preempted"
+        self.queue.appendleft(req)
+
+    # -- QoS class selection ------------------------------------------------
+    def _weight(self, c: int) -> float:
+        w = self.class_weights.get(c)
+        if w is None:
+            return 2.0 ** (-max(int(c), 0))
+        # a zero/negative override would deadlock the credit accrual —
+        # clamp to a tiny share instead (≈ "only when alone")
+        return max(float(w), 1e-6)
+
+    def _select_class(self) -> tuple[Optional[int], Optional[dict]]:
+        """Deficit-weighted pick among classes present in the queue
+        (pure — commits nothing). Every backlogged class earns its
+        weight per round until one can afford an admission (credit
+        >= 1); richest wins, urgency breaks ties. Returns
+        ``(class, credits-after-accrual)``."""
+        present = {r.sampling.priority for r in self.queue}
+        if not present:
+            return None, None
+        eff = {c: self._credit.get(c, 0.0) for c in present}
+        while max(eff.values()) < 1.0:
+            for c in eff:
+                eff[c] += self._weight(c)
+        win = min(present, key=lambda c: (-eff[c], c))
+        return win, eff
+
+    def peek_candidate(self) -> Optional[Request]:
+        """The request :meth:`next_admission` would try next (oldest of
+        the deficit-selected class) — the engine's preemption planner
+        asks this to decide whether a blocked urgent request justifies
+        evicting a running batch one."""
+        win, _ = self._select_class()
+        if win is None:
+            return None
+        return next(r for r in self.queue
+                    if r.sampling.priority == win)
+
+    def blocks_needed(self, req: Request) -> int:
+        """Worst-case NEW blocks ``req`` needs (gross of prefix
+        sharing — the preemption planner's conservative bound)."""
+        bs = self.block_size or self.max_len
+        return -(-(len(req.prompt) + req.sampling.max_tokens) // bs)
+
+    def preemption_victim(self, candidate: Request,
+                          running) -> Optional[int]:
+        """Pick the slot to evict for ``candidate``: among running
+        requests with STRICTLY lower priority (higher class number),
+        the lowest-priority one, least-progressed first (fewest decoded
+        tokens = fewest spilled bytes = least wasted work if it never
+        resumes). ``running`` is ``[(slot, Request), ...]``; None = no
+        eligible victim (equal-or-higher-priority work never preempts,
+        so uniform-priority traffic keeps the historical run-to-
+        completion guarantee)."""
+        pc = candidate.sampling.priority
+        victims = [(s, r) for s, r in running
+                   if r.sampling.priority > pc]
+        if not victims:
+            return None
+        slot, _ = max(victims, key=lambda sr: (
+            sr[1].sampling.priority, -len(sr[1].tokens), sr[0]))
+        return slot
+
     def next_admission(self) -> Optional[tuple[Request, int]]:
-        """Pop the oldest queued request into a free slot, or None
-        (no queue, no slot, or — paged — not enough free blocks even
-        after cache eviction: the head waits).
+        """Pop the deficit-selected class's oldest request into a free
+        slot, or None (no queue, no slot, or — paged — not enough free
+        blocks even after cache eviction: the chosen head waits).
 
         Paged pools attach the admission plan as ``req.admit``:
         ``{"table": [block ids], "first_uncached": int,
         "cow": (src, dst) | None}`` — blocks already allocated/shared,
-        so the engine only maps them into control vectors."""
+        so the engine only maps them into control vectors. A request
+        carrying a KV spill instead gets
+        ``{"table": ..., "resume": True, ...}``: all-fresh blocks the
+        engine refills from the host arena (no prefill lane work)."""
         if not self.queue or not self.free:
             return None
-        req = self.queue[0]
+        win, eff = self._select_class()
+        req = next(r for r in self.queue
+                   if r.sampling.priority == win)
         plan = None
         if self.blocks is not None:
-            plan = self._page_plan(req)
+            plan = self._resume_plan(req) if req.spill is not None \
+                else self._page_plan(req)
             if plan is None:
                 return None
-        self.queue.popleft()
+        # commit the deficit round only on a real admission (a blocked
+        # head must not burn its class's credits while it waits)
+        self._credit = eff
+        self._credit[win] -= 1.0
+        self.queue.remove(req)
         slot = self.free.pop(0)
         req.slot = slot
-        req.status = "prefill"
+        req.status = "resuming" if req.spill is not None else "prefill"
         req.admit = plan
         req.mark("admit")
         return req, slot
+
+    def _resume_plan(self, req: Request) -> Optional[dict]:
+        """Price a spill-resume: the full worst case in FRESH blocks
+        (no prefix sharing — the spilled bytes are this request's own
+        history and flow back from the host arena), evicting cache
+        leaves if the free list is short. None = cannot fit yet."""
+        total = self.blocks_needed(req)
+        if total > self.blocks.free_blocks and self.cache is not None:
+            self.evictions_total += self.cache.evict(
+                total - self.blocks.free_blocks)
+        if total > self.blocks.free_blocks:
+            return None
+        fresh = [self.blocks.alloc() for _ in range(total)]
+        req.cached_tokens = 0
+        return {"table": fresh, "first_uncached": 0, "cow": None,
+                "resume": True}
 
     def _page_plan(self, req: Request) -> Optional[dict]:
         """Price ``req`` in blocks net of the prefix cache, evicting

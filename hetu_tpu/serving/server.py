@@ -38,7 +38,8 @@ def sampling_from_payload(p: dict) -> SamplingParams:
         top_k=int(p.get("top_k", 0)),
         top_p=float(p.get("top_p", 0.0)),
         eos_id=None if p.get("eos_id") is None else int(p["eos_id"]),
-        max_tokens=int(p.get("max_tokens", 16)))
+        max_tokens=int(p.get("max_tokens", 16)),
+        priority=int(p.get("priority", 1)))
 
 
 def submit_payload(engine: ServingEngine, tok: str) -> Request:

@@ -1,0 +1,314 @@
+"""Speculative-decoding draft plane: who proposes the k tokens the
+fused serving step verifies.
+
+Decode emits one token per active slot per fused-step iteration, so at
+production TPOT targets most of each step's FLOPs sit idle — the
+memory-bound decode wall speculative decoding (Leviathan et al., "Fast
+Inference from Transformers via Speculative Decoding") climbs by
+verifying k DRAFTED tokens in one forward pass. The serving engine's
+verify lane (``ServingEngine(spec_depth=k)``) does the checking; this
+module is where drafts come from:
+
+- :class:`NgramDraftsman` — self-drafting prompt-lookup (Saxena,
+  "Prompt Lookup Decoding" / LLMA): a host-side per-slot suffix index
+  over the request's OWN tokens (prompt + emitted). The last n-gram is
+  looked up in the history; if it occurred before, the tokens that
+  followed it are the draft. No second model, no device work, and on
+  the repetitive traffic real serving sees (code edits, RAG quoting
+  its context, multi-turn echoes) acceptance is high exactly when the
+  tokens were cheapest to predict;
+- :class:`ModelDraftsman` — the small-model path through the existing
+  model zoo (a tiny GPT drafting for a Llama, etc.): the draft model
+  keeps its own per-slot KV arena and ONE jitted step per iteration
+  first *catches up* on the tokens the target committed last iteration
+  (a ``(S, k+1)``-wide masked window — no separate prefill lane: a
+  fresh slot warms up over its first ``ceil(P/(k+1))`` iterations,
+  drafting meanwhile disabled for it), then greedily drafts k tokens.
+  Draft KV for rejected tokens is overwritten by the next catch-up
+  before anything attends it, the same rewind discipline the target
+  arena uses.
+
+Both draftsmen are PROPOSERS only: the engine's verify lane accepts a
+draft token iff it equals what sequential greedy decode would have
+emitted, so a bad draftsman can only cost speed, never correctness
+(``docs/SERVING.md`` — "Speculation + QoS").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class SpeculativeConfigError(ValueError):
+    """A speculation configuration that could never run soundly.
+
+    Raised at :class:`~hetu_tpu.serving.engine.ServingEngine`
+    construction (never mid-decode, where the failure mode would be a
+    silently corrupted ``pos``): a draft depth whose verify window
+    cannot fit a slot, or a draft model whose gate couples co-batched
+    rows (its routing depends on which OTHER requests share the batch,
+    so its drafts — and its own KV — are not a function of the request
+    alone)."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+
+
+def check_draft_depth(spec_depth: int, max_len: int) -> int:
+    """Validate the engine-level draft depth against the slot budget.
+
+    The verify lane feeds ``spec_depth + 1`` rows per slot, so a depth
+    that cannot fit even an empty slot (``spec_depth + 1 > max_len``)
+    would force every write past the blocks the table owns — raise the
+    named error instead of letting the clamp arithmetic corrupt
+    ``pos``."""
+    k = int(spec_depth)
+    if k < 0:
+        raise SpeculativeConfigError(
+            f"spec_depth must be >= 0, got {k}")
+    if k and k + 1 > int(max_len):
+        raise SpeculativeConfigError(
+            f"spec_depth {k} would overflow a slot: the verify lane "
+            f"writes {k + 1} rows per iteration but max_len is "
+            f"{max_len} — lower spec_depth or raise max_len")
+    return k
+
+
+def check_draft_model(draft_model) -> None:
+    """Refuse draft models whose routing is batch-coupled.
+
+    A gate with ``batch_coupled = True`` (the PR 9 marker on
+    Sinkhorn-style balance gates) routes each row as a function of the
+    WHOLE batch, so the draft model's proposals for one request change
+    with its co-batched neighbors — its KV cache is not replayable and
+    its drafts are not a pure function of the request. The verify lane
+    would still be correct (bad drafts just get rejected), but the
+    draft cache's catch-up replay would diverge from what was drafted;
+    fail loudly at construction instead."""
+    seen: set[int] = set()
+    stack = [draft_model]
+    while stack:
+        obj = stack.pop()
+        if id(obj) in seen or not hasattr(obj, "__dict__"):
+            continue
+        seen.add(id(obj))
+        if getattr(obj, "batch_coupled", False):
+            raise SpeculativeConfigError(
+                f"draft model uses a batch-coupled gate "
+                f"({type(obj).__name__}): its routing depends on which "
+                f"other requests share the batch, so its drafts are "
+                f"not a function of one request — use a per-token "
+                f"gate (topk/ktop1/sam) for the draft model")
+        for v in vars(obj).values():
+            if hasattr(v, "__dict__"):
+                stack.append(v)
+
+
+class NgramDraftsman:
+    """Per-slot prompt-lookup drafting over the request's own tokens.
+
+    For each slot, an incremental suffix index maps every n-gram
+    (``n = ngram`` down to 1) to the position of its most recent
+    occurrence. :meth:`propose` looks up the current tail n-gram
+    (longest first) and drafts the tokens that followed its previous
+    occurrence. Pure host bookkeeping — O(appended tokens) per
+    iteration, nothing on the device."""
+
+    #: draftsmen are proposal-only: the engine treats this flag as "no
+    #: device work per iteration" (cheap enough to run under the lock)
+    host_only = True
+
+    def __init__(self, slots: int, *, ngram: int = 3):
+        self.ngram = max(1, int(ngram))
+        self._index: list[dict] = [dict() for _ in range(slots)]
+        self._prev: list[dict] = [dict() for _ in range(slots)]
+        self._seq: list[list[int]] = [[] for _ in range(slots)]
+
+    def reset(self, slot: int, tokens: Sequence[int]) -> None:
+        """(Re)bind ``slot`` to a fresh request whose history is
+        ``tokens`` (the prompt at admission; prompt + emitted on a
+        spill-resume)."""
+        self._index[slot] = {}
+        self._prev[slot] = {}
+        self._seq[slot] = []
+        self.extend(slot, tokens)
+
+    def extend(self, slot: int, tokens: Sequence[int]) -> None:
+        """Append committed tokens and index the new suffixes. Index
+        values are the position AFTER the n-gram (where its
+        continuation starts); the previous occurrence is kept too —
+        the current TAIL's latest occurrence is always itself, and the
+        draft is whatever followed it last time around."""
+        seq = self._seq[slot]
+        idx = self._index[slot]
+        prev = self._prev[slot]
+        for t in tokens:
+            seq.append(int(t))
+            end = len(seq)
+            for n in range(1, self.ngram + 1):
+                if end >= n:
+                    key = tuple(seq[end - n:end])
+                    old = idx.get(key)
+                    if old is not None:
+                        prev[key] = old
+                    idx[key] = end
+
+    def propose(self, slot: int, k: int) -> list[int]:
+        """Up to ``k`` draft tokens continuing the slot's current tail
+        (longest matching n-gram wins; an n-gram whose only occurrence
+        is the tail itself proposes nothing)."""
+        if k <= 0:
+            return []
+        seq = self._seq[slot]
+        idx = self._index[slot]
+        prev = self._prev[slot]
+        end = len(seq)
+        for n in range(min(self.ngram, end), 0, -1):
+            key = tuple(seq[end - n:end])
+            j = idx.get(key)
+            if j == end:                 # the tail is its own latest hit
+                j = prev.get(key)
+            if j is not None and j < end:
+                return seq[j:j + k]
+        return []
+
+
+class ModelDraftsman:
+    """Small-model drafting with a per-slot KV cache and one jitted
+    step (catch-up + k-token greedy scan), compiled once.
+
+    The draft arena is the paged layout with ONE wide block per slot
+    (identity block tables), so the masked per-cell writes ride the
+    same ``row_mask`` scatter path the verify lane uses. Per slot the
+    draftsman tracks ``draft_pos`` — how many committed positions its
+    cache has consumed; a slot drafts only when fully caught up
+    (``draft_pos == pos + 1``), so admissions and spill-resumes warm up
+    over a few iterations instead of needing a draft prefill lane."""
+
+    host_only = False
+
+    def __init__(self, model, params, *, slots: int, max_len: int,
+                 spec_depth: int, cache_dtype=None):
+        import jax
+        import jax.numpy as jnp
+
+        from hetu_tpu.models.generation import init_kv_caches
+
+        check_draft_model(model)
+        self.model = model
+        self.params = params
+        self.K = int(spec_depth)
+        self.H = self.K + 1                  # catch-up window width
+        self.slots = int(slots)
+        # one wide block per slot, sized so the deepest speculative
+        # write (pos + K - 1 <= max_len + K - 2) never clamps
+        self.row_len = int(max_len) + self.K + 1
+        max_pos = getattr(getattr(model, "cfg", None), "max_positions",
+                          None)
+        if max_pos is not None and self.row_len > max_pos:
+            raise SpeculativeConfigError(
+                f"draft model max_positions {max_pos} cannot address "
+                f"the target's max_len {max_len} + spec_depth "
+                f"{self.K} rows — use a draft model with a longer "
+                f"context or lower spec_depth")
+        self.caches = init_kv_caches(
+            model, self.slots + 1, self.row_len,
+            cache_dtype if cache_dtype is not None else jnp.float32)
+        # identity tables: slot r owns arena block r+1 (0 = null)
+        self._tables = jnp.asarray(
+            np.arange(1, self.slots + 1, dtype=np.int32)[:, None])
+        self.draft_pos = np.zeros(self.slots, np.int64)
+        self._fn = self._build(jax, jnp)
+
+    def _build(self, jax, jnp):
+        model, K, H = self.model, self.K, self.H
+        n_rows = (self.slots + 1) * self.row_len
+
+        def draft_step(params, caches, hist_tok, hist_pos, hist_len,
+                       active, tables):
+            from hetu_tpu.engine.train_step import record_trace
+            from hetu_tpu.models import generation
+            record_trace("serving_draft_step")   # 1 compile, ever
+            lane = jnp.arange(H)[None, :]
+            positions = hist_pos[:, None] + lane
+            valid = (lane < hist_len[:, None]) & active[:, None] \
+                & (positions < self.row_len)
+            logits, caches = generation.decode(
+                model, params, hist_tok, positions, caches,
+                slot_mask=active, block_tables=tables, row_mask=valid)
+            seed_row = jnp.clip(hist_len - 1, 0, H - 1)
+            lg = jnp.take_along_axis(
+                logits, seed_row[:, None, None], axis=1)[:, 0]
+            first = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            base = hist_pos + hist_len            # first draft's write
+
+            def body(carry, j):
+                caches, tok = carry
+                pos = (base + j)[:, None]
+                # rows that consumed nothing this call have no seed —
+                # their scan output is garbage and must not write
+                ok = active[:, None] & (hist_len > 0)[:, None] \
+                    & (pos < self.row_len)
+                lg, caches = generation.decode(
+                    model, params, tok[:, None], pos, caches,
+                    slot_mask=active, block_tables=tables, row_mask=ok)
+                nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+                return (caches, nxt), tok
+
+            if K > 1:
+                (caches, last), toks = jax.lax.scan(
+                    body, (caches, first), jnp.arange(K - 1))
+                drafts = jnp.concatenate(
+                    [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+            else:
+                drafts = first[:, None]
+            return caches, drafts                  # (S, K)
+
+        return jax.jit(draft_step, donate_argnums=(1,))
+
+    def reset(self, slot: int, tokens: Sequence[int]) -> None:
+        """A new (or resumed) request owns ``slot``: its draft KV is
+        cold — catch-up restarts from position 0."""
+        self.draft_pos[slot] = 0
+
+    def extend(self, slot: int, tokens: Sequence[int]) -> None:
+        """Committed tokens are consumed via catch-up, not eagerly."""
+
+    def propose_all(self, seqs: list[Optional[Sequence[int]]],
+                    pos: np.ndarray, active: np.ndarray,
+                    budget: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """One draft pass for the whole slot pool.
+
+        ``seqs[r]`` is slot r's full committed history (prompt +
+        emitted tokens, ``None`` for empty slots), ``pos[r]`` the
+        target's next KV write index (history[pos] is the not-yet-fed
+        last token), ``budget[r]`` the engine's per-slot depth clamp.
+        Returns ``(draft_tok (S, K) int32, draft_len (S,) int32)`` —
+        zero length for cold (still catching up) or inactive slots."""
+        import numpy as _np
+        S, H = self.slots, self.H
+        hist_tok = _np.zeros((S, H), _np.int32)
+        hist_pos = _np.zeros(S, _np.int32)
+        hist_len = _np.zeros(S, _np.int32)
+        warm = _np.zeros(S, bool)
+        for r in range(S):
+            if not active[r] or seqs[r] is None:
+                continue
+            avail = int(pos[r]) + 1 - int(self.draft_pos[r])
+            if avail <= 0:
+                continue       # nothing new to consume — skip this turn
+            h = min(H, avail)
+            lo = int(self.draft_pos[r])
+            hist_tok[r, :h] = seqs[r][lo:lo + h]
+            hist_pos[r] = lo
+            hist_len[r] = h
+            self.draft_pos[r] = lo + h
+            warm[r] = (lo + h) == int(pos[r]) + 1
+        self.caches, drafts = self._fn(
+            self.params, self.caches, hist_tok, hist_pos, hist_len,
+            active, self._tables)
+        drafts = _np.asarray(drafts)
+        draft_len = _np.where(warm & active, budget, 0).astype(_np.int32)
+        return drafts.astype(_np.int32), draft_len

@@ -365,6 +365,9 @@ _SERVING_PLANE_SERIES = (
     "serving_queue_depth", "serving_slot_occupancy",
     "serving_ttft_seconds", "serving_tpot_seconds",
     "serving_step_seconds",
+    "serving_draft_tokens_total", "serving_accepted_tokens_total",
+    "serving_decode_slot_steps_total", "serving_preemptions_total",
+    "serving_kv_spilled_blocks_total", "serving_kv_resumed_blocks_total",
 )
 
 
@@ -416,6 +419,28 @@ def serving_plane_summary(records: list[dict]) -> Optional[list[str]]:
                          + f"p50 {h['p50'] * 1e3:.1f}ms  "
                          f"p99 {h['p99'] * 1e3:.1f}ms  "
                          f"(n={int(h['count'])})")
+    dr = sum(by_label.get("serving_draft_tokens_total", {}).values())
+    if dr:
+        ac = sum(by_label.get(
+            "serving_accepted_tokens_total", {}).values())
+        steps = sum(by_label.get(
+            "serving_decode_slot_steps_total", {}).values())
+        line = (f"{int(ac)}/{int(dr)} accepted "
+                f"({100.0 * ac / dr:.0f}%)")
+        if steps:
+            line += f"  {1.0 + ac / steps:.2f} tok/slot-step"
+        lines.append("speculation".ljust(width) + line)
+    pre = by_label.get("serving_preemptions_total", {})
+    if pre:
+        spilled = sum(by_label.get(
+            "serving_kv_spilled_blocks_total", {}).values())
+        resumed = sum(by_label.get(
+            "serving_kv_resumed_blocks_total", {}).values())
+        per = " ".join(f"p{k}:{int(v)}" for k, v in sorted(pre.items()))
+        lines.append("preemptions".ljust(width)
+                     + f"{int(sum(pre.values()))} ({per})  "
+                     f"spilled {int(spilled)} / resumed "
+                     f"{int(resumed)} blocks")
     if "serving_slot_occupancy" in gauges:
         lines.append("slot occupancy".ljust(width)
                      + f"{100.0 * gauges['serving_slot_occupancy']:.0f}%"

@@ -121,6 +121,10 @@ SLOW_TESTS = {
     # regression stay in the quick tier
     "test_cache_on_off_identical_across_arrival_permutations",
     "test_int8_paged_pool_matches_and_hits",
+    # demoted for ISSUE 11's quick additions (the ~720s/870s budget):
+    # oversubscription is admission arithmetic the quick BlockManager
+    # unit already covers — the end-to-end run is a parity matrix
+    "test_oversubscribed_slots_share_the_arena",
     "test_graft_entry_fn_runs",
     "test_dryrun_multichip_smoke",
     # example-script smoke

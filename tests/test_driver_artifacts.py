@@ -28,12 +28,16 @@ def test_bench_emits_json_contract():
 
 def test_bench_serving_emits_json_contract(tmp_path):
     """``bench.py --serving`` must emit the offered-load sweep headline
-    and write BENCH_serving.json (the serving-plane round evidence)."""
+    and write BENCH_serving.json (the serving-plane round evidence) —
+    plus BENCH_spec.json, the speculation + QoS evidence (ISSUE 11):
+    tokens-per-slot-step > 1 at high draft acceptance, the
+    iteration-normalized TPOT improving monotonically with acceptance,
+    and a preempt→spill→resume probe that lost nothing."""
     env = dict(os.environ)
     env["HETU_TPU_BENCH_PLATFORM"] = "cpu"
     r = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "bench.py"), "--serving"],
-        capture_output=True, text=True, timeout=300, env=env, cwd=_ROOT)
+        capture_output=True, text=True, timeout=500, env=env, cwd=_ROOT)
     assert r.returncode == 0, r.stderr[-2000:]
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     for key in ("metric", "value", "unit", "sweep"):
@@ -46,6 +50,31 @@ def test_bench_serving_emits_json_contract(tmp_path):
             assert key in row, (key, row)
     with open(os.path.join(_ROOT, "BENCH_serving.json")) as f:
         assert json.load(f) == rec
+
+    with open(os.path.join(_ROOT, "BENCH_spec.json")) as f:
+        spec = json.load(f)
+    assert spec["spec_depth"] >= 2
+    rows = sorted(spec["sweep"], key=lambda s: s["acceptance_rate"])
+    assert len(rows) >= 3
+    # the adversarial floor commits exactly the non-speculative rate;
+    # tokens/slot-step rises monotonically with acceptance and beats 1
+    # where drafts land (acceptance-weighted — the fused step did the
+    # extra tokens' work inside the same iteration)
+    assert rows[0]["acceptance_rate"] == 0.0
+    assert rows[0]["tokens_per_slot_step"] == 1.0
+    for a, b in zip(rows, rows[1:]):
+        assert b["acceptance_rate"] > a["acceptance_rate"], rows
+        assert b["tokens_per_slot_step"] >= a["tokens_per_slot_step"]
+        # iteration-normalized TPOT (slot-steps per token) improves
+        # monotonically with acceptance — the wall-clock TPOT column
+        # rides along but is not asserted (CPU-smoke noise)
+        assert b["slot_steps_per_token"] <= a["slot_steps_per_token"]
+    assert rows[-1]["tokens_per_slot_step"] > 1.2, rows
+    probe = spec["preemption_probe"]
+    assert probe["preemptions"] >= 1
+    assert probe["spilled_blocks"] >= 1
+    assert probe["resumed_blocks"] == probe["spilled_blocks"]
+    assert probe["tokens_match_undisturbed"] is True
 
 
 @pytest.mark.slow

@@ -603,10 +603,13 @@ def test_serving_loop_watchdog_trips_on_stalled_step(telem, tmp_path):
     R = eng._fin_cap
     hang = threading.Event()
 
-    def fake_fn(params, caches, ctl, pf, bt, cow, key, it):
+    def fake_fn(params, caches, ctl, pf, bt, cow, spec, key, it):
         if hang.is_set():
             time.sleep(1.2)          # the stalled fake step
-        return (caches, np.zeros(S, np.int32), np.zeros(R, np.int32),
+        # the 9-operand/6-result contract (ISSUE 11 verify lane):
+        # committed tokens (S, K+1) + per-slot commit counts
+        return (caches, np.zeros((S, 1), np.int32),
+                np.ones(S, np.int32), np.zeros(R, np.int32),
                 ctl["pos"], ctl["last_tok"])
 
     eng._fn = fake_fn

@@ -1,0 +1,614 @@
+"""Speculation + QoS plane (ISSUE 11): speculative decoding in the
+fused serving step, priority scheduling, resumable KV-spill preemption.
+
+Acceptance discipline:
+
+- greedy speculative decode is TOKEN-IDENTICAL to non-speculative
+  decode (and to one-shot ``generate``) for every acceptance/rejection
+  pattern — a draftsman can only cost speed, never correctness — and
+  ``record_trace("serving_step")`` stays at 1 compile with speculation
+  and preemption churn enabled;
+- preempt→spill→resume produces identical output to an undisturbed
+  run, with ZERO prefill-lane work on resume;
+- the scheduler's deficit-weighted classes degrade to exact FCFS for
+  single-class traffic (the historical submission-order contract).
+
+Quick-tier tests here are host-side (no compiled serving step); every
+compile-bearing test is marked slow (ROADMAP quick-tier budget).
+"""
+
+import numpy as np
+import pytest
+
+from hetu_tpu.serving.kv_pool import HostSpillArena, SpillEntry
+from hetu_tpu.serving.scheduler import Request, SamplingParams, Scheduler
+from hetu_tpu.serving.speculative import (
+    NgramDraftsman, SpeculativeConfigError,
+)
+
+MAX_LEN = 32
+CHUNK = 8
+
+
+def _mk(i, plen, max_tokens=4, priority=1):
+    return Request(id=i, prompt=np.arange(1, plen + 1, dtype=np.int32),
+                   sampling=SamplingParams(max_tokens=max_tokens,
+                                           priority=priority),
+                   submit_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# host-side: draft plane
+# ---------------------------------------------------------------------------
+
+def test_ngram_draftsman_proposes_continuations():
+    d = NgramDraftsman(2, ngram=3)
+    pat = [5, 9, 2, 7]
+    d.reset(0, pat * 4)
+    # the tail 3-gram occurred before: the draft is what followed it
+    assert d.propose(0, 4) == pat
+    assert d.propose(0, 2) == pat[:2]
+    # novel history proposes nothing (the tail's only occurrence is
+    # itself)
+    d.reset(1, [1, 2, 3, 4, 5, 6, 7])
+    assert d.propose(1, 4) == []
+    # emitted tokens extend the index incrementally
+    d.extend(1, [1, 2])       # tail [1, 2] matched earlier -> continue 3
+    assert d.propose(1, 3) == [3, 4, 5]
+    # k <= 0 is a no-op, slots are independent
+    assert d.propose(0, 0) == []
+    assert d.propose(0, 4) == pat
+
+
+def test_speculative_config_errors_are_named():
+    """SATELLITE: the two guard rails raise the named error at
+    construction, never corrupting pos mid-decode."""
+    from hetu_tpu.serving.speculative import (
+        check_draft_depth, check_draft_model,
+    )
+    with pytest.raises(SpeculativeConfigError,
+                       match="would overflow a slot"):
+        check_draft_depth(MAX_LEN, MAX_LEN)
+    assert check_draft_depth(4, MAX_LEN) == 4
+    assert check_draft_depth(0, MAX_LEN) == 0
+
+    class Gate:
+        batch_coupled = True
+
+    class MLP:
+        def __init__(self):
+            self.gate = Gate()
+
+    class Model:
+        def __init__(self):
+            self.mlp = MLP()
+
+    with pytest.raises(SpeculativeConfigError,
+                       match="batch-coupled gate"):
+        check_draft_model(Model())
+    check_draft_model(object())          # benign models pass
+
+
+# ---------------------------------------------------------------------------
+# host-side: QoS scheduler
+# ---------------------------------------------------------------------------
+
+def test_scheduler_single_class_stays_exact_fcfs():
+    """The historical contract: uniform-priority traffic admits in
+    exact submission order (generate_many's ordering depends on it)."""
+    sched = Scheduler(slots=2, max_len=16)
+    for i in range(4):
+        assert sched.submit(_mk(i, 4))
+    a = sched.next_admission()
+    b = sched.next_admission()
+    assert (a[0].id, b[0].id) == (0, 1)
+    assert sched.next_admission() is None      # no free slot
+    sched.release(a[1])
+    assert sched.next_admission()[0].id == 2
+
+
+def test_scheduler_deficit_weighted_classes():
+    """Backlogged classes share admissions ~2:1 per priority step
+    (weight 2^-c), urgent first, and batch traffic never starves."""
+    sched = Scheduler(slots=1, max_len=16)
+    for i in range(8):
+        assert sched.submit(_mk(i, 4, priority=0))
+    for i in range(8, 16):
+        assert sched.submit(_mk(i, 4, priority=2))
+    order = []
+    for _ in range(12):
+        adm = sched.next_admission()
+        order.append(adm[0].sampling.priority)
+        sched.release(adm[1])
+    # urgent goes first...
+    assert order[0] == 0
+    # ...batch is NOT starved while urgent is backlogged (a 2 shows up
+    # well before the 8 queued 0s run out)...
+    assert 2 in order[:8]
+    # ...and while BOTH classes are backlogged (the first 10 — class 0
+    # still has members), urgent takes the ~4x share its 2^-c weight
+    # promises
+    both = order[:10]
+    assert both.count(0) >= 3 * both.count(2) >= 3
+    # within a class, FCFS by id
+    sched2 = Scheduler(slots=1, max_len=16)
+    for i, pr in enumerate([2, 0, 2, 0]):
+        sched2.submit(_mk(i, 4, priority=pr))
+    adm = sched2.next_admission()
+    assert (adm[0].id, adm[0].sampling.priority) == (1, 0)
+
+
+def test_scheduler_preemption_victim_selection():
+    """Victims: strictly lower priority only, lowest class first,
+    least-progressed among equals."""
+    sched = Scheduler(slots=2, max_len=16)
+    cand = _mk(0, 4, priority=0)
+    v1, v2 = _mk(1, 4, priority=2), _mk(2, 4, priority=2)
+    v1.tokens = [7, 8, 9]
+    v2.tokens = [7]
+    assert sched.preemption_victim(cand, [(0, v1), (1, v2)]) == 1
+    # equal priority never preempts (run-to-completion preserved)
+    same = _mk(3, 4, priority=2)
+    assert sched.preemption_victim(same, [(0, v1), (1, v2)]) is None
+    # a higher-priority runner is never a victim of a lower candidate
+    hi = _mk(4, 4, priority=0)
+    assert sched.preemption_victim(_mk(5, 4, priority=1),
+                                   [(0, hi)]) is None
+
+
+def test_requeue_preempted_resumes_before_class_peers():
+    sched = Scheduler(slots=1, max_len=16)
+    sched.submit(_mk(0, 4, priority=1))
+    sched.submit(_mk(1, 4, priority=1))
+    victim = _mk(9, 4, priority=1)
+    victim.tokens = [3]
+    sched.requeue_preempted(victim)
+    assert victim.status == "preempted"
+    assert sched.next_admission()[0].id == 9
+
+
+# ---------------------------------------------------------------------------
+# host-side: spill arena + pricing
+# ---------------------------------------------------------------------------
+
+def _entry(req_id, nb, *, ver=0, bs=16):
+    data = (np.zeros((2, nb, bs, 2, 4), np.float32),
+            np.zeros((2, nb, bs, 2, 4), np.float32))
+    return SpillEntry(req_id=req_id, data=data, n_blocks=nb,
+                      block_size=bs, pos=8, last_tok=3, tokens=[3],
+                      weight_version=ver)
+
+
+def test_spill_arena_capacity_and_ledgers():
+    arena = HostSpillArena(max_blocks=3)
+    assert arena.can_fit(3) and not arena.can_fit(4)
+    arena.put(_entry(0, 2))
+    assert arena.blocks_held == 2 and not arena.can_fit(2)
+    with pytest.raises(ValueError, match="spill arena full"):
+        arena.put(_entry(1, 2))
+    arena.put(_entry(1, 1))
+    assert arena.pop(0).req_id == 0
+    assert arena.blocks_held == 1
+    assert arena.spilled_total == 3 and arena.resumed_total == 2
+    # detach (router pull) is not a resume
+    arena.pop(1, resumed=False)
+    assert arena.resumed_total == 2 and arena.blocks_held == 0
+    # unbounded arena
+    assert HostSpillArena(None).can_fit(10 ** 9)
+
+
+def test_spill_arena_pricing_matches_block_ledger():
+    """SATELLITE: the host arena is priced with the SAME
+    kv_bytes_per_block arithmetic the device pool allocates with."""
+    from hetu_tpu.engine.memory import (
+        kv_bytes_per_block, size_spill_arena,
+    )
+    from hetu_tpu.models import GPTConfig
+    cfg = GPTConfig.tiny()
+    per = kv_bytes_per_block(cfg, block_size=16)
+    assert size_spill_arena(cfg, host_budget_bytes=10.5 * per,
+                            block_size=16) == 10
+    assert size_spill_arena(cfg, host_budget_bytes=10.5 * per / 4,
+                            block_size=16, cache_dtype="bf16") == 5
+    with pytest.raises(ValueError, match="does not fit"):
+        size_spill_arena(cfg, host_budget_bytes=per / 2, block_size=16)
+
+
+def test_spill_entry_compatibility_gates():
+    class Pool:
+        block_size = 16
+        caches = (np.zeros((2, 9, 16, 2, 4), np.float32),
+                  np.zeros((2, 9, 16, 2, 4), np.float32))
+
+    e = _entry(0, 2, ver=3)
+    assert e.compatible_with(Pool(), 3)
+    assert not e.compatible_with(Pool(), 4)      # weight version moved
+
+    class Pool8(Pool):
+        block_size = 8
+    assert not e.compatible_with(Pool8(), 3)     # layout mismatch
+
+    class PoolQ(Pool):
+        caches = (np.zeros((2, 9, 16, 2, 4), np.int8),) * 4
+    assert not e.compatible_with(PoolQ(), 3)     # dtype/leaf mismatch
+
+
+# ---------------------------------------------------------------------------
+# host-side: RESULT verb roundtrip (no engine, no compile)
+# ---------------------------------------------------------------------------
+
+def test_result_verb_carries_spec_qos_timing():
+    """SATELLITE: the RESULT payload's timing block reports
+    drafted/accepted/spilled counts and the priority class — driven
+    through the real protocol handler against a stub engine."""
+    import threading
+
+    from hetu_tpu.serving.server import (
+        decode_payload, handle_serving_command,
+    )
+
+    req = _mk(7, 5, max_tokens=4, priority=0)
+    req.tokens = [11, 12, 13, 14]
+    req.status = "done"
+    req.drafted = 6
+    req.accepted = 5
+    req.preemptions = 1
+    req.spilled_blocks = 2
+    req.resumed_blocks = 2
+    req.mark("admit")
+    req.done.set()
+
+    class Stub:
+        _requests_by_id = {7: req}
+        _lock = threading.Lock()
+
+        def result(self, r, timeout=None):
+            return r.result()
+
+    resp = handle_serving_command(Stub(), "RESULT", ["7", "0"])
+    assert resp.startswith("VAL ")
+    r = decode_payload(resp.split(" ", 1)[1])
+    t = r["timing"]
+    assert t["priority"] == 0
+    assert t["drafted"] == 6 and t["accepted"] == 5
+    assert t["preemptions"] == 1
+    assert t["spilled_blocks"] == 2 and t["resumed_blocks"] == 2
+    # and the priority knob decodes from the SUBMIT payload
+    from hetu_tpu.serving.server import sampling_from_payload
+    sp = sampling_from_payload({"prompt": [1], "priority": 2,
+                                "max_tokens": 3})
+    assert sp.priority == 2 and sp.max_tokens == 3
+
+
+# ---------------------------------------------------------------------------
+# compiled acceptance tests (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gpt():
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.key(0), dtype=jnp.float32)
+    return cfg, model, params
+
+
+def _ref(model, params, prompt, max_tokens, **kw):
+    import jax.numpy as jnp
+
+    from hetu_tpu.models import generate
+    out = generate(model, params, jnp.asarray(prompt, jnp.int32)[None],
+                   max_new_tokens=max_tokens, max_len=MAX_LEN, **kw)
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+def _corpus(cfg, seed=0):
+    """Mixed repetitive (high n-gram acceptance) + random prompts."""
+    rng = np.random.default_rng(seed)
+    pat = rng.integers(1, cfg.vocab_size, (4,)).tolist()
+    return [pat * 4, rng.integers(1, cfg.vocab_size, (7,)).tolist(),
+            pat * 3 + pat[:2], rng.integers(1, cfg.vocab_size,
+                                            (11,)).tolist(),
+            pat * 2]
+
+
+@pytest.mark.slow
+def test_spec_greedy_token_identical_all_patterns(gpt):
+    """ACCEPTANCE: speculative greedy decode == one-shot generate for
+    every request across arrival orders, mixed draft depths, and a
+    FORCED-rejection draftsman — at 1 fused-step compile."""
+    from hetu_tpu import telemetry
+    from hetu_tpu.engine import trace_counts
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params = gpt
+    prompts = _corpus(cfg)
+    sp = SamplingParams(max_tokens=6)
+    want = [_ref(model, params, p, 6) for p in prompts]
+
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, spec_depth=3)
+    before = trace_counts().get("serving_step", 0)
+    assert eng.generate_many(prompts, sp) == want
+    assert eng.generate_many(list(reversed(prompts)), sp) \
+        == list(reversed(want))
+    # forced rejection: a hostile draftsman that always proposes wrong
+    # tokens — outputs must be bit-identical, speed is all it can lose
+    class Hostile:
+        host_only = True
+
+        def reset(self, *a):
+            pass
+
+        def extend(self, *a):
+            pass
+
+        def propose(self, slot, k):
+            return [0] * k           # token 0 never sampled (prompts>0)
+
+    eng._draftsman = Hostile()
+    assert eng.generate_many(prompts, sp) == want
+    assert trace_counts().get("serving_step", 0) - before == 1, \
+        "speculation churn re-traced the fused step"
+    # mixed depths in one batch: depth riding per-slot data
+    eng2 = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, spec_depth=1)
+    assert eng2.generate_many(prompts, sp) == want
+    # sampled requests coexist (depth clamps to 0 for them, in range)
+    mixed = [SamplingParams(max_tokens=6),
+             SamplingParams(max_tokens=6, temperature=1.0, top_k=10)]
+    outs = eng.generate_many(prompts[:2], mixed)
+    assert outs[0] == want[0]
+    assert all(0 <= t < cfg.vocab_size for t in outs[1])
+    _ = telemetry
+
+
+@pytest.mark.slow
+def test_spec_int8_pool_matches_and_accepts(gpt):
+    """ACCEPTANCE: the quantized paged pool under speculation still
+    reproduces one-shot int8 generation, and drafts actually land."""
+    import jax.numpy as jnp
+
+    from hetu_tpu import telemetry
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params = gpt
+    prompts = _corpus(cfg, seed=2)
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                            prefill_chunk=CHUNK, cache_dtype=jnp.int8,
+                            spec_depth=3)
+        sp = SamplingParams(max_tokens=5)
+        want = [_ref(model, params, p, 5, cache_dtype=jnp.int8)
+                for p in prompts]
+        assert eng.generate_many(prompts, sp) == want
+        reg = telemetry.get_registry()
+        ac = reg.counter("serving_accepted_tokens_total").value()
+        assert ac > 0
+        steps = reg.counter("serving_decode_slot_steps_total").value()
+        assert 1.0 + ac / steps > 1.0    # tokens per slot-step beat 1
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()
+
+
+@pytest.mark.slow
+def test_preempt_spill_resume_identity(gpt):
+    """ACCEPTANCE: preempt→spill→resume output == undisturbed run, the
+    resumed request does ZERO prefill-lane work, and the spill/resume
+    executables stay at one compile each."""
+    from hetu_tpu import telemetry
+    from hetu_tpu.engine import trace_counts
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params = gpt
+    rng = np.random.default_rng(1)
+    lo_p = rng.integers(1, cfg.vocab_size, (10,)).tolist()
+    hi_p = rng.integers(1, cfg.vocab_size, (8,)).tolist()
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        eng = ServingEngine(model, params, slots=1, max_len=MAX_LEN,
+                            prefill_chunk=CHUNK)
+        before = trace_counts().get("serving_step", 0)
+        lo = eng.submit(lo_p, SamplingParams(max_tokens=16, priority=2))
+        for _ in range(6):
+            eng.step()                       # lo mid-decode
+        assert len(lo.tokens) > 1
+        hi = eng.submit(hi_p, SamplingParams(max_tokens=4, priority=0))
+        eng.run_until_drained()
+        assert lo.preemptions == 1
+        assert lo.spilled_blocks >= 1
+        assert lo.resumed_blocks == lo.spilled_blocks
+        assert list(hi.tokens) == _ref(model, params, hi_p, 4)
+        assert list(lo.tokens) == _ref(model, params, lo_p, 16)
+        # zero prefill-lane work on resume: the only prefill chunks are
+        # the ORIGINAL ones (ceil(10/8) = 2), and the event trail shows
+        # preempted -> admit -> resumed with no prefill between
+        assert lo.timing()["prefill_chunks"] == 2
+        phases = [p for p, _, _ in lo.events]
+        i = phases.index("preempted")
+        assert phases[i:i + 3] == ["preempted", "admit", "resumed"]
+        assert trace_counts().get("serving_step", 0) - before <= 1
+        assert trace_counts().get("serving_kv_spill", 0) <= 1
+        assert trace_counts().get("serving_kv_resume", 0) <= 1
+        reg = telemetry.get_registry()
+        assert reg.counter("serving_preemptions_total").value(
+            priority="2") == 1
+        t = lo.result()["timing"]
+        assert t["preemptions"] == 1 and t["spilled_blocks"] >= 1
+        # the arena drained (gauge parity)
+        assert eng.spill_arena.blocks_held == 0
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()
+
+
+@pytest.mark.slow
+def test_preempt_with_speculation_churn_one_compile(gpt):
+    """Speculation AND preemption in the same engine: token identity
+    holds through the combined churn at 1 fused-step compile."""
+    from hetu_tpu.engine import trace_counts
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params = gpt
+    rng = np.random.default_rng(3)
+    pat = rng.integers(1, cfg.vocab_size, (4,)).tolist()
+    lo_p = pat * 4                      # repetitive: speculation bites
+    hi_p = rng.integers(1, cfg.vocab_size, (8,)).tolist()
+    eng = ServingEngine(model, params, slots=1, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, spec_depth=3)
+    before = trace_counts().get("serving_step", 0)
+    lo = eng.submit(lo_p, SamplingParams(max_tokens=12, priority=2))
+    for _ in range(4):
+        eng.step()
+    hi = eng.submit(hi_p, SamplingParams(max_tokens=4, priority=0))
+    eng.run_until_drained()
+    assert lo.preemptions >= 1
+    assert list(lo.tokens) == _ref(model, params, lo_p, 12)
+    assert list(hi.tokens) == _ref(model, params, hi_p, 4)
+    assert trace_counts().get("serving_step", 0) - before <= 1
+
+
+@pytest.mark.slow
+def test_model_draftsman_greedy_parity(gpt):
+    """The small-model draft path: a zoo model drafting (here the
+    target itself — the acceptance ceiling) stays token-identical and
+    actually accepts drafts once warm, at 1 draft-step compile."""
+    from hetu_tpu import telemetry
+    from hetu_tpu.engine import trace_counts
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params = gpt
+    prompts = _corpus(cfg, seed=4)[:3]
+    sp = SamplingParams(max_tokens=8)
+    want = [_ref(model, params, p, 8) for p in prompts]
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                            prefill_chunk=CHUNK, spec_depth=3,
+                            draft_model=model, draft_params=params)
+        assert eng.generate_many(prompts, sp) == want
+        assert trace_counts().get("serving_draft_step", 0) == 1
+        reg = telemetry.get_registry()
+        dr = reg.counter("serving_draft_tokens_total").value()
+        ac = reg.counter("serving_accepted_tokens_total").value()
+        assert dr > 0
+        # self-drafting: once warm, acceptance is near-perfect
+        assert ac / dr > 0.8, (ac, dr)
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()
+
+
+@pytest.mark.slow
+def test_router_death_requeue_resumes_on_peer(gpt):
+    """ACCEPTANCE: kill_replica mid-decode loses/duplicates nothing AND
+    the dead replica's mid-decode request moves its KV to the peer
+    (resumed dispatch, no re-prefill)."""
+    from hetu_tpu import telemetry
+    from hetu_tpu.serving import Router, SamplingParams, ServingEngine
+
+    cfg, model, params = gpt
+    telemetry.reset()
+    telemetry.enable(True)
+    router = Router(poll_s=0.001)
+    try:
+        engines = {}
+        for name in ("r0", "r1"):
+            engines[name] = ServingEngine(
+                model, params, slots=2, max_len=MAX_LEN,
+                prefill_chunk=CHUNK)
+            router.register(name, engines[name])
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, cfg.vocab_size, (6,)).tolist()
+                   for _ in range(6)]
+        sp = SamplingParams(max_tokens=12)
+        want = [_ref(model, params, p, 12) for p in prompts]
+        reqs = [router.submit(p, sp) for p in prompts]
+        # wait until a replica has mid-decode work, then kill it
+        victim = None
+        for _ in range(2000):
+            for name, eng in engines.items():
+                if eng._active.any() and router._replicas[
+                        name].state == "live":
+                    victim = name
+                    break
+            if victim:
+                break
+            import time
+            time.sleep(0.002)
+        assert victim is not None
+        router.kill_replica(victim)
+        for r in reqs:
+            assert r.done.wait(120.0)
+        assert [list(r.tokens) for r in reqs] == want   # zero lost/dup
+        # at least one request rode the resumable path to the peer
+        resumed = sum(r.resumed_dispatches for r in reqs)
+        assert resumed >= 1, "death requeue never used the KV spill"
+        assert telemetry.get_registry().counter(
+            "router_resumed_requeues_total").value() >= 1
+    finally:
+        router.stop()
+        telemetry.enable(False)
+        telemetry.reset()
+
+
+@pytest.mark.slow
+def test_publisher_preemptive_drain_resumes_on_peers(gpt):
+    """WeightPublisher drains route through the resumable path: a
+    replica with long-running decodes drains by SPILLING them to a
+    same-version peer — no lost work, outputs complete, and the swap
+    still lands."""
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_tpu.serving import (
+        Router, SamplingParams, ServingEngine, WeightPublisher,
+    )
+
+    cfg, model, params = gpt
+    router = Router(poll_s=0.001)
+    try:
+        engines = {}
+        for name in ("r0", "r1"):
+            engines[name] = ServingEngine(
+                model, params, slots=2, max_len=MAX_LEN,
+                prefill_chunk=CHUNK)
+            router.register(name, engines[name])
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(1, cfg.vocab_size, (5,)).tolist()
+                   for _ in range(4)]
+        sp = SamplingParams(max_tokens=14)
+        reqs = [router.submit(p, sp) for p in prompts]
+        # let decodes get going, then push new weights mid-flight
+        import time
+        for _ in range(2000):
+            if any(e._active.any() for e in engines.values()):
+                break
+            time.sleep(0.002)
+        params2 = jax.tree.map(lambda x: x * (1.0 + 1e-3)
+                               if isinstance(x, jax.Array) else x,
+                               params)
+        report = WeightPublisher(router).publish(params2, version=7)
+        assert all("skipped" not in p for p in report["replicas"])
+        for r in reqs:
+            assert r.done.wait(120.0)
+            assert r.status == "done"
+        # requests admitted before the push finished under version 0 —
+        # a preempted-and-resumed one must NOT have re-prefilled under
+        # the new weights
+        for r in reqs:
+            assert r.weight_version == 0
+        assert router.fleet_status()["weight_versions"] == [7]
+        # outputs under the OLD weights match old-weight one-shots
+        want = [_ref(model, params, p, 14) for p in prompts]
+        assert [list(r.tokens) for r in reqs] == want
+    finally:
+        router.stop()

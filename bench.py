@@ -914,6 +914,164 @@ def moe_main():
     print(json.dumps(result))
 
 
+def chaos_main():
+    """``bench.py --chaos``: goodput vs injected kills under the three
+    recovery disciplines — restart-from-disk (the reference's only
+    mode), live in-memory reshard, and live reshard with async delta
+    checkpointing. Each mode trains the same stream on the 8-virtual-CPU
+    mesh, takes two kills driven through the REAL heartbeat/membership
+    path, and reports the goodput ledger + recovery/detection latency +
+    delta-checkpoint byte savings. CPU-smoke ratios are the product
+    (absolute times only matter on TPU); BENCH_chaos.json is the round
+    artifact and ``tools/trace_summary`` grows a matching "recovery
+    plane" section."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
+    jax.config.update("jax_platforms", "cpu")
+    telemetry.enable(True)
+
+    import numpy as np
+
+    from hetu_tpu.engine import chaos
+    from hetu_tpu.engine.elastic import (
+        ElasticController, ElasticSupervisor, HeartbeatSender,
+    )
+    from hetu_tpu.engine.trainer import Trainer, TrainerConfig
+    from hetu_tpu.rpc import Coordinator
+    from hetu_tpu.tools.galvatron import ModelDims, TPUTopology
+
+    cfg = GPTConfig.tiny()
+    dims = ModelDims.from_config(cfg, seq_len=32, global_batch=8)
+    topo = TPUTopology(num_devices=8)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (8, 33))
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    seg = 4                       # steps between kills
+    kill_at = ("w7", "w3")        # two kills per run
+
+    modes = (
+        ("restart_from_disk",
+         dict(force_disk=True), dict(delta_ckpt=False, async_ckpt=False)),
+        ("live_reshard",
+         dict(force_disk=False), dict(delta_ckpt=False, async_ckpt=False)),
+        ("live_reshard_delta_async",
+         dict(force_disk=False), dict(delta_ckpt=True, async_ckpt=True)),
+    )
+
+    def run_mode(name, sup_kw, ckpt_kw):
+        telemetry.reset()
+        telemetry.enable(True)
+        chaos._clear_for_tests()
+        out = tempfile.mkdtemp(prefix=f"chaos_{name}_")
+        ckpt = os.path.join(out, "ckpt")
+        trainer = Trainer(
+            GPTLMHeadModel(cfg), optim.adamw(1e-2), Strategy(dp=8),
+            TrainerConfig(ckpt_dir=ckpt, distributed_ckpt=True,
+                          total_steps=10_000, log_every=0,
+                          telemetry=True, **ckpt_kw))
+        t0 = _time.perf_counter()
+        disk_loads = {"n": 0}
+        from hetu_tpu.utils import dist_checkpoint as _dc
+        orig_load = _dc.load_checkpoint_distributed
+
+        def counted_load(*a, **kw):
+            disk_loads["n"] += 1
+            return orig_load(*a, **kw)
+
+        _dc.load_checkpoint_distributed = counted_load
+        try:
+            with Coordinator() as coord:
+                hbs = {f"w{i}": HeartbeatSender(
+                    coord.port, f"w{i}", interval_s=0.25).start()
+                    for i in range(8)}
+                ctrl = ElasticController(coord.port, timeout_ms=3000)
+                sup = ElasticSupervisor(
+                    trainer, ctrl,
+                    device_map={f"w{i}": [i] for i in range(8)},
+                    dims=dims, topo=topo, checkpoint_dir=ckpt,
+                    allow_hetero=False, poll_s=0.2,
+                    strategy_filter=lambda s: s.pp == 1,
+                    **sup_kw).start()
+                monkey = chaos.ChaosMonkey(
+                    {n: (lambda n=n: hbs[n].stop()) for n in hbs})
+                losses = []
+                stream = iter(batch for _ in range(seg * 3))
+                losses += sup.run(stream, seg, ckpt_every=1)
+                for i, victim in enumerate(kill_at):
+                    monkey.kill(victim)
+                    deadline = _time.monotonic() + 30
+                    while sup.pending() + len(sup.recoveries) < i + 1 \
+                            and _time.monotonic() < deadline:
+                        _time.sleep(0.1)
+                    losses += sup.run(stream, seg, ckpt_every=1)
+                sup.stop()
+                for hb in hbs.values():
+                    hb.stop()
+        finally:
+            _dc.load_checkpoint_distributed = orig_load
+        wall = _time.perf_counter() - t0
+        rep = trainer.goodput.report(wall_s=wall)
+        snap = telemetry.get_registry().snapshot()
+
+        def series_sum(base, sel=""):
+            return sum(v for k, v in snap.items()
+                       if k.split("{")[0] == base and sel in k
+                       and isinstance(v, (int, float)))
+
+        trainer.close()
+        shutil.rmtree(out, ignore_errors=True)
+        row = {
+            "mode": name, "steps": len(losses),
+            "kills": len(monkey.kills),
+            "recoveries": len(sup.recoveries),
+            "recovery_modes": [r["mode"] for r in sup.recoveries],
+            "disk_loads": disk_loads["n"],
+            "goodput": round(rep.goodput, 4),
+            "wall_s": round(wall, 3),
+            "recovery_s": round(sum(r["seconds"]
+                                    for r in sup.recoveries), 3),
+            "detect_s_mean": round(float(np.mean(
+                [r["detect_s"] for r in sup.recoveries
+                 if r["detect_s"] is not None] or [0.0])), 3),
+            "checkpoint_s": round(
+                rep.components.get("checkpoint", 0.0), 3),
+            "ckpt_written_bytes": int(series_sum(
+                "checkpoint_delta_bytes_total", 'kind="written"')),
+            "ckpt_reused_bytes": int(series_sum(
+                "checkpoint_delta_bytes_total", 'kind="reused"')),
+            "final_loss": round(losses[-1]["loss"], 4),
+            "final_step": losses[-1]["step"],
+        }
+        print(f"[chaos] {json.dumps(row)}", file=sys.stderr, flush=True)
+        return row
+
+    sweep = [run_mode(*m) for m in modes]
+    by_mode = {r["mode"]: r for r in sweep}
+    best = by_mode["live_reshard_delta_async"]
+    result = {
+        "metric": "chaos_goodput_live_delta",
+        "value": best["goodput"], "unit": "fraction_of_wall",
+        "device": "cpu-sim-8", "kills_per_run": len(kill_at),
+        "sweep": sweep,
+        "note": "goodput under 2 injected kills via the heartbeat/"
+                "membership path; restart-from-disk vs live reshard vs "
+                "live reshard + async delta checkpoints",
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_chaos.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    try:
+        _write_bench_telemetry(result)
+    except Exception:
+        pass
+    print(json.dumps(result))
+
+
 def main():
     telemetry.enable(True)
     if not probe_tpu():
@@ -1201,5 +1359,7 @@ if __name__ == "__main__":
         moe_main()
     elif "--ragged" in sys.argv:
         ragged_main()
+    elif "--chaos" in sys.argv:
+        chaos_main()
     else:
         main()

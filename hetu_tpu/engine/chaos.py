@@ -1,0 +1,251 @@
+"""Chaos harness: kill-based fault injection for the preemption plane.
+
+SURVEY §5.3 ends with "no kill-based chaos testing": the reference (and,
+until this module, this tree) could *recover* from failures but nothing
+ever proved it — every elastic code path was exercised only by polite,
+cooperative exits. This module makes failure injectable on purpose and
+continuously testable:
+
+- :func:`chaos_point` — named in-line injection sites compiled into
+  production code paths (``dist_ckpt.between_tensor_and_index``,
+  ``trainer.mid_switch``, ...). Disarmed they cost one dict lookup.
+  Armed (programmatically via :func:`arm`, or through the environment
+  for subprocess workers — ``HETU_CHAOS_POINT``) they SIGKILL the
+  process or raise :class:`ChaosError` at exactly that site, after an
+  optional hit count — "die between the tensor-file rename and the
+  index write" becomes a one-line test.
+- :class:`ChaosMonkey` — a scheduler over named kill targets (pool
+  workers via ``ElasticWorkerPool.kill_worker``, simulated in-process
+  workers via their heartbeat, the coordinator/controller itself).
+  Every kill lands a ``chaos_kill`` flight event and a
+  ``chaos_kills_total{target=...}`` counter *in the surviving process*
+  (the victim of a SIGKILL writes nothing — the injector is the
+  forensic witness), and stamps :func:`last_kill_ts` so the recovery
+  path can report detection latency (``elastic_detect_seconds``).
+
+The assertion side lives in ``engine/elastic.py`` (the supervisor that
+must survive these kills) and ``tests/test_chaos.py`` (loss-curve
+continuity vs an undisturbed run). docs/ELASTICITY.md documents the
+knobs.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+from hetu_tpu.telemetry.flight import flight_record
+from hetu_tpu.utils.logging import get_logger
+
+#: environment knobs for subprocess workers (ElasticWorkerPool ships its
+#: env to every worker): HETU_CHAOS_POINT="<name>[:<nth-hit>]" arms one
+#: point, HETU_CHAOS_ACTION ∈ {sigkill, raise}, HETU_CHAOS_RANK limits
+#: the arming to one worker rank (default: all ranks).
+_ENV_POINT = "HETU_CHAOS_POINT"
+_ENV_ACTION = "HETU_CHAOS_ACTION"
+_ENV_RANK = "HETU_CHAOS_RANK"
+_ENV_GEN = "HETU_CHAOS_GEN"
+
+
+class ChaosError(RuntimeError):
+    """Raised by an armed chaos point with ``action="raise"``."""
+
+
+_lock = threading.Lock()
+_armed: dict[str, dict] = {}      # name -> {action, after, hits}
+_fired: list[dict] = []           # raise-action firings (test forensics)
+_last_kill: dict[str, float] = {}  # target -> unix ts of last injected kill
+
+
+def arm(name: str, *, action: str = "raise", after: int = 1) -> None:
+    """Arm ``name``: the ``after``-th :func:`chaos_point` hit fires
+    (``action``: ``"raise"`` → :class:`ChaosError`, ``"sigkill"`` →
+    ``SIGKILL`` to *this* process — the real preemption shape)."""
+    if action not in ("raise", "sigkill"):
+        raise ValueError(f"chaos action must be raise|sigkill: {action!r}")
+    with _lock:
+        _armed[name] = {"action": action, "after": int(after), "hits": 0}
+
+
+def disarm(name: Optional[str] = None) -> None:
+    """Disarm one point (or all of them; also clears the fired log)."""
+    with _lock:
+        if name is None:
+            _armed.clear()
+            _fired.clear()
+        else:
+            _armed.pop(name, None)
+
+
+def fired() -> list[dict]:
+    """Raise-action firings so far (``[{point, hit, ...fields}]``)."""
+    with _lock:
+        return list(_fired)
+
+
+def _env_spec(name: str) -> Optional[dict]:
+    """Arming from the environment (subprocess workers). Returns the
+    spec when ``name`` is armed for this process, else None."""
+    spec = os.environ.get(_ENV_POINT)
+    if not spec:
+        return None
+    rank = os.environ.get(_ENV_RANK)
+    if rank is not None and os.environ.get("HETU_RANK") != rank:
+        return None
+    # restartable pools: arm only one generation, or the restarted
+    # worker dies at the same point forever
+    gen = os.environ.get(_ENV_GEN)
+    if gen is not None and os.environ.get("HETU_GENERATION") != gen:
+        return None
+    point, _, after = spec.partition(":")
+    if point != name:
+        return None
+    return {"action": os.environ.get(_ENV_ACTION, "sigkill"),
+            "after": int(after) if after else 1}
+
+
+def chaos_point(name: str, **fields) -> None:
+    """An injection site. Disarmed: a dict lookup. Armed: count the hit
+    and, on the ``after``-th one, record a ``chaos_kill`` flight event
+    and die (SIGKILL) or raise (:class:`ChaosError`)."""
+    with _lock:
+        spec = _armed.get(name)
+        if spec is None:
+            env = _env_spec(name)
+            if env is None:
+                return
+            spec = _armed[name] = {**env, "hits": 0}
+        spec["hits"] += 1
+        if spec["hits"] != spec["after"]:
+            return
+        action = spec["action"]
+        _fired.append({"point": name, "hit": spec["hits"], **fields})
+    # outside the lock: the flight record and the kill must not deadlock
+    # a recorder used by other threads
+    flight_record("chaos_kill", target=name, action=action, **fields)
+    _count_kill(name)
+    get_logger().warning(f"chaos: firing {action} at point {name!r}")
+    if action == "sigkill":
+        # SIGKILL is uncatchable — leave the postmortem NOW (the dump is
+        # atomic; a best-effort failure must not save the victim)
+        try:
+            from hetu_tpu.telemetry.flight import get_flight_recorder
+            get_flight_recorder().dump(reason="chaos_kill")
+        except Exception:
+            pass
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise ChaosError(f"chaos point {name!r} fired")
+
+
+def _count_kill(target: str) -> None:
+    with _lock:
+        _last_kill[target] = time.time()
+        _last_kill["*"] = _last_kill[target]
+    from hetu_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.get_registry().counter(
+            "chaos_kills_total",
+            "injected kills by target (chaos harness)").inc(target=target)
+
+
+def last_kill_ts(target: str = "*") -> Optional[float]:
+    """Unix timestamp of the most recent injected kill (``"*"`` = any
+    target) — the recovery path subtracts this to report detection
+    latency. None when no kill was injected in this process."""
+    with _lock:
+        return _last_kill.get(target)
+
+
+def _clear_for_tests() -> None:
+    with _lock:
+        _armed.clear()
+        _fired.clear()
+        _last_kill.clear()
+
+
+class ChaosMonkey:
+    """Kill scheduler over named targets.
+
+    A target is ``(name, kill_fn)``: a pool worker
+    (``lambda: pool.kill_worker(rank)``), a simulated in-process worker
+    (``heartbeat.stop`` — the CPU-simulation stand-in for a SIGKILLed
+    host), or the coordinator/controller. Kills can be driven
+    explicitly (:meth:`kill` — deterministic tests, step-indexed
+    injection) or on a wall-clock period (:meth:`start` — soak runs).
+    Every kill is witnessed here: ``chaos_kill`` flight event +
+    ``chaos_kills_total{target=...}`` + the :func:`last_kill_ts` stamp.
+    """
+
+    def __init__(self, targets: Optional[dict[str, Callable[[], None]]]
+                 = None, *, period_s: float = 0.0, max_kills: int = 0,
+                 seed: int = 0):
+        import random
+        self.targets = dict(targets or {})
+        self.period_s = float(period_s)
+        self.max_kills = int(max_kills)
+        self.kills: list[dict] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def for_pool(cls, pool, ranks=None, **kw) -> "ChaosMonkey":
+        """Targets ``worker-<rank>`` → ``pool.kill_worker(rank)`` for an
+        :class:`~hetu_tpu.rpc.launcher.ElasticWorkerPool`."""
+        ranks = range(pool.num_workers) if ranks is None else ranks
+        return cls({f"worker-{r}": (lambda r=r: pool.kill_worker(r))
+                    for r in ranks}, **kw)
+
+    def add_target(self, name: str, kill_fn: Callable[[], None]) -> None:
+        self.targets[name] = kill_fn
+
+    def kill(self, name: Optional[str] = None, **fields) -> str:
+        """Kill ``name`` (or a uniformly random target). Records the
+        witness events, then invokes the target's kill function."""
+        if not self.targets:
+            raise ValueError("chaos monkey has no targets")
+        if name is None:
+            name = self._rng.choice(sorted(self.targets))
+        kill_fn = self.targets[name]
+        flight_record("chaos_kill", target=name, action="kill", **fields)
+        _count_kill(name)
+        self.kills.append({"target": name, "ts": time.time(), **fields})
+        get_logger().warning(f"chaos: killing {name}")
+        kill_fn()
+        return name
+
+    # -- wall-clock soak mode ------------------------------------------------
+    def start(self) -> "ChaosMonkey":
+        if self.period_s <= 0:
+            raise ValueError("start() needs period_s > 0")
+        self._stop = threading.Event()
+
+        def run():
+            while not self._stop.wait(self.period_s):
+                if self.max_kills and len(self.kills) >= self.max_kills:
+                    return
+                try:
+                    self.kill()
+                except Exception as e:   # a dead target is not fatal
+                    get_logger().warning(f"chaos kill failed: {e}")
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="chaos-monkey")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "ChaosMonkey":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False

@@ -13,22 +13,45 @@ checkpoints are global-valued, so cross-topology restore is just a load).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable, Optional
 
+import jax
+
 from hetu_tpu.engine.straggler import StragglerReport
 from hetu_tpu.rpc.client import CoordinatorClient
+from hetu_tpu.telemetry.flight import flight_record
 from hetu_tpu.utils.logging import get_logger
 
 
 class HeartbeatSender:
-    """Background heartbeat thread for one worker."""
+    """Background heartbeat thread for one worker.
 
-    def __init__(self, port: int, name: str, interval_s: float = 1.0):
+    Transient RPC failures (a coordinator GC pause, a dropped TCP
+    segment, a rolling restart) are retried through a fresh connection
+    with jittered exponential backoff — the hardened-client discipline
+    from the serving plane. Only ``max_failures`` CONSECUTIVE failures
+    kill the thread, loudly (error log + ``heartbeat_give_up`` flight
+    event + optional ``on_give_up`` callback); anything less used to
+    silently stop the heartbeat and get the worker falsely declared
+    dead. Every failed send counts ``heartbeat_send_failures_total``.
+    """
+
+    def __init__(self, port: int, name: str, interval_s: float = 1.0, *,
+                 max_failures: int = 5, backoff_s: float = 0.05,
+                 backoff_max_s: float = 2.0,
+                 on_give_up: Optional[Callable[[str], None]] = None):
         self.client = CoordinatorClient(port)
         self.name = name
         self.interval_s = interval_s
+        self.max_failures = int(max_failures)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.on_give_up = on_give_up
+        self.consecutive_failures = 0
+        self.gave_up = False
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -37,15 +60,54 @@ class HeartbeatSender:
         self._thread.start()
         return self
 
+    def _count_failure(self) -> None:
+        from hetu_tpu import telemetry
+        if telemetry.enabled():
+            telemetry.get_registry().counter(
+                "heartbeat_send_failures_total",
+                "failed heartbeat sends (retried with backoff; only "
+                "max_failures consecutive ones kill the sender)").inc(
+                    worker=self.name)
+
     def _run(self):
         while not self._stop.wait(self.interval_s):
             try:
                 self.client.heartbeat(self.name)
-            except Exception:
-                return
+                self.consecutive_failures = 0
+            except Exception as e:
+                self.consecutive_failures += 1
+                self._count_failure()
+                flight_record("heartbeat_send_failure", worker=self.name,
+                              consecutive=self.consecutive_failures,
+                              error=type(e).__name__)
+                if self.consecutive_failures >= self.max_failures:
+                    self.gave_up = True
+                    get_logger().error(
+                        f"heartbeat[{self.name}]: {self.max_failures} "
+                        f"consecutive send failures ({e!r}) — giving up; "
+                        f"this worker WILL be declared dead")
+                    flight_record("heartbeat_give_up", worker=self.name,
+                                  failures=self.consecutive_failures)
+                    if self.on_give_up is not None:
+                        try:
+                            self.on_give_up(self.name)
+                        except Exception:
+                            pass
+                    return
+                delay = min(self.backoff_max_s,
+                            self.backoff_s
+                            * (2 ** (self.consecutive_failures - 1)))
+                if self._stop.wait(delay * (0.5 + random.random())):
+                    return
+                try:
+                    self.client._reconnect()
+                except Exception:
+                    pass   # next send retries the connect itself
 
-    def stop(self):
+    def stop(self, join: bool = False):
         self._stop.set()
+        if join and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
 
 class ElasticController:
@@ -63,7 +125,8 @@ class ElasticController:
                       num_layers: Optional[int] = None,
                       num_microbatches: int = 8,
                       allow_hetero: bool = True,
-                      alive_device_ids=None):
+                      alive_device_ids=None,
+                      candidate_filter: Optional[Callable] = None):
         """New strategy for the surviving device count.
 
         Power-of-two survivor counts get a uniform Strategy from the
@@ -77,7 +140,13 @@ class ElasticController:
         survivors (pow2 stage sizes, layers ∝ stage width via the
         Malleus planner) and adopt it when its bubble-discounted
         throughput beats the stranded-uniform plan. Feed the result to
-        ``Trainer.shrink_to`` — both strategy kinds hot-switch."""
+        ``Trainer.shrink_to`` — both strategy kinds hot-switch.
+
+        ``candidate_filter`` is the operator constraint on the recovery
+        strategy and governs BOTH kinds — it may be handed a uniform
+        :class:`Strategy` or a ``HeteroStrategy`` (write attribute
+        checks as ``getattr(s, "tp", 1)`` where the kinds differ; both
+        expose ``pp``)."""
         from hetu_tpu.tools.galvatron import TPUTopology, search_uniform
 
         n = n_alive_devices
@@ -91,6 +160,9 @@ class ElasticController:
             het = _hetero_recovery(n_alive_devices, num_layers,
                                    num_microbatches,
                                    alive_device_ids=alive_device_ids)
+            if het is not None and candidate_filter is not None \
+                    and not candidate_filter(het):
+                het = None   # the operator constraint governs BOTH kinds
             if het is not None:
                 # bubble-discounted device-seconds: hetero keeps all
                 # survivors busy but pays the pipeline bubble; the
@@ -109,6 +181,11 @@ class ElasticController:
             dcn_bw=topo.dcn_bw, hbm_bytes=topo.hbm_bytes,
             mxu_efficiency=topo.mxu_efficiency, dp_overlap=topo.dp_overlap)
         cands = search_uniform(dims, new_topo)
+        if candidate_filter is not None:
+            # operator constraint on the recovery strategy (e.g. exclude
+            # pipeline plans on runtimes where the SPMD executor is
+            # gated — the search's cost ranking is preserved)
+            cands = [c for c in cands if candidate_filter(c.strategy)]
         if not cands:
             return None
         get_logger().info(
@@ -117,19 +194,48 @@ class ElasticController:
         return cands[0].strategy
 
     def watch(self, on_failure: Callable[[list[str], list[str]], None], *,
-              poll_s: float = 1.0, stop: Optional[threading.Event] = None):
-        """Poll membership; invoke ``on_failure(alive, dead)`` once when
-        deaths appear. Returns the watcher thread."""
+              poll_s: float = 1.0, stop: Optional[threading.Event] = None,
+              one_shot: bool = False):
+        """Poll membership; invoke ``on_failure(alive, dead)`` when NEW
+        deaths appear. Returns the watcher thread (``thread.stop_event``
+        stops it; join for a clean teardown).
+
+        The watcher RE-ARMS after the callback returns, so the second
+        failure in a job is observed too (the one-shot-and-exit shape is
+        available for back-compat via ``one_shot=True``). A member that
+        resumes beating (or is re-admitted) leaves the seen-dead set, so
+        its NEXT death fires again. Transient ``check()`` failures (the
+        coordinator itself briefly unreachable) are logged and retried
+        on the next poll, never fatal to the watcher."""
         stop = stop or threading.Event()
+        seen_dead: set[str] = set()
 
         def run():
             while not stop.wait(poll_s):
-                alive, dead = self.check()
-                if dead:
+                try:
+                    alive, dead = self.check()
+                except Exception as e:
+                    get_logger().warning(
+                        f"elastic watch: membership check failed ({e!r})"
+                        f" — retrying")
+                    continue
+                seen_dead.intersection_update(dead)   # revived members
+                new = [d for d in dead if d not in seen_dead]
+                if not new:
+                    continue
+                seen_dead.update(new)
+                flight_record("elastic_member_death", dead=new,
+                              alive=list(alive))
+                try:
                     on_failure(alive, dead)
+                except Exception as e:
+                    get_logger().error(
+                        f"elastic watch: on_failure raised {e!r}")
+                if one_shot:
                     return
 
-        t = threading.Thread(target=run, daemon=True)
+        t = threading.Thread(target=run, daemon=True,
+                             name="elastic-watch")
         t.start()
         t.stop_event = stop  # type: ignore[attr-defined]
         return t
@@ -223,3 +329,293 @@ def elastic_resume(model, opt, new_strategy, *, state=None, devices=None,
     from hetu_tpu.utils.dist_checkpoint import load_checkpoint_distributed
     return new_plan, load_checkpoint_distributed(
         checkpoint_dir, model, opt, plan=new_plan)
+
+
+class ElasticSupervisor:
+    """The in-job shrink/grow loop: membership watch → recovery plan →
+    live reshard (or disk fallback) → keep training — re-armed for the
+    next failure.
+
+    Wires the pieces that already existed but were never driven end to
+    end: :meth:`ElasticController.watch` detects member loss through the
+    heartbeat path, :meth:`ElasticController.recovery_plan` picks a
+    strategy for the survivors, and ``Trainer.shrink_to`` live-reshards
+    the resident state through the HotSPa ``cross_topology_switch`` — no
+    disk read while the controller survives. When the live reshard is
+    impossible (or the controller restarted with no resident state,
+    ``force_disk``), recovery falls back to the newest COMPLETE
+    checkpoint under ``checkpoint_dir`` (torn saves are rejected by the
+    loader's step-stamp checks). ``grow`` re-admits a returning worker
+    through the same switch path.
+
+    Failure callbacks land on the watcher thread; the actual recovery
+    runs at a step boundary of the supervised loop (:meth:`poll` /
+    :meth:`run`) — resharding live state under a mid-flight train step
+    would race the donated buffers.
+
+    Telemetry: ``elastic_recoveries_total{mode=live|disk|grow}``,
+    ``elastic_recovery_seconds{mode=...}`` and
+    ``elastic_detect_seconds`` (kill → membership-detection latency,
+    when the chaos harness stamped the kill); flight events
+    ``elastic_replan`` / ``elastic_resume`` / ``elastic_grow`` make
+    every recovery forensically visible. Recovery wall time lands in the
+    goodput ledger under the ``recovery`` category.
+    """
+
+    def __init__(self, trainer, controller: ElasticController, *,
+                 device_map: dict, dims, topo,
+                 checkpoint_dir: Optional[str] = None,
+                 num_layers: Optional[int] = None,
+                 num_microbatches: int = 8,
+                 allow_hetero: bool = True,
+                 strategy_filter: Optional[Callable] = None,
+                 force_disk: bool = False,
+                 poll_s: float = 0.2):
+        self.trainer = trainer
+        self.controller = controller
+        #: worker name -> the jax device ids that worker's death removes
+        self.device_map = {k: list(v) for k, v in device_map.items()}
+        self.dims = dims
+        self.topo = topo
+        self.checkpoint_dir = checkpoint_dir
+        self.num_layers = num_layers
+        self.num_microbatches = num_microbatches
+        self.allow_hetero = allow_hetero
+        self.strategy_filter = strategy_filter
+        self.force_disk = force_disk
+        self.poll_s = poll_s
+        self._all_devices = list(trainer.devices or jax.devices())
+        self._acct = None     # ONE goodput ledger across run() segments
+        self._pending: list[tuple] = []
+        self._lock = threading.Lock()
+        self._watch_thread = None
+        self.recoveries: list[dict] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ElasticSupervisor":
+        self._watch_thread = self.controller.watch(
+            self._on_failure, poll_s=self.poll_s)   # re-arming watch
+        return self
+
+    def stop(self) -> None:
+        t = self._watch_thread
+        if t is not None:
+            t.stop_event.set()
+            t.join(timeout=5.0)
+            self._watch_thread = None
+        if self._acct is not None:
+            # close the ledger: reports taken after the supervised
+            # session must not dilute goodput with idle time
+            self._acct.freeze()
+
+    def __enter__(self) -> "ElasticSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- failure intake (watcher thread) ------------------------------------
+    def _on_failure(self, alive: list[str], dead: list[str]) -> None:
+        from hetu_tpu.engine import chaos
+        detect_s = None
+        kill_ts = chaos.last_kill_ts()
+        if kill_ts is not None:
+            detect_s = max(0.0, time.time() - kill_ts)
+        with self._lock:
+            self._pending.append((list(alive), list(dead), detect_s))
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- recovery (step-boundary thread) ------------------------------------
+    def poll(self) -> int:
+        """Apply every pending failure; call between steps. Returns the
+        number of recoveries performed."""
+        n = 0
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return n
+                alive, dead, detect_s = self._pending.pop(0)
+            self._recover(alive, dead, detect_s)
+            n += 1
+
+    def _surviving_devices(self, alive: list[str]) -> list:
+        alive_ids = set()
+        for name in alive:
+            alive_ids.update(self.device_map.get(name, ()))
+        return [d for d in self._all_devices if d.id in alive_ids]
+
+    def _recover(self, alive: list[str], dead: list[str],
+                 detect_s: Optional[float]) -> None:
+        from hetu_tpu import telemetry
+        reg = telemetry.get_registry()
+        t0 = time.perf_counter()
+        devices = self._surviving_devices(alive)
+        if not devices:
+            raise RuntimeError(
+                f"elastic: no surviving devices (alive={alive})")
+        strategy = ElasticController.recovery_plan(
+            self.dims, self.topo, len(devices),
+            num_layers=self.num_layers,
+            num_microbatches=self.num_microbatches,
+            allow_hetero=self.allow_hetero,
+            alive_device_ids=[d.id for d in devices],
+            candidate_filter=self.strategy_filter)
+        if strategy is None:
+            raise RuntimeError(
+                f"elastic: no recovery strategy for {len(devices)} "
+                f"surviving devices")
+        flight_record("elastic_replan", dead=dead,
+                      n_devices=len(devices),
+                      strategy=getattr(strategy, "to_json",
+                                       lambda: "?")())
+        if detect_s is not None and telemetry.enabled():
+            reg.histogram(
+                "elastic_detect_seconds",
+                "injected kill → membership-detection latency").observe(
+                    detect_s)
+        trainer = self.trainer
+        if trainer._ckpt_writer is not None:
+            try:
+                trainer._ckpt_writer.wait()   # drain in-flight save
+            except Exception as e:
+                get_logger().warning(
+                    f"elastic: in-flight checkpoint write failed ({e!r})")
+            trainer._ckpt_writer = None
+        mode = "live"
+        if self.force_disk:
+            trainer.state = None   # a restarted controller: nothing live
+        try:
+            trainer.shrink_to(devices, strategy)
+            if trainer.state is None:
+                raise RuntimeError("no live state")
+        except Exception as e:
+            if self.checkpoint_dir is None:
+                raise
+            if not self.force_disk:
+                get_logger().warning(
+                    f"elastic: live reshard failed ({e!r}) — falling "
+                    f"back to the newest complete checkpoint")
+            mode = "disk"
+            trainer.state = None
+            if trainer.plan is None or trainer.plan.strategy is not strategy:
+                trainer.shrink_to(devices, strategy)
+            trainer.resume(self.checkpoint_dir)
+        dt = time.perf_counter() - t0
+        step = int(jax.device_get(trainer.state.step)) \
+            if trainer.state is not None else -1
+        if telemetry.enabled():
+            reg.counter(
+                "elastic_recoveries_total",
+                "completed elastic recoveries by mode (live = in-memory "
+                "reshard, disk = checkpoint fallback, grow = "
+                "re-admission)").inc(mode=mode)
+            reg.histogram(
+                "elastic_recovery_seconds",
+                "failure-callback → training-resumable latency").observe(
+                    dt, mode=mode)
+        flight_record("elastic_resume", mode=mode, seconds=round(dt, 3),
+                      step=step, n_devices=len(devices))
+        trainer._note("recovery", dt)
+        self.recoveries.append(
+            {"mode": mode, "seconds": dt, "detect_s": detect_s,
+             "dead": dead, "n_devices": len(devices), "step": step,
+             "strategy": strategy,
+             "device_ids": [d.id for d in devices]})
+        get_logger().info(
+            f"elastic: recovered ({mode}) onto {len(devices)} devices "
+            f"at step {step} in {dt:.2f}s")
+
+    # -- grow (re-admission) -------------------------------------------------
+    def grow(self, name: str, device_ids, *, strategy=None) -> None:
+        """Re-admit a returning worker: its devices rejoin the mesh and
+        the live state hot-switches onto the grown plan (the same
+        cross-topology path a shrink uses). The worker must already be
+        heartbeating again under ``name``."""
+        from hetu_tpu import telemetry
+        t0 = time.perf_counter()
+        self.device_map[name] = list(device_ids)
+        alive, _ = self.controller.check()
+        devices = self._surviving_devices(
+            list(set(alive) | {name}))
+        if strategy is None:
+            strategy = ElasticController.recovery_plan(
+                self.dims, self.topo, len(devices),
+                num_layers=self.num_layers,
+                num_microbatches=self.num_microbatches,
+                allow_hetero=self.allow_hetero,
+                alive_device_ids=[d.id for d in devices],
+                candidate_filter=self.strategy_filter)
+        if strategy is None:
+            raise RuntimeError(
+                f"elastic: no grow strategy for {len(devices)} devices")
+        self.trainer.grow_to(devices, strategy)
+        dt = time.perf_counter() - t0
+        if telemetry.enabled():
+            telemetry.get_registry().counter(
+                "elastic_recoveries_total", "").inc(mode="grow")
+            telemetry.get_registry().histogram(
+                "elastic_recovery_seconds", "").observe(dt, mode="grow")
+        flight_record("elastic_grow", worker=name,
+                      n_devices=len(devices), seconds=round(dt, 3))
+        self.recoveries.append(
+            {"mode": "grow", "seconds": dt, "detect_s": None,
+             "dead": [], "n_devices": len(devices),
+             "step": int(jax.device_get(self.trainer.state.step))
+             if self.trainer.state is not None else -1})
+
+    # -- the supervised loop -------------------------------------------------
+    def run(self, batches, steps: int, *,
+            ckpt_every: int = 0) -> list[dict]:
+        """Train ``steps`` steps under supervision: pending failures are
+        recovered at step boundaries, checkpoints land on the
+        ``ckpt_every`` cadence (through ``Trainer.save`` — async/delta
+        per the trainer config). Returns per-step records
+        ``[{step, loss}]``; the trainer's goodput ledger (category
+        ``recovery`` included) covers the whole supervised session: ONE
+        ledger spans every ``run()`` segment of this supervisor, frozen
+        by :meth:`stop` — the wall between segments (e.g. the detection
+        window after an injected kill) stays visible as unaccounted
+        time instead of vanishing into a fresh ledger."""
+        from hetu_tpu.telemetry import GoodputAccountant
+        trainer = self.trainer
+        if trainer.state is None:
+            trainer.initialize()
+        if self._acct is None:
+            self._acct = GoodputAccountant(
+                peak_flops=trainer.config.peak_flops)
+        acct = self._acct
+        trainer.goodput = acct
+        from hetu_tpu.engine.train_step import trace_total
+        history = []
+        it = iter(batches)
+        try:
+            for _ in range(steps):
+                self.poll()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                t0 = time.perf_counter()
+                n_traces = trace_total()
+                metrics = trainer.train_step(batch)
+                step = int(jax.device_get(trainer.state.step))
+                loss = float(jax.device_get(metrics["loss"]))
+                # a step that re-traced spent its wall on trace+XLA
+                # compile (the first step after a recovery switch), not
+                # productive compute — same ledger split as train()
+                acct.record("compile" if trace_total() > n_traces
+                            else "compute", time.perf_counter() - t0)
+                acct.add_step()
+                if "input_ids" in batch:
+                    acct.add_tokens(int(batch["input_ids"].size))
+                history.append({"step": step, "loss": loss})
+                if ckpt_every and trainer.config.ckpt_dir \
+                        and step % ckpt_every == 0:
+                    trainer.save()
+        finally:
+            self.poll()
+        return history

@@ -49,6 +49,11 @@ class TrainerConfig:
     precision: str = "bf16"      # "bf16" | "fp32"
     attn_impl: str = "auto"
     distributed_ckpt: bool = False   # per-host shard files, no gather
+    delta_ckpt: bool = False     # distributed saves after the first one
+                                 # rewrite only CHANGED pieces (content
+                                 # hashes; unchanged pieces reference
+                                 # the previous save's step-stamped
+                                 # file) — docs/ELASTICITY.md
     prefetch: int = 2            # device-prefetch depth for train();
                                  # 0 disables (reference: async C++
                                  # dataloader + dedicated H2D stream)
@@ -397,11 +402,23 @@ class Trainer:
         ``ElasticController.recovery_plan``); defaults to the current one,
         which must fit the surviving device count.
         """
+        return self._retarget(devices, strategy, kind="shrink")
+
+    def grow_to(self, devices, strategy: Optional[Strategy] = None):
+        """Elastic re-admission: a recovered worker's devices rejoin the
+        mesh and the live state hot-switches onto the GROWN plan — the
+        same cross-topology switch a shrink uses, in the other direction
+        (``engine.elastic.ElasticSupervisor.grow`` drives this from the
+        membership side)."""
+        return self._retarget(devices, strategy, kind="grow")
+
+    def _retarget(self, devices, strategy, *, kind: str):
         self.devices = list(devices)
-        # cached plans pin dead devices — drop the whole pool (the cache
-        # may be process-shared: a device loss invalidates every plan
-        # compiled for the old topology anyway)
+        # cached plans pin departed devices — drop the whole pool (the
+        # cache may be process-shared: a membership change invalidates
+        # every plan compiled for the old topology anyway)
         self.cache.clear()
+        self.flight.record(f"elastic_{kind}", n_devices=len(self.devices))
         return self.set_strategy(strategy if strategy is not None
                                  else self.strategy)
 
@@ -458,10 +475,18 @@ class Trainer:
                 # TrainState
                 state = state_from_hetero(state, self.plan, self.model)
             if self.config.distributed_ckpt:
+                import glob
                 from hetu_tpu.utils.dist_checkpoint import (
                     save_checkpoint_distributed)
+                delta = None
+                if self.config.delta_ckpt and glob.glob(
+                        os.path.join(path, "index-host*.json")):
+                    delta = path   # in-place series: delta vs last save
                 self._ckpt_writer = save_checkpoint_distributed(
-                    path, state,
+                    path, state, delta_base=delta,
+                    # hash even the series' first, full save — the next
+                    # one deltas against it
+                    hash_pieces=self.config.delta_ckpt or None,
                     async_save=self.config.async_ckpt and not wait)
             else:
                 self._ckpt_writer = save_checkpoint(

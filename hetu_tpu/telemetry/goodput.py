@@ -22,7 +22,7 @@ from typing import Callable, Optional
 #: canonical categories, in table order; "compute" is productive time,
 #: everything after it is overhead, "other" is the unaccounted remainder.
 CATEGORIES = ("compute", "compile", "switch", "checkpoint", "stall",
-              "eval")
+              "eval", "recovery")
 
 #: span-name → category mapping used when a report is rebuilt from trace
 #: records (``report_from_records`` / tools/trace_summary.py).
@@ -36,7 +36,7 @@ SPAN_CATEGORIES = {
     "precompile": None,
     "switch": "switch", "cross_topology_switch": None,
     "checkpoint": "checkpoint", "checkpoint_write": None,
-    "checkpoint_gather": None,
+    "checkpoint_gather": None, "checkpoint_snapshot": None,
     "stall": "stall", "eval": "eval",
 }
 

@@ -537,6 +537,108 @@ _HEALTH_SERIES = (
 )
 
 
+#: recovery-plane series (chaos harness + elastic supervisor +
+#: incremental checkpointing): the direct evidence the preemption plane
+#: detects kills, recovers fast, and that checkpoint cadence is no
+#: longer priced into step time (docs/ELASTICITY.md).
+_RECOVERY_SERIES = (
+    "chaos_kills_total", "elastic_recoveries_total",
+    "elastic_recovery_seconds", "elastic_detect_seconds",
+    "heartbeat_send_failures_total", "checkpoint_snapshot_seconds",
+    "checkpoint_write_seconds", "checkpoint_delta_bytes_total",
+)
+
+
+def recovery_plane_summary(records: list[dict]) -> Optional[list[str]]:
+    """Lines for the recovery-plane section (kills, detection latency,
+    recovery seconds by mode, checkpoint cadence vs step-time overhead),
+    or None when no snapshot carries recovery series."""
+    snap: Optional[dict] = None
+    goodput_rec: Optional[dict] = None
+    for rec in records:
+        if rec.get("kind") == "goodput":
+            goodput_rec = rec
+        cand = rec.get("metrics") if rec.get("kind") == "metrics_snapshot" \
+            else rec.get("telemetry")
+        if isinstance(cand, dict) and any(
+                k.split("{")[0] in _RECOVERY_SERIES for k in cand):
+            snap = cand
+    if snap is None:
+        return None
+
+    def by_label(base: str) -> dict[str, object]:
+        out = {}
+        for series, v in snap.items():
+            if series.split("{")[0] != base:
+                continue
+            label = series[len(base):].strip("{}")
+            out[label or "*"] = v
+        return out
+
+    width = 18
+    lines: list[str] = []
+    kills = by_label("chaos_kills_total")
+    if kills:
+        total = int(sum(kills.values()))
+        detail = ", ".join(
+            f"{k.split('=')[-1].strip(chr(34))}: {int(v)}"
+            for k, v in sorted(kills.items()))
+        lines.append("kills".ljust(width) + f"{total} injected ({detail})")
+    recs = by_label("elastic_recoveries_total")
+    if recs:
+        total = int(sum(recs.values()))
+        detail = ", ".join(
+            f"{k.split('=')[-1].strip(chr(34))}: {int(v)}"
+            for k, v in sorted(recs.items()))
+        lines.append("recoveries".ljust(width) + f"{total} ({detail})")
+    det = by_label("elastic_detect_seconds").get("*")
+    if isinstance(det, dict) and det.get("count"):
+        lines.append("detection".ljust(width)
+                     + f"p50 {det['p50']:.2f}s  max {det['max']:.2f}s "
+                     f"(kill → membership)")
+    rsec = by_label("elastic_recovery_seconds")
+    for label, h in sorted(rsec.items()):
+        if isinstance(h, dict) and h.get("count"):
+            mode = label.split("=")[-1].strip('"')
+            lines.append(f"recovery ({mode})".ljust(width)
+                         + f"p50 {h['p50']:.2f}s  max {h['max']:.2f}s "
+                         f"({h['count']}x)")
+    hb = by_label("heartbeat_send_failures_total")
+    if hb:
+        lines.append("heartbeat".ljust(width)
+                     + f"{int(sum(hb.values()))} sends failed "
+                     f"(retried with backoff)")
+    snaps = by_label("checkpoint_snapshot_seconds").get("*")
+    if isinstance(snaps, dict) and snaps.get("count"):
+        line = ("ckpt snapshot".ljust(width)
+                + f"p50 {1e3 * snaps['p50']:.0f}ms step-blocking")
+        wr = by_label("checkpoint_write_seconds")
+        wasync = next((h for k, h in wr.items() if "async" in k), None)
+        if isinstance(wasync, dict) and wasync.get("count"):
+            line += f" / write p50 {1e3 * wasync['p50']:.0f}ms async"
+        lines.append(line)
+    delta = by_label("checkpoint_delta_bytes_total")
+    written = sum(v for k, v in delta.items() if "written" in k)
+    reused = sum(v for k, v in delta.items() if "reused" in k)
+    if written or reused:
+        saved = 100.0 * reused / (written + reused) \
+            if (written + reused) else 0.0
+        lines.append("ckpt delta".ljust(width)
+                     + f"{_fmt_bytes(written)} written / "
+                     f"{_fmt_bytes(reused)} reused ({saved:.0f}% saved)")
+    if goodput_rec:
+        comps = goodput_rec.get("components", {})
+        wall = goodput_rec.get("wall_s", 0.0)
+        ck = comps.get("checkpoint", 0.0)
+        rc = comps.get("recovery", 0.0)
+        if wall and (ck or rc):
+            lines.append("cadence cost".ljust(width)
+                         + f"checkpoint {ck:.2f}s + recovery {rc:.2f}s "
+                         f"of {wall:.2f}s wall "
+                         f"({100.0 * (ck + rc) / wall:.1f}%)")
+    return lines or None
+
+
 def health_summary(records: list[dict]) -> Optional[list[str]]:
     """Lines for the watchdog/SLO health section, or None when neither
     a health series nor an ``slo_alert`` record is present. Counters
@@ -616,6 +718,12 @@ def summarize(path: str, *, wall_s: Optional[float] = None,
         parts.append("")
         parts.append("== expert plane ==")
         parts.extend(xp)
+
+    rp = recovery_plane_summary(records)
+    if rp:
+        parts.append("")
+        parts.append("== recovery plane ==")
+        parts.extend(rp)
 
     hl = health_summary(records)
     if hl:

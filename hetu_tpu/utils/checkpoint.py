@@ -86,6 +86,11 @@ class CheckpointWriter:
         self._thread = thread
         self._error: Optional[BaseException] = None
         self.write_seconds: Optional[float] = None
+        # distributed snapshot-then-write saves: the step-blocking
+        # device→host gather latency, and (after wait()) the delta-save
+        # byte accounting {written_bytes, reused_bytes, ...}
+        self.snapshot_seconds: Optional[float] = None
+        self.stats: Optional[dict] = None
 
     def wait(self):
         if self._thread is not None:

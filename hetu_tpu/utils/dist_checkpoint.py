@@ -7,12 +7,29 @@ per-shard save/load (``python/hetu/utils/checkpoint/ht_safetensors.py:223,
 519`` — each rank saves its local slices, an index maps slices to files).
 
 Design:
-- **Save**: for every leaf (a possibly-sharded ``jax.Array``), each process
-  writes the data of its *addressable* shards with ``replica_id == 0`` into
-  its own ``ckpt-host{p:05d}.safetensors`` file, one entry per (tensor,
-  device-shard piece). A per-host ``index-host{p:05d}.json`` records, for
-  every piece: file, entry name, global offset, and piece shape. No global
-  gather ever happens.
+- **Save** is snapshot-then-write: the blocking part of ``save()`` is ONLY
+  the device→host gather of this process's ``replica_id == 0`` shards into
+  a private host snapshot (copied — donated device buffers may be reused
+  by the next step while the write is in flight). Everything else —
+  quantization, content hashing, serialization, fsync/rename — runs on the
+  writer thread under ``async_save`` so checkpoint cadence stops trading
+  against step time (``writer.snapshot_seconds`` vs
+  ``writer.write_seconds`` is the asserted split).
+- Each save writes a **step-stamped** tensor file
+  (``ckpt-host{p:05d}-s{step:08d}.safetensors``) plus a per-host
+  ``index-host{p:05d}.json`` mapping every (tensor, device-shard piece) to
+  (file, global offset, shape, content hash). Write-then-rename ordering
+  (tensors → index → meta) means a crash anywhere mid-save leaves the
+  previous save fully loadable: the old index still points at the old
+  step's file, which the stamped naming never overwrites.
+- **Delta saves** (``delta_base=``): pieces whose content hash, offsets and
+  shape match the base save are not rewritten — their index entries
+  *reference* the base's physical file (``base_dir`` relative to this
+  save, ``base_step``). References are resolved to the physical file at
+  save time, so chains stay one level deep no matter how many deltas
+  follow a full save. The loader chases exactly that one level and
+  extends the torn-save check to references: a missing or step-mismatched
+  base file is a hard ``torn delta`` error, never silent garbage.
 - **Load**: the merged piece index describes the full logical tensor. Each
   destination device shard is assembled via
   ``jax.make_array_from_callback``: the callback reads only the overlapping
@@ -21,13 +38,17 @@ Design:
   reference's ``ParamSlice`` intersection, done with numpy slices.
 - Cross-strategy and cross-topology restore follow for free: the piece
   index is layout-independent, so save under dp×tp and load under
-  pp×fsdp — or under a different device count (the elastic path).
+  pp×fsdp — or under a different device count (the elastic path). Delta
+  saves inherit the property (the index is what changed, not the format).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import re
+import time
 
 from typing import Any, Optional
 
@@ -36,6 +57,7 @@ import numpy as np
 from safetensors import safe_open
 from safetensors.numpy import save_file
 
+from hetu_tpu import telemetry
 from hetu_tpu.engine.state import TrainState
 from hetu_tpu.utils.checkpoint import (
     CheckpointWriter, _META_FILE, _MODEL_PREFIX, _OPT_PREFIX, _flatten,
@@ -43,13 +65,26 @@ from hetu_tpu.utils.checkpoint import (
 )
 from hetu_tpu.utils.windows import assemble_window
 
+_STEP_RE = re.compile(r"-s(\d+)\.safetensors$")
 
-def _host_file(p: int) -> str:
-    return f"ckpt-host{p:05d}.safetensors"
+
+def _host_file(p: int, step: int) -> str:
+    return f"ckpt-host{p:05d}-s{step:08d}.safetensors"
 
 
 def _host_index(p: int) -> str:
     return f"index-host{p:05d}.json"
+
+
+def _piece_hash(data: np.ndarray) -> str:
+    """Content hash of one piece (dtype + shape + raw bytes) — the delta
+    detector. Computed on the writer thread, over the RAW (pre-quantize)
+    bytes so the decision is storage-format independent."""
+    h = hashlib.sha256()
+    h.update(str(data.dtype).encode())
+    h.update(str(tuple(data.shape)).encode())
+    h.update(np.ascontiguousarray(data).tobytes())
+    return h.hexdigest()
 
 
 def _leaf_pieces(leaf) -> list[dict]:
@@ -57,9 +92,11 @@ def _leaf_pieces(leaf) -> list[dict]:
 
     A piece = {entry-local name suffix, data, start offsets, shape}. For a
     replicated/unsharded array exactly one process-0 replica owns it.
+    The data is COPIED to host: the caller may hand the snapshot to a
+    background writer while the (donated) device buffer is reused.
     """
     if not isinstance(leaf, jax.Array):
-        arr = np.asarray(leaf)
+        arr = np.array(leaf, copy=True)
         if jax.process_index() == 0:
             return [{"data": arr, "start": [0] * arr.ndim,
                      "shape": list(arr.shape)}]
@@ -70,15 +107,69 @@ def _leaf_pieces(leaf) -> list[dict]:
             continue
         idx = shard.index  # tuple of slices into the global shape
         start = [0 if s.start is None else int(s.start) for s in idx]
-        data = np.asarray(shard.data)
+        data = np.array(shard.data, copy=True)
         pieces.append({"data": data, "start": start,
                        "shape": list(data.shape)})
     return pieces
 
 
+def _load_base_manifest(base_path: str, p: int) -> dict[str, dict]:
+    """``{entry_name: {hash, file, dir, step, q8, start, shape}}`` for the
+    base save this delta references — references already resolved to the
+    PHYSICAL file (one level: a base entry that is itself a reference
+    contributes its own target), so delta chains never deepen."""
+    fp = os.path.join(base_path, _host_index(p))
+    if not os.path.exists(fp):
+        return {}
+    with open(fp) as f:
+        doc = json.load(f)
+    if "pieces" not in doc:
+        return {}
+    step = doc.get("step", -1)
+    out: dict[str, dict] = {}
+    for entries in doc["pieces"].values():
+        for e in entries:
+            if "base_dir" in e:
+                d = os.path.normpath(
+                    os.path.join(base_path, e["base_dir"]))
+                s = e.get("base_step", -1)
+            else:
+                d, s = os.path.normpath(base_path), step
+            out[e["entry"]] = {
+                "hash": e.get("hash"), "file": e["file"], "dir": d,
+                "step": s, "q8": e.get("q8", False),
+                "start": e.get("start"), "shape": e.get("shape")}
+    return out
+
+
+def _local_files_of_index(path: str, p: int) -> set[str]:
+    """Tensor files under ``path`` that ``path``'s current host index
+    still needs (its own file + same-dir references) — the GC keep-set
+    protecting the previous complete save."""
+    fp = os.path.join(path, _host_index(p))
+    if not os.path.exists(fp):
+        return set()
+    try:
+        with open(fp) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return set()
+    keep: set[str] = set()
+    norm = os.path.normpath(path)
+    for entries in doc.get("pieces", {}).values():
+        for e in entries:
+            d = norm if "base_dir" not in e else os.path.normpath(
+                os.path.join(path, e["base_dir"]))
+            if d == norm:
+                keep.add(e["file"])
+    return keep
+
+
 def save_checkpoint_distributed(path: str, state: TrainState, *,
                                 async_save: bool = False,
-                                quantize: Optional[str] = None
+                                quantize: Optional[str] = None,
+                                delta_base: Optional[str] = None,
+                                hash_pieces: Optional[bool] = None
                                 ) -> CheckpointWriter:
     """Write this process's shards of ``state`` (params + opt + step).
 
@@ -86,6 +177,18 @@ def save_checkpoint_distributed(path: str, state: TrainState, *,
     ``quantize="int8"`` stores 2-D+ float params as int8 with per-channel
     scales, computed per piece (optimizer state stays full precision) —
     the reference's quantized storage (``ht_safetensors.py:42-49``).
+
+    ``delta_base``: a previous save whose unchanged pieces this save
+    reuses by reference instead of rewriting (``delta_base=path`` is the
+    common in-place series: save step N as a delta against step N-1 in
+    the same directory). ``writer.stats`` (after ``wait()``) reports
+    ``{"written_bytes", "reused_bytes", "reused_pieces"}``.
+
+    ``hash_pieces``: content-hash every piece so the NEXT save can delta
+    against this one. Defaults to ``delta_base is not None``; pass
+    ``True`` on the first full save of a delta series (what
+    ``TrainerConfig(delta_ckpt=True)`` does). Left off, non-delta users
+    never pay the hashing on their (possibly synchronous) save path.
     """
     if quantize not in (None, "int8"):
         raise ValueError(f"quantize must be None or 'int8', got "
@@ -98,47 +201,125 @@ def save_checkpoint_distributed(path: str, state: TrainState, *,
     p = jax.process_index()
     step = int(jax.device_get(state.step))
 
-    tensors: dict[str, np.ndarray] = {}
-    index: dict[str, list[dict]] = {}
-    for key, leaf in flat.items():
-        entries = []
-        for i, piece in enumerate(_leaf_pieces(leaf)):
-            entry = f"{key}#p{i}"
-            data = piece["data"]
-            q8 = bool(quantize == "int8" and key not in opt_keys
-                      and data.ndim >= 2
-                      and np.issubdtype(data.dtype, np.floating))
-            if q8:
-                from hetu_tpu.ops.quantization import quantize_int8
-                import jax.numpy as jnp
-                qv, scale = quantize_int8(jnp.asarray(
-                    np.float32(data)))
-                tensors[entry] = np.asarray(jax.device_get(qv))
-                tensors[entry + ".q8scale"] = np.asarray(
-                    jax.device_get(scale))
-            else:
-                tensors[entry] = data
-            entries.append({"entry": entry, "file": _host_file(p),
-                            "start": piece["start"],
-                            "shape": piece["shape"], "q8": q8})
-        if entries:
-            index[key] = entries
-        gshape = list(leaf.shape) if hasattr(leaf, "shape") else []
-        for e in entries:
-            e["global_shape"] = gshape
+    # -- snapshot: the ONLY step-blocking part — device→host copies of
+    # this process's pieces (a consistent point-in-time image the writer
+    # thread owns outright)
+    t0 = time.perf_counter()
+    with telemetry.span("checkpoint_snapshot", path=path, step=step):
+        snapshot: dict[str, tuple[list[dict], list]] = {}
+        for key, leaf in flat.items():
+            pieces = _leaf_pieces(leaf)
+            gshape = list(leaf.shape) if hasattr(leaf, "shape") else []
+            if pieces:
+                snapshot[key] = (pieces, gshape)
+    snapshot_s = time.perf_counter() - t0
+    if telemetry.enabled():
+        telemetry.get_registry().histogram(
+            "checkpoint_snapshot_seconds",
+            "device→host snapshot latency (the step-blocking slice of a "
+            "distributed save)").observe(snapshot_s)
+    stats = {"written_bytes": 0, "reused_bytes": 0, "reused_pieces": 0,
+             "written_pieces": 0}
+    do_hash = bool(delta_base is not None if hash_pieces is None
+                   else hash_pieces)
 
     def write():
+        from hetu_tpu.engine.chaos import chaos_point
         os.makedirs(path, exist_ok=True)
-        # write-then-rename so a crash mid-save leaves the previous files
-        # intact; the per-host step stamp lets the loader reject a torn
-        # multi-host save (some hosts at step N, a crashed one still at N-1)
-        tmp = os.path.join(path, _host_file(p) + ".tmp")
+        host_file = _host_file(p, step)
+        base = _load_base_manifest(delta_base, p) if delta_base else {}
+        prev_keep = _local_files_of_index(path, p)
+        norm_path = os.path.normpath(path)
+        tensors: dict[str, np.ndarray] = {}
+        index: dict[str, list[dict]] = {}
+        for key, (pieces, gshape) in snapshot.items():
+            entries = []
+            for i, piece in enumerate(pieces):
+                entry = f"{key}#p{i}"
+                data = piece["data"]
+                q8 = bool(quantize == "int8" and key not in opt_keys
+                          and data.ndim >= 2
+                          and np.issubdtype(data.dtype, np.floating))
+                h = _piece_hash(data) if do_hash else None
+                e = {"entry": entry, "start": piece["start"],
+                     "shape": piece["shape"], "q8": q8,
+                     "global_shape": gshape}
+                if h is not None:
+                    e["hash"] = h
+                b = base.get(entry)
+                # reuse only when content, window AND storage format
+                # match — and never reference the very file this save is
+                # about to replace (a same-step re-save must rewrite)
+                reuse = (b is not None and h is not None
+                         and b.get("hash") == h
+                         and b.get("q8", False) == q8
+                         and list(b.get("start") or []) == piece["start"]
+                         and list(b.get("shape") or []) == piece["shape"]
+                         and not (b["dir"] == norm_path
+                                  and b["file"] == host_file))
+                if reuse:
+                    e["file"] = b["file"]
+                    e["base_dir"] = os.path.relpath(b["dir"], path)
+                    e["base_step"] = b["step"]
+                    stats["reused_bytes"] += data.nbytes
+                    stats["reused_pieces"] += 1
+                else:
+                    e["file"] = host_file
+                    if q8:
+                        from hetu_tpu.ops.quantization import quantize_int8
+                        import jax.numpy as jnp
+                        qv, scale = quantize_int8(jnp.asarray(
+                            np.float32(data)))
+                        tensors[entry] = np.asarray(jax.device_get(qv))
+                        tensors[entry + ".q8scale"] = np.asarray(
+                            jax.device_get(scale))
+                    else:
+                        tensors[entry] = data
+                    stats["written_bytes"] += data.nbytes
+                    stats["written_pieces"] += 1
+                entries.append(e)
+            if entries:
+                index[key] = entries
+        if telemetry.enabled():
+            c = telemetry.get_registry().counter(
+                "checkpoint_delta_bytes_total",
+                "distributed-save payload bytes by fate (reused = "
+                "referenced from a previous save, not rewritten)")
+            c.inc(stats["written_bytes"], kind="written")
+            c.inc(stats["reused_bytes"], kind="reused")
+        # write-then-rename, tensors before index before meta: a crash at
+        # ANY point leaves the previous (index, meta, step-stamped file)
+        # triple intact and consistent — the loader serves the previous
+        # complete step (chaos-tested at the injection point below)
+        tmp = os.path.join(path, host_file + ".tmp")
         save_file(tensors, tmp)
-        os.replace(tmp, os.path.join(path, _host_file(p)))
-        tmp = os.path.join(path, _host_index(p) + ".tmp")
+        os.replace(tmp, os.path.join(path, host_file))
+        chaos_point("dist_ckpt.between_tensor_and_index",
+                    step=step, host=p)
+        # the new index embeds the PREVIOUS save's piece map (one level,
+        # prev-of-prev dropped): a torn multi-host save — some hosts
+        # committed step N, a crashed one still at N-1 — then degrades
+        # to a consistent N-1 load instead of a hard error, because the
+        # N hosts can still serve their N-1 pieces (whose files the GC
+        # keep-set protects for exactly one save cycle)
+        prev_doc = None
+        idx_path = os.path.join(path, _host_index(p))
+        if os.path.exists(idx_path):
+            try:
+                with open(idx_path) as f:
+                    old = json.load(f)
+                if "pieces" in old:
+                    prev_doc = {"step": old.get("step", -1),
+                                "pieces": old["pieces"]}
+            except (OSError, ValueError):
+                prev_doc = None
+        doc = {"step": step, "pieces": index}
+        if prev_doc is not None:
+            doc["prev"] = prev_doc
+        tmp = idx_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"step": step, "pieces": index}, f)
-        os.replace(tmp, os.path.join(path, _host_index(p)))
+            json.dump(doc, f)
+        os.replace(tmp, idx_path)
         if p == 0:
             tmp = os.path.join(path, _META_FILE + ".tmp")
             with open(tmp, "w") as f:
@@ -146,8 +327,29 @@ def save_checkpoint_distributed(path: str, state: TrainState, *,
                            "framework": "hetu_tpu",
                            "layout": "sharded"}, f)
             os.replace(tmp, os.path.join(path, _META_FILE))
+        # GC this host's stamped files no longer referenced by the NEW
+        # index — but keep everything the PREVIOUS index needed, so the
+        # last complete save stays loadable through the next crash window
+        keep = {host_file} | prev_keep
+        for entries in index.values():
+            for e in entries:
+                d = norm_path if "base_dir" not in e else os.path.normpath(
+                    os.path.join(path, e["base_dir"]))
+                if d == norm_path:
+                    keep.add(e["file"])
+        prefix = f"ckpt-host{p:05d}-s"
+        for fname in os.listdir(path):
+            if fname.startswith(prefix) and fname.endswith(".safetensors") \
+                    and fname not in keep:
+                try:
+                    os.unlink(os.path.join(path, fname))
+                except OSError:
+                    pass
 
-    return _run_write(write, async_save)
+    writer = _run_write(write, async_save)
+    writer.snapshot_seconds = snapshot_s
+    writer.stats = stats
+    return writer
 
 
 class _PieceReader:
@@ -172,10 +374,19 @@ class _PieceReader:
                 # an elastic shrink leaves stale higher-numbered host files
                 # behind; only indexes matching meta's step participate —
                 # real holes then surface via coverage accounting in read()
-                if expected_step is not None \
-                        and doc.get("step", -1) != expected_step:
+                pieces = None
+                if expected_step is None \
+                        or doc.get("step", -1) == expected_step:
+                    pieces = doc["pieces"]
+                elif doc.get("prev", {}).get("step") == expected_step:
+                    # this host got one save AHEAD of meta (a torn
+                    # multi-host save killed the meta writer): serve its
+                    # embedded previous piece map — the previous complete
+                    # step, consistently with the other hosts
+                    pieces = doc["prev"]["pieces"]
+                if pieces is None:
                     continue
-                for k, v in doc["pieces"].items():
+                for k, v in pieces.items():
                     self.index.setdefault(k, []).extend(v)
         if not found:
             raise FileNotFoundError(
@@ -186,13 +397,47 @@ class _PieceReader:
                 f"torn checkpoint: no host index matches meta step "
                 f"{expected_step} (host steps: {self.steps}) — the last "
                 f"multi-host save was interrupted")
+        self._check_refs()
         self._files: dict[str, Any] = {}
 
-    def _open(self, fname: str):
-        if fname not in self._files:
-            self._files[fname] = safe_open(
-                os.path.join(self.path, fname), framework="numpy")
-        return self._files[fname]
+    def _entry_dir(self, e: dict) -> str:
+        if "base_dir" not in e:
+            return self.path
+        return os.path.normpath(os.path.join(self.path, e["base_dir"]))
+
+    def _check_refs(self) -> None:
+        """Torn-DELTA detection, extending the per-host step-stamp check
+        to references: every referenced base file must still exist and
+        carry the step stamp the reference recorded (a base directory
+        that was garbage-collected or re-saved past the referenced step
+        would otherwise serve silently wrong bytes)."""
+        seen: set[str] = set()
+        for k, entries in self.index.items():
+            for e in entries:
+                if "base_dir" not in e:
+                    continue
+                fp = os.path.join(self._entry_dir(e), e["file"])
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                if not os.path.exists(fp):
+                    raise ValueError(
+                        f"torn delta: {k} references base file {fp} "
+                        f"which no longer exists — the base save was "
+                        f"removed or never completed")
+                m = _STEP_RE.search(e["file"])
+                if m and e.get("base_step") is not None \
+                        and int(m.group(1)) != int(e["base_step"]):
+                    raise ValueError(
+                        f"torn delta: {k} references {e['file']} at step "
+                        f"{e['base_step']} but the file is stamped "
+                        f"s{int(m.group(1))}")
+
+    def _open(self, dirpath: str, fname: str):
+        fp = os.path.join(dirpath, fname)
+        if fp not in self._files:
+            self._files[fp] = safe_open(fp, framework="numpy")
+        return self._files[fp]
 
     def close(self):
         self._files.clear()  # drops safe_open handles / mmaps
@@ -214,7 +459,7 @@ class _PieceReader:
         """
 
         def fetch(e, sl):
-            f = self._open(e["file"])
+            f = self._open(self._entry_dir(e), e["file"])
             if e.get("q8"):
                 # dequantize the whole piece (scales are per-channel of
                 # the piece), then slice — pieces are shard-sized
@@ -258,6 +503,19 @@ def load_checkpoint_distributed(path: str, model, opt, plan=None
         return _load_with_reader(reader, meta, model, opt, plan)
     finally:
         reader.close()
+
+
+def checkpoint_step(path: str) -> Optional[int]:
+    """Step of the checkpoint under ``path``, or None when there is no
+    complete sharded checkpoint there (elastic fallback probing)."""
+    try:
+        with open(os.path.join(path, _META_FILE)) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if meta.get("layout") != "sharded":
+        return None
+    return int(meta.get("step", 0))
 
 
 def _load_with_reader(reader, meta, model, opt, plan) -> TrainState:

@@ -286,6 +286,94 @@ def test_elastic_resume_prefers_live_state(monkeypatch, tmp_path):
                                float(m_disk["loss"]), rtol=1e-6)
 
 
+@pytest.mark.slow
+def test_elastic_resume_disk_fallback_when_reshard_raises(monkeypatch,
+                                                         tmp_path):
+    """The live reshard can be impossible (e.g. the only copy of a shard
+    lived on the dead devices): elastic_resume must warn-then-load from
+    the sharded checkpoint — and with NO checkpoint_dir it must re-raise
+    instead of limping on (``elastic.py`` fallback paths)."""
+    import jax.numpy as jnp
+    from hetu_tpu import optim
+    from hetu_tpu.engine import init_state, make_plan
+    from hetu_tpu.engine.elastic import elastic_resume
+    from hetu_tpu.models import GPTLMHeadModel
+    from hetu_tpu.parallel import switch as switch_mod
+    from hetu_tpu.parallel.strategy import Strategy
+    from hetu_tpu.utils import dist_checkpoint
+
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    opt = optim.adamw(1e-3)
+    plan8 = make_plan(model, opt, Strategy(dp=2, tp=4))
+    state = init_state(model, opt, plan8, jax.random.key(0),
+                       dtype=jnp.float32)
+    ckpt = str(tmp_path / "ck")
+    dist_checkpoint.save_checkpoint_distributed(ckpt, state)
+
+    def reshard_impossible(s, p):
+        raise RuntimeError("shards lost with the dead devices")
+
+    monkeypatch.setattr(switch_mod, "switch_strategy",
+                        reshard_impossible)
+    # live state present but unreshardable + a checkpoint: disk fallback
+    new_plan, new_state = elastic_resume(
+        model, opt, Strategy(dp=2, tp=2), devices=jax.devices()[:4],
+        state=state, checkpoint_dir=ckpt)
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(new_state.params)):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)))
+    assert {d.id for leaf in jax.tree.leaves(new_state.params)
+            for d in leaf.sharding.device_set} <= {0, 1, 2, 3}
+    # no checkpoint to fall back to: the reshard error must surface
+    with pytest.raises(RuntimeError, match="shards lost"):
+        elastic_resume(model, opt, Strategy(dp=2, tp=2),
+                       devices=jax.devices()[:4], state=state,
+                       checkpoint_dir=None)
+    # dead controller (no live state) and no checkpoint_dir: explicit
+    with pytest.raises(ValueError, match="nothing to resume"):
+        elastic_resume(model, opt, Strategy(dp=2, tp=2),
+                       devices=jax.devices()[:4], state=None,
+                       checkpoint_dir=None)
+
+
+def test_recovery_plan_hetero_adoption_boundary():
+    """Hetero-vs-stranded-uniform adoption at a non-pow2 survivor count
+    with REAL alive ids: adopted only when the bubble-discounted
+    throughput of using ALL survivors beats the stranded-pow2 subset —
+    few microbatches (deep bubble) must fall back to uniform."""
+    from hetu_tpu.parallel.hetero import HeteroStrategy
+    from hetu_tpu.parallel.strategy import Strategy
+
+    dims = ModelDims.from_config(GPTConfig.tiny(), seq_len=128,
+                                 global_batch=8)
+    topo = TPUTopology(num_devices=8)
+    alive = [0, 1, 2, 4, 5, 6]        # device 3 and 7 died: 6 alive
+    # 8 microbatches: hetero over 4+2 (pp=2) → eff 6*8/9 = 5.33 > 4
+    s = ElasticController.recovery_plan(
+        dims, topo, n_alive_devices=6, num_layers=8,
+        num_microbatches=8, alive_device_ids=alive)
+    assert isinstance(s, HeteroStrategy)
+    assert sum(st.n_devices for st in s.stages) == 6
+    assert sorted(s.device_ids) == alive       # binds REAL survivors
+    # 1 microbatch: the pipeline bubble eats the gain (6*1/2 = 3 < 4):
+    # stranded-uniform on the pow2 subset wins
+    s1 = ElasticController.recovery_plan(
+        dims, topo, n_alive_devices=6, num_layers=8,
+        num_microbatches=1, alive_device_ids=alive)
+    assert isinstance(s1, Strategy) and s1.num_devices == 4
+    # candidate_filter governs BOTH kinds: it must veto the hetero plan
+    # (pp=2 pipeline) AND constrain the uniform fallback
+    s2 = ElasticController.recovery_plan(
+        dims, topo, n_alive_devices=6, num_layers=8,
+        num_microbatches=8, alive_device_ids=alive,
+        candidate_filter=lambda st: getattr(st, "tp", 1) == 1
+        and st.pp == 1)
+    assert isinstance(s2, Strategy)
+    assert s2.tp == 1 and s2.pp == 1
+
+
 @pytest.mark.parametrize("native", [True, False], ids=["cpp", "python"])
 def test_coordinator_two_generation_race(native):
     """Partial-partition hardening (VERDICT r4 weak #7): a generation-0

@@ -42,7 +42,9 @@ def test_save_writes_only_local_shards(tmp_path, setup):
     cfg, model, opt, plan, state = setup
     save_checkpoint_distributed(str(tmp_path), state)
     files = sorted(os.listdir(tmp_path))
-    assert "ckpt-host00000.safetensors" in files
+    # tensor files are step-stamped so a later save never overwrites the
+    # bytes a crash-interrupted index still points at
+    assert "ckpt-host00000-s00000000.safetensors" in files
     assert "index-host00000.json" in files and "meta.json" in files
     with open(tmp_path / "index-host00000.json") as f:
         index = json.load(f)["pieces"]
@@ -160,6 +162,154 @@ def test_old_index_format_rejected_with_hint(tmp_path, setup):
         json.dump(doc["pieces"], f)  # the pre-format-2 flat layout
     with pytest.raises(ValueError, match="format"):
         load_checkpoint_distributed(str(tmp_path), model, opt)
+
+
+def test_crash_between_tensor_and_index_serves_previous_step(tmp_path,
+                                                             setup):
+    """Writer-side torn-save regression (the load-bearing ordering:
+    tensors → index → meta). The writer dies BETWEEN the tensor-file
+    rename and the index write; the loader must serve the PREVIOUS
+    complete step — bit-identically — because the step-stamped naming
+    never overwrote its bytes."""
+    from hetu_tpu.engine import chaos
+    from hetu_tpu.utils.dist_checkpoint import checkpoint_step
+
+    cfg, model, opt, plan, state = setup
+    save_checkpoint_distributed(str(tmp_path), state)
+    assert checkpoint_step(str(tmp_path)) == 0
+    bumped = state._replace(step=np.int32(1))
+    chaos.arm("dist_ckpt.between_tensor_and_index", action="raise")
+    try:
+        with pytest.raises(chaos.ChaosError):
+            save_checkpoint_distributed(str(tmp_path), bumped,
+                                        delta_base=str(tmp_path))
+    finally:
+        chaos.disarm()
+    # the torn save left the previous triple intact and consistent
+    assert checkpoint_step(str(tmp_path)) == 0
+    restored = load_checkpoint_distributed(str(tmp_path), model, opt)
+    assert int(restored.step) == 0
+    _assert_states_equal(state, restored)
+    # ...and the interrupted save can simply be retried
+    save_checkpoint_distributed(str(tmp_path), bumped,
+                                delta_base=str(tmp_path))
+    assert checkpoint_step(str(tmp_path)) == 1
+
+
+def test_delta_save_rewrites_only_changed_pieces(tmp_path, setup):
+    """Acceptance: a delta save after a partial update rewrites < 50% of
+    the full-save bytes, loads bit-identically under a DIFFERENT plan
+    (cross-topology), and a re-save with nothing changed writes ~0."""
+    import jax.numpy as jnp
+
+    cfg, model, opt, plan, state = setup
+    # first, FULL save of the series: hashed so the next can delta on it
+    w0 = save_checkpoint_distributed(str(tmp_path), state,
+                                     hash_pieces=True)
+    w0.wait()
+    full_bytes = w0.stats["written_bytes"]
+    assert full_bytes > 0 and w0.stats["reused_bytes"] == 0
+
+    # an optimizer-state-preserving partial update: params nudged,
+    # moments untouched (the frozen-rows / early-training shape)
+    new_params = jax.tree.map(lambda x: x + jnp.ones_like(x),
+                              state.params)
+    state2 = state._replace(step=np.int32(1), params=new_params)
+    w1 = save_checkpoint_distributed(str(tmp_path), state2,
+                                     delta_base=str(tmp_path))
+    w1.wait()
+    assert w1.stats["reused_pieces"] > 0
+    assert w1.stats["written_bytes"] < 0.5 * full_bytes, w1.stats
+    # cross-topology load of the delta is bit-identical
+    plan2 = make_plan(model, opt, Strategy(tp=8))
+    restored = load_checkpoint_distributed(str(tmp_path), model, opt,
+                                           plan=plan2)
+    _assert_states_equal(state2, restored)
+    # nothing changed: the next delta reuses (almost) everything
+    state3 = state2._replace(step=np.int32(2))
+    w2 = save_checkpoint_distributed(str(tmp_path), state3,
+                                     delta_base=str(tmp_path))
+    w2.wait()
+    assert w2.stats["written_bytes"] == 0, w2.stats
+    restored3 = load_checkpoint_distributed(str(tmp_path), model, opt)
+    assert int(restored3.step) == 2
+    _assert_states_equal(state3, restored3)
+
+
+def test_torn_delta_missing_base_detected(tmp_path, setup):
+    """A delta whose referenced base file was removed (or re-stamped)
+    must raise — the step-stamp check extended to references."""
+    import glob
+
+    cfg, model, opt, plan, state = setup
+    save_checkpoint_distributed(str(tmp_path), state, hash_pieces=True)
+    state2 = state._replace(step=np.int32(1))
+    w = save_checkpoint_distributed(str(tmp_path), state2,
+                                    delta_base=str(tmp_path))
+    w.wait()
+    assert w.stats["reused_pieces"] > 0
+    for f in glob.glob(str(tmp_path / "ckpt-host*-s00000000.safetensors")):
+        os.remove(f)
+    with pytest.raises(ValueError, match="torn delta"):
+        load_checkpoint_distributed(str(tmp_path), model, opt)
+
+
+def test_host_ahead_of_meta_degrades_to_previous_step(tmp_path, setup):
+    """A host got one save AHEAD of meta (the writer died between its
+    index write and the meta write — or, multi-host, before the meta
+    rank's index landed): the ahead index serves its EMBEDDED previous
+    piece map, so the load degrades to a consistent N-1 instead of the
+    old hard 'torn checkpoint' error."""
+    cfg, model, opt, plan, state = setup
+    save_checkpoint_distributed(str(tmp_path), state)
+    state2 = state._replace(step=np.int32(1))
+    w = save_checkpoint_distributed(str(tmp_path), state2,
+                                    delta_base=str(tmp_path))
+    w.wait()
+    with open(tmp_path / "index-host00000.json") as f:
+        ahead = json.load(f)
+    assert ahead["step"] == 1 and ahead["prev"]["step"] == 0
+    # meta never advanced: the writer died right before it
+    with open(tmp_path / "meta.json", "w") as f:
+        json.dump({"step": 0, "format_version": 2,
+                   "framework": "hetu_tpu", "layout": "sharded"}, f)
+    restored = load_checkpoint_distributed(str(tmp_path), model, opt)
+    assert int(restored.step) == 0
+    _assert_states_equal(state, restored)
+
+
+def test_async_snapshot_save_does_not_block_on_io(tmp_path, setup,
+                                                  monkeypatch):
+    """Acceptance: with snapshot-then-write, the save() call blocks only
+    for the device→host snapshot — a (simulated) slow filesystem never
+    blocks the trainer. All I/O, hashing and quantization run on the
+    writer thread."""
+    import time as _time
+
+    from hetu_tpu.utils import dist_checkpoint as dc
+
+    cfg, model, opt, plan, state = setup
+    slow = 0.5
+    real_save_file = dc.save_file
+
+    def sleepy_save_file(tensors, path):
+        _time.sleep(slow)
+        return real_save_file(tensors, path)
+
+    monkeypatch.setattr(dc, "save_file", sleepy_save_file)
+    t0 = _time.perf_counter()
+    w = save_checkpoint_distributed(str(tmp_path), state,
+                                    async_save=True)
+    blocked = _time.perf_counter() - t0
+    w.wait()
+    assert w.write_seconds >= slow             # the I/O happened...
+    assert blocked < 0.8 * slow, (blocked, w.write_seconds)  # ...but
+    # never on the caller; and the snapshot half is accounted separately
+    assert w.snapshot_seconds is not None
+    assert w.snapshot_seconds <= blocked + 0.01
+    restored = load_checkpoint_distributed(str(tmp_path), model, opt,
+                                           plan=plan)
+    _assert_states_equal(state, restored)
 
 
 def test_quantized_sharded_checkpoint(tmp_path, setup):

@@ -150,6 +150,51 @@ def test_bench_ragged_emits_json_contract():
 
 
 @pytest.mark.slow
+def test_bench_chaos_emits_json_contract():
+    """``bench.py --chaos`` must emit the recovery-discipline sweep and
+    write BENCH_chaos.json: three modes, each surviving two kills driven
+    through the real heartbeat/membership path, with the live modes
+    reading NOTHING from disk, every discipline converging to the SAME
+    final loss (recovery is lossless), and async+delta checkpointing
+    blocking the loop measurably less than sync full saves."""
+    env = dict(os.environ)
+    env["HETU_TPU_BENCH_PLATFORM"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--chaos"],
+        capture_output=True, text=True, timeout=580, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "sweep", "kills_per_run"):
+        assert key in rec, (key, rec)
+    assert rec["value"] > 0 and rec["kills_per_run"] == 2
+    modes = [s["mode"] for s in rec["sweep"]]
+    assert modes == ["restart_from_disk", "live_reshard",
+                     "live_reshard_delta_async"]
+    by = {s["mode"]: s for s in rec["sweep"]}
+    for s in rec["sweep"]:
+        assert s["kills"] == 2 and s["recoveries"] == 2, s
+        assert 0 < s["goodput"] <= 1
+        assert s["detect_s_mean"] > 0
+    assert by["restart_from_disk"]["recovery_modes"] == ["disk", "disk"]
+    assert by["restart_from_disk"]["disk_loads"] == 2
+    for m in ("live_reshard", "live_reshard_delta_async"):
+        assert by[m]["recovery_modes"] == ["live", "live"]
+        assert by[m]["disk_loads"] == 0          # never touched disk
+    # recovery is lossless: every discipline lands on the same loss
+    finals = {s["final_loss"] for s in rec["sweep"]}
+    assert len(finals) == 1, rec["sweep"]
+    assert all(s["final_step"] == by["live_reshard"]["final_step"]
+               for s in rec["sweep"])
+    # the whole point of snapshot-then-write + delta: the loop blocks
+    # less per save than the sync full-save discipline
+    assert by["live_reshard_delta_async"]["checkpoint_s"] \
+        < 0.8 * by["live_reshard"]["checkpoint_s"], by
+    assert by["live_reshard_delta_async"]["ckpt_reused_bytes"] > 0
+    with open(os.path.join(_ROOT, "BENCH_chaos.json")) as f:
+        assert json.load(f) == rec
+
+
+@pytest.mark.slow
 def test_bench_moe_emits_json_contract():
     """``bench.py --moe`` must emit the expert-plane headline and write
     BENCH_moe.json with the serialized-vs-chunked and eager-vs-delayed

@@ -577,6 +577,46 @@ def test_trace_summary_health_section(tmp_path, capsys):
     assert "loss_spike" in out and "4.5x baseline" in out
 
 
+def test_trace_summary_recovery_plane_section(tmp_path, capsys):
+    from hetu_tpu.tools.trace_summary import main
+    path = str(tmp_path / "t.jsonl")
+    hist = {"count": 2, "sum": 6.0, "min": 2.8, "max": 3.2,
+            "p50": 3.0, "p90": 3.2, "p99": 3.2}
+    rec_hist = {"count": 2, "sum": 0.3, "min": 0.1, "max": 0.2,
+                "p50": 0.15, "p90": 0.2, "p99": 0.2}
+    recs = [
+        {"kind": "goodput", "wall_s": 40.0,
+         "components": {"compute": 30.0, "checkpoint": 1.5,
+                        "recovery": 0.3}, "tokens": 1000, "steps": 12},
+        {"kind": "metrics_snapshot", "metrics": {
+            'chaos_kills_total{target="w7"}': 1.0,
+            'chaos_kills_total{target="w3"}': 1.0,
+            'elastic_recoveries_total{mode="live"}': 2.0,
+            "elastic_detect_seconds": hist,
+            'elastic_recovery_seconds{mode="live"}': rec_hist,
+            'heartbeat_send_failures_total{worker="w1"}': 3.0,
+            "checkpoint_snapshot_seconds": {
+                "count": 12, "sum": 0.12, "min": 0.005, "max": 0.02,
+                "p50": 0.01, "p90": 0.02, "p99": 0.02},
+            'checkpoint_delta_bytes_total{kind="written"}': 1.5e6,
+            'checkpoint_delta_bytes_total{kind="reused"}': 8.5e6}},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    assert main([path]) == 0
+    out = capsys.readouterr().out
+    assert "== recovery plane ==" in out
+    assert "2 injected" in out and "w7: 1" in out
+    assert "recoveries" in out and "live: 2" in out
+    assert "detection" in out and "p50 3.00s" in out
+    assert "recovery (live)" in out
+    assert "3 sends failed" in out
+    assert "ckpt snapshot" in out and "10ms step-blocking" in out
+    assert "85% saved" in out
+    assert "cadence cost" in out and "4.5%" in out
+
+
 # ---------------------------------------------------------------------------
 # serving-engine hang: the injected stalled fake step (no compiles —
 # the fused fn is monkeypatched, so this stays quick-tier)

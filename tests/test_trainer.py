@@ -132,8 +132,10 @@ def test_trainer_distributed_checkpoint_roundtrip(tmp_path):
                 _cfg(ckpt_dir=str(tmp_path), distributed_ckpt=True,
                      total_steps=2))
     t.train(_batches(2))
+    import glob
     import os
-    assert os.path.exists(tmp_path / "ckpt-host00000.safetensors")
+    assert glob.glob(str(tmp_path / "ckpt-host00000-s*.safetensors"))
+    assert os.path.exists(tmp_path / "index-host00000.json")
     t2 = Trainer(GPTLMHeadModel(CFG), optim.adamw(1e-3),
                  Strategy(tp=8), _cfg())  # different layout on resume
     t2.resume(str(tmp_path))

@@ -1072,6 +1072,205 @@ def chaos_main():
     print(json.dumps(result))
 
 
+_BENCH_KERNELS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_kernels.json")
+
+
+def kernels_main():
+    """``bench.py --kernels``: kernel-plane microbench (ISSUE 14).
+
+    Three sweeps, each kernel-vs-reference with a parity check:
+
+    - **decode**: paged Pallas kernel vs the XLA-gather reference over
+      slots × block_size, with the analytic per-step HBM read bytes
+      from ``engine.memory.decode_attn_read_bytes`` (the gather tax);
+    - **packed prefill**: the flash lane's intra-pack + arena-history
+      LSE-combine vs the per-token gather formulation;
+    - **W8A8 FFN**: int8×int8 matmul with fused rescale vs W8A16 vs
+      fp32.
+
+    On CPU the Pallas kernels run in INTERPRET mode, so wall times are
+    a smoke signal only — the committed headline is the ANALYTIC
+    gather-tax byte ratio, and the real-TPU wall numbers fold into the
+    ROADMAP measurement-debt run. BENCH_kernels.json carries the sweep.
+    """
+    import numpy as np
+
+    telemetry.enable(True)
+    if not probe_tpu():
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    interpret = not on_tpu
+
+    from hetu_tpu.engine.memory import decode_attn_read_bytes
+    from hetu_tpu.ops.attention import attention_with_lse
+    from hetu_tpu.ops.paged_pallas import (
+        combine_attention_lse, paged_attention_pallas,
+        paged_attention_reference,
+    )
+    from hetu_tpu.ops.quantization import int8_matmul, int8_w8a8_matmul, \
+        quantize_int8
+
+    rng = np.random.default_rng(0)
+
+    def timed(fn, *args, iters=8):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return out, (time.perf_counter() - t0) / iters * 1e3
+
+    # -- decode: paged kernel vs XLA gather over slots × block_size ----
+    import types
+    hq = hkv = (12 if on_tpu else 4)
+    d = 64 if on_tpu else 32
+    # price the analytic bytes from dims MATCHING the timed arrays
+    # (one layer, these heads, this head_dim) — the per-row byte fields
+    # must describe the kernel the row timed
+    cfg = types.SimpleNamespace(num_layers=1, num_heads=hq,
+                                num_kv_heads=hkv, head_dim=d,
+                                hidden_size=hq * d)
+    sweep = []
+    slots_axis = (16, 64) if on_tpu else (4, 16)
+    bs_axis = (16, 32) if on_tpu else (8, 16)
+    for S in slots_axis:
+        for bs in bs_axis:
+            W = 64 if on_tpu else 16          # table lanes per slot
+            ctx = (W * bs) // 4               # live context: 1/4 table
+            per = -(-ctx // bs)
+            n_blocks = 1 + S * per
+            q = jnp.asarray(rng.normal(size=(S, 1, hq, d)), jnp.float32)
+            k = jnp.asarray(rng.normal(size=(n_blocks, bs, hkv, d)),
+                            jnp.float32)
+            v = jnp.asarray(rng.normal(size=(n_blocks, bs, hkv, d)),
+                            jnp.float32)
+            tbl = np.zeros((S, W), np.int32)
+            for s in range(S):
+                tbl[s, :per] = 1 + s * per + np.arange(per)
+            tbl = jnp.asarray(tbl)
+            off = jnp.full((S,), ctx - 1, jnp.int32)
+
+            pg = jax.jit(lambda q, k, v, t, o: paged_attention_pallas(
+                q, k, v, t, o, interpret=interpret))
+            rf = jax.jit(paged_attention_reference)
+            o1, ms_pg = timed(pg, q, k, v, tbl, off)
+            o2, ms_rf = timed(rf, q, k, v, tbl, off)
+            maxdiff = float(jnp.max(jnp.abs(o1 - o2)))
+            b_pg = decode_attn_read_bytes(
+                cfg, context_len=ctx, table_len=W * bs, block_size=bs,
+                kernel="paged")
+            b_rf = decode_attn_read_bytes(
+                cfg, context_len=ctx, table_len=W * bs, block_size=bs,
+                kernel="reference")
+            sweep.append({
+                "slots": S, "block_size": bs, "context": ctx,
+                "table_len": W * bs,
+                "paged_ms": round(ms_pg, 3),
+                "reference_ms": round(ms_rf, 3),
+                "hbm_bytes_paged": int(b_pg),
+                "hbm_bytes_reference": int(b_rf),
+                "hbm_bytes_ratio": round(b_rf / b_pg, 2),
+                "maxdiff": maxdiff,
+                "parity_ok": maxdiff < 1e-4,
+            })
+
+    # -- packed prefill: flash LSE-combine vs per-token gather ---------
+    C, n_req = (128, 4) if on_tpu else (24, 3)
+    bs, W = 8, 8
+    hist = C // n_req            # every request has this much history
+    per_req = C // n_req
+    n_blocks = 1 + n_req * W
+    k_arena = rng.normal(size=(n_blocks, bs, hkv, d)).astype(np.float32)
+    v_arena = rng.normal(size=(n_blocks, bs, hkv, d)).astype(np.float32)
+    tblp = np.zeros((n_req, W), np.int32)
+    for r in range(n_req):
+        tblp[r] = 1 + r * W + np.arange(W)
+    qp = rng.normal(size=(1, C, hq, d)).astype(np.float32)
+    kp = rng.normal(size=(1, C, hkv, d)).astype(np.float32)
+    vp = rng.normal(size=(1, C, hkv, d)).astype(np.float32)
+    seg = np.repeat(np.arange(n_req), per_req).astype(np.int32)
+    pos = np.concatenate([hist + np.arange(per_req)] * n_req
+                         ).astype(np.int32)
+    # scatter the pack into the arena (the write both lanes share)
+    for t in range(C):
+        row = tblp[seg[t], pos[t] // bs] * bs + pos[t] % bs
+        k_arena.reshape(-1, hkv, d)[row] = kp[0, t]
+        v_arena.reshape(-1, hkv, d)[row] = vp[0, t]
+    k_arena, v_arena = jnp.asarray(k_arena), jnp.asarray(v_arena)
+    tbl_tok = jnp.asarray(tblp[seg])
+    qp, kp, vp = jnp.asarray(qp), jnp.asarray(kp), jnp.asarray(vp)
+    segj, posj = jnp.asarray(seg), jnp.asarray(pos)
+    hists = jnp.full((C,), hist, jnp.int32)
+
+    def prefill_flash(qp, kp, vp):
+        intra, lse_i = attention_with_lse(
+            qp, kp, vp, causal=True, segment_ids=segj[None, :],
+            impl="pallas" if on_tpu else "reference")
+        hist_o, lse_h = paged_attention_pallas(
+            qp[0][:, None], k_arena, v_arena, tbl_tok, hists - 1,
+            return_lse=True, interpret=interpret)
+        return combine_attention_lse(
+            intra, lse_i, hist_o[:, 0][None], lse_h[:, :, 0].T[None])
+
+    def prefill_ref(qp):
+        return paged_attention_reference(
+            qp[0][:, None], k_arena, v_arena, tbl_tok, posj)[:, 0][None]
+
+    of, ms_fl = timed(jax.jit(prefill_flash), qp, kp, vp)
+    orf, ms_rf = timed(jax.jit(prefill_ref), qp)
+    pf_diff = float(jnp.max(jnp.abs(of - orf)))
+    prefill = {
+        "pack_tokens": C, "requests": n_req, "history": hist,
+        "flash_ms": round(ms_fl, 3), "reference_ms": round(ms_rf, 3),
+        "maxdiff": pf_diff, "parity_ok": pf_diff < 1e-4,
+    }
+
+    # -- W8A8 vs W8A16 vs fp FFN matmul --------------------------------
+    T, E, H = (1024, 768, 3072) if on_tpu else (64, 128, 512)
+    x = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(E, H)) * 0.02, jnp.float32)
+    wq, ws = quantize_int8(w, axis=0)
+    _, ms_fp = timed(jax.jit(jnp.matmul), x, w)
+    _, ms_a16 = timed(jax.jit(lambda x: int8_matmul(x, wq, ws)), x)
+    o88, ms_a8 = timed(jax.jit(
+        lambda x: int8_w8a8_matmul(x, w)), x)
+    ref = x @ w
+    rel = float(jnp.max(jnp.abs(o88 - ref))
+                / (jnp.max(jnp.abs(ref)) + 1e-9))
+    w8a8 = {
+        "tokens": T, "embed": E, "hidden": H,
+        "fp32_ms": round(ms_fp, 3), "w8a16_ms": round(ms_a16, 3),
+        "w8a8_ms": round(ms_a8, 3), "max_rel_err": rel,
+    }
+
+    headline = sweep[-1]
+    result = {
+        "metric": "kernel_plane_gather_tax" if on_tpu
+        else "kernel_plane_cpu_smoke",
+        # the headline is the ANALYTIC HBM-read ratio the paged kernel
+        # buys at the largest swept shape — wall clock only means
+        # something on the real chip (interpret mode smoke-tests
+        # numerics, not speed)
+        "value": headline["hbm_bytes_ratio"],
+        "unit": "x_hbm_read_bytes",
+        "interpret": interpret,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "decode_sweep": sweep,
+        "prefill": prefill,
+        "w8a8": w8a8,
+    }
+    with open(_BENCH_KERNELS_PATH, "w") as f:
+        json.dump(result, f, indent=1)
+    try:
+        _write_bench_telemetry(result)
+    except Exception:
+        pass
+    print(json.dumps(result))
+
+
 def main():
     telemetry.enable(True)
     if not probe_tpu():
@@ -1361,5 +1560,7 @@ if __name__ == "__main__":
         ragged_main()
     elif "--chaos" in sys.argv:
         chaos_main()
+    elif "--kernels" in sys.argv:
+        kernels_main()
     else:
         main()

@@ -389,6 +389,8 @@ class ElasticSupervisor:
         self._pending: list[tuple] = []
         self._lock = threading.Lock()
         self._watch_thread = None
+        self._watchdog = None
+        self._abort_reason: Optional[str] = None
         self.recoveries: list[dict] = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -414,6 +416,55 @@ class ElasticSupervisor:
     def __exit__(self, *exc) -> bool:
         self.stop()
         return False
+
+    # -- hang-watchdog intake (ROADMAP PR 12 residual) -----------------------
+    def attach_watchdog(self, watchdog) -> None:
+        """Wire a :class:`~hetu_tpu.telemetry.flight.HangWatchdog` into
+        the recovery path: a tripped TRAINER watchdog means the current
+        step is wedged — almost always a collective waiting on a peer
+        that died without its heartbeat lapsing yet — so the trip
+        ABORTS the step (its record is discarded, its wall lands in the
+        goodput ledger's ``recovery`` category) and feeds the same
+        pending-recovery queue a membership death would, with the
+        membership snapshot taken AT TRIP TIME. Step-boundary
+        discipline is unchanged: the recovery applies when the wedged
+        call returns (or raises) and :meth:`poll` next runs — the host
+        cannot cancel an in-flight device step, but it no longer waits
+        for the heartbeat path to notice what the watchdog already
+        proved. A previously installed ``on_trip`` callback keeps
+        firing (the supervisor chains, never replaces). The supervised
+        :meth:`run` loop feeds the watchdog's beats."""
+        prev = watchdog.on_trip
+
+        def on_trip(reason: str) -> None:
+            if prev is not None:
+                try:
+                    prev(reason)
+                except Exception:
+                    pass    # a user callback must not eat the recovery
+            self._on_trip(reason)
+
+        watchdog.on_trip = on_trip
+        self._watchdog = watchdog
+
+    def _on_trip(self, reason: str) -> None:
+        """Runs on the watchdog monitor thread."""
+        from hetu_tpu import telemetry
+        flight_record("elastic_watchdog_abort", reason=reason)
+        if telemetry.enabled():
+            telemetry.get_registry().counter(
+                "elastic_watchdog_aborts_total",
+                "wedged steps aborted into the elastic recovery path "
+                "by a trainer hang-watchdog trip").inc()
+        try:
+            alive, dead = self.controller.check()
+        except Exception:
+            # the coordinator may be the thing that is wedged: recover
+            # onto everyone we knew about (a same-topology re-setup)
+            alive, dead = list(self.device_map), []
+        with self._lock:
+            self._abort_reason = reason
+            self._pending.append((list(alive), list(dead), None))
 
     # -- failure intake (watcher thread) ------------------------------------
     def _on_failure(self, alive: list[str], dead: list[str]) -> None:
@@ -602,6 +653,21 @@ class ElasticSupervisor:
                 t0 = time.perf_counter()
                 n_traces = trace_total()
                 metrics = trainer.train_step(batch)
+                with self._lock:
+                    aborted, self._abort_reason = \
+                        self._abort_reason, None
+                if aborted is not None:
+                    # the watchdog declared this step wedged while it
+                    # was in flight: discard its record (the recovery
+                    # poll() runs next iteration re-establishes state)
+                    # and ledger the wall as recovery, not compute
+                    acct.record("recovery", time.perf_counter() - t0)
+                    get_logger().warning(
+                        f"elastic: step aborted by watchdog "
+                        f"({aborted}) — recovering")
+                    continue
+                if self._watchdog is not None:
+                    self._watchdog.beat()
                 step = int(jax.device_get(trainer.state.step))
                 loss = float(jax.device_get(metrics["loss"]))
                 # a step that re-traced spent its wall on trace+XLA

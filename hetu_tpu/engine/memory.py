@@ -365,6 +365,44 @@ def size_kv_blocks(cfg, *, hbm_budget_bytes: float, block_size: int,
     return blocks
 
 
+def decode_attn_read_bytes(cfg, *, context_len: int, table_len: int,
+                           block_size: int, rows: int = 1,
+                           cache_dtype: str = "fp32", tp: int = 1,
+                           kernel: str = "paged") -> float:
+    """HBM bytes ONE slot's decode attention reads per fused step —
+    the gather-tax arithmetic the kernel plane exists to kill.
+
+    ``kernel="reference"`` prices the XLA-gather path: every layer
+    MATERIALIZES the slot's full ``table_len``-row KV view
+    (``gather_block_rows`` — written once by the gather, read back by
+    the attention contraction, and on int8 arenas dequantized to the
+    compute dtype first), so bytes scale with the TABLE WIDTH the
+    long-prompt lane widened, not the live context. ``kernel="paged"``
+    prices the Pallas kernel: only the ``ceil(context/block_size)``
+    live pages stream HBM→VMEM, once, in the arena's own dtype (int8
+    pages + their fp32 scales — the dequant happens in VMEM). ``rows``
+    (1 classic decode, k+1 verify-lane, C packed-prefill) does not
+    change the KV read — the q tile rides VMEM — so it is accepted and
+    ignored; it documents the call shape."""
+    del rows
+    if kernel not in ("paged", "reference"):
+        raise ValueError(f"kernel must be paged|reference, "
+                         f"got {kernel!r}")
+    if kernel == "paged":
+        live = -(-int(context_len) // int(block_size))
+        return live * kv_bytes_per_block(
+            cfg, block_size=block_size, cache_dtype=cache_dtype, tp=tp)
+    gathered = kv_bytes_per_block(cfg, block_size=table_len,
+                                  cache_dtype=cache_dtype, tp=tp)
+    if cache_dtype == "int8":
+        # the reference path dequantizes the gathered view to fp32
+        # scratch before the einsum reads it — a second, 4x-wide pass
+        gathered += kv_bytes_per_block(cfg, block_size=table_len,
+                                       cache_dtype="fp32", tp=tp)
+    # written by the gather + read back by the attention contraction
+    return 2.0 * gathered
+
+
 def size_spill_arena(cfg, *, host_budget_bytes: float, block_size: int,
                      cache_dtype: str = "fp32", tp: int = 1) -> int:
     """How many KV blocks the host spill arena may park in

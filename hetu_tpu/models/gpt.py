@@ -106,7 +106,8 @@ class GPTBlock(Module):
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
-                 block_tables=None, row_mask=None, dropout_key=None,
+                 block_tables=None, row_mask=None, attn_kernel="reference",
+                 pack=None, w8a8=None, dropout_key=None,
                  return_kv=False):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
@@ -115,7 +116,9 @@ class GPTBlock(Module):
                                      kv_cache=kv_cache,
                                      slot_mask=slot_mask,
                                      block_tables=block_tables,
-                                     row_mask=row_mask)
+                                     row_mask=row_mask,
+                                     attn_kernel=attn_kernel,
+                                     pack=pack)
             x = x + a
             mlp_in = self.ln_2(params["ln_2"], x)
             if self.returns_aux:
@@ -124,10 +127,10 @@ class GPTBlock(Module):
                 # expert FFNs instead of the dense oracle's O(rows·E));
                 # aux is train-only. One-shot generate and the serving
                 # engine's fused step both land here, so their tokens
-                # match by construction.
+                # match by construction. (W8A8 covers dense FFNs only.)
                 h = self.mlp.decode(params["mlp"], mlp_in)
             else:
-                h = self.mlp(params["mlp"], mlp_in)
+                h = self.mlp(params["mlp"], mlp_in, w8a8=w8a8)
             return x + h, new_cache
         # positions only matter for decode (GPT's learned position
         # embedding is applied in embed(), not per block)

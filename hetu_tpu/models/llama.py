@@ -100,7 +100,8 @@ class LlamaBlock(Module):
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
-                 block_tables=None, row_mask=None, dropout_key=None,
+                 block_tables=None, row_mask=None, attn_kernel="reference",
+                 pack=None, w8a8=None, dropout_key=None,
                  return_kv=False):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
@@ -110,15 +111,18 @@ class LlamaBlock(Module):
                                      kv_cache=kv_cache,
                                      slot_mask=slot_mask,
                                      block_tables=block_tables,
-                                     row_mask=row_mask)
+                                     row_mask=row_mask,
+                                     attn_kernel=attn_kernel,
+                                     pack=pack)
             x = x + a
             mlp_in = self.post_attn_norm(params["post_attn_norm"], x)
             if self.returns_aux:
                 # MoE decode: per-row top-k through gathered local-
-                # expert einsums (MoEMLP.decode); aux is train-only
+                # expert einsums (MoEMLP.decode); aux is train-only.
+                # (W8A8 covers dense FFNs only.)
                 h = self.mlp.decode(params["mlp"], mlp_in)
             else:
-                h = self.mlp(params["mlp"], mlp_in)
+                h = self.mlp(params["mlp"], mlp_in, w8a8=w8a8)
             return x + h, new_cache
         ka = k1 = k2 = None
         if dropout_key is not None and self.attn_pdrop > 0:

@@ -237,7 +237,21 @@ class ParallelMLP(Module):
         self.fc_out = RowParallelLinear(hidden, features, bias=bias,
                                         axis="mlp")
 
-    def __call__(self, params, x):
+    def __call__(self, params, x, *, w8a8=None):
+        """``w8a8`` (None | traced bool) selects the quantized-COMPUTE
+        lane per call: activations quantize per token, weights per
+        output channel, and both matmuls contract in int8 with one
+        fused rescale (``ops.quantization.int8_w8a8_matmul``). A traced
+        flag rides ``lax.cond`` so the serving engine can A/B the lane
+        PER LAYER as data (``StackedBlocks.decode(w8a8_mask=)``);
+        ``None`` (the default, and every training path) is exactly the
+        historical fp lane — no cond, bit-for-bit unchanged."""
+        if w8a8 is None:
+            return self._fp_lane(params, x)
+        return jax.lax.cond(w8a8, self._w8a8_lane, self._fp_lane,
+                            params, x)
+
+    def _fp_lane(self, params, x):
         if self.gated:
             h = self.activation(self.gate_proj(params["gate_proj"], x),
                                 self.up_proj(params["up_proj"], x))
@@ -245,6 +259,37 @@ class ParallelMLP(Module):
             h = self.activation(self.fc_in(params["fc_in"], x))
         h = act_constrain(h, "hidden")
         return self.fc_out(params["fc_out"], h)
+
+    def _w8a8_lane(self, params, x):
+        """Both FFN matmuls in int8 (W8A8). Biases and the activation
+        stay fp; the canonical activation cut points keep their
+        ``act_constrain`` layouts so GSPMD shards the lane like the fp
+        one. Weights quantize at trace time from the live fp params
+        (pre-quantized weight trees are a future optimization — the
+        lane's point is the int8 CONTRACTION, which is where decode
+        FFN time goes)."""
+        from hetu_tpu.ops.quantization import int8_w8a8_matmul
+        dt = self.compute_dtype()
+        x = x.astype(dt)
+
+        def lin(mod, p):
+            y = int8_w8a8_matmul(x, p["weight"].astype(dt), dtype=dt)
+            if mod.use_bias:
+                y = y + p["bias"].astype(dt)
+            return act_constrain(y, "hidden")
+
+        if self.gated:
+            h = self.activation(lin(self.gate_proj, params["gate_proj"]),
+                                lin(self.up_proj, params["up_proj"]))
+        else:
+            h = self.activation(lin(self.fc_in, params["fc_in"]))
+        h = act_constrain(h, "hidden")
+        y = int8_w8a8_matmul(h, params["fc_out"]["weight"].astype(dt),
+                             dtype=dt)
+        y = act_constrain(y, "tokens")
+        if self.fc_out.use_bias:
+            y = y + params["fc_out"]["bias"].astype(dt)
+        return y
 
 
 class ParallelAttention(Module):
@@ -294,8 +339,8 @@ class ParallelAttention(Module):
 
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl: str = "auto", kv_cache=None, slot_mask=None,
-                 block_tables=None, row_mask=None,
-                 dropout_rate: float = 0.0, dropout_key=None,
+                 block_tables=None, row_mask=None, attn_kernel="reference",
+                 pack=None, dropout_rate: float = 0.0, dropout_key=None,
                  return_kv: bool = False):
         """``return_kv=True`` (train path only) additionally returns the
         rotary-applied per-head ``(k, v)`` of this call — the exact
@@ -312,7 +357,8 @@ class ParallelAttention(Module):
             return self._decode(params, x, kv_cache, positions=positions,
                                 slot_mask=slot_mask,
                                 block_tables=block_tables,
-                                row_mask=row_mask)
+                                row_mask=row_mask,
+                                attn_kernel=attn_kernel, pack=pack)
         b, s, _ = x.shape
         q = self.q_proj(params["q_proj"], x).reshape(
             b, s, self.num_heads, self.head_dim)
@@ -385,7 +431,8 @@ class ParallelAttention(Module):
         return out
 
     def _decode(self, params, x, kv_cache, *, positions=None,
-                slot_mask=None, block_tables=None, row_mask=None):
+                slot_mask=None, block_tables=None, row_mask=None,
+                attn_kernel: str = "reference", pack=None):
         """Incremental decoding with a KV cache.
 
         ``kv_cache``: (k_buf, v_buf) of shape (b, max_len, hkv, d); the
@@ -425,7 +472,30 @@ class ParallelAttention(Module):
         max draft depth must not write the unused trailing rows, whose
         positions could land beyond the blocks its table owns (a
         clamped scatter there would corrupt a live block). Paged mode
-        only."""
+        only.
+
+        ``attn_kernel`` ("reference" | "paged", paged mode only)
+        selects HOW the attention reads the arena: "reference" is the
+        XLA-gather path (materializes each row's full table view —
+        :func:`~hetu_tpu.ops.attention.gather_block_rows`, the
+        CPU/0.4.37 fallback), "paged" streams KV tiles through the
+        block tables inside the Pallas kernel
+        (:func:`~hetu_tpu.ops.paged_pallas.paged_attention_pallas` —
+        no materialized gather, cost ∝ live context). Resolve requests
+        with :func:`~hetu_tpu.ops.attention.resolve_decode_kernel`.
+
+        ``pack`` switches to the PACKED-PREFILL flash mode
+        (:meth:`_decode_packed`): ``x`` is one ``(1, C, embed)`` row of
+        C pack tokens from many requests, with per-token
+        ``block_tables`` (C, W) / ``positions`` (1, C) and pack dict
+        keys ``segment_ids`` (1, C), ``hist`` (C,), ``valid`` (C,),
+        ``impl``."""
+        if pack is not None:
+            return self._decode_packed(params, x, kv_cache,
+                                       positions=positions,
+                                       block_tables=block_tables,
+                                       pack=pack,
+                                       attn_kernel=attn_kernel)
         quant = len(kv_cache) == 4
         b, s, _ = x.shape
         per_row = slot_mask is not None
@@ -500,31 +570,157 @@ class ParallelAttention(Module):
             vnew_q, vnew_s = quantize_int8(v, axis=-1)
             kq_b, ks_b = upd(kq_b, knew_q), upd(ks_b, knew_s)
             vq_b, vs_b = upd(vq_b, vnew_q), upd(vs_b, vnew_s)
-            if paged:
-                # gather the int8 rows + scales (1/4 the bytes of the
-                # dequantized view), dequantize only the gathered rows
-                from hetu_tpu.ops.attention import gather_block_rows
-                k_buf = dequantize_int8(
-                    gather_block_rows(kq_b, block_tables),
-                    gather_block_rows(ks_b, block_tables), q.dtype)
-                v_buf = dequantize_int8(
-                    gather_block_rows(vq_b, block_tables),
-                    gather_block_rows(vs_b, block_tables), q.dtype)
-            else:
-                k_buf = dequantize_int8(kq_b, ks_b, q.dtype)
-                v_buf = dequantize_int8(vq_b, vs_b, q.dtype)
             new_cache = (kq_b, ks_b, vq_b, vs_b)
         else:
             k_buf, v_buf = kv_cache
             k_buf, v_buf = upd(k_buf, k), upd(v_buf, v)
             new_cache = (k_buf, v_buf)
-        # causal offsets mask both the future and never-written slots
-        # (their positions exceed every live q position)
-        out = attention_reference(q, k_buf, v_buf, causal=self.causal,
-                                  q_offset=index, kv_offset=0,
-                                  block_tables=block_tables
-                                  if paged and not quant else None)
+
+        if paged and attn_kernel == "paged" and self.causal:
+            # the Pallas kernel streams arena tiles through the block
+            # tables — no materialized gather, dead lanes skipped, int8
+            # pages dequantized per tile in VMEM
+            from hetu_tpu.ops.paged_pallas import paged_attention_pallas
+            if quant:
+                out = paged_attention_pallas(
+                    q, kq_b, vq_b, block_tables, index,
+                    k_scale=ks_b, v_scale=vs_b)
+            else:
+                out = paged_attention_pallas(
+                    q, k_buf, v_buf, block_tables, index)
+        elif paged:
+            if attn_kernel == "paged":
+                from hetu_tpu.ops.attention import record_kernel_fallback
+                record_kernel_fallback(
+                    "decode_non_causal",
+                    "the paged kernel implements causal decode only")
+            # the XLA-gather twin (int8 arenas gather quantized rows +
+            # scales — 1/4 the bytes — and dequantize after); causal
+            # offsets mask both the future and never-written slots
+            from hetu_tpu.ops.paged_pallas import \
+                paged_attention_reference
+            if quant:
+                out = paged_attention_reference(
+                    q, kq_b, vq_b, block_tables, index,
+                    k_scale=ks_b, v_scale=vs_b, causal=self.causal)
+            else:
+                out = paged_attention_reference(
+                    q, k_buf, v_buf, block_tables, index,
+                    causal=self.causal)
+        else:
+            if quant:
+                from hetu_tpu.ops.quantization import dequantize_int8
+                k_buf = dequantize_int8(kq_b, ks_b, q.dtype)
+                v_buf = dequantize_int8(vq_b, vs_b, q.dtype)
+            out = attention_reference(
+                q, k_buf, v_buf, causal=self.causal,
+                q_offset=index, kv_offset=0)
         out = out.reshape(b, s, self.num_heads * self.head_dim)
+        return self.out_proj(params["out_proj"], out), new_cache
+
+    def _decode_packed(self, params, x, kv_cache, *, positions,
+                       block_tables, pack, attn_kernel):
+        """Packed-prefill FLASH mode: the serving engine's prefill pack
+        as ONE ``(1, C, embed)`` row instead of C one-token batch rows.
+
+        The C tokens belong to many requests (``pack["segment_ids"]``,
+        -1 on pad lanes); each token's attention decomposes into two
+        DISJOINT parts that LSE-combine exactly
+        (``ops.paged_pallas.combine_attention_lse``):
+
+        - **intra-pack**: flash attention over the pack itself with
+          segment isolation + causal masking — within one request's
+          contiguous run positions ascend with pack index, so
+          index-causality IS position-causality, and segment ids stop
+          any cross-request (or cross-document) leakage;
+        - **arena history**: each token attends its request's
+          already-resident KV — earlier chunks of a multi-chunk
+          prompt, prefix-cache hits — through its block table, masked
+          to positions ``< pack["hist"][t]`` (the token's chunk-start
+          offset, so the rows this very pack just scattered are
+          excluded: the intra part owns them).
+
+        KV writes stay per-token scatters through the tables (pads drop
+        out of bounds), bit-identical to the per-token reference lane —
+        only the attention READ changes formulation."""
+        if not self.causal:
+            raise ValueError(
+                "the packed-prefill flash lane requires causal "
+                "attention: its intra-pack/arena-history split relies "
+                "on the causal position mask to keep the two KV sets "
+                "disjoint (use prefill_attn='reference')")
+        quant = len(kv_cache) == 4
+        b, C, _ = x.shape
+        n_blk, blk = kv_cache[0].shape[0], kv_cache[0].shape[1]
+        q = self.q_proj(params["q_proj"], x).reshape(
+            b, C, self.num_heads, self.head_dim)
+        k = self.k_proj(params["k_proj"], x).reshape(
+            b, C, self.num_kv_heads, self.head_dim)
+        v = self.v_proj(params["v_proj"], x).reshape(
+            b, C, self.num_kv_heads, self.head_dim)
+        if self._rope is not None:
+            cos, sin = self._rope
+            q = apply_rotary(q, cos, sin, positions=positions)
+            k = apply_rotary(k, cos, sin, positions=positions)
+        pos = positions[0]                               # (C,)
+        blk_ids = jnp.take_along_axis(block_tables,
+                                      (pos // blk)[:, None], axis=1)[:, 0]
+        rows = jnp.where(pack["valid"], blk_ids * blk + pos % blk,
+                         n_blk * blk)                    # pad → dropped
+
+        def upd(buf, new):
+            flat = buf.reshape((n_blk * blk,) + buf.shape[2:])
+            flat = flat.at[rows].set(new[0].astype(buf.dtype),
+                                     mode="drop")
+            return flat.reshape(buf.shape)
+
+        if quant:
+            from hetu_tpu.ops.quantization import (dequantize_int8,
+                                                   quantize_int8)
+            kq_b, ks_b, vq_b, vs_b = kv_cache
+            knew_q, knew_s = quantize_int8(k, axis=-1)
+            vnew_q, vnew_s = quantize_int8(v, axis=-1)
+            kq_b, ks_b = upd(kq_b, knew_q), upd(ks_b, knew_s)
+            vq_b, vs_b = upd(vq_b, vnew_q), upd(vs_b, vnew_s)
+            new_cache = (kq_b, ks_b, vq_b, vs_b)
+            # the reference per-token lane attends the arena's
+            # ROUND-TRIPPED int8 values for in-pack rows — match it
+            k = dequantize_int8(knew_q, knew_s, q.dtype)
+            v = dequantize_int8(vnew_q, vnew_s, q.dtype)
+        else:
+            k_b, v_b = kv_cache
+            k_b, v_b = upd(k_b, k), upd(v_b, v)
+            new_cache = (k_b, v_b)
+
+        from hetu_tpu.ops.attention import attention_with_lse
+        from hetu_tpu.ops.paged_pallas import (
+            combine_attention_lse, paged_attention_pallas,
+            paged_attention_reference,
+        )
+        intra, lse_i = attention_with_lse(
+            q, k, v, causal=self.causal,
+            segment_ids=pack["segment_ids"], impl=pack["impl"])
+
+        qh = q[0][:, None]                       # (C, 1, hq, d) rows
+        hist_off = pack["hist"].astype(jnp.int32) - 1   # kpos <= hist-1
+        if quant:
+            arena = dict(k_scale=ks_b, v_scale=vs_b)
+            ka, va = kq_b, vq_b
+        else:
+            arena = {}
+            ka, va = k_b, v_b
+        if attn_kernel == "paged":
+            hist, lse_h = paged_attention_pallas(
+                qh, ka, va, block_tables, hist_off, return_lse=True,
+                **arena)
+        else:
+            hist, lse_h = paged_attention_reference(
+                qh, ka, va, block_tables, hist_off, return_lse=True,
+                **arena)
+        hist = hist[:, 0][None]                  # (1, C, hq, d)
+        lse_h = lse_h[:, :, 0].T[None]           # (C, hq, 1) → (1, hq, C)
+        out = combine_attention_lse(intra, lse_i, hist, lse_h)
+        out = out.reshape(b, C, self.num_heads * self.head_dim)
         return self.out_proj(params["out_proj"], out), new_cache
 
 
@@ -813,16 +1009,36 @@ class StackedBlocks(Module):
             carry = seg_prefetch(carry, 0, n_layers)
         return carry
 
-    def decode(self, params, x, caches, **kwargs):
+    def decode(self, params, x, caches, *, w8a8_mask=None, **kwargs):
         """Incremental decoding: scan layers threading per-layer KV caches
-        (leaves shaped (layers, b, max_len, hkv, d))."""
+        (leaves shaped (layers, b, max_len, hkv, d)).
+
+        ``w8a8_mask`` ((layers,) bool, optional) rides the scan as xs:
+        layer ``l``'s decode FFN takes the W8A8 int8 lane iff
+        ``w8a8_mask[l]`` (``ParallelMLP.__call__(w8a8=...)``) — the
+        per-layer A/B knob for quantized decode compute. ``None`` (the
+        default) never touches the flag and stays bit-identical to the
+        historical path."""
+        if w8a8_mask is None:
+            def body(h, inputs):
+                layer_params, cache = inputs
+                h, new_cache = self._block(layer_params, h,
+                                           kv_cache=cache, **kwargs)
+                return h, new_cache
+
+            x, new_caches = jax.lax.scan(body, x, (params, caches))
+            return x, new_caches
+
+        w8a8_mask = jnp.asarray(w8a8_mask, bool)
+
         def body(h, inputs):
-            layer_params, cache = inputs
+            layer_params, cache, flag = inputs
             h, new_cache = self._block(layer_params, h, kv_cache=cache,
-                                       **kwargs)
+                                       w8a8=flag, **kwargs)
             return h, new_cache
 
-        x, new_caches = jax.lax.scan(body, x, (params, caches))
+        x, new_caches = jax.lax.scan(body, x,
+                                     (params, caches, w8a8_mask))
         return x, new_caches
 
     def prefill(self, params, x, *, positions=None, segment_ids=None,

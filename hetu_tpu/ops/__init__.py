@@ -8,6 +8,9 @@ from hetu_tpu.ops.losses import (
     mse_loss, nll_loss, bce_loss, bce_with_logits_loss, kl_div_loss,
 )
 from hetu_tpu.ops.attention import attention_reference, flash_attention
+# NOTE: the paged-attention kernels (ops/paged_pallas.py) are imported
+# lazily at their dispatch sites — a top-level import here would pull
+# the Pallas/Mosaic chain into every `import hetu_tpu.ops`.
 from hetu_tpu.ops.dropout import dropout
 
 __all__ = [

@@ -14,6 +14,7 @@ Layout convention everywhere: (batch, seq, num_heads, head_dim), GQA allowed
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional
 
 import jax
@@ -21,6 +22,77 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
+
+# -- decode-kernel dispatch (ISSUE 14) ---------------------------------------
+# The paged Pallas decode kernel and the XLA-gather reference path are
+# selected per call site; a request for the kernel that cannot be
+# honored (tp-sharded GSPMD context — Mosaic kernels cannot be
+# auto-partitioned — or a non-causal attention module) degrades LOUDLY:
+# warn once per site and count it, the same discipline as
+# ``parallel.overlap.record_ring_fallback``.
+
+_KERNEL_FALLBACKS: dict = {}
+_WARNED_KERNEL_SITES: set = set()
+_KERNEL_LOCK = threading.Lock()
+
+
+def record_kernel_fallback(site: str, detail: str = "") -> None:
+    """Count (and warn ONCE per site about) a decode-attention call that
+    asked for the paged Pallas kernel but ran the XLA-gather reference
+    path instead. Audited by ``attn_kernel_fallback_total``."""
+    with _KERNEL_LOCK:
+        _KERNEL_FALLBACKS[site] = _KERNEL_FALLBACKS.get(site, 0) + 1
+        first = site not in _WARNED_KERNEL_SITES
+        _WARNED_KERNEL_SITES.add(site)
+    from hetu_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.get_registry().counter(
+            "attn_kernel_fallback_total",
+            "paged-kernel requests that fell back to the XLA-gather "
+            "reference path").inc(site=site)
+    if first:
+        import warnings
+        warnings.warn(
+            f"attn_kernel='paged' fell back to the XLA-gather reference "
+            f"path at {site}: {detail} (warned once per site; counted "
+            f"in attn_kernel_fallback_total)", stacklevel=3)
+
+
+def kernel_fallbacks() -> dict:
+    with _KERNEL_LOCK:
+        return dict(_KERNEL_FALLBACKS)
+
+
+def resolve_decode_kernel(requested: str, *, tp: int = 1,
+                          site: str = "decode") -> str:
+    """Resolve an ``attn_kernel`` request to the path that will run.
+
+    ``"auto"`` → the paged Pallas kernel on TPU, the XLA-gather
+    reference elsewhere (interpret-mode Pallas loses to the XLA-fused
+    gather on CPU — the same heuristic ``flash_attention`` uses).
+    An explicit ``"paged"`` is honored everywhere EXCEPT under a
+    tp-sharded GSPMD activation context with real Mosaic lowering
+    ("Mosaic kernels cannot be automatically partitioned"); interpret
+    mode lowers to partitionable jax ops and stays honored, so CPU
+    parity tests cover the kernel under any mesh."""
+    if requested not in ("auto", "paged", "reference"):
+        raise ValueError(
+            f"attn_kernel must be auto|paged|reference, got {requested!r}")
+    resolved = requested
+    if resolved == "auto":
+        resolved = "paged" if jax.default_backend() == "tpu" \
+            else "reference"
+    # the tp guard applies to BOTH an explicit "paged" and an
+    # auto-derived one — a tp-sharded TPU plan must degrade to the
+    # gather path, never hand GSPMD a raw Mosaic call
+    if resolved == "paged" and tp > 1:
+        from hetu_tpu.ops.flash_pallas import _interpret_default
+        if not _interpret_default():
+            record_kernel_fallback(
+                site, f"tp={tp} GSPMD context cannot auto-partition a "
+                      f"Mosaic kernel (wrap-in-shard_map is future work)")
+            return "reference"
+    return resolved
 
 
 def _expand_kv(k, num_q_heads):
@@ -167,6 +239,34 @@ def flash_attention(q, k, v, *, causal: bool = False,
                                segment_ids=segment_ids, scale=scale,
                                dropout_rate=dropout_rate,
                                dropout_key=dropout_key)
+
+
+def attention_with_lse(q, k, v, *, causal: bool = False,
+                       segment_ids: Optional[jnp.ndarray] = None,
+                       scale: Optional[float] = None,
+                       impl: str = "reference",
+                       interpret: Optional[bool] = None):
+    """Attention that ALSO returns the log-sum-exp — ``(out, lse)`` with
+    ``out`` (b, s, h, d) and ``lse`` (b, h, s) fp32.
+
+    The packed-prefill flash lane needs both: each pack token's output
+    is the LSE-combine of an intra-pack part (this function, segment
+    isolation via ``segment_ids``) and an arena-history part (the paged
+    kernel) — ``ops.paged_pallas.combine_attention_lse``. Inference-only
+    (no vjp); ``impl="pallas"`` runs the flash forward kernel,
+    ``"reference"`` the fp32 oracle."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if impl == "pallas":
+        from hetu_tpu.ops.flash_pallas import _flash_fwd
+        out, lse = _flash_fwd(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), segment_ids, segment_ids,
+            causal=causal, scale=scale, interpret=interpret)
+        return jnp.swapaxes(out, 1, 2), lse
+    return attention_reference(q, k, v, causal=causal,
+                               segment_ids=segment_ids, scale=scale,
+                               return_lse=True)
 
 
 def _pallas_sharded_call(q, k, v, *, causal, segment_ids, scale,

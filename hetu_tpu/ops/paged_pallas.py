@@ -1,0 +1,337 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The serving fleet's hottest per-token op is decode attention over the
+block-paged KV arena. Until this kernel, every path paid the GATHER TAX:
+``ops.attention.gather_block_rows`` materializes each row's full
+``(table_width * block_size, hkv, d)`` KV view per layer per step — HBM
+traffic proportional to the TABLE WIDTH, not the live context, plus a
+same-size scratch allocation the XLA gather writes before attention
+reads it back. This module is the TPU-native PagedAttention shape
+(vLLM, SOSP'23) mapped onto the Pallas idioms the flash kernels already
+use:
+
+- **block-table-indexed async copies per KV tile**: the per-slot block
+  tables and positions ride a ``PrefetchScalarGridSpec`` scalar-prefetch
+  operand, so each grid step's K/V BlockSpec ``index_map`` reads the
+  table and DMAs the *physical* arena page straight into VMEM — the
+  indirection costs an SMEM lookup, not a materialized gather;
+- **online softmax** over table lanes (the KV grid axis is
+  "arbitrary"): running max / denominator / accumulator live in VMEM
+  scratch exactly like ``flash_pallas``;
+- **dead-lane skip**: a ``pl.when`` on the scalar-prefetched per-slot
+  position skips every page beyond the slot's live context, so cost
+  scales with ``ceil(context / block_size)`` pages, not ``table_width``
+  (the long-prompt lane's wide tables ride free);
+- **per-row ``q_offset`` semantics**: q row ``i`` of slot ``s`` attends
+  absolute positions ``<= q_offset[s] + i`` — the speculative verify
+  lane's k+1 rows (PR 11) and the packed-prefill per-token rows are the
+  same contract ``attention_reference(q_offset=array)`` speaks;
+- **arena-layout lanes**: fp32/bf16 arenas stream directly; the int8
+  arena streams quantized pages + their fp32 scales and dequantizes
+  per tile in VMEM (1/4 the HBM bytes of a dequantized gather).
+
+``pages_per_step`` (how many table lanes one grid step streams) is the
+kernel's tunable: ``workloads/paged_tune.py`` measures winners per
+block size on the real chip into ``workloads/out/paged_blocks.json``
+(``core.measured.read_measured``, the same persistence the flash block
+sweep uses).
+
+The XLA-gather path (``paged_attention_reference``) remains the
+CPU/0.4.37 fallback and the parity oracle; dispatch lives in
+``ParallelAttention._decode`` behind ``attn_kernel="paged"|"reference"``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hetu_tpu.ops.flash_pallas import _interpret_default
+
+NEG_INF = -1e30
+NUM_LANES = 128
+
+
+def _tuned_pages(block_size: int) -> Optional[int]:
+    """Measured ``pages_per_step`` winner for this block size
+    (``workloads/paged_tune.py`` → ``paged_blocks.json``), or None."""
+    if jax.default_backend() != "tpu":
+        return None
+    from hetu_tpu.core.measured import read_measured
+    data = read_measured("paged_blocks.json")
+    try:
+        for e in data["entries"]:
+            if int(e["block_size"]) == int(block_size):
+                return int(e["pages_per_step"])
+    except (KeyError, TypeError, ValueError):
+        pass
+    return None
+
+
+def default_pages_per_step(block_size: int) -> int:
+    """Tuned winner when measured, else stream ~128 KV rows per grid
+    step (a full MXU contraction's worth) capped at 8 parallel page
+    DMAs."""
+    tuned = _tuned_pages(block_size)
+    if tuned is not None:
+        return max(1, tuned)
+    return max(1, min(8, 128 // max(1, int(block_size))))
+
+
+def _paged_kernel(tbl_ref, off_ref, q_ref, *refs, rows, g, bs, L,
+                  n_steps, quant):
+    """One grid step: slot ``s``, kv head ``h``, table-lane chunk ``w``
+    (L pages). Online softmax across chunks (grid axis 2 is
+    "arbitrary")."""
+    s_i = pl.program_id(0)
+    w = pl.program_id(2)
+
+    # static ref layout: L k pages, L v pages, [L k scales, L v scales],
+    # then outputs (o, lse) and scratch (m, l, acc)
+    k_pages = refs[:L]
+    v_pages = refs[L:2 * L]
+    idx = 2 * L
+    if quant:
+        ks_pages = refs[idx:idx + L]
+        vs_pages = refs[idx + L:idx + 2 * L]
+        idx += 2 * L
+    o_ref, lse_ref = refs[idx], refs[idx + 1]
+    m_scr, l_scr, acc_scr = refs[idx + 2], refs[idx + 3], refs[idx + 4]
+
+    @pl.when(w == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    off = off_ref[s_i]
+    # q row r of the (rows = R*g) tile belongs to verify row r // g and
+    # attends absolute positions <= off + r // g
+    qpos = off + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) // g
+    last_q = off + (rows // g - 1)
+    q = q_ref[0, 0]                              # (rows, d), scale folded
+
+    for j in range(L):
+        page_start = (w * L + j) * bs
+
+        def compute(j=j, page_start=page_start):
+            if quant:
+                k = k_pages[j][0, :, 0].astype(jnp.float32) \
+                    * ks_pages[j][0, :, 0]       # (bs, d) dequant in VMEM
+                v = v_pages[j][0, :, 0].astype(jnp.float32) \
+                    * vs_pages[j][0, :, 0]
+            else:
+                k = k_pages[j][0, :, 0]          # (bs, d)
+                v = v_pages[j][0, :, 0]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            kpos = page_start + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, bs), 1)
+            mask = kpos <= qpos
+            s = jnp.where(mask, s, NEG_INF)
+            m_prev = m_scr[:, :1]
+            l_prev = l_scr[:, :1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_next = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_next)
+            p = jnp.where(mask, p, 0.0)
+            l_cur = jnp.sum(p, axis=1, keepdims=True)
+            alpha = jnp.exp(m_prev - m_next)
+            m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+            l_scr[...] = jnp.broadcast_to(alpha * l_prev + l_cur,
+                                          l_scr.shape)
+            pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            acc_scr[...] = acc_scr[...] * alpha + pv
+
+        # dead-lane skip: pages wholly beyond the slot's last live
+        # position never touch the MXU (cost ∝ context, not table
+        # width; the table's null-block pad lanes land here too)
+        pl.when(page_start <= last_q)(compute)
+
+    @pl.when(w == n_steps - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l == 0.0, NEG_INF, m_scr[:, :1] + jnp.log(l_safe))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def paged_attention_pallas(q, k, v, block_tables, q_offset, *,
+                           k_scale=None, v_scale=None,
+                           scale: Optional[float] = None,
+                           pages_per_step: Optional[int] = None,
+                           interpret: Optional[bool] = None,
+                           return_lse: bool = False):
+    """Decode attention through per-slot block tables, in-kernel.
+
+    - ``q``: ``(S, R, hq, d)`` — S slots × R rows (1 for classic decode,
+      k+1 for the speculative verify lane, C×1 for the packed-prefill
+      per-token rows); row ``i`` of slot ``s`` attends absolute
+      positions ``<= q_offset[s] + i``.
+    - ``k``/``v``: the paged arena ``(n_blocks, block_size, hkv, d)``;
+      int8 when ``k_scale``/``v_scale`` (``(n_blocks, block_size, hkv,
+      1)`` fp32) are given — pages dequantize per tile in VMEM.
+    - ``block_tables``: ``(S, W)`` int32 — logical lane ``w`` of slot
+      ``s`` holds positions ``[w*block_size, (w+1)*block_size)`` at
+      physical page ``block_tables[s, w]``.
+    - ``q_offset``: ``(S,)`` int32 per-slot base position.
+
+    Returns ``(S, R, hq, d)`` in q's dtype (plus the fp32
+    ``(S, R*… )``-shaped LSE ``(S, hq, R)`` when ``return_lse`` — the
+    packed-prefill lane's LSE-combine consumes it). Matches
+    ``attention_reference(causal=True, q_offset=array,
+    block_tables=...)`` semantics up to fp associativity.
+    """
+    S, R, hq, d = q.shape
+    n_blocks, bs, hkv, _ = k.shape
+    g = hq // hkv
+    rows = R * g
+    quant = k_scale is not None
+    W = block_tables.shape[1]
+    L = pages_per_step or default_pages_per_step(bs)
+    L = max(1, min(L, W))
+    n_steps = -(-W // L)
+    Wp = n_steps * L
+    if Wp != W:
+        # pad lanes point at the null block; their positions start at
+        # W*bs > any live q position, so the mask (and the dead-lane
+        # skip) keeps them inert
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, Wp - W)))
+    block_tables = block_tables.astype(jnp.int32)
+    q_offset = jnp.asarray(q_offset, jnp.int32).reshape(S)
+    interpret = _interpret_default() if interpret is None else interpret
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    # (S, R, hkv*g, d) → (S, hkv, R*g, d): tile row r = (row r//g,
+    # group member r%g) so one kv head serves its whole q group
+    qf = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    qh = qf.reshape(S, R, hkv, g, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(S, hkv, rows, d)
+
+    q_spec = pl.BlockSpec((1, 1, rows, d),
+                          lambda s, h, w, tbl, off: (s, h, 0, 0))
+
+    def page_spec(j, scalar=False):
+        width = 1 if scalar else d
+        return pl.BlockSpec(
+            (1, bs, 1, width),
+            lambda s, h, w, tbl, off, j=j: (tbl[s, w * L + j], 0, h, 0))
+
+    in_specs = [q_spec]
+    args = [qh]
+    in_specs += [page_spec(j) for j in range(L)]
+    args += [k] * L
+    in_specs += [page_spec(j) for j in range(L)]
+    args += [v] * L
+    if quant:
+        in_specs += [page_spec(j, scalar=True) for j in range(L)]
+        args += [k_scale] * L
+        in_specs += [page_spec(j, scalar=True) for j in range(L)]
+        args += [v_scale] * L
+
+    out_specs = [
+        pl.BlockSpec((1, 1, rows, d),
+                     lambda s, h, w, tbl, off: (s, h, 0, 0)),
+        pl.BlockSpec((1, 1, rows, NUM_LANES),
+                     lambda s, h, w, tbl, off: (s, h, 0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((S, hkv, rows, d), q.dtype),
+        jax.ShapeDtypeStruct((S, hkv, rows, NUM_LANES), jnp.float32),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, hkv, n_steps),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((rows, NUM_LANES), jnp.float32),
+            pltpu.VMEM((rows, NUM_LANES), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+    )
+    out, lse_l = pl.pallas_call(
+        functools.partial(_paged_kernel, rows=rows, g=g, bs=bs, L=L,
+                          n_steps=n_steps, quant=quant),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_tables, q_offset, *args)
+
+    # (S, hkv, R*g, d) → (S, R, hq, d)
+    out = out.reshape(S, hkv, R, g, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(S, R, hq, d)
+    if return_lse:
+        # (S, hkv, R*g) rows (i*g + gj) → (S, hq, R) with head
+        # h = kh*g + gj — the attention_reference LSE layout
+        lse = lse_l[..., 0].reshape(S, hkv, R, g) \
+            .transpose(0, 1, 3, 2).reshape(S, hq, R)
+        return out, lse
+    return out
+
+
+def paged_attention_reference(q, k, v, block_tables, q_offset, *,
+                              k_scale=None, v_scale=None,
+                              scale: Optional[float] = None,
+                              causal: bool = True,
+                              return_lse: bool = False):
+    """The XLA-gather twin (and parity oracle): materialize each slot's
+    table view with :func:`~hetu_tpu.ops.attention.gather_block_rows`
+    and run the dense reference — exactly what ``ParallelAttention.
+    _decode`` did before the kernel existed, kept as the CPU/0.4.37
+    fallback. Int8 arenas gather quantized rows + scales (1/4 the
+    bytes) and dequantize after, matching the kernel's lanes."""
+    from hetu_tpu.ops.attention import (
+        attention_reference, gather_block_rows,
+    )
+    from hetu_tpu.ops.quantization import dequantize_int8
+    if k_scale is not None:
+        k_buf = dequantize_int8(gather_block_rows(k, block_tables),
+                                gather_block_rows(k_scale, block_tables),
+                                q.dtype)
+        v_buf = dequantize_int8(gather_block_rows(v, block_tables),
+                                gather_block_rows(v_scale, block_tables),
+                                q.dtype)
+        return attention_reference(q, k_buf, v_buf, causal=causal,
+                                   q_offset=q_offset, kv_offset=0,
+                                   scale=scale, return_lse=return_lse)
+    return attention_reference(q, k, v, causal=causal,
+                               q_offset=q_offset,
+                               kv_offset=0, scale=scale,
+                               block_tables=block_tables,
+                               return_lse=return_lse)
+
+
+def combine_attention_lse(o1, lse1, o2, lse2):
+    """Merge two attention partials computed over DISJOINT KV sets.
+
+    ``o``: ``(b, q, h, d)``; ``lse``: ``(b, h, q)`` natural-log-sum-exp
+    of each part's masked logits (``attention_reference(return_lse=
+    True)`` / the kernels' lse output). The packed-prefill flash lane
+    uses this to fuse the intra-pack flash part with the arena-history
+    paged part — the standard flash-decoding split-KV reduction. A part
+    with no live keys carries ``lse ≈ NEG_INF`` and weighs 0; two empty
+    parts yield exact 0 (the reference's fully-masked-row convention).
+    """
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    den = w1 + w2
+    den = jnp.where(den == 0.0, 1.0, den)
+
+    def rowwise(w):                      # (b, h, q) → (b, q, h, 1)
+        return jnp.moveaxis(w, 1, 2)[..., None]
+
+    out = (o1.astype(jnp.float32) * rowwise(w1 / den)
+           + o2.astype(jnp.float32) * rowwise(w2 / den))
+    return out.astype(o1.dtype)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 
@@ -66,3 +67,25 @@ def int8_matmul(x, q_weight, scale, dtype=None):
     dtype = dtype or x.dtype
     w = dequantize_int8(q_weight, scale, dtype)
     return jnp.matmul(x.astype(dtype), w)
+
+
+def int8_w8a8_matmul(x, w, *, dtype=None):
+    """W8A8 matmul: quantize the ACTIVATIONS too, contract in int8, and
+    rescale once — the quantized-COMPUTE lane (int8 only covered KV
+    *storage* before; this is the decode-FFN compute half).
+
+    ``x`` (..., in) gets per-row (per-token) symmetric scales over the
+    contraction axis, ``w`` (in, out) per-output-channel scales; the
+    int8×int8 contraction accumulates in int32 (``preferred_element_
+    type`` — the MXU's native int8 path on TPU) and the two scale
+    vectors FUSE into one rank-1 rescale of the int32 result:
+    ``out = acc * x_scale ⊗ w_scale``. Output in ``x``'s dtype (or
+    ``dtype``)."""
+    xq, xs = quantize_int8(x, axis=-1)           # (..., 1) per-token
+    wq, ws = quantize_int8(w, axis=0)            # (1, out) per-channel
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * xs * ws.reshape(
+        (1,) * (acc.ndim - 1) + (-1,))
+    return out.astype(dtype or x.dtype)

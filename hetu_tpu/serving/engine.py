@@ -162,6 +162,9 @@ class ServingEngine:
                  preempt: bool = True,
                  spill_host_budget_bytes: Optional[float] = None,
                  class_weights: Optional[dict] = None,
+                 attn_kernel: str = "auto",
+                 prefill_attn: str = "auto",
+                 w8a8="off",
                  plan=None, seed: int = 0,
                  counter_sample_every: int = 32,
                  watchdog: bool = False, watchdog_factor: float = 8.0,
@@ -355,6 +358,59 @@ class ServingEngine:
             self.slo = slo or None
         self._slo_every_s = float(slo_every_s)
         self._slo_last_eval = 0.0
+
+        # -- kernel plane (ISSUE 14): decode attention dispatch is
+        # arena-layout-aware (the same call serves fp32/bf16/int8 —
+        # the kernel streams int8 pages + scales and dequantizes per
+        # tile) and resolved ONCE here: the choice is baked into the
+        # compiled step, so the 1-compile audit is untouched.
+        from hetu_tpu.ops.attention import resolve_decode_kernel
+        tp = plan.strategy.tp if plan is not None else 1
+        self.attn_kernel = resolve_decode_kernel(
+            attn_kernel, tp=tp, site="serving_decode")
+        # prefill lanes: "flash" packs the chunk as ONE row — intra-pack
+        # flash attention with segment isolation, LSE-combined with each
+        # token's arena history through its block table; "reference" is
+        # the historical per-token paged lane. "flash_pallas" forces the
+        # Pallas intra kernel (interpret on CPU — quick-tier coverage).
+        if prefill_attn == "auto":
+            prefill_attn = "flash" if jax.default_backend() == "tpu" \
+                else "reference"
+        if prefill_attn not in ("reference", "flash", "flash_pallas"):
+            raise ValueError(
+                f"prefill_attn must be auto|reference|flash|"
+                f"flash_pallas, got {prefill_attn!r}")
+        self.prefill_attn = prefill_attn
+        self._pack_impl = "pallas" if (
+            prefill_attn == "flash_pallas"
+            or (prefill_attn == "flash"
+                and jax.default_backend() == "tpu")) else "reference"
+        # W8A8 decode-FFN compute: per-layer A/B as a (layers,) bool
+        # baked into the step. Gated on the int8 arena — an operator
+        # who priced the KV at 8 bits has already accepted 8-bit error
+        # on the decode path; off by default on CPU ("auto").
+        L = model.blocks.num_layers
+        if w8a8 in (None, False, "off"):
+            self._w8a8_mask = None
+        else:
+            if w8a8 == "auto":
+                on = self.pool.quantized \
+                    and jax.default_backend() == "tpu"
+                mask = np.ones(L, bool) if on else None
+            else:
+                if not self.pool.quantized:
+                    raise ValueError(
+                        "w8a8 needs the int8 arena (cache_dtype="
+                        "jnp.int8): the quantized-compute lane is "
+                        "gated on pools already accepting 8-bit error")
+                if w8a8 in (True, "on"):
+                    mask = np.ones(L, bool)
+                else:                     # iterable of layer indices
+                    mask = np.zeros(L, bool)
+                    mask[np.asarray(list(w8a8), int)] = True
+            self._w8a8_mask = jnp.asarray(mask) if mask is not None \
+                else None
+
         self._fn = self._build_step()
         self._cp_fn = self._build_cp_prefill() \
             if self._cp_buckets is not None else None
@@ -391,6 +447,10 @@ class ServingEngine:
         model = self.model
         R = self._fin_cap
         K = self.spec_depth
+        kern = self.attn_kernel
+        w8a8_mask = self._w8a8_mask
+        flash_lane = self.prefill_attn != "reference"
+        pack_impl = self._pack_impl
 
         def step(params, caches, ctl, pf, bt, cow, spec, key, it):
             record_trace("serving_step")    # churn must never re-enter
@@ -434,7 +494,8 @@ class ServingEngine:
                 logits, caches = generation.decode(
                     model, params, tok_in, positions, caches,
                     slot_mask=ctl["active"], block_tables=bt,
-                    row_mask=row_valid)
+                    row_mask=row_valid, attn_kernel=kern,
+                    w8a8_mask=w8a8_mask)
                 greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 # leading-match acceptance: draft i commits iff drafts
                 # 1..i all matched (cumprod) and i < depth
@@ -472,17 +533,39 @@ class ServingEngine:
             # request see their in-pack predecessors exactly like a
             # dense chunk. (cond keeps idle iterations free.)
             def do_prefill(caches):
-                pos = pf["pos"][:, None]                     # (C, 1)
-                h = model.embed(params, pf["tokens"][:, None],
-                                positions=pos)
-                h, caches = model.blocks.decode(
-                    params["blocks"], h, caches, positions=pos,
-                    slot_mask=pf["valid"],
-                    block_tables=jnp.take(bt, pf["slot"], axis=0))
+                if flash_lane:
+                    # packed FLASH prefill: the whole chunk as ONE
+                    # (1, C) row — intra-pack flash with segment
+                    # isolation (ids = slots, -1 pads), LSE-combined
+                    # with each token's arena history (positions
+                    # < its chunk-start offset) through the paged
+                    # read path. KV writes stay per-token scatters.
+                    pos = pf["pos"][None, :]                 # (1, C)
+                    h = model.embed(params, pf["tokens"][None, :],
+                                    positions=pos)
+                    h, caches = model.blocks.decode(
+                        params["blocks"], h, caches, positions=pos,
+                        block_tables=jnp.take(bt, pf["slot"], axis=0),
+                        attn_kernel=kern,
+                        pack={"segment_ids": pf["seg"][None, :],
+                              "hist": pf["hist"],
+                              "valid": pf["valid"],
+                              "impl": pack_impl})
+                    hrow = h[0]                              # (C, E)
+                else:
+                    pos = pf["pos"][:, None]                 # (C, 1)
+                    h = model.embed(params, pf["tokens"][:, None],
+                                    positions=pos)
+                    h, caches = model.blocks.decode(
+                        params["blocks"], h, caches, positions=pos,
+                        slot_mask=pf["valid"],
+                        block_tables=jnp.take(bt, pf["slot"], axis=0),
+                        attn_kernel=kern)
+                    hrow = h[:, 0]                           # (C, E)
                 # FIRST tokens for the <= R requests whose prefill
                 # completes this iteration: head only on their last
                 # real rows (never the full pack's vocab projection)
-                hf = jnp.take(h[:, 0], pf["fin_row"], axis=0)[:, None]
+                hf = jnp.take(hrow, pf["fin_row"], axis=0)[:, None]
                 hf = model.hidden_norm(params, hf)
                 w = generation._head_weight(model, params)
                 lg = jnp.einsum("bse,ve->bsv", hf.astype(jnp.float32),
@@ -531,13 +614,28 @@ class ServingEngine:
         model = self.model
         n_blk, blk = self.pool.n_blocks, self.pool.block_size
         quant = self.pool.quantized
+        # the lane's attention impl: the flash prefill lanes route the
+        # training-mode forward through flash_attention_pallas ("auto"
+        # lets the dispatch gate check tiling support on the real chip;
+        # "pallas" is the explicit/interpret test mode), reference is
+        # the dense oracle — the ring/zigzag cp split reuses whichever
+        # kernel per shard (ring_attention(impl=...))
+        cp_impl = {"reference": "reference", "flash": "auto",
+                   "flash_pallas": "pallas"}[self.prefill_attn]
 
         def cp_prefill(params, caches, tokens, positions, table,
                        fin_pos, temp, topk, topp, key):
             record_trace("serving_cp_prefill")   # <= n lane buckets
             h = model.embed(params, tokens, positions=positions)
+            # segment ids split the bucket row into prompt (0) vs pad
+            # (1): pad rows — whose KV the scatter drops anyway — stop
+            # attending the prompt, and the flash kernel gets the
+            # packed-varlen operands data/packing.py standardized
+            seg = (positions > fin_pos).astype(jnp.int32)
             h, (ks, vs) = model.blocks.prefill(params["blocks"], h,
-                                               positions=positions)
+                                               positions=positions,
+                                               segment_ids=seg,
+                                               attn_impl=cp_impl)
             # scatter each layer's (L,) prompt rows into the arena at
             # the rows the slot's table maps; pad rows (beyond the real
             # prompt) target n_blk*blk and drop. Zigzag cp layouts feed
@@ -645,6 +743,13 @@ class ServingEngine:
             reg.counter(
                 "serving_cp_prefill_tokens_total",
                 "prompt tokens prefilled through the CP lane").inc(P)
+            reg.counter(
+                "prefill_attn_kernel_total",
+                "prefill-lane executions by attention path (flash "
+                "= packed/CP flash lane, reference = per-token "
+                "gather math)").inc(
+                path="flash" if self.prefill_attn != "reference"
+                else "reference")
             flight_record("serving_cp_prefill", req=req.id,
                           trace=req.trace_id, slot=slot, tokens=P,
                           bucket=job["bucket"])
@@ -1196,6 +1301,8 @@ class ServingEngine:
             tpos = np.zeros(C, np.int32)
             tslot = np.zeros(C, np.int32)
             tvalid = np.zeros(C, bool)
+            tseg = np.full(C, -1, np.int32)      # -1 isolates pad lanes
+            thist = np.zeros(C, np.int32)        # per-token chunk start
             fin_row = np.zeros(R, np.int32)
             fin_slot = np.zeros(R, np.int32)
             fills: list[tuple[dict, int]] = []   # (entry, n) this iter
@@ -1210,6 +1317,13 @@ class ServingEngine:
                 tpos[used:used + n] = np.arange(off, off + n)
                 tslot[used:used + n] = ent["slot"]
                 tvalid[used:used + n] = True
+                # flash-lane operands: segment id = the slot (one
+                # contiguous run per request per pack, so index-causal
+                # == position-causal within it); hist = the run's
+                # start offset — arena rows below it (earlier chunks,
+                # prefix-cache hits) belong to the history part
+                tseg[used:used + n] = ent["slot"]
+                thist[used:used + n] = off
                 if off + n >= len(req.prompt):
                     fin_row[len(fin_ents)] = used + n - 1
                     fin_slot[len(fin_ents)] = ent["slot"]
@@ -1218,6 +1332,7 @@ class ServingEngine:
                 used += n
             pf = {"run": np.bool_(used > 0), "tokens": tokens,
                   "pos": tpos, "slot": tslot, "valid": tvalid,
+                  "seg": tseg, "hist": thist,
                   "fin_row": fin_row, "fin_slot": fin_slot}
             # CoW lanes: unused dst = n_blocks scatters out of bounds
             cow_src = np.zeros(S, np.int32)
@@ -1252,6 +1367,19 @@ class ServingEngine:
                     "accepted/this is the mean tokens committed per "
                     "slot-step — the speculation win, 1.0 without "
                     "drafts").inc(int(active_prev.size))
+                reg.counter(
+                    "serving_attn_kernel_total",
+                    "fused decode/verify steps by attention path "
+                    "(paged = Pallas block-table kernel, reference = "
+                    "XLA gather)").inc(path=self.attn_kernel)
+            if used:
+                reg.counter(
+                    "prefill_attn_kernel_total",
+                    "prefill-lane executions by attention path (flash "
+                    "= packed/CP flash lane, reference = per-token "
+                    "gather math)").inc(
+                    path="flash" if self.prefill_attn != "reference"
+                    else "reference")
             # decode results for the slots that were active going in:
             # each commits ncommit tokens (accepted drafts + bonus) —
             # EOS or budget can finish the request mid-commit, in which

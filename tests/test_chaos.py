@@ -199,6 +199,78 @@ def test_watch_rearms_for_second_failure_and_stops_cleanly():
         assert not t2.is_alive()
 
 
+def test_watchdog_trip_feeds_supervisor_recovery_path(tmp_path,
+                                                      monkeypatch):
+    """ISSUE 14 SATELLITE (ROADMAP PR 12 residual): a tripped trainer
+    watchdog ABORTS the step into the supervisor's recovery path — the
+    trip snapshots membership, enqueues a pending recovery, sets the
+    abort flag the supervised loop honors, and CHAINS (never replaces)
+    a pre-existing on_trip callback. Host-side: stub trainer/controller,
+    fake clock, monkeypatched _recover — no compiles."""
+    import types
+
+    from hetu_tpu import telemetry
+    from hetu_tpu.engine.elastic import ElasticSupervisor
+    from hetu_tpu.telemetry.flight import HangWatchdog
+
+    class StubController:
+        fail = False
+
+        def check(self):
+            if self.fail:
+                raise ConnectionError("coordinator wedged too")
+            return (["w0", "w1"], ["w2"])
+
+    trainer = types.SimpleNamespace(devices=None)
+    ctrl = StubController()
+    sup = ElasticSupervisor(trainer, ctrl,
+                            device_map={"w0": [0], "w1": [1],
+                                        "w2": [2]},
+                            dims=None, topo=None)
+    clock = [0.0]
+    wd = HangWatchdog(name="train", min_timeout_s=1.0,
+                      dump_dir=str(tmp_path), clock=lambda: clock[0])
+    prev_calls = []
+    wd.on_trip = prev_calls.append
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        sup.attach_watchdog(wd)
+        wd.beat()
+        clock[0] += 0.1
+        wd.beat()
+        clock[0] += 50.0
+        assert wd.check() is not None          # tripped
+        # the user's callback still fired, AND the supervisor ingested
+        assert prev_calls and "watchdog[train]" in prev_calls[0]
+        assert sup.pending() == 1
+        with sup._lock:
+            assert sup._abort_reason is not None
+        recovered = []
+        monkeypatch.setattr(
+            sup, "_recover",
+            lambda alive, dead, ds: recovered.append((alive, dead)))
+        assert sup.poll() == 1
+        # trip-time membership snapshot drives the plan
+        assert recovered[0] == (["w0", "w1"], ["w2"])
+        assert telemetry.get_registry().counter(
+            "elastic_watchdog_aborts_total").value() == 1
+
+        # a wedged COORDINATOR degrades to everyone-we-knew-about
+        # (pause/resume re-arms without the 50s stall entering the
+        # rolling median)
+        ctrl.fail = True
+        wd.pause()
+        wd.resume()
+        clock[0] += 50.0
+        assert wd.check() is not None
+        assert sup.poll() == 1
+        assert recovered[1] == (["w0", "w1", "w2"], [])
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()
+
+
 # -- in-process supervised recovery (slow: compiles several plans) -----------
 
 def _mk_trainer(tmp_path, **cfg_kw):

@@ -223,6 +223,40 @@ def test_bench_moe_emits_json_contract():
         assert json.load(f) == rec
 
 
+@pytest.mark.slow
+def test_bench_kernels_emits_json_contract():
+    """``bench.py --kernels`` must emit the kernel-plane microbench and
+    write BENCH_kernels.json: the paged-vs-reference decode sweep over
+    slots×block_size (parity green, gather-tax byte ratio > 1), the
+    packed flash-vs-reference prefill parity, and the W8A8-vs-W8A16 FFN
+    comparison — the CPU smoke runs the Pallas kernels in interpret
+    mode (schema in place for the real-TPU measurement-debt run)."""
+    env = dict(os.environ)
+    env["HETU_TPU_BENCH_PLATFORM"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--kernels"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "decode_sweep", "prefill",
+                "w8a8", "interpret", "device"):
+        assert key in rec, (key, rec)
+    assert rec["value"] > 1          # the gather tax is real
+    assert rec["unit"] == "x_hbm_read_bytes"
+    assert len(rec["decode_sweep"]) >= 4
+    for row in rec["decode_sweep"]:
+        assert row["parity_ok"] is True, row
+        assert row["hbm_bytes_reference"] > row["hbm_bytes_paged"]
+        assert row["hbm_bytes_ratio"] > 1
+    assert rec["prefill"]["parity_ok"] is True
+    assert rec["w8a8"]["max_rel_err"] < 0.05
+    # all three lanes timed
+    for k in ("fp32_ms", "w8a16_ms", "w8a8_ms"):
+        assert rec["w8a8"][k] > 0
+    with open(os.path.join(_ROOT, "BENCH_kernels.json")) as f:
+        assert json.load(f) == rec
+
+
 def test_graft_entry_fn_runs():
     import jax
     sys.path.insert(0, _ROOT)

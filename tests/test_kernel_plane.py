@@ -1,0 +1,450 @@
+"""Kernel plane (ISSUE 14): paged-attention decode kernel, flash in the
+prefill lanes, W8A8 decode compute.
+
+Acceptance discipline: the kernel plane changes HOW attention reads the
+arena, never WHAT it computes — every path is pinned to the XLA-gather
+reference (greedy-token identity end to end, fp-noise tolerance at the
+op level) across fp32/int8 arenas, speculative verify rows,
+preempt/resume churn and the packed flash prefill lane, with the
+``record_trace("serving_step")`` 1-compile audit intact throughout.
+Quick-tier tests run the Pallas kernels in interpret mode on tiny
+shapes (host-cheap — satellite 6); engine-level parity matrices are
+slow-tier.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import telemetry
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel, generate
+from hetu_tpu.ops.paged_pallas import (
+    combine_attention_lse, paged_attention_pallas,
+    paged_attention_reference,
+)
+
+MAX_LEN = 32
+CHUNK = 8
+BLOCK = 8
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.key(0), dtype=jnp.float32)
+    return cfg, model, params
+
+
+def _arena(rng, *, S=3, R=1, hq=4, hkv=2, d=16, n_blocks=9, bs=4, W=8,
+           dtype=jnp.float32):
+    q = jnp.asarray(rng.normal(size=(S, R, hq, d)), dtype)
+    k = jnp.asarray(rng.normal(size=(n_blocks, bs, hkv, d)), dtype)
+    v = jnp.asarray(rng.normal(size=(n_blocks, bs, hkv, d)), dtype)
+    tbl = np.zeros((S, W), np.int32)
+    for s in range(S):
+        tbl[s] = np.concatenate(
+            [rng.permutation(np.arange(1, n_blocks))[:W - 1], [0]])
+    return q, k, v, jnp.asarray(tbl)
+
+
+# ---------------------------------------------------------------------------
+# quick tier: interpret-mode kernel units (host-cheap)
+# ---------------------------------------------------------------------------
+
+def test_paged_kernel_matches_reference_gqa_and_verify_rows():
+    """The kernel == the XLA-gather oracle across GQA grouping, verify
+    rows (R>1, the spec-decode shape), per-slot offsets and
+    pages_per_step tilings — including a pages_per_step that does NOT
+    divide the table width (the pad-lane path)."""
+    rng = np.random.default_rng(0)
+    q, k, v, tbl = _arena(rng, R=3)
+    off = jnp.asarray([0, 5, 17], jnp.int32)
+    ref, lse_r = paged_attention_reference(q, k, v, tbl, off,
+                                           return_lse=True)
+    for pages in (1, 3, 8):
+        out, lse = paged_attention_pallas(q, k, v, tbl, off,
+                                          pages_per_step=pages,
+                                          return_lse=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_r),
+                                   atol=1e-5)
+
+
+def test_paged_kernel_int8_arena_lane():
+    """Int8 arenas stream quantized pages + fp32 scales and dequantize
+    per tile — same numbers as gather-then-dequantize."""
+    from hetu_tpu.ops.quantization import quantize_int8
+    rng = np.random.default_rng(1)
+    q, k, v, tbl = _arena(rng, R=2)
+    off = jnp.asarray([3, 0, 9], jnp.int32)
+    kq, ks = quantize_int8(k, axis=-1)
+    vq, vs = quantize_int8(v, axis=-1)
+    out = paged_attention_pallas(q, kq, vq, tbl, off,
+                                 k_scale=ks, v_scale=vs)
+    ref = paged_attention_reference(q, kq, vq, tbl, off,
+                                    k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_paged_kernel_dead_lanes_inert():
+    """Table lanes beyond the live context must not contribute even
+    when they point at LIVE blocks full of garbage — the dead-lane
+    skip and the position mask both have to hold (a reused block is
+    never zeroed, so this is the no-stale-reads guarantee)."""
+    rng = np.random.default_rng(2)
+    q, k, v, tbl = _arena(rng)
+    off = jnp.asarray([1, 2, 3], jnp.int32)
+    base = paged_attention_pallas(q, k, v, tbl, off)
+    poisoned = jnp.asarray(tbl).at[:, 2:].set(7)   # garbage mappings
+    out = paged_attention_pallas(q, k, v, poisoned, off)
+    ref = paged_attention_reference(q, k, v, poisoned, off)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5)
+    # positions < block 2 are unchanged by the poisoning at all
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                               atol=1e-5)
+
+
+def test_combine_attention_lse_matches_joint_softmax():
+    """Splitting the KV set and LSE-combining the partials must equal
+    one joint softmax — including one side being fully masked."""
+    from hetu_tpu.ops.attention import attention_reference
+    rng = np.random.default_rng(3)
+    b, sq, h, d, sk = 2, 3, 4, 16, 10
+    q = jnp.asarray(rng.normal(size=(b, sq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, sk, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, sk, h, d)), jnp.float32)
+    joint = attention_reference(q, k, v)
+    o1, l1 = attention_reference(q, k[:, :6], v[:, :6], return_lse=True)
+    o2, l2 = attention_reference(q, k[:, 6:], v[:, 6:], return_lse=True)
+    out = combine_attention_lse(o1, l1, o2, l2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(joint),
+                               atol=1e-5)
+    # one side empty (all-masked ≈ NEG_INF lse): combine == other side
+    from hetu_tpu.ops.paged_pallas import NEG_INF
+    empty = jnp.zeros_like(o2)
+    lse_e = jnp.full_like(l2, NEG_INF)
+    out1 = combine_attention_lse(o1, l1, empty, lse_e)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(o1),
+                               atol=1e-6)
+
+
+def test_packed_flash_formulation_matches_per_token_gather():
+    """Ops-level packed-prefill parity: intra-pack (segment-isolated
+    flash PALLAS kernel, interpret) + arena-history, LSE-combined, ==
+    the per-token union through the tables — and a token of request A
+    is PROVABLY blind to request B's pack rows (segment isolation)."""
+    from hetu_tpu.ops.attention import attention_with_lse
+    rng = np.random.default_rng(4)
+    hkv = hq = 4
+    d, bs, W, n_req = 16, 4, 6, 2
+    per_req, hist = 6, 5                    # 5 tokens already resident
+    C = n_req * per_req
+    n_blocks = 1 + n_req * W
+    k_arena = rng.normal(size=(n_blocks, bs, hkv, d)).astype(np.float32)
+    v_arena = rng.normal(size=(n_blocks, bs, hkv, d)).astype(np.float32)
+    tbl = np.zeros((n_req, W), np.int32)
+    for r in range(n_req):
+        tbl[r] = 1 + r * W + np.arange(W)
+    seg = np.repeat(np.arange(n_req), per_req).astype(np.int32)
+    pos = np.concatenate([hist + np.arange(per_req)] * n_req
+                         ).astype(np.int32)
+    qp = rng.normal(size=(1, C, hq, d)).astype(np.float32)
+    kp = rng.normal(size=(1, C, hkv, d)).astype(np.float32)
+    vp = rng.normal(size=(1, C, hkv, d)).astype(np.float32)
+    for t in range(C):                      # the shared scatter
+        row = tbl[seg[t], pos[t] // bs] * bs + pos[t] % bs
+        k_arena.reshape(-1, hkv, d)[row] = kp[0, t]
+        v_arena.reshape(-1, hkv, d)[row] = vp[0, t]
+    k_arena, v_arena = jnp.asarray(k_arena), jnp.asarray(v_arena)
+    tbl_tok = jnp.asarray(tbl[seg])
+
+    intra, lse_i = attention_with_lse(
+        jnp.asarray(qp), jnp.asarray(kp), jnp.asarray(vp), causal=True,
+        segment_ids=jnp.asarray(seg)[None, :], impl="pallas")
+    hist_o, lse_h = paged_attention_pallas(
+        jnp.asarray(qp)[0][:, None], k_arena, v_arena, tbl_tok,
+        jnp.full((C,), hist - 1, jnp.int32), return_lse=True)
+    out = combine_attention_lse(intra, lse_i, hist_o[:, 0][None],
+                                lse_h[:, :, 0].T[None])
+    ref = paged_attention_reference(
+        jnp.asarray(qp)[0][:, None], k_arena, v_arena, tbl_tok,
+        jnp.asarray(pos))[:, 0][None]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5)
+    # segment isolation: corrupting request B's PACK rows leaves
+    # request A's outputs bit-identical (no cross-document leakage)
+    kp2 = kp.copy()
+    kp2[0, per_req:] += 100.0
+    intra2, lse_i2 = attention_with_lse(
+        jnp.asarray(qp), jnp.asarray(kp2), jnp.asarray(vp), causal=True,
+        segment_ids=jnp.asarray(seg)[None, :], impl="pallas")
+    out2 = combine_attention_lse(intra2, lse_i2, hist_o[:, 0][None],
+                                 lse_h[:, :, 0].T[None])
+    assert np.array_equal(np.asarray(out2[:, :per_req]),
+                          np.asarray(out[:, :per_req]))
+    assert not np.allclose(np.asarray(out2[:, per_req:]),
+                           np.asarray(out[:, per_req:]))
+
+
+def test_w8a8_matmul_semantics_and_error_bound():
+    from hetu_tpu.ops.quantization import int8_w8a8_matmul
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(7, 33)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(33, 19)) * 0.05, jnp.float32)
+    out = int8_w8a8_matmul(x, w)
+    ref = x @ w
+    assert out.shape == ref.shape and out.dtype == ref.dtype
+    rel = float(jnp.max(jnp.abs(out - ref))
+                / (jnp.max(jnp.abs(ref)) + 1e-9))
+    assert rel < 0.05, rel
+    # exact on values that quantize losslessly (scale = amax/127)
+    xq = jnp.asarray(np.sign(rng.normal(size=(4, 8))) * 127.0)
+    wq = jnp.asarray(np.sign(rng.normal(size=(8, 3))) * 127.0)
+    np.testing.assert_allclose(np.asarray(int8_w8a8_matmul(xq, wq)),
+                               np.asarray(xq @ wq), rtol=1e-6)
+
+
+def test_resolve_decode_kernel_and_fallback_counter(monkeypatch):
+    from hetu_tpu.ops.attention import (
+        kernel_fallbacks, record_kernel_fallback, resolve_decode_kernel,
+    )
+    assert resolve_decode_kernel("auto") == "reference"   # CPU backend
+    assert resolve_decode_kernel("reference") == "reference"
+    # interpret lowering partitions fine → tp>1 stays honored on CPU
+    assert resolve_decode_kernel("paged", tp=2) == "paged"
+    with pytest.raises(ValueError, match="auto\\|paged\\|reference"):
+        resolve_decode_kernel("fast")
+    # real Mosaic lowering under tp>1 → loud fallback, counted
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        monkeypatch.setenv("HETU_PALLAS_INTERPRET", "0")
+        before = kernel_fallbacks().get("t_site", 0)
+        with pytest.warns(UserWarning, match="fell back"):
+            assert resolve_decode_kernel("paged", tp=2,
+                                         site="t_site") == "reference"
+        assert kernel_fallbacks()["t_site"] == before + 1
+        reg = telemetry.get_registry()
+        assert reg.counter("attn_kernel_fallback_total").value(
+            site="t_site") >= 1
+        # warn-once: the second fallback counts but stays quiet
+        resolve_decode_kernel("paged", tp=2, site="t_site")
+        assert kernel_fallbacks()["t_site"] == before + 2
+        # an AUTO-derived "paged" hits the same tp guard (a tp-sharded
+        # TPU default must degrade, never hand GSPMD a Mosaic call)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert resolve_decode_kernel("auto", tp=2,
+                                     site="t_site") == "reference"
+        assert kernel_fallbacks()["t_site"] == before + 3
+        assert resolve_decode_kernel("auto", tp=1) == "paged"
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()
+    del record_kernel_fallback
+
+
+def test_decode_attn_read_bytes_prices_the_gather_tax():
+    """SATELLITE: the ledger prices the reference path by TABLE width
+    (materialize + read back, +dequant pass on int8) and the kernel by
+    LIVE pages — the analytic ratio bench --kernels reports."""
+    from hetu_tpu.engine.memory import (
+        decode_attn_read_bytes, kv_bytes_per_block,
+    )
+    cfg = GPTConfig.tiny()
+    per_block = kv_bytes_per_block(cfg, block_size=16)
+    paged = decode_attn_read_bytes(cfg, context_len=33, table_len=1024,
+                                   block_size=16, kernel="paged")
+    ref = decode_attn_read_bytes(cfg, context_len=33, table_len=1024,
+                                 block_size=16, kernel="reference")
+    assert paged == 3 * per_block            # ceil(33/16) live pages
+    assert ref == 2 * kv_bytes_per_block(cfg, block_size=1024)
+    assert ref / paged > 10                  # the long-table tax
+    # int8: kernel reads int8 pages; reference pays the dequant pass
+    p8 = decode_attn_read_bytes(cfg, context_len=33, table_len=1024,
+                                block_size=16, cache_dtype="int8",
+                                kernel="paged")
+    r8 = decode_attn_read_bytes(cfg, context_len=33, table_len=1024,
+                                block_size=16, cache_dtype="int8",
+                                kernel="reference")
+    assert p8 < paged and r8 > ref * 0.5
+    with pytest.raises(ValueError, match="paged\\|reference"):
+        decode_attn_read_bytes(cfg, context_len=1, table_len=16,
+                               block_size=16, kernel="gather")
+
+
+def test_engine_kernel_knob_validation(gpt):
+    """Knob resolution is loud: bad names raise, W8A8 without the int8
+    arena raises, CPU auto resolves to the reference path, and the
+    per-layer W8A8 mask honors an index list."""
+    from hetu_tpu.serving import ServingEngine
+    cfg, model, params = gpt
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, block_size=BLOCK)
+    assert eng.attn_kernel == "reference"       # CPU auto
+    assert eng.prefill_attn == "reference"
+    assert eng._w8a8_mask is None
+    with pytest.raises(ValueError, match="auto\\|paged\\|reference"):
+        ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                      attn_kernel="mosaic")
+    with pytest.raises(ValueError, match="prefill_attn"):
+        ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                      prefill_attn="turbo")
+    with pytest.raises(ValueError, match="int8 arena"):
+        ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                      w8a8="on")
+    eng8 = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                         prefill_chunk=CHUNK, block_size=BLOCK,
+                         cache_dtype=jnp.int8, w8a8=[0])
+    assert np.asarray(eng8._w8a8_mask).tolist() == [True, False]
+    # "auto" stays OFF on CPU even with the int8 arena
+    eng_a = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                          prefill_chunk=CHUNK, block_size=BLOCK,
+                          cache_dtype=jnp.int8, w8a8="auto")
+    assert eng_a._w8a8_mask is None
+
+
+# ---------------------------------------------------------------------------
+# slow tier: engine-level parity matrices (compile-bearing)
+# ---------------------------------------------------------------------------
+
+def _prompts(cfg, lens, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, (L,)).tolist() for L in lens]
+
+
+def _ref(model, params, prompt, max_tokens, **kw):
+    out = generate(model, params, jnp.asarray(prompt, jnp.int32)[None],
+                   max_new_tokens=max_tokens, max_len=MAX_LEN, **kw)
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+@pytest.mark.slow
+def test_engine_paged_kernel_greedy_identical_with_spec_and_int8(gpt):
+    """ACCEPTANCE: the paged kernel is greedy-token-identical to the
+    reference path across fp32 and int8 arenas WITH spec-decode verify
+    rows (depth 2) and arrival churn, at 1 fused-step compile per
+    engine."""
+    from hetu_tpu.engine import trace_counts
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+    cfg, model, params = gpt
+    prompts = _prompts(cfg, (5, 11, 3, 7), seed=7)
+    sp = SamplingParams(max_tokens=8)
+
+    for dtype in (jnp.float32, jnp.int8):
+        outs = {}
+        for kern, depth in (("reference", 0), ("paged", 0),
+                            ("paged", 2)):
+            before = trace_counts().get("serving_step", 0)
+            eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                                prefill_chunk=CHUNK, block_size=BLOCK,
+                                cache_dtype=dtype, attn_kernel=kern,
+                                spec_depth=depth)
+            # churn: stagger arrivals across iterations
+            reqs = [eng.submit(prompts[0], sp), eng.submit(prompts[1],
+                                                           sp)]
+            for _ in range(3):
+                eng.step()
+            reqs += [eng.submit(p, sp) for p in prompts[2:]]
+            eng.run_until_drained()
+            outs[(kern, depth)] = [list(r.tokens) for r in reqs]
+            assert trace_counts().get("serving_step", 0) - before == 1
+        assert outs[("paged", 0)] == outs[("reference", 0)], dtype
+        assert outs[("paged", 2)] == outs[("reference", 0)], dtype
+
+
+@pytest.mark.slow
+def test_engine_paged_kernel_preempt_resume_identity(gpt):
+    """ACCEPTANCE: preempt→spill→resume churn on the PAGED kernel path
+    stays token-identical to the one-shot oracle."""
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+    cfg, model, params = gpt
+    rng = np.random.default_rng(11)
+    lo_p = rng.integers(1, cfg.vocab_size, (10,)).tolist()
+    hi_p = rng.integers(1, cfg.vocab_size, (8,)).tolist()
+    eng = ServingEngine(model, params, slots=1, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, attn_kernel="paged")
+    lo = eng.submit(lo_p, SamplingParams(max_tokens=16, priority=2))
+    for _ in range(6):
+        eng.step()
+    hi = eng.submit(hi_p, SamplingParams(max_tokens=4, priority=0))
+    eng.run_until_drained()
+    assert lo.preemptions == 1 and lo.resumed_blocks >= 1
+    assert list(hi.tokens) == _ref(model, params, hi_p, 4)
+    assert list(lo.tokens) == _ref(model, params, lo_p, 16)
+
+
+@pytest.mark.slow
+def test_engine_packed_flash_prefill_identity_and_isolation(gpt):
+    """ACCEPTANCE: the packed flash prefill lane (pallas intra kernel,
+    interpret) + paged kernel decode is greedy-identical to the
+    reference engine; co-packed requests match their SOLO runs (no
+    cross-document leakage through the pack); prefill KV matches the
+    reference lane's arena at 1e-6 (fp reassociation across the two
+    formulations)."""
+    from hetu_tpu.engine import trace_counts
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+    cfg, model, params = gpt
+    prompts = _prompts(cfg, (3, 4, 9), seed=13)   # first two co-pack
+    sp = SamplingParams(max_tokens=6)
+
+    def build(**kw):
+        return ServingEngine(model, params, slots=3, max_len=MAX_LEN,
+                             prefill_chunk=CHUNK, block_size=BLOCK,
+                             **kw)
+
+    ref_eng = build()
+    ref_out = ref_eng.generate_many(prompts, sp)
+    before = trace_counts().get("serving_step", 0)
+    fl_eng = build(prefill_attn="flash_pallas", attn_kernel="paged")
+    fl_out = fl_eng.generate_many(prompts, sp)
+    assert trace_counts().get("serving_step", 0) - before == 1
+    assert fl_out == ref_out
+    # solo runs (nothing co-packed) — identical tokens
+    for p, toks in zip(prompts[:2], fl_out[:2]):
+        solo = build(prefill_attn="flash_pallas").generate_many(
+            [p], sp)[0]
+        assert solo == toks
+    # prefill KV parity: a single max_tokens=1 request writes ONLY
+    # prefill rows — the two lanes' arenas must agree to fp noise
+    one = SamplingParams(max_tokens=1)
+    e_r = build()
+    e_f = build(prefill_attn="flash_pallas")
+    e_r.generate_many([prompts[2]], one)
+    e_f.generate_many([prompts[2]], one)
+    for a, b in zip(jax.tree.leaves(e_r.pool.caches),
+                    jax.tree.leaves(e_f.pool.caches)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=1e-6)
+
+
+@pytest.mark.slow
+def test_engine_w8a8_serves_and_counts(gpt):
+    """W8A8 decode FFNs serve through the fused step (int8 arena gate,
+    per-layer mask) with the kernel-path counters flowing."""
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+    cfg, model, params = gpt
+    prompts = _prompts(cfg, (5, 9), seed=17)
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                            prefill_chunk=CHUNK, block_size=BLOCK,
+                            cache_dtype=jnp.int8, attn_kernel="paged",
+                            w8a8="on")
+        out = eng.generate_many(prompts, SamplingParams(max_tokens=6))
+        assert all(len(t) == 6 for t in out)
+        reg = telemetry.get_registry()
+        assert reg.counter("serving_attn_kernel_total").value(
+            path="paged") > 0
+        assert reg.counter("prefill_attn_kernel_total").value(
+            path="reference") > 0
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()

@@ -1050,20 +1050,208 @@ def chaos_main():
         print(f"[chaos] {json.dumps(row)}", file=sys.stderr, flush=True)
         return row
 
+    def fleet_soak():
+        """Chaos-soak the MULTI-PROCESS serving fleet (ROADMAP PR 12
+        residual): ``ChaosMonkey.start(period_s=...)`` SIGKILLs engine
+        processes on a wall-clock period while a request stream runs —
+        the ledger proves zero lost / duplicated / corrupted requests
+        (greedy tokens checked against the one-shot oracle)."""
+        import numpy as np
+
+        from hetu_tpu.rpc.launcher import launch_serving_fleet
+        from hetu_tpu.serving import SamplingParams
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        scfg = GPTConfig.tiny()
+        smodel = GPTLMHeadModel(scfg)
+        sparams = smodel.init(jax.random.key(0), dtype=jnp.float32)
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(1, scfg.vocab_size, (n,)).tolist()
+                   for n in (5, 9, 3, 7, 6, 4)]
+        sp = SamplingParams(max_tokens=4)
+        from hetu_tpu.models import generate as _gen
+        want = [np.asarray(_gen(
+            smodel, sparams, jnp.asarray(p, jnp.int32)[None],
+            max_new_tokens=4, max_len=64)[0, len(p):]).tolist()
+            for p in prompts]
+        fleet = launch_serving_fleet(
+            n_replicas=3, remote=True,
+            engine_spec="workloads.fleet_replica:build_engine",
+            env={"PYTHONPATH": repo}, beat_timeout_s=2.0,
+            poll_s=0.005)
+        router = fleet.router
+        try:
+            router.generate_many(prompts[:3], sp)    # warm compiles
+            monkey = chaos.ChaosMonkey(
+                {n: (lambda n=n: fleet.kill_replica_process(n))
+                 for n in ("r1", "r2")},   # r0 always survives
+                period_s=1.5, max_kills=2, seed=0)
+            reqs = []
+            monkey.start()
+            try:
+                deadline = _time.monotonic() + 6.0
+                i = 0
+                while _time.monotonic() < deadline:
+                    reqs.append((i % len(prompts), router.submit(
+                        prompts[i % len(prompts)], sp)))
+                    i += 1
+                    _time.sleep(0.05)
+            finally:
+                monkey.stop()
+            lost = wrong = done = 0
+            for idx, r in reqs:
+                if not r.done.wait(120.0) or r.status != "done":
+                    lost += 1
+                elif list(r.tokens) != want[idx]:
+                    wrong += 1
+                else:
+                    done += 1
+            return {
+                "replicas": 3, "kills": len(monkey.kills),
+                "killed": [k["target"] for k in monkey.kills],
+                "submitted": len(reqs), "completed": done,
+                "lost": lost, "corrupted": wrong,
+                "requeues": router.requeues_total,
+                "dead": [n for n, h in router._replicas.items()
+                         if h.state == "dead"],
+            }
+        finally:
+            fleet.stop()
+
     sweep = [run_mode(*m) for m in modes]
     by_mode = {r["mode"]: r for r in sweep}
     best = by_mode["live_reshard_delta_async"]
+    soak = fleet_soak()
+    print(f"[chaos] fleet_soak {json.dumps(soak)}", file=sys.stderr,
+          flush=True)
     result = {
         "metric": "chaos_goodput_live_delta",
         "value": best["goodput"], "unit": "fraction_of_wall",
         "device": "cpu-sim-8", "kills_per_run": len(kill_at),
         "sweep": sweep,
+        "fleet_soak": soak,
         "note": "goodput under 2 injected kills via the heartbeat/"
                 "membership path; restart-from-disk vs live reshard vs "
-                "live reshard + async delta checkpoints",
+                "live reshard + async delta checkpoints; fleet_soak = "
+                "periodic ChaosMonkey SIGKILLs against the "
+                "multi-process serving fleet (zero lost/duplicated)",
     }
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_chaos.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    try:
+        _write_bench_telemetry(result)
+    except Exception:
+        pass
+    print(json.dumps(result))
+
+
+_BENCH_FLEET_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_fleet.json")
+
+
+def fleet_main():
+    """``bench.py --fleet``: the multi-process fleet smoke (ISSUE 15).
+
+    Two comparisons on the CPU smoke model: (1) **dispatch overhead** —
+    the same workload through an in-process 2-replica fleet vs a
+    2-engine-PROCESS fleet behind the same Router (submit → verbs over
+    the coordinator → RESULT polls), reported as per-request latency
+    delta; (2) **colocated vs P/D-split** at a fixed offered load — two
+    ``role="both"`` replicas vs a prefill tier streaming KV blocks to a
+    decode tier, reported as TTFT/TPOT medians. Absolute numbers only
+    matter on TPU (ROADMAP measurement debt); BENCH_fleet.json is the
+    contract artifact."""
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+    telemetry.enable(True)
+    from hetu_tpu.rpc.launcher import launch_serving_fleet
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cfg = GPTConfig.tiny()
+    slots, max_len, chunk, max_tokens = 4, 64, 16, 8
+    offered = 12
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.key(0), dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    sp = SamplingParams(max_tokens=max_tokens)
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            (int(rng.integers(4, 24)),)).tolist()
+               for _ in range(offered)]
+
+    def run_through(router):
+        router.generate_many(prompts[:2], SamplingParams(max_tokens=2))
+        t0 = time.perf_counter()
+        reqs = [router.submit(p, sp) for p in prompts]
+        for r in reqs:
+            r.done.wait(300.0)
+        wall = time.perf_counter() - t0
+        docs = [r.result() for r in reqs]
+        total = [d["timing"].get("router_total_ms", 0.0) for d in docs]
+        tpot = [d["timing"]["decode_ms"] / (len(d["tokens"]) - 1)
+                for d in docs
+                if d["timing"].get("decode_ms") is not None
+                and len(d["tokens"]) > 1]
+        return {
+            "completed": sum(d["status"] == "done" for d in docs),
+            "wall_s": round(wall, 3),
+            "total_ms_p50": round(float(np.median(total)), 2),
+            "tpot_ms_p50": round(float(np.median(tpot)), 3)
+            if tpot else None,
+        }
+
+    def mk_engine(i):
+        return ServingEngine(model, params, slots=slots,
+                             max_len=max_len, prefill_chunk=chunk)
+
+    # -- (1) in-process vs multi-process dispatch overhead
+    fleet = launch_serving_fleet(mk_engine, 2, poll_s=0.002)
+    local = run_through(fleet.router)
+    fleet.stop()
+    fleet = launch_serving_fleet(
+        n_replicas=2, remote=True,
+        engine_spec="workloads.fleet_replica:build_engine",
+        env={"PYTHONPATH": repo,
+             "HETU_FLEET_SLOTS": str(slots),
+             "HETU_FLEET_MAX_LEN": str(max_len),
+             "HETU_FLEET_CHUNK": str(chunk)},
+        beat_timeout_s=5.0, poll_s=0.002)
+    remote = run_through(fleet.router)
+    fleet.stop()
+    overhead = round(remote["total_ms_p50"] - local["total_ms_p50"], 2)
+
+    # -- (2) colocated vs P/D split at the same offered load
+    fleet = launch_serving_fleet(mk_engine, 2, poll_s=0.002)
+    colocated = run_through(fleet.router)
+    fleet.stop()
+    fleet = launch_serving_fleet(
+        mk_engine, 2, names=["pre", "dec"],
+        roles={"pre": "prefill", "dec": "decode"}, poll_s=0.002)
+    split = run_through(fleet.router)
+    snap = telemetry.get_registry().snapshot()
+    split["kv_stream_blocks"] = int(snap.get(
+        "fleet_kv_stream_blocks_total", 0))
+    split["pd_handoffs"] = int(snap.get("fleet_pd_handoffs_total", 0))
+    fleet.stop()
+
+    result = {
+        "metric": "fleet_dispatch_overhead_ms_cpu_smoke",
+        "value": overhead, "unit": "ms_p50_per_request",
+        "vs_baseline": 0.0,
+        "device": "cpu-smoke", "replicas": 2, "offered": offered,
+        "slots": slots, "max_len": max_len, "max_tokens": max_tokens,
+        "in_process": local,
+        "multi_process": remote,
+        "pd": {"colocated": colocated, "split": split},
+        "note": "multi-process dispatch rides SUBMIT/RESULT/ESTATUS "
+                "coordinator verbs; P/D split streams KV blocks "
+                "prefill→decode over the same transport. CPU smoke — "
+                "absolute latencies are meaningless off-TPU, the "
+                "contract is completion + the transport working.",
+    }
+    with open(_BENCH_FLEET_PATH, "w") as f:
         json.dump(result, f, indent=1)
     try:
         _write_bench_telemetry(result)
@@ -1562,5 +1750,7 @@ if __name__ == "__main__":
         chaos_main()
     elif "--kernels" in sys.argv:
         kernels_main()
+    elif "--fleet" in sys.argv:
+        fleet_main()
     else:
         main()

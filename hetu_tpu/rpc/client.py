@@ -13,6 +13,7 @@ import random
 import socket
 import time
 import urllib.parse
+import uuid
 from typing import Any, Optional
 
 
@@ -21,10 +22,12 @@ class CoordinatorClient:
 
     The serving-plane verbs carry ``retries`` + jittered exponential
     backoff (reconnect between attempts) instead of blocking forever on
-    a dead replica socket: the socket ``timeout`` bounds every recv, a
-    connection failure reconnects and retries, and a TIMEOUT on a
-    non-idempotent verb (SUBMIT/GENERATE — the command may already have
-    reached the engine) raises instead of risking a duplicate request.
+    a dead replica socket: the socket ``timeout`` bounds every recv and
+    a connection failure reconnects and retries. SUBMIT/GENERATE carry
+    an IDEMPOTENCY KEY the server dedups on, so even a response timeout
+    retries safely (a duplicate delivery joins the original request) —
+    the PR 8 at-most-once carve-out survives only for verbs whose
+    effect has no key (DRAIN/EVICT/SWAPWEIGHTS: one delivery attempt).
     Training-plane verbs (RANK/KV/BARRIER) keep their original
     semantics — BARRIER is *supposed* to block.
     """
@@ -156,20 +159,44 @@ class CoordinatorClient:
 
     # -- serving plane (hetu_tpu/serving — coordinator with an engine) ------
     def _serving_payload(self, prompt, **sampling) -> str:
-        obj = {"prompt": [int(t) for t in prompt], **sampling}
+        obj = {"prompt": [int(t) for t in prompt],
+               **{k: v for k, v in sampling.items() if v is not None}}
         return urllib.parse.quote(
             json.dumps(obj, separators=(",", ":")), safe="")
 
-    def serving_submit(self, prompt, **sampling) -> int:
+    def serving_submit(self, prompt, *, idem_key: Optional[str] = None,
+                       **sampling) -> int:
         """Queue a generation request; returns its id (FCFS).
-        Retries only across CONNECTION failures — a response timeout
-        may mean the engine already queued it (at-most-once)."""
+
+        Every submit carries an IDEMPOTENCY KEY (auto-generated unless
+        ``idem_key`` names one): the server dedups by key, so a
+        response timeout is now safely retried — a duplicate delivery
+        returns the ORIGINAL request's id instead of queueing a second
+        generation. This closes PR 8's at-most-once carve-out."""
+        return int(self.serving_submit_info(
+            prompt, idem_key=idem_key, **sampling)["id"])
+
+    def serving_submit_info(self, prompt, *,
+                            idem_key: Optional[str] = None,
+                            resume: Optional[dict] = None,
+                            **sampling) -> dict:
+        """:meth:`serving_submit` returning the full handshake:
+        ``{"id", "trace_id", "resumed"}``. ``resume`` attaches a
+        wire-format KV spill (``serving.fleet.spill_to_wire``) — the
+        fleet proxy's resumable requeue; ``resumed`` reports whether
+        the engine accepted it (layout + weight version compatible)."""
+        payload = dict(sampling)
+        payload["idem"] = idem_key or uuid.uuid4().hex
+        if resume is not None:
+            payload["resume"] = resume
         resp = self._cmd_retry(
-            f"SUBMIT {self._serving_payload(prompt, **sampling)}",
-            idempotent=False)
+            f"SUBMIT {self._serving_payload(prompt, **payload)}")
         if not resp.startswith("ID "):
             raise RuntimeError(f"serving submit failed: {resp}")
-        return int(resp.split()[1])
+        parts = resp.split()
+        return {"id": int(parts[1]),
+                "trace_id": parts[2] if len(parts) > 2 else "",
+                "resumed": len(parts) > 3 and parts[3] == "R"}
 
     def serving_result(self, req_id: int,
                        timeout_ms: int = 0) -> Optional[dict]:
@@ -183,15 +210,80 @@ class CoordinatorClient:
             raise RuntimeError(f"serving result failed: {resp}")
         return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
 
-    def serving_generate(self, prompt, **sampling) -> dict:
+    def serving_generate(self, prompt, *,
+                         idem_key: Optional[str] = None,
+                         **sampling) -> dict:
         """Blocking generate over the line protocol (engine loop must
-        be running server-side, e.g. ``ServingServer.start()``)."""
+        be running server-side, e.g. ``ServingServer.start()``).
+        Idempotency-keyed like :meth:`serving_submit`: a retried
+        delivery joins the original request instead of generating
+        twice."""
+        payload = dict(sampling)
+        payload["idem"] = idem_key or uuid.uuid4().hex
         resp = self._cmd_retry(
-            f"GENERATE {self._serving_payload(prompt, **sampling)}",
-            idempotent=False)
+            f"GENERATE {self._serving_payload(prompt, **payload)}")
         if not resp.startswith("VAL "):
             raise RuntimeError(f"serving generate failed: {resp}")
         return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
+
+    # -- fleet engine verbs (serving.fleet.RemoteEngineProxy) ---------------
+    def _val_verb(self, line: str, *, idempotent: bool = True) -> dict:
+        resp = self._cmd_retry(line, idempotent=idempotent)
+        if not resp.startswith("VAL "):
+            raise RuntimeError(f"{line.split()[0]} failed: {resp}")
+        return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
+
+    def serving_estatus(self) -> dict:
+        """Light engine-status poll (load / queue depth / occupancy /
+        weight version / has_work) — the remote replica handle's
+        heartbeat-cum-load signal."""
+        return self._val_verb("ESTATUS")
+
+    def serving_cancel_queued(self, ids) -> dict:
+        """Pull queued (not yet admitted) requests off the remote
+        engine — the router's drain leg. Returns
+        ``{"cancelled": [{"id", "spill"}]}`` with wire-format spills
+        for requests that carried KV."""
+        enc = urllib.parse.quote(json.dumps(
+            {"ids": [int(i) for i in ids]},
+            separators=(",", ":")), safe="")
+        return self._val_verb(f"CANCELQ {enc}", idempotent=False)
+
+    def serving_evict(self, req_id: int,
+                      lock_timeout_s: Optional[float] = None) -> dict:
+        """Force one request out of the remote engine, salvaging its
+        resident KV: ``{"status", "spill": wire | None}``."""
+        enc = urllib.parse.quote(json.dumps(
+            {"id": int(req_id), "lock_timeout_s": lock_timeout_s},
+            separators=(",", ":")), safe="")
+        return self._val_verb(f"EVICT {enc}", idempotent=False)
+
+    def serving_prefill(self, prompt, **sampling) -> dict:
+        """Prefill-tier verb: admission + prefill on the remote engine,
+        blocking until the KV is ready. Returns ``{"done": True,
+        "result": ...}`` for requests that finished within their first
+        token, else ``{"done": False, "id", "tokens", "spill": wire}``
+        — the KV-block payload a decode replica resumes from."""
+        resp = self._cmd_retry(
+            f"PREFILL {self._serving_payload(prompt, **sampling)}",
+            idempotent=False)
+        if not resp.startswith("VAL "):
+            raise RuntimeError(f"serving prefill failed: {resp}")
+        return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
+
+    def serving_swap_weights(self, path: str, version: int) -> dict:
+        """Remote leg of a dist-checkpoint weight push: the engine
+        process loads ``path`` onto its own topology and swaps. NOT
+        retried on timeout — the load may already be in flight."""
+        enc = urllib.parse.quote(json.dumps(
+            {"path": path, "version": int(version)},
+            separators=(",", ":")), safe="")
+        return self._val_verb(f"SWAPWEIGHTS {enc}", idempotent=False)
+
+    def serving_stop_engine(self) -> None:
+        resp = self._cmd_retry("STOPENGINE", idempotent=False)
+        if resp != "OK":
+            raise RuntimeError(f"stop engine failed: {resp}")
 
     # -- fleet verbs (coordinator with a serving.router.Router) -------------
     def fleet_status(self) -> dict:

@@ -345,17 +345,44 @@ class ElasticWorkerPool:
 class FleetHandle:
     """A launched serving fleet: the router, its replica names, and the
     optional coordinator front door. ``stop()`` tears down front door →
-    router → every replica loop (reverse launch order)."""
+    router → every replica loop (reverse launch order). A REMOTE fleet
+    also carries its engine processes (``procs``) — ``stop()`` SIGTERMs
+    them after the router lets go, and :meth:`kill_replica_process` is
+    the chaos hook (real SIGKILL; the router's heartbeat staleness
+    detects it)."""
 
     router: object                   # serving.router.Router
     replicas: list
     coordinator: Optional[object] = None   # PyCoordinatorServer | None
     port: Optional[int] = None
+    procs: dict = dataclasses.field(default_factory=dict)
+    #                                ^ name → subprocess.Popen (remote)
+    engine_ports: dict = dataclasses.field(default_factory=dict)
+    _logs: list = dataclasses.field(default_factory=list)
+
+    def kill_replica_process(self, name: str, sig=signal.SIGKILL):
+        """Chaos hook: SIGKILL one remote engine process. Death is
+        detected by the router through heartbeat staleness — nothing
+        here tells it."""
+        self.procs[name].send_signal(sig)
 
     def stop(self):
         if self.coordinator is not None:
             self.coordinator.stop()
         self.router.stop()
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 10
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in self._logs:
+            if log is not subprocess.DEVNULL and not log.closed:
+                log.close()
+        self._logs = []
 
     def __enter__(self):
         return self
@@ -365,33 +392,134 @@ class FleetHandle:
         return False
 
 
-def launch_serving_fleet(build_engine, n_replicas: int, *,
+def launch_serving_fleet(build_engine=None, n_replicas: int = 2, *,
                          names: Optional[Sequence[str]] = None,
+                         roles: Optional[dict] = None,
                          port: Optional[int] = None,
                          bind: str = "127.0.0.1", token: str = "",
+                         remote: bool = False,
+                         engine_spec: Optional[str] = None,
+                         env: Optional[dict] = None,
+                         platform_env: Optional[dict] = None,
+                         log_dir: Optional[str] = None,
+                         spawn_timeout_s: float = 120.0,
+                         beat_timeout_s: Optional[float] = None,
                          **router_kw) -> FleetHandle:
-    """Bring up an in-process serving fleet: N replicas (each built by
-    ``build_engine(i)`` — a fresh ServingEngine per call, its background
-    loop started by registration), one load-aware Router over them, and
-    — when ``port`` is given — a coordinator speaking the full verb set
-    (SUBMIT/RESULT/GENERATE routed fleet-wide, FLEET/DRAIN/RESUME,
-    HEALTHZ/METRICS) as the fleet's front door.
+    """Bring up a serving fleet: N replicas, one load-aware Router over
+    them, and — when ``port`` is given — a coordinator speaking the
+    full verb set (SUBMIT/RESULT/GENERATE routed fleet-wide,
+    FLEET/DRAIN/RESUME, HEALTHZ/METRICS) as the fleet's front door.
 
-    This is the single-host deployment shape (threads share one
-    process's devices) used by ``workloads/rollout_loop.py``, ``bench.py
-    --router`` and the router tests; a multi-host fleet runs one replica
-    per accelerator host and registers through the same Router API.
+    **In-process** (default): each replica is ``build_engine(i)`` — a
+    fresh ServingEngine whose background loop registration starts.
+    Threads share one process's devices: the single-host shape used by
+    ``workloads/rollout_loop.py``, ``bench.py --router`` and the
+    router tests.
+
+    **Multi-process** (``remote=True`` — ISSUE 15): one engine PROCESS
+    per replica. ``engine_spec`` names a ``module:function`` the child
+    resolves and calls with its replica index (closures cannot cross
+    the process boundary); each child serves its engine on a private
+    line-protocol port (``serving.fleet.replica_main``), the launcher
+    waits for it to answer PING, and registers a
+    ``RemoteEngineProxy``-backed handle — death detection is heartbeat
+    staleness, KV spills and weight pushes travel the wire
+    (``docs/SERVING.md`` "Disaggregated fleet"). ``platform_env``
+    defaults to the CPU-simulation flow
+    (``ElasticWorkerPool.CPU_SIM_ENV``); pass ``{}`` to inherit (real
+    TPU hosts). ``roles`` maps replica name → ``prefill|decode|both``
+    for P/D disaggregation (both modes).
+
     Lazy imports keep the launcher importable without jax.
     """
     from hetu_tpu.serving.router import Router
 
-    router = Router(**router_kw)
     names = list(names) if names is not None \
         else [f"r{i}" for i in range(n_replicas)]
     if len(names) != n_replicas:
         raise ValueError(f"{len(names)} names for {n_replicas} replicas")
-    for i, name in enumerate(names):
-        router.register(name, build_engine(i))
+    roles = dict(roles or {})
+    if beat_timeout_s is not None:
+        router_kw["beat_timeout_s"] = beat_timeout_s
+    router = Router(**router_kw)
+    handle = FleetHandle(router=router, replicas=names)
+
+    if remote:
+        if engine_spec is None:
+            raise ValueError(
+                "remote=True needs engine_spec='module:function' — a "
+                "builder the engine process can import (closures "
+                "cannot cross the process boundary)")
+        penv = dict(ElasticWorkerPool.CPU_SIM_ENV
+                    if platform_env is None else platform_env)
+        for i, name in enumerate(names):
+            eport = _free_port()
+            env_i = dict(os.environ)
+            env_i.update(penv)
+            env_i.update(env or {})
+            env_i.update({
+                "HETU_ENGINE_SPEC": engine_spec,
+                "HETU_REPLICA_INDEX": str(i),
+                "HETU_REPLICA_NAME": name,
+                "HETU_ENGINE_PORT": str(eport),
+                # the engine ports must enforce the same token as the
+                # front door — an unauthenticated replica port would
+                # accept STOPENGINE/SWAPWEIGHTS from anyone local
+                "HETU_ENGINE_TOKEN": token,
+            })
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+                log = open(os.path.join(log_dir, f"{name}.log"), "w")
+                handle._logs.append(log)
+            else:
+                log = subprocess.DEVNULL
+            p = subprocess.Popen(
+                [sys.executable, "-m", "hetu_tpu.serving.fleet"],
+                env=env_i, stdout=log, stderr=log)
+            handle.procs[name] = p
+            handle.engine_ports[name] = eport
+        # wait for every engine to answer, then register its proxy —
+        # registration starts the status poller (= the heartbeat). A
+        # replica that fails to come up must not leak its siblings:
+        # tear the whole half-launched fleet down before re-raising.
+        from hetu_tpu.rpc.client import CoordinatorClient
+        from hetu_tpu.serving.fleet import RemoteEngineProxy
+        deadline = time.monotonic() + spawn_timeout_s
+        try:
+            for name in names:
+                eport = handle.engine_ports[name]
+                while True:
+                    proc = handle.procs[name]
+                    if proc.poll() is not None:
+                        raise RuntimeError(
+                            f"fleet replica {name} exited "
+                            f"rc={proc.poll()} before serving "
+                            f"(check log_dir logs)")
+                    try:
+                        cli = CoordinatorClient(eport, timeout=2.0,
+                                                retries=0)
+                        ok = cli.ping()
+                        cli.close()
+                        if ok:
+                            break
+                    except OSError:
+                        pass
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"fleet replica {name} not serving on "
+                            f":{eport} within {spawn_timeout_s}s")
+                    time.sleep(0.1)
+                router.register(
+                    name, RemoteEngineProxy(eport, token=token or None),
+                    role=roles.get(name, "both"))
+        except BaseException:
+            handle.stop()             # SIGTERM spawned procs, close
+            raise                     # logs, stop router + pollers
+    else:
+        for i, name in enumerate(names):
+            router.register(name, build_engine(i),
+                            role=roles.get(name, "both"))
+
     coordinator = None
     if port is not None:
         from hetu_tpu.rpc.py_server import PyCoordinatorServer
@@ -399,8 +527,11 @@ def launch_serving_fleet(build_engine, n_replicas: int, *,
                                           serving=router)
         coordinator.start()
         coordinator.wait_ready()
+    handle.coordinator = coordinator
+    handle.port = port
     get_logger().info(
-        f"serving fleet up: {n_replicas} replicas ({', '.join(names)})"
+        f"serving fleet up: {n_replicas} "
+        f"{'process' if remote else 'in-process'} replicas "
+        f"({', '.join(names)})"
         + (f", coordinator :{port}" if port is not None else ""))
-    return FleetHandle(router=router, replicas=names,
-                       coordinator=coordinator, port=port)
+    return handle

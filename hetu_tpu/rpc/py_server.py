@@ -13,6 +13,16 @@ import time
 from typing import Optional
 
 
+#: serving verbs forwarded to hetu_tpu/serving/server.py — duplicated
+#: here (instead of imported) so the bare coordinator stays importable
+#: without jax; tests/test_fleet.py asserts this mirrors
+#: ``serving.server.SERVING_COMMANDS``.
+_SERVING_VERBS = ("SUBMIT", "RESULT", "GENERATE",
+                  "FLEET", "DRAIN", "RESUME",
+                  "ESTATUS", "CANCELQ", "EVICT", "PREFILL",
+                  "SWAPWEIGHTS", "STOPENGINE")
+
+
 class _State:
     def __init__(self):
         self.lock = threading.Lock()
@@ -64,6 +74,16 @@ class _Handler(socketserver.StreamRequestHandler):
             elif cmd == "BEAT":
                 with st.lock:
                     st.beats[args[0]] = time.monotonic()
+                # a fleet front door forwards replica beats into the
+                # attached Router's staleness tracking (remote engine
+                # processes beat their own name; unknown names are
+                # training workers — ignored by the router)
+                serving = getattr(self.server, "serving", None)
+                if serving is not None and hasattr(serving, "heartbeat"):
+                    try:
+                        serving.heartbeat(args[0])
+                    except Exception:       # noqa: BLE001
+                        pass
                 self._send("OK")
             elif cmd == "STATUS":
                 timeout = int(args[0]) / 1e3
@@ -89,12 +109,12 @@ class _Handler(socketserver.StreamRequestHandler):
                         ev = b["event"]
                 ev.wait()
                 self._send("OK")
-            elif cmd in ("SUBMIT", "RESULT", "GENERATE",
-                         "FLEET", "DRAIN", "RESUME"):
+            elif cmd in _SERVING_VERBS:
                 # serving-plane verbs (hetu_tpu/serving/server.py) —
                 # lazy import keeps the bare coordinator jax-free.
                 # ``serving`` may be one ServingEngine or a fleet
-                # Router (FLEET/DRAIN/RESUME are router-only).
+                # Router (FLEET/DRAIN/RESUME are router-only; the
+                # ESTATUS.. engine-process verbs drive one replica).
                 from hetu_tpu.serving.server import handle_serving_command
                 resp = handle_serving_command(
                     getattr(self.server, "serving", None), cmd, args)

@@ -22,13 +22,24 @@ cache with radix-tree prefix sharing.
 - :mod:`~hetu_tpu.serving.router` — the FLEET plane: load-aware +
   prefix-sticky dispatch over N replicas, drain/death requeue, and the
   :class:`WeightPublisher` live train→serve weight push (rolling
-  drain → swap → resume through the HotSPa reshard core).
+  drain → swap → resume through the HotSPa reshard core, or — for
+  multi-process fleets — the ``dist_ckpt`` sharded-checkpoint
+  transport);
+- :mod:`~hetu_tpu.serving.fleet` — the MULTI-PROCESS rung: remote
+  replicas driven through coordinator verbs (heartbeat-staleness death
+  detection, idempotency-keyed submission), prefill/decode
+  disaggregation roles, the KV-block wire format, and the engine
+  process entry point (``python -m hetu_tpu.serving.fleet``).
 
 ``docs/SERVING.md`` documents the architecture, block lifecycle, and
 the fleet state machines.
 """
 
 from hetu_tpu.serving.engine import ServingEngine, sample_slots
+from hetu_tpu.serving.fleet import (
+    RemoteEngineProxy, RemoteReplicaHandle, RemoteRequest,
+    spill_from_wire, spill_to_wire,
+)
 from hetu_tpu.serving.kv_pool import (
     NULL_BLOCK, BlockManager, HostSpillArena, KVPool, SpillEntry,
     cache_dtype_name,
@@ -54,4 +65,6 @@ __all__ = [
     "NgramDraftsman", "ModelDraftsman", "SpeculativeConfigError",
     "Router", "RouterRequest", "ReplicaHandle", "WeightPublisher",
     "materialize_params",
+    "RemoteEngineProxy", "RemoteReplicaHandle", "RemoteRequest",
+    "spill_to_wire", "spill_from_wire",
 ]

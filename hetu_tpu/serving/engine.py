@@ -956,8 +956,13 @@ class ServingEngine:
                     req.status = "evicted"
                     return None
             slot = req.slot
+            # a "prefilled" request is PARKED (P/D handoff): its slot is
+            # inactive but still owns the request and its KV blocks —
+            # exactly what the prefill tier evicts to stream downstream
+            parked = req.status == "prefilled" and slot is not None \
+                and self._slot_req[slot] is req
             if slot is None or self._slot_req[slot] is not req \
-                    or not self._active[slot]:
+                    or not (self._active[slot] or parked):
                 return None
             nb = max(1, -(-int(self._pos[slot])
                           // self.pool.block_size))
@@ -991,10 +996,45 @@ class ServingEngine:
                       blocks=spill_plan["nb"])
         return entry
 
+    def prefill_only(self, prompt: Sequence[int],
+                     sampling: Optional[SamplingParams] = None, *,
+                     timeout_s: Optional[float] = None
+                     ) -> tuple[Request, Optional[SpillEntry]]:
+        """Prefill-tier entry point (P/D disaggregation): admit
+        ``prompt``, run its prefill (packed or CP lane) through the
+        normal iteration machinery, and return ``(req, entry)`` where
+        ``entry`` is the evicted :class:`SpillEntry` holding the
+        finished KV blocks + the first token — ready to stream to a
+        decode-tier replica's ``submit(resume=entry)``. ``entry`` is
+        None when the request FINISHED within its first token (EOS or
+        ``max_tokens=1`` — nothing left to decode; ``req.result()`` is
+        the answer) or was rejected at admission.
+
+        Works both driven (no background loop: iterations run here)
+        and with :meth:`start` running (this just waits)."""
+        req = self.submit(prompt, sampling, handoff=True)
+        if req.status == "rejected":
+            return req, None
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        while req.status != "prefilled" and not req.done.is_set():
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"prefill_only: request #{req.id} not prefilled "
+                    f"within {timeout_s}s (status {req.status!r})")
+            if self._thread is None:
+                self.step()
+            else:
+                time.sleep(0.001)
+        if req.done.is_set():
+            return req, None
+        return req, self.evict_request(req)
+
     # -- submission ---------------------------------------------------------
     def submit(self, prompt: Sequence[int],
                sampling: Optional[SamplingParams] = None, *,
-               resume: Optional[SpillEntry] = None) -> Request:
+               resume: Optional[SpillEntry] = None,
+               handoff: bool = False) -> Request:
         """Queue one request (deficit-selected by its priority class;
         pure FCFS when every request shares one class). Returns the
         live Request — poll ``req.done`` / :meth:`result`, or drive
@@ -1007,12 +1047,25 @@ class ServingEngine:
         prefill-lane work. An incompatible entry (e.g. the fleet
         swapped weights since the spill) silently degrades to a fresh
         replay, which under greedy decoding regenerates the same
-        tokens."""
+        tokens.
+
+        ``handoff`` is the prefill-tier mode (P/D disaggregation): the
+        request runs admission + prefill here, then PARKS after its
+        first token (status ``"prefilled"``, slot inactive but still
+        holding its KV blocks) instead of decoding on — the caller
+        (``prefill_only`` / the fleet router) evicts the KV and resumes
+        it on a decode-tier replica."""
         sampling = sampling or SamplingParams()
+        if handoff and resume is not None:
+            raise ValueError(
+                "handoff with resume makes no sense: a resumed "
+                "request's KV already exists — submit it to the "
+                "decode tier directly")
         with self._lock:
             req = Request(id=self._next_id,
                           prompt=np.asarray(prompt, np.int32).ravel(),
-                          sampling=sampling, submit_s=time.monotonic())
+                          sampling=sampling, submit_s=time.monotonic(),
+                          handoff=bool(handoff))
             self._next_id += 1
             if resume is not None and resume.compatible_with(
                     self.pool, self.weight_version):
@@ -1482,6 +1535,19 @@ class ServingEngine:
         hit_eos = sp.eos_id is not None and tok == sp.eos_id
         if hit_eos or len(req.tokens) >= sp.max_tokens:
             self._finish(slot, now, reg)
+        elif req.handoff and req.status == "decode":
+            # prefill-tier park (P/D disaggregation): the first token
+            # landed, so prefill is DONE — stop decoding here. The slot
+            # goes inactive but keeps its request and KV blocks; the
+            # fleet layer evicts the spill and streams it to a
+            # decode-tier replica, which resumes token-for-token.
+            self._active[slot] = False
+            self._ctl_dirty = True
+            req.status = "prefilled"
+            req.mark("prefilled", ts_s=now)
+            flight_record("serving_prefill_handoff", req=req.id,
+                          trace=req.trace_id, slot=slot,
+                          prompt_len=len(req.prompt))
 
     def _finish(self, slot: int, now: float, reg) -> None:
         req = self._slot_req[slot]

@@ -1,0 +1,567 @@
+"""Multi-process serving fleet: remote replicas over the coordinator.
+
+PR 8's fleet plane proved the serving verbs and the rolling weight
+push, but every replica was an in-process thread sharing one process's
+devices. This module is the multi-process rung (ISSUE 15): the Router
+keeps its exact dispatch/drain/death machinery, and a replica becomes a
+*process* — one :class:`~hetu_tpu.serving.engine.ServingEngine` behind
+its own line-protocol coordinator (``serving/server.py``), driven
+through:
+
+- :class:`RemoteEngineProxy` — satisfies the engine duck type the
+  Router speaks (``submit``/``cancel_queued``/``evict_request``/
+  ``has_work``/``load``/``weight_version``/``stop``) by translating
+  each call into hardened :class:`~hetu_tpu.rpc.client.CoordinatorClient`
+  verbs (SUBMIT with **idempotency keys** so retry-after-timeout is
+  safe, ESTATUS polling, CANCELQ/EVICT for drains and salvage,
+  SWAPWEIGHTS for the dist-checkpoint weight push, PREFILL for the
+  prefill tier). A background poller keeps load/occupancy/version fresh
+  and doubles as the liveness signal;
+- :class:`RemoteReplicaHandle` — a
+  :class:`~hetu_tpu.serving.router.ReplicaHandle` whose death detection
+  is **heartbeat staleness** (every successful status poll is a beat;
+  ``loop_alive()`` is never true because there is no local loop
+  thread), so a SIGKILLed engine process is declared dead by the
+  router's existing ``beat_timeout_s`` machinery and its in-flight
+  requests requeue onto peers;
+- the **KV wire format** (:func:`spill_to_wire` /
+  :func:`spill_from_wire`) — a
+  :class:`~hetu_tpu.serving.kv_pool.SpillEntry` serialized losslessly
+  (raw bytes + dtype + shape per cache leaf, base64 on the one-line
+  protocol), so preemptive drains, kill salvage and the prefill tier
+  move KV **between processes** instead of assuming shared host RAM.
+  Bitwise: ``from_wire(to_wire(e))`` reproduces every page exactly;
+- :func:`replica_main` — the engine-process entry point
+  (``python -m hetu_tpu.serving.fleet``): builds the engine from an
+  env-named ``module:function`` spec, serves it on its port, and waits
+  for SIGTERM. ``rpc/launcher.launch_serving_fleet(remote=True)``
+  spawns one of these per replica and registers the proxies.
+
+Prefill/decode disaggregation rides the same machinery: a replica
+registered with ``role="prefill"`` runs admission + prefill only
+(``ServingEngine.prefill_only`` parks the request after its first
+token), the router evicts the finished KV blocks and streams them —
+wire format and all — to a ``role="decode"`` replica, which maps them
+in with a block-table edit and resumes through the existing
+``submit(resume=)`` path. TTFT (prefill pool) and TPOT (decode pool)
+then scale independently. ``docs/SERVING.md`` ("Disaggregated fleet")
+has the state machines and failure semantics.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import threading
+import time
+import uuid
+from typing import Optional, Sequence
+
+import numpy as np
+
+from hetu_tpu.serving.kv_pool import SpillEntry
+from hetu_tpu.serving.router import ReplicaHandle
+from hetu_tpu.serving.scheduler import SamplingParams
+from hetu_tpu.utils.logging import get_logger
+
+# -- KV wire format -----------------------------------------------------------
+
+
+def array_to_wire(a: np.ndarray) -> dict:
+    """One numpy array → a JSON-safe dict (dtype + shape + raw bytes,
+    base64). Lossless for every arena dtype incl. int8 pages and their
+    fp32 scales."""
+    a = np.ascontiguousarray(a)
+    return {"dtype": str(a.dtype), "shape": list(a.shape),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def array_from_wire(d: dict) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"])
+    ).reshape(d["shape"]).copy()
+
+
+def spill_to_wire(entry: SpillEntry) -> dict:
+    """Serialize a SpillEntry for the line protocol — the payload that
+    moves KV blocks replica→replica through the coordinator (preemptive
+    drains, kill salvage, prefill→decode streaming)."""
+    return {"req_id": entry.req_id,
+            "n_blocks": entry.n_blocks,
+            "block_size": entry.block_size,
+            "pos": entry.pos, "last_tok": entry.last_tok,
+            "tokens": [int(t) for t in entry.tokens],
+            "weight_version": entry.weight_version,
+            "data": [array_to_wire(a) for a in entry.data]}
+
+
+def spill_from_wire(d: dict) -> SpillEntry:
+    return SpillEntry(
+        req_id=int(d["req_id"]),
+        data=tuple(array_from_wire(a) for a in d["data"]),
+        n_blocks=int(d["n_blocks"]), block_size=int(d["block_size"]),
+        pos=int(d["pos"]), last_tok=int(d["last_tok"]),
+        tokens=[int(t) for t in d["tokens"]],
+        weight_version=int(d["weight_version"]))
+
+
+# -- the remote request -------------------------------------------------------
+
+
+class RemoteRequest:
+    """Router-side view of one request living on a REMOTE engine.
+
+    Duck-typed to the slice of :class:`~hetu_tpu.serving.scheduler.
+    Request` the router and the line-protocol front end read: ``id``,
+    ``status``, ``error``, ``tokens``, ``weight_version``,
+    ``first_token_s``, ``submit_s``, ``done``, ``spill``, ``handoff``,
+    ``timing()``. The proxy's poller (or the blocking PREFILL call)
+    fills it in from RESULT payloads."""
+
+    def __init__(self, prompt, sampling: SamplingParams, *,
+                 handoff: bool = False):
+        self.id: int = _next_provisional_id()
+        self.prompt = [int(t) for t in prompt]
+        self.sampling = sampling
+        self.submit_s = time.monotonic()
+        self.status = "queued"
+        self.error: Optional[str] = None
+        self.tokens: list = []
+        self.weight_version: int = 0
+        self.first_token_s: Optional[float] = None
+        self.finish_s: Optional[float] = None
+        self.trace_id = uuid.uuid4().hex[:12]
+        self.handoff = bool(handoff)
+        self.spill: Optional[SpillEntry] = None
+        self.done = threading.Event()
+        self._timing: dict = {}
+
+    def timing(self) -> dict:
+        return dict(self._timing)
+
+    def result(self) -> dict:
+        return {"id": self.id, "status": self.status,
+                "tokens": list(self.tokens), "error": self.error,
+                "weight_version": self.weight_version,
+                "timing": self.timing()}
+
+    def _fill_from(self, doc: dict) -> None:
+        """Adopt a RESULT/PREFILL payload as this request's state."""
+        self.status = doc.get("status", "done")
+        self.error = doc.get("error")
+        self.tokens = list(doc.get("tokens", []))
+        self.weight_version = int(doc.get("weight_version", 0))
+        self._timing = dict(doc.get("timing", {}))
+        self.finish_s = time.monotonic()
+        if self.first_token_s is None and self.tokens:
+            # approximate: the real TTFT happened on the remote engine
+            # and rides the timing breakdown; the local stamp only
+            # feeds the router's EWMA tiebreak
+            ttft_ms = self._timing.get("ttft_ms")
+            self.first_token_s = self.submit_s + ttft_ms / 1e3 \
+                if ttft_ms is not None else time.monotonic()
+
+
+#: provisional ids are NEGATIVE so they can never collide with a remote
+#: engine's real (>= 0) request ids inside one handle's inflight map
+_provisional = itertools.count(1)
+
+
+def _next_provisional_id() -> int:
+    return -next(_provisional)
+
+
+class _RemoteSched:
+    """Duck-typed ``engine.scheduler`` view (depth/occupancy) for
+    :meth:`ReplicaHandle.status`, fed by the proxy's status poller."""
+
+    def __init__(self, proxy: "RemoteEngineProxy"):
+        self._proxy = proxy
+
+    @property
+    def depth(self) -> int:
+        return int(self._proxy._status.get("depth", 0))
+
+    @property
+    def occupancy(self) -> float:
+        return float(self._proxy._status.get("occupancy", 0.0))
+
+
+# -- the engine proxy ---------------------------------------------------------
+
+
+class RemoteEngineProxy:
+    """ServingEngine duck type over the coordinator line protocol.
+
+    One persistent :class:`CoordinatorClient` (lock-guarded — the
+    router thread and the poller share it) carries the short verbs;
+    long-blocking calls (PREFILL, SWAPWEIGHTS, STOPENGINE) open their
+    own connection so they never starve status polls. Every transport
+    failure is survivable: a failed submit leaves the request queued
+    and unreachable-marked (the router's heartbeat-staleness death
+    detection requeues it onto a peer), a failed evict degrades to a
+    fresh requeue, a failed status poll just ages the beat.
+    """
+
+    remote = True                    # Router.register picks the handle
+
+    def __init__(self, port: int, host: str = "127.0.0.1", *,
+                 token: Optional[str] = None,
+                 poll_s: float = 0.05, timeout_s: float = 5.0,
+                 swap_timeout_s: float = 300.0):
+        self.port, self.host = int(port), host
+        self._token = token
+        self._poll_s = float(poll_s)
+        self._timeout_s = float(timeout_s)
+        self._swap_timeout_s = float(swap_timeout_s)
+        self._lock = threading.RLock()
+        self._cli = None
+        self._pending: dict[int, RemoteRequest] = {}
+        self._status: dict = {}
+        self._handle: Optional[ReplicaHandle] = None   # beat sink
+        self._stop = None            # duck parity with ServingEngine
+        self._thread: Optional[threading.Thread] = None
+        self._stop_ev: Optional[threading.Event] = None
+        self.scheduler = _RemoteSched(self)
+
+    # -- transport ----------------------------------------------------------
+    def _client(self, *, fresh: bool = False, timeout: Optional[float]
+                = None):
+        from hetu_tpu.rpc.client import CoordinatorClient
+        if fresh:
+            return CoordinatorClient(
+                self.port, host=self.host, token=self._token,
+                timeout=timeout or self._timeout_s, retries=1)
+        if self._cli is None:
+            # one bounded retry: SUBMIT rides an idempotency key (a
+            # duplicate delivery joins the original request), ESTATUS
+            # is read-only — a single TCP hiccup must not strand work
+            self._cli = CoordinatorClient(
+                self.port, host=self.host, token=self._token,
+                timeout=self._timeout_s, retries=1, backoff_s=0.02)
+        return self._cli
+
+    def _drop_client(self) -> None:
+        with self._lock:
+            if self._cli is not None:
+                try:
+                    self._cli.close()
+                except OSError:
+                    pass
+                self._cli = None
+
+    #: load reported while the engine is UNREACHABLE (a failed verb or
+    #: status poll): effectively infinite, so least-loaded dispatch
+    #: steers new work to healthy peers during the staleness window
+    #: before the router declares the replica dead. Self-correcting —
+    #: the next successful poll restores the real load.
+    _SUSPECT_LOAD = 1 << 30
+
+    def _mark_suspect(self) -> None:
+        self._status = dict(self._status, load=self._SUSPECT_LOAD)
+
+    # -- engine duck type (what Router calls) --------------------------------
+    @property
+    def load(self) -> int:
+        return int(self._status.get("load", 0))
+
+    @property
+    def weight_version(self) -> int:
+        return int(self._status.get("weight_version", 0))
+
+    def has_work(self) -> bool:
+        return bool(self._status.get("has_work", False))
+
+    def submit(self, prompt: Sequence[int],
+               sampling: Optional[SamplingParams] = None, *,
+               resume: Optional[SpillEntry] = None,
+               handoff: bool = False) -> RemoteRequest:
+        sampling = sampling or SamplingParams()
+        rr = RemoteRequest(prompt, sampling, handoff=handoff)
+        if handoff:
+            # PREFILL blocks server-side until the KV is ready — run it
+            # on its own connection + thread so dispatch stays snappy
+            threading.Thread(target=self._prefill_call, args=(rr,),
+                             daemon=True,
+                             name=f"prefill-{self.port}").start()
+            return rr
+        try:
+            with self._lock:
+                doc = self._client().serving_submit_info(
+                    rr.prompt, resume=spill_to_wire(resume)
+                    if resume is not None else None,
+                    **_sampling_kw(sampling))
+        except Exception as e:                        # noqa: BLE001
+            if _is_rejection(e):
+                rr.status, rr.error = "rejected", str(e)
+                rr.done.set()
+                return rr
+            # unreachable / flaky transport (retries exhausted): mark
+            # the request so the router monitor requeues it onto a
+            # peer even while this replica's beats stay fresh — a
+            # transient failure must never strand a request forever
+            self._drop_client()
+            self._mark_suspect()
+            rr.status = "transport_failed"
+            rr.error = f"transport: {e}"
+            return rr
+        rr.id = int(doc["id"])
+        rr.trace_id = doc.get("trace_id", rr.trace_id)
+        if resume is not None and doc.get("resumed"):
+            rr.spill = resume          # identity marker the router reads
+        rr.status = "dispatched"
+        self._pending[rr.id] = rr
+        return rr
+
+    def _prefill_call(self, rr: RemoteRequest) -> None:
+        try:
+            cli = self._client(fresh=True,
+                               timeout=self._swap_timeout_s)
+            try:
+                doc = cli.serving_prefill(rr.prompt,
+                                          **_sampling_kw(rr.sampling))
+            finally:
+                cli.close()
+        except Exception as e:                        # noqa: BLE001
+            if _is_rejection(e):
+                rr.status, rr.error = "rejected", str(e)
+                rr.done.set()
+                return
+            self._mark_suspect()
+            rr.status = "transport_failed"    # monitor requeues onto
+            rr.error = f"transport: {e}"      # a peer (or back here)
+            return
+        rr.id = int(doc["id"])
+        rr.trace_id = doc.get("trace_id", rr.trace_id)
+        if doc.get("done"):
+            rr._fill_from(doc["result"])
+            rr.done.set()
+            return
+        rr.tokens = list(doc.get("tokens", []))
+        rr.weight_version = int(doc.get("weight_version", 0))
+        rr.first_token_s = time.monotonic()
+        rr.spill = spill_from_wire(doc["spill"])
+        rr.status = "prefilled"       # the router monitor takes it from
+        #                               here (evict → stream → requeue)
+
+    def cancel_queued(self, ids=None) -> list[RemoteRequest]:
+        want = [rid for rid in (ids if ids is not None
+                                else list(self._pending))
+                if rid in self._pending and rid >= 0]
+        if not want:
+            return []
+        try:
+            with self._lock:
+                doc = self._client().serving_cancel_queued(want)
+        except Exception:                             # noqa: BLE001
+            self._drop_client()
+            return []
+        out = []
+        for c in doc.get("cancelled", []):
+            rr = self._pending.pop(int(c["id"]), None)
+            if rr is None:
+                continue
+            rr.status = "cancelled"
+            if c.get("spill") is not None:
+                rr.spill = spill_from_wire(c["spill"])
+            out.append(rr)
+        return out
+
+    def evict_request(self, req: RemoteRequest, *,
+                      lock_timeout_s: Optional[float] = None
+                      ) -> Optional[SpillEntry]:
+        if req.spill is not None:
+            # the PREFILL round trip already carried the KV
+            entry, req.spill = req.spill, None
+            req.status = "evicted"
+            self._pending.pop(req.id, None)
+            return entry
+        try:
+            with self._lock:
+                doc = self._client().serving_evict(
+                    req.id, lock_timeout_s=lock_timeout_s)
+        except Exception:                             # noqa: BLE001
+            self._drop_client()
+            return None                # salvage is best-effort
+        req.status = doc.get("status", req.status)
+        if req.status in ("evicted", "cancelled"):
+            self._pending.pop(req.id, None)
+        if doc.get("spill") is None:
+            return None
+        return spill_from_wire(doc["spill"])
+
+    def swap_from_checkpoint(self, path: str, version: int) -> dict:
+        """The remote leg of a ``transport="dist_ckpt"`` weight push:
+        the engine process loads ``path`` (shared filesystem / blob
+        store) onto its own topology and swaps. Own connection — a
+        large load must not block status polls."""
+        cli = self._client(fresh=True, timeout=self._swap_timeout_s)
+        try:
+            return cli.serving_swap_weights(path, version)
+        finally:
+            cli.close()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, idle_sleep_s: float = 0.0) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_ev = threading.Event()
+        self._thread = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name=f"remote-engine-poll-{self.port}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop_ev is not None:
+            self._stop_ev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            cli = self._client(fresh=True, timeout=2.0)
+            try:
+                cli.serving_stop_engine()
+            finally:
+                cli.close()
+        except Exception:                             # noqa: BLE001
+            pass                       # the process may already be gone
+        self._drop_client()
+
+    # -- the poller ----------------------------------------------------------
+    def _poll_loop(self) -> None:
+        while not self._stop_ev.is_set():
+            self._poll_once()
+            self._stop_ev.wait(self._poll_s)
+
+    def _poll_once(self) -> bool:
+        try:
+            with self._lock:
+                self._status = self._client().serving_estatus()
+        except Exception:                             # noqa: BLE001
+            self._drop_client()
+            self._mark_suspect()
+            return False               # no beat: staleness accumulates
+        if self._handle is not None:
+            self._handle.last_beat = time.monotonic()
+        for rid, rr in list(self._pending.items()):
+            if rr.done.is_set() or rr.status in ("prefilled",
+                                                 "evicted",
+                                                 "cancelled"):
+                continue
+            try:
+                with self._lock:
+                    doc = self._client().serving_result(rid,
+                                                        timeout_ms=0)
+            except Exception:                         # noqa: BLE001
+                self._drop_client()
+                return False
+            if doc is None:
+                continue
+            rr._fill_from(doc)
+            self._pending.pop(rid, None)
+            rr.done.set()
+        return True
+
+
+def _sampling_kw(sp: SamplingParams) -> dict:
+    return {"temperature": sp.temperature, "top_k": sp.top_k,
+            "top_p": sp.top_p, "eos_id": sp.eos_id,
+            "max_tokens": sp.max_tokens, "priority": sp.priority}
+
+
+def _is_rejection(e: Exception) -> bool:
+    """Admission rejections come back as ``ERR rejected: ...`` lines
+    the client surfaces as RuntimeError — terminal, not transport."""
+    return isinstance(e, RuntimeError) and "rejected" in str(e)
+
+
+# -- the replica handle -------------------------------------------------------
+
+
+class RemoteReplicaHandle(ReplicaHandle):
+    """A :class:`ReplicaHandle` whose replica lives in ANOTHER process.
+
+    Liveness inverts: there is no loop thread to watch, so
+    ``loop_alive()``/``loop_died()`` are always False and death comes
+    exclusively from **heartbeat staleness** — the proxy's poller
+    stamps ``last_beat`` on every successful status round trip, and the
+    router's existing ``beat_timeout_s`` check declares the replica
+    dead when the beats stop (process SIGKILLed, host gone, network
+    partitioned). Registration itself counts as the first beat, so a
+    replica that never answers is reaped after one timeout instead of
+    living forever."""
+
+    remote = True
+
+    def __init__(self, name: str, proxy: RemoteEngineProxy):
+        super().__init__(name, proxy)        # type: ignore[arg-type]
+        proxy._handle = self
+        self.last_beat = time.monotonic()    # registration = beat 0
+
+    def loop_alive(self) -> bool:
+        return False
+
+    def loop_died(self) -> bool:
+        return False
+
+    def status(self) -> dict:
+        doc = super().status()
+        doc["remote"] = True
+        doc["beat_age_s"] = round(
+            time.monotonic() - self.last_beat, 3) \
+            if self.last_beat is not None else None
+        return doc
+
+
+# -- the engine-process entry point -------------------------------------------
+
+
+def replica_main() -> int:
+    """Entry point of one fleet engine process
+    (``python -m hetu_tpu.serving.fleet``, spawned by
+    ``rpc/launcher.launch_serving_fleet(remote=True)``).
+
+    Env contract:
+
+    - ``HETU_ENGINE_SPEC``   — ``module:function``; called with the
+      replica index, must return a ready ServingEngine (the fleet
+      analogue of the launcher's ``build_engine(i)``)
+    - ``HETU_REPLICA_INDEX`` — this replica's index (default 0)
+    - ``HETU_REPLICA_NAME``  — this replica's fleet name
+    - ``HETU_ENGINE_PORT``   — the line-protocol port to serve on
+    - ``HETU_ENGINE_TOKEN``  — optional bearer token
+
+    Serves until SIGTERM (clean launcher teardown); SIGKILL is the
+    chaos path — the router's heartbeat staleness handles it.
+    """
+    import importlib
+    import os
+    import signal
+
+    spec = os.environ["HETU_ENGINE_SPEC"]
+    idx = int(os.environ.get("HETU_REPLICA_INDEX", "0"))
+    port = int(os.environ["HETU_ENGINE_PORT"])
+    name = os.environ.get("HETU_REPLICA_NAME", f"r{idx}")
+    token = os.environ.get("HETU_ENGINE_TOKEN", "")
+    mod_name, fn_name = spec.split(":")
+    build = getattr(importlib.import_module(mod_name), fn_name)
+    engine = build(idx)
+
+    from hetu_tpu.serving.server import ServingServer
+    srv = ServingServer(engine, port, token=token)
+    srv.start()
+    srv.wait_ready()
+    get_logger().info(
+        f"fleet replica {name} (index {idx}) serving on :{port}")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(replica_main())

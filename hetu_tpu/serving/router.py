@@ -121,6 +121,8 @@ class ReplicaHandle:
         self.name = name
         self.engine = engine
         self.state = "live"          # live | draining | dead
+        self.role = "both"           # both | prefill | decode (P/D
+        #                              disaggregation — set by register)
         self.registered_s = time.monotonic()
         self.last_beat: Optional[float] = None   # external heartbeats
         self.inflight: dict[int, RouterRequest] = {}   # inner id → rreq
@@ -147,7 +149,8 @@ class ReplicaHandle:
         return self.engine.weight_version
 
     def status(self) -> dict:
-        return {"state": self.state, "load": self.load,
+        return {"state": self.state, "role": self.role,
+                "load": self.load,
                 "queue_depth": self.engine.scheduler.depth,
                 "occupancy": round(self.engine.scheduler.occupancy, 4),
                 "loop_running": self.loop_alive(),
@@ -161,15 +164,18 @@ class ReplicaHandle:
 class Router:
     """Load-aware, prefix-sticky dispatch over registered replicas.
 
-    In-process fleet: replicas are live :class:`ServingEngine` objects
-    whose background loops this process runs (threads — the suite's and
-    the rollout workload's deployment shape; one engine per accelerator
-    process reaches the same Router through the coordinator verbs).
-    Death is detected from the replica's loop thread (and, for
-    externally-driven replicas, heartbeat staleness once
-    :meth:`heartbeat` has been seen); a monitor thread finalizes
-    completions, requeues the dead replica's undelivered requests onto
-    peers, and keeps the fleet gauges fresh.
+    Replicas come in two shapes: live :class:`ServingEngine` objects
+    whose background loops this process runs (threads — the suite's
+    and the rollout workload's single-host shape), and REMOTE engine
+    processes registered through a
+    :class:`~hetu_tpu.serving.fleet.RemoteEngineProxy` (ISSUE 15 —
+    one engine per accelerator host, the serving verbs travel the
+    coordinator line protocol). Death is detected from the replica's
+    loop thread, or — for remote/externally-driven replicas — from
+    heartbeat staleness; a monitor thread finalizes completions,
+    streams prefill-tier handoffs to the decode tier, requeues a dead
+    replica's undelivered requests onto peers, and keeps the fleet
+    gauges fresh.
     """
 
     def __init__(self, *, affinity_tokens: int = 16,
@@ -197,9 +203,25 @@ class Router:
 
     # -- replica lifecycle --------------------------------------------------
     def register(self, name: str, engine: ServingEngine, *,
-                 start: bool = True) -> ReplicaHandle:
+                 start: bool = True,
+                 role: str = "both") -> ReplicaHandle:
         """Add a replica (its engine loop is started unless it already
-        runs or ``start=False``) and ensure the monitor is running."""
+        runs or ``start=False``) and ensure the monitor is running.
+
+        ``engine`` may be an in-process :class:`ServingEngine` or a
+        :class:`~hetu_tpu.serving.fleet.RemoteEngineProxy` (a replica
+        in another process — the handle then detects death by
+        heartbeat staleness instead of watching a loop thread).
+
+        ``role`` is the P/D-disaggregation tier: ``"both"`` (default —
+        the colocated shape), ``"prefill"`` (admission + prefill only:
+        finished KV blocks stream to the decode tier), or ``"decode"``
+        (resumes streamed KV and decodes). Dispatch only splits when a
+        live prefill replica AND a live decode-capable replica both
+        exist; otherwise requests run colocated wherever they land."""
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, "
+                             f"got {role!r}")
         with self._lock:
             if name in self._replicas \
                     and self._replicas[name].state != "dead":
@@ -209,10 +231,15 @@ class Router:
                 # replicas whose loop thread died as dead, and a handle
                 # published with the thread not yet up would race it
                 engine.start()
-            h = ReplicaHandle(name, engine)
+            if getattr(engine, "remote", False):
+                from hetu_tpu.serving.fleet import RemoteReplicaHandle
+                h = RemoteReplicaHandle(name, engine)
+            else:
+                h = ReplicaHandle(name, engine)
+            h.role = role
             self._replicas[name] = h
         flight_record("router_replica", replica=name, state="live",
-                      event="register")
+                      event="register", role=role)
         self.start()
         return h
 
@@ -261,6 +288,7 @@ class Router:
                 version = h.engine.weight_version
                 peer_ok = any(
                     p.state == "live" and p is not h
+                    and p.role in ("both", "decode")
                     and p.engine.weight_version == version
                     for p in self._replicas.values())
                 if peer_ok:
@@ -330,8 +358,15 @@ class Router:
                 # because its step is WEDGED still holds its iteration
                 # lock, and this path runs under the router lock — a
                 # timed-out acquire degrades to the pre-spill fresh
-                # requeue instead of freezing the whole fleet
-                if rreq.inner is not None:
+                # requeue instead of freezing the whole fleet. A dead
+                # REMOTE replica is unreachable by definition (beats
+                # stopped: SIGKILL, host loss, partition) — attempting
+                # K wire EVICTs here would stall the router lock up to
+                # K connect timeouts for salvage that cannot succeed;
+                # cross-process KV moves only on cooperative paths
+                # (drains, P/D handoffs)
+                if rreq.inner is not None \
+                        and not getattr(h, "remote", False):
                     try:
                         rreq.spill = h.engine.evict_request(
                             rreq.inner, lock_timeout_s=2.0)
@@ -358,8 +393,12 @@ class Router:
         return max(live, key=lambda h: hashlib.blake2b(
             f"{h.name}|{key}".encode(), digest_size=8).digest())
 
-    def _pick_locked(self, prompt) -> Optional[tuple[ReplicaHandle, str]]:
-        live = [h for h in self._replicas.values() if h.state == "live"]
+    def _pick_locked(self, prompt, *, tier: str = "decode"
+                     ) -> Optional[tuple[ReplicaHandle, str]]:
+        roles = ("prefill",) if tier == "prefill" \
+            else ("both", "decode")
+        live = [h for h in self._replicas.values()
+                if h.state == "live" and h.role in roles]
         if not live:
             return None
         loads = {h.name: h.load for h in live}
@@ -381,12 +420,35 @@ class Router:
             rreq.finish_s = time.monotonic()
             rreq.done.set()
             return True                      # terminal — not pending
-        picked = self._pick_locked(rreq.prompt)
+        # P/D disaggregation: a FRESH request (no KV spill riding along)
+        # goes to the prefill tier when one exists alongside a live
+        # decode-capable peer — it prefills there, parks after its
+        # first token, and the monitor streams its KV blocks to the
+        # decode tier (reason "pd_handoff" requeue). A spill-carrying
+        # request always goes straight to the decode tier: its KV
+        # already exists.
+        handoff = False
+        if rreq.spill is None and any(
+                h.state == "live" and h.role == "prefill"
+                for h in self._replicas.values()) and any(
+                h.state == "live" and h.role in ("both", "decode")
+                for h in self._replicas.values()):
+            picked = self._pick_locked(rreq.prompt, tier="prefill")
+            handoff = picked is not None
+        else:
+            picked = None
+        if picked is None:
+            picked = self._pick_locked(rreq.prompt)
         if picked is None:
             return False
         h, reason = picked
-        inner = h.engine.submit(rreq.prompt, rreq.sampling,
-                                resume=rreq.spill)
+        if handoff:
+            reason = "pd_prefill"
+            inner = h.engine.submit(rreq.prompt, rreq.sampling,
+                                    handoff=True)
+        else:
+            inner = h.engine.submit(rreq.prompt, rreq.sampling,
+                                    resume=rreq.spill)
         if rreq.spill is not None:
             if inner.spill is rreq.spill:     # the peer took the KV
                 rreq.resumed_dispatches += 1
@@ -396,7 +458,15 @@ class Router:
                     "(resumed mid-decode, no re-prefill)").inc()
             rreq.spill = None      # stale either way once dispatched —
             #                        a later death re-spills fresh state
-        rreq.attempts += 1
+        if not handoff:
+            # a planned prefill-tier placement is half of the normal
+            # P/D flow, not a failure retry: only the decode placement
+            # (and real requeues) spend the max_attempts budget, so a
+            # split request tolerates as many replica deaths as a
+            # colocated one. The evict-failure loop stays bounded —
+            # _handoff_locked charges an attempt when the KV pull
+            # comes back empty.
+            rreq.attempts += 1
         rreq.replica = h.name
         rreq.inner = inner
         if inner.status == "rejected":       # admission gate: terminal
@@ -425,11 +495,28 @@ class Router:
                         from_replica: str, reason: str) -> None:
         rreq.inner = None                    # old replica's work is void
         rreq.status = "queued"
-        self.requeues_total += 1
-        telemetry.get_registry().counter(
-            "router_requeues_total",
-            "in-flight requests re-dispatched after a replica "
-            "drain/death").inc()
+        reg = telemetry.get_registry()
+        if reason == "pd_handoff":
+            # the planned prefill→decode hop is not a failure requeue —
+            # it gets its own ledger so drain/death stats stay honest
+            reg.counter(
+                "fleet_pd_handoffs_total",
+                "requests handed from the prefill tier to the decode "
+                "tier (P/D disaggregation — KV streamed, zero "
+                "re-prefill)").inc()
+        else:
+            self.requeues_total += 1
+            reg.counter(
+                "router_requeues_total",
+                "in-flight requests re-dispatched after a replica "
+                "drain/death").inc()
+            src = self._replicas.get(from_replica)
+            if src is not None and getattr(src, "remote", False):
+                reg.counter(
+                    "fleet_remote_requeues_total",
+                    "requeues whose source replica was a REMOTE "
+                    "process (death detected by heartbeat staleness, "
+                    "or a cross-process drain)").inc()
         flight_record("router_requeue", req=rreq.id,
                       trace=rreq.trace_id, from_replica=from_replica,
                       reason=reason)
@@ -496,6 +583,43 @@ class Router:
                 else 0.8 * h.ttft_ewma_s + 0.2 * ttft
         rreq.done.set()
 
+    def _handoff_locked(self, h: ReplicaHandle, inner_id: int,
+                        rreq: RouterRequest, reg) -> None:
+        """Move one prefilled request from its prefill-tier replica to
+        the decode tier: evict the parked KV (a SpillEntry — the same
+        payload preemption and drains move) and requeue it with the
+        spill riding along, so the decode replica resumes it with ZERO
+        prefill-lane work."""
+        inner = rreq.inner
+        try:
+            entry = h.engine.evict_request(inner, lock_timeout_s=5.0)
+        except Exception:                             # noqa: BLE001
+            entry = None
+        if inner.done.is_set():          # raced to completion under us
+            h.inflight.pop(inner_id, None)
+            self._finalize_locked(h, rreq)
+            return
+        h.inflight.pop(inner_id, None)
+        rreq.spill = entry
+        if entry is None:
+            # the KV pull failed (wedged engine / lost wire payload):
+            # this re-enters the prefill tier for a fresh prefill —
+            # charge an attempt so a persistently failing replica
+            # cannot loop the request forever
+            rreq.attempts += 1
+        if entry is not None:
+            reg.counter(
+                "fleet_kv_stream_blocks_total",
+                "KV blocks streamed between fleet replicas "
+                "(prefill→decode handoffs, cross-process drains and "
+                "salvage)").inc(entry.n_blocks)
+            flight_record("fleet_kv_stream", req=rreq.id,
+                          trace=rreq.trace_id, from_replica=h.name,
+                          blocks=entry.n_blocks,
+                          tokens=len(entry.tokens))
+        self._requeue_locked(rreq, from_replica=h.name,
+                             reason="pd_handoff")
+
     def _tick(self) -> None:
         now = time.monotonic()
         reg = telemetry.get_registry()
@@ -521,6 +645,29 @@ class Router:
                             and rreq.inner.done.is_set():
                         h.inflight.pop(inner_id)
                         self._finalize_locked(h, rreq)
+                    elif rreq.inner is not None \
+                            and getattr(rreq.inner, "handoff", False) \
+                            and rreq.inner.status == "prefilled":
+                        # P/D: prefill finished and PARKED — pull its
+                        # KV blocks (one gather, or already carried by
+                        # the remote PREFILL round trip) and stream
+                        # them to the decode tier
+                        self._handoff_locked(h, inner_id, rreq, reg)
+                    elif getattr(rreq.inner, "status", "") \
+                            == "transport_failed":
+                        # the remote submit never landed (transient
+                        # transport failure, retries exhausted) — the
+                        # replica may be perfectly alive, so staleness
+                        # will never fire: requeue it ourselves
+                        h.inflight.pop(inner_id, None)
+                        if getattr(rreq.inner, "handoff", False):
+                            # prefill placements are budget-free —
+                            # charge the failure here so a flaky
+                            # prefill tier cannot loop forever
+                            rreq.attempts += 1
+                        self._requeue_locked(
+                            rreq, from_replica=h.name,
+                            reason="transport_failed")
             # place parked requests as capacity (re)appears
             still: deque[RouterRequest] = deque()
             while self._pending:
@@ -540,6 +687,15 @@ class Router:
                           "requests, as dispatch sees it").set(
                     0 if h.state == "dead" else h.load,
                     replica=h.name)
+                if getattr(h, "remote", False) and h.state != "dead" \
+                        and h.last_beat is not None:
+                    reg.gauge(
+                        "fleet_replica_beat_age_seconds",
+                        "seconds since a remote replica's last "
+                        "successful status poll — the staleness "
+                        "signal that declares it dead past "
+                        "beat_timeout_s").set(
+                        round(now - h.last_beat, 3), replica=h.name)
 
     def start(self) -> None:
         with self._lock:
@@ -590,6 +746,13 @@ class Router:
             }
 
 
+def jax_tree_leaves(tree):
+    """Array leaves of a pytree (lazy jax import — the router stays
+    importable host-side)."""
+    import jax
+    return [x for x in jax.tree.leaves(tree) if hasattr(x, "size")]
+
+
 def materialize_params(params, engine: ServingEngine):
     """Copy ``params`` onto ``engine``'s topology for a swap.
 
@@ -633,13 +796,94 @@ class WeightPublisher:
     decode — push latency stops scaling with ``max_tokens``. The last
     replica of a rolling push (no old-version peer left) falls back to
     run-to-completion, preserving the one-request-one-version
-    invariant."""
+    invariant.
+
+    **Transports** (ISSUE 15): ``transport="reshard"`` (default) moves
+    parameters in memory through the HotSPa reshard core — in-process
+    replicas only. ``transport="dist_ckpt"`` publishes the new version
+    ONCE as a sharded checkpoint (``utils/dist_checkpoint.
+    save_params_distributed`` under ``ckpt_dir`` — successive pushes
+    delta against the previous version, so a fine-tune push writes
+    only what changed) and each replica loads it onto its own
+    topology: in-process engines via ``load_params_distributed``,
+    remote engine processes via the SWAPWEIGHTS verb (the path must be
+    reachable from every replica host — shared filesystem or blob
+    store). Version directories referenced by a delta must outlive it;
+    the publisher never deletes them."""
 
     def __init__(self, router: Router, *,
-                 drain_timeout_s: float = 60.0, preempt: bool = True):
+                 drain_timeout_s: float = 60.0, preempt: bool = True,
+                 transport: str = "reshard",
+                 ckpt_dir: Optional[str] = None):
+        if transport not in ("reshard", "dist_ckpt"):
+            raise ValueError(f"transport must be reshard|dist_ckpt, "
+                             f"got {transport!r}")
+        if transport == "dist_ckpt" and not ckpt_dir:
+            raise ValueError("transport='dist_ckpt' needs ckpt_dir= "
+                             "(where version directories are written)")
         self.router = router
         self.drain_timeout_s = float(drain_timeout_s)
         self.preempt = bool(preempt)
+        self.transport = transport
+        self.ckpt_dir = ckpt_dir
+        self._last_dir: Optional[str] = None   # delta base for the
+        #                                        next version's save
+        self._last_version = 0       # monotonic floor: remote handles
+        #                              report POLLED versions, which can
+        #                              lag a just-finished push — the
+        #                              publisher's own ledger keeps
+        #                              auto-versioning monotonic anyway
+
+    def _publish_checkpoint(self, params, version: int, reg) -> str:
+        """Write version ``version`` once (delta against the previous
+        push when one exists) and return its directory."""
+        import os
+
+        from hetu_tpu.utils.dist_checkpoint import (
+            save_params_distributed,
+        )
+        path = os.path.join(self.ckpt_dir, f"v{int(version):08d}")
+        writer = save_params_distributed(
+            path, params, version=version,
+            delta_base=self._last_dir, hash_pieces=True)
+        writer.wait()
+        reg.counter(
+            "weight_push_bytes_total",
+            "parameter bytes moved by fleet weight pushes, by "
+            "transport (dist_ckpt counts bytes WRITTEN once per push "
+            "— delta savings show here; reshard counts device-copy "
+            "bytes per replica)").inc(
+            writer.stats["written_bytes"], transport="dist_ckpt")
+        return path
+
+    def _swap_replica(self, h: ReplicaHandle, params, path, version,
+                      reg) -> dict:
+        """The per-replica swap leg, by transport + replica locality."""
+        if self.transport == "dist_ckpt":
+            if getattr(h, "remote", False):
+                return h.engine.swap_from_checkpoint(path, version)
+            from hetu_tpu.utils.dist_checkpoint import (
+                load_params_distributed,
+            )
+            local = load_params_distributed(path, h.engine.model,
+                                            plan=h.engine._plan)
+            return h.engine.swap_params(local, version=version)
+        if getattr(h, "remote", False):
+            raise RuntimeError(
+                f"replica {h.name!r} is remote: the in-memory reshard "
+                f"transport cannot reach another process — use "
+                f"WeightPublisher(transport='dist_ckpt', ckpt_dir=...)")
+        local = materialize_params(params, h.engine)
+        reg.counter(
+            "weight_push_bytes_total",
+            "parameter bytes moved by fleet weight pushes, by "
+            "transport (dist_ckpt counts bytes WRITTEN once per push "
+            "— delta savings show here; reshard counts device-copy "
+            "bytes per replica)").inc(
+            sum(int(x.size) * x.dtype.itemsize
+                for x in jax_tree_leaves(local)),
+            transport="reshard")
+        return h.engine.swap_params(local, version=version)
 
     def publish(self, state_or_params, *,
                 version: Optional[int] = None) -> dict:
@@ -648,13 +892,19 @@ class WeightPublisher:
         (per-replica durations + flush counts)."""
         params = getattr(state_or_params, "params", state_or_params)
         t0 = time.perf_counter()
+        reg = telemetry.get_registry()
         with self.router._lock:
             names = sorted(n for n, h in self.router._replicas.items()
                            if h.state != "dead")
             if version is None:
-                version = 1 + max(
-                    (self.router._replicas[n].weight_version
-                     for n in names), default=0)
+                version = max(
+                    1 + max((self.router._replicas[n].weight_version
+                             for n in names), default=0),
+                    self._last_version + 1)
+        self._last_version = max(self._last_version, int(version))
+        path = None
+        if self.transport == "dist_ckpt":
+            path = self._publish_checkpoint(params, version, reg)
         per = []
         for name in names:
             h = self.router._replicas.get(name)
@@ -671,13 +921,14 @@ class WeightPublisher:
                         h, reason="drain_timeout")
                 per.append({"replica": name, "skipped": "drain_timeout"})
                 continue
-            local = materialize_params(params, h.engine)
-            info = h.engine.swap_params(local, version=version)
+            info = self._swap_replica(h, params, path, version, reg)
             self.router.resume(name)
             per.append({"replica": name, "requeued": requeued,
-                        "flushed_blocks": info["flushed_blocks"],
+                        "flushed_blocks": info.get("flushed_blocks", 0),
                         "ms": round((time.perf_counter() - t1) * 1e3,
                                     3)})
+        if path is not None:
+            self._last_dir = path
         dur_ms = (time.perf_counter() - t0) * 1e3
         reg = telemetry.get_registry()
         reg.histogram("weight_push_duration_ms",

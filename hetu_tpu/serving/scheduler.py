@@ -86,6 +86,14 @@ class Request:
     #                                    — swaps only land on drained
     #                                    engines, so one request is one
     #                                    version, end to end
+    handoff: bool = False              # prefill-tier mode (ISSUE 15):
+    #                                    the engine parks the request
+    #                                    after its FIRST token (status
+    #                                    "prefilled", slot inactive but
+    #                                    owned) instead of decoding on —
+    #                                    the fleet layer evicts its KV
+    #                                    and streams it to a decode-tier
+    #                                    replica (docs/SERVING.md)
     admit: Optional[dict] = dataclasses.field(
         default=None, repr=False, compare=False)  # paged admission plan
     # -- speculation + QoS ledgers (ISSUE 11) --

@@ -15,6 +15,7 @@ matching ``serving_*`` calls in ``rpc/client.py``.
 from __future__ import annotations
 
 import json
+import threading
 import urllib.parse
 from typing import Optional
 
@@ -93,9 +94,64 @@ def _prune_request_map(m: dict) -> None:
 #: serving verbs the coordinator forwards here. SUBMIT/RESULT/GENERATE
 #: accept EITHER a ServingEngine or a fleet Router (same duck-typed
 #: surface: submit()/result()/_requests_by_id); FLEET/DRAIN/RESUME are
-#: router-only (fleet lifecycle over the wire).
+#: router-only (fleet lifecycle over the wire); ESTATUS/CANCELQ/EVICT/
+#: PREFILL/SWAPWEIGHTS/STOPENGINE are the engine-process verbs the
+#: fleet's RemoteEngineProxy drives (docs/SERVING.md "Disaggregated
+#: fleet"). ``rpc/py_server.py`` mirrors this tuple (it must stay
+#: importable without jax) — a quick-tier test keeps them in sync.
 SERVING_COMMANDS = ("SUBMIT", "RESULT", "GENERATE",
-                    "FLEET", "DRAIN", "RESUME")
+                    "FLEET", "DRAIN", "RESUME",
+                    "ESTATUS", "CANCELQ", "EVICT", "PREFILL",
+                    "SWAPWEIGHTS", "STOPENGINE")
+
+
+_idem_init_lock = threading.Lock()
+
+
+def _idem_map(engine) -> tuple:
+    """Per-server ``(lock, idempotency-key → request)`` pair (attached
+    to the engine/router object the coordinator serves).
+    SUBMIT/GENERATE payloads carry an ``idem`` key; a duplicate
+    delivery — the client retrying after a response timeout, or two
+    front ends racing one logical request — joins the ORIGINAL request
+    instead of queueing a second generation. The lock makes
+    check-and-insert atomic across the coordinator's handler threads."""
+    pair = getattr(engine, "_idem_requests", None)
+    if pair is None:
+        with _idem_init_lock:
+            pair = getattr(engine, "_idem_requests", None)
+            if pair is None:
+                pair = (threading.Lock(), {})
+                engine._idem_requests = pair
+    return pair
+
+
+def _prune_idem_map(m: dict) -> None:
+    if len(m) <= _REQUEST_MAP_CAP:
+        return
+    for key in [k for k, r in m.items()
+                if r.done.is_set()][:len(m) - _REQUEST_MAP_CAP]:
+        m.pop(key, None)
+
+
+def _count_dedup(verb: str) -> None:
+    from hetu_tpu import telemetry
+    telemetry.get_registry().counter(
+        "serving_idem_dedup_total",
+        "duplicate SUBMIT/GENERATE deliveries suppressed by "
+        "idempotency key (client retry-after-timeout joined the "
+        "original request)").inc(verb=verb)
+
+
+def _submit_from_payload(engine, p: dict):
+    """Decode one SUBMIT/GENERATE/PREFILL payload and queue it —
+    wire-format KV spills (``resume``) ride along for the fleet's
+    cross-process resumable requeue."""
+    kw = {}
+    if p.get("resume") is not None:
+        from hetu_tpu.serving.fleet import spill_from_wire
+        kw["resume"] = spill_from_wire(p["resume"])
+    return engine.submit(p["prompt"], sampling_from_payload(p), **kw)
 
 
 def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
@@ -125,14 +181,33 @@ def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
             return f"ERR {type(e).__name__}: {e}"
     try:
         if cmd == "SUBMIT":
-            req = submit_payload(engine, args[0])
+            p = decode_payload(args[0])
+            key = p.get("idem")
+            if key:
+                lock, m = _idem_map(engine)
+                with lock:              # atomic check-and-queue
+                    if key in m:
+                        req = m[key]
+                        _count_dedup("SUBMIT")
+                        tail = " R" if getattr(req, "spill", None) \
+                            is not None else ""
+                        return f"ID {req.id} {req.trace_id}{tail}"
+                    req = _submit_from_payload(engine, p)
+                    if req.status != "rejected":
+                        m[key] = req
+                        _prune_idem_map(m)
+            else:
+                req = _submit_from_payload(engine, p)
             if req.status == "rejected":
                 return f"ERR rejected: {req.error}"
             engine._requests_by_id[req.id] = req
             _prune_request_map(engine._requests_by_id)
             # id + trace_id: the trace id keys the request's Perfetto
-            # track and the RESULT timing breakdown (docs/SERVING.md)
-            return f"ID {req.id} {req.trace_id}"
+            # track and the RESULT timing breakdown (docs/SERVING.md);
+            # the trailing R acknowledges an accepted KV resume
+            tail = " R" if p.get("resume") is not None \
+                and req.spill is not None else ""
+            return f"ID {req.id} {req.trace_id}{tail}"
         if cmd == "RESULT":
             req = engine._requests_by_id.get(int(args[0]))
             if req is None:
@@ -143,11 +218,96 @@ def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
                 return "PEND"
             engine._requests_by_id.pop(req.id, None)
             return f"VAL {encode_payload(r)}"
-        # GENERATE: blocking submit + wait (the engine loop must be
-        # running — ServingServer.start does that)
-        req = submit_payload(engine, args[0])
-        r = req.result() if req.status == "rejected" \
-            else engine.result(req, timeout=None)
-        return f"VAL {encode_payload(r)}"
+        if cmd == "GENERATE":
+            # blocking submit + wait (the engine loop must be running —
+            # ServingServer.start does that)
+            p = decode_payload(args[0])
+            key = p.get("idem")
+            if key:
+                lock, m = _idem_map(engine)
+                with lock:              # atomic check-and-queue
+                    if key in m:
+                        _count_dedup("GENERATE")
+                        req = m[key]
+                    else:
+                        req = _submit_from_payload(engine, p)
+                        if req.status != "rejected":
+                            m[key] = req
+                            _prune_idem_map(m)
+            else:
+                req = _submit_from_payload(engine, p)
+            r = req.result() if req.status == "rejected" \
+                else engine.result(req, timeout=None)
+            return f"VAL {encode_payload(r)}"
+        return _handle_engine_command(engine, cmd, args)
     except Exception as e:                        # noqa: BLE001
         return f"ERR {type(e).__name__}: {e}"
+
+
+def _handle_engine_command(engine, cmd: str, args: list) -> str:
+    """The engine-process verbs behind the fleet's RemoteEngineProxy
+    (ESTATUS/CANCELQ/EVICT/PREFILL/SWAPWEIGHTS/STOPENGINE). Duck-typed
+    defensively: a Router front door answers ESTATUS with what it has
+    and refuses the engine-only verbs loudly."""
+    from hetu_tpu.serving.fleet import spill_to_wire
+    if cmd == "ESTATUS":
+        doc = {"load": getattr(engine, "load", 0),
+               "weight_version": getattr(engine, "weight_version", 0),
+               "has_work": engine.has_work()
+               if hasattr(engine, "has_work") else False}
+        sched = getattr(engine, "scheduler", None)
+        doc["depth"] = getattr(sched, "depth", 0) if sched else 0
+        doc["occupancy"] = round(getattr(sched, "occupancy", 0.0), 4) \
+            if sched else 0.0
+        return f"VAL {encode_payload(doc)}"
+    if cmd == "STOPENGINE":
+        engine.stop()
+        return "OK"
+    if cmd == "CANCELQ":
+        if not hasattr(engine, "cancel_queued"):
+            return "ERR not an engine"
+        p = decode_payload(args[0])
+        moved = engine.cancel_queued({int(i) for i in p["ids"]})
+        out = []
+        for r in moved:
+            engine._requests_by_id.pop(r.id, None)
+            r.status = "cancelled"
+            out.append({"id": r.id,
+                        "spill": spill_to_wire(r.spill)
+                        if r.spill is not None else None})
+        return f"VAL {encode_payload({'cancelled': out})}"
+    if cmd == "EVICT":
+        p = decode_payload(args[0])
+        req = engine._requests_by_id.get(int(p["id"]))
+        if req is None:
+            return "ERR unknown request id"
+        entry = engine.evict_request(
+            req, lock_timeout_s=p.get("lock_timeout_s"))
+        if req.status == "evicted":
+            engine._requests_by_id.pop(req.id, None)
+        return f"VAL {encode_payload({'status': req.status, 'spill': spill_to_wire(entry) if entry is not None else None})}"
+    if cmd == "PREFILL":
+        if not hasattr(engine, "prefill_only"):
+            return "ERR not an engine"
+        p = decode_payload(args[0])
+        req, entry = engine.prefill_only(p["prompt"],
+                                         sampling_from_payload(p))
+        if req.status == "rejected":
+            return f"ERR rejected: {req.error}"
+        if entry is None:
+            return f"VAL {encode_payload({'done': True, 'id': req.id, 'trace_id': req.trace_id, 'result': req.result()})}"
+        doc = {"done": False, "id": req.id, "trace_id": req.trace_id,
+               "tokens": [int(t) for t in req.tokens],
+               "weight_version": req.weight_version,
+               "spill": spill_to_wire(entry)}
+        return f"VAL {encode_payload(doc)}"
+    if cmd == "SWAPWEIGHTS":
+        p = decode_payload(args[0])
+        from hetu_tpu.utils.dist_checkpoint import (
+            load_params_distributed,
+        )
+        params = load_params_distributed(p["path"], engine.model,
+                                         plan=engine._plan)
+        info = engine.swap_params(params, version=p.get("version"))
+        return f"VAL {encode_payload(info)}"
+    return "ERR unknown command"

@@ -537,6 +537,92 @@ _HEALTH_SERIES = (
 )
 
 
+#: fleet-plane series (serving/router.py + serving/fleet.py): dispatch
+#: spread, requeues (and their remote/multi-process slice), P/D
+#: handoffs with the KV blocks they streamed, weight pushes by
+#: transport, and remote-replica heartbeat ages — the direct evidence
+#: a disaggregated fleet is balanced, resuming instead of re-prefilling
+#: and detecting dead processes (docs/SERVING.md "Disaggregated fleet").
+_FLEET_PLANE_SERIES = (
+    "router_requests_total", "router_requeues_total",
+    "router_resumed_requeues_total", "fleet_remote_requeues_total",
+    "fleet_pd_handoffs_total", "fleet_kv_stream_blocks_total",
+    "weight_pushes_total", "weight_push_bytes_total",
+    "router_replicas_live", "fleet_replica_beat_age_seconds",
+    "serving_idem_dedup_total",
+)
+
+
+def fleet_plane_summary(records: list[dict]) -> Optional[list[str]]:
+    """Lines for the fleet-plane section, or None when no snapshot
+    carries router/fleet series. Reads the LAST snapshot (counters are
+    cumulative, gauges last-write-wins)."""
+    snap: Optional[dict] = None
+    for rec in records:
+        cand = rec.get("metrics") if rec.get("kind") == "metrics_snapshot" \
+            else rec.get("telemetry")
+        if isinstance(cand, dict) and any(
+                k.split("{")[0] in _FLEET_PLANE_SERIES for k in cand):
+            snap = cand
+    if snap is None:
+        return None
+    by_label: dict[str, dict[str, float]] = {}
+    for series, v in snap.items():
+        base = series.split("{")[0]
+        if base not in _FLEET_PLANE_SERIES \
+                or not isinstance(v, (int, float)):
+            continue
+        label = series.split('="', 1)[1].split('"', 1)[0] \
+            if "{" in series else ""
+        by_label.setdefault(base, {})[label] = float(v)
+    lines = []
+    width = 18
+    disp = by_label.get("router_requests_total", {})
+    if disp:
+        total = sum(disp.values())
+        parts = " / ".join(f"{r or '?'}:{int(v)}"
+                           for r, v in sorted(disp.items()))
+        lines.append("dispatch".ljust(width)
+                     + f"{int(total)} ({parts})")
+    rq = sum(by_label.get("router_requeues_total", {}).values())
+    if rq:
+        remote = sum(by_label.get(
+            "fleet_remote_requeues_total", {}).values())
+        resumed = sum(by_label.get(
+            "router_resumed_requeues_total", {}).values())
+        lines.append("requeues".ljust(width)
+                     + f"{int(rq)} ({int(remote)} remote, "
+                     f"{int(resumed)} KV-resumed)")
+    pd = sum(by_label.get("fleet_pd_handoffs_total", {}).values())
+    if pd:
+        blocks = sum(by_label.get(
+            "fleet_kv_stream_blocks_total", {}).values())
+        lines.append("P/D handoffs".ljust(width)
+                     + f"{int(pd)} requests, {int(blocks)} KV blocks "
+                     f"streamed")
+    pushes = sum(by_label.get("weight_pushes_total", {}).values())
+    if pushes:
+        bt = by_label.get("weight_push_bytes_total", {})
+        parts = " / ".join(f"{t}:{v / 1e6:.1f}MB"
+                           for t, v in sorted(bt.items()))
+        lines.append("weight pushes".ljust(width)
+                     + f"{int(pushes)}" + (f"  ({parts})" if bt else ""))
+    dedup = sum(by_label.get("serving_idem_dedup_total", {}).values())
+    if dedup:
+        lines.append("idem dedups".ljust(width)
+                     + f"{int(dedup)} duplicate deliveries suppressed")
+    live = by_label.get("router_replicas_live", {})
+    if live:
+        line = f"{int(sum(live.values()))} live"
+        beats = by_label.get("fleet_replica_beat_age_seconds", {})
+        if beats:
+            worst = max(beats.items(), key=lambda kv: kv[1])
+            line += (f"  (stalest remote beat: {worst[0]} "
+                     f"{worst[1] * 1e3:.0f}ms)")
+        lines.append("replicas".ljust(width) + line)
+    return lines or None
+
+
 #: recovery-plane series (chaos harness + elastic supervisor +
 #: incremental checkpointing): the direct evidence the preemption plane
 #: detects kills, recovers fast, and that checkpoint cadence is no
@@ -718,6 +804,12 @@ def summarize(path: str, *, wall_s: Optional[float] = None,
         parts.append("")
         parts.append("== expert plane ==")
         parts.extend(xp)
+
+    fl = fleet_plane_summary(records)
+    if fl:
+        parts.append("")
+        parts.append("== fleet plane ==")
+        parts.extend(fl)
 
     rp = recovery_plane_summary(records)
     if rp:

@@ -190,16 +190,54 @@ def save_checkpoint_distributed(path: str, state: TrainState, *,
     ``TrainerConfig(delta_ckpt=True)`` does). Left off, non-delta users
     never pay the hashing on their (possibly synchronous) save path.
     """
-    if quantize not in (None, "int8"):
-        raise ValueError(f"quantize must be None or 'int8', got "
-                         f"{quantize!r}")
     flat = {_MODEL_PREFIX + k: v for k, v in _flatten(state.params).items()}
     opt_keys = {_OPT_PREFIX + k
                 for k in _flatten(state.opt_state)}
     flat.update({_OPT_PREFIX + k: v
                  for k, v in _flatten(state.opt_state).items()})
+    return _save_flat(path, flat, opt_keys=opt_keys,
+                      step=int(jax.device_get(state.step)),
+                      async_save=async_save, quantize=quantize,
+                      delta_base=delta_base, hash_pieces=hash_pieces,
+                      contents="state")
+
+
+def save_params_distributed(path: str, params, *, version: int,
+                            async_save: bool = False,
+                            quantize: Optional[str] = None,
+                            delta_base: Optional[str] = None,
+                            hash_pieces: Optional[bool] = None
+                            ) -> CheckpointWriter:
+    """Params-only sharded save — the fleet weight-push transport
+    (``WeightPublisher(transport="dist_ckpt")``).
+
+    Same machinery, format and crash-safety as
+    :func:`save_checkpoint_distributed` (step-stamped files, torn-save
+    detection, delta saves against ``delta_base`` — the previous
+    published version, so a fine-tune push writes only what changed);
+    ``version`` rides where a training save's ``step`` does, and the
+    meta marks ``contents: "params"`` so a full-state loader refuses it
+    loudly instead of missing optimizer tensors at load time. Load the
+    result with :func:`load_params_distributed`."""
+    flat = {_MODEL_PREFIX + k: v for k, v in _flatten(params).items()}
+    return _save_flat(path, flat, opt_keys=set(), step=int(version),
+                      async_save=async_save, quantize=quantize,
+                      delta_base=delta_base, hash_pieces=hash_pieces,
+                      contents="params")
+
+
+def _save_flat(path: str, flat: dict, *, opt_keys: set, step: int,
+               async_save: bool, quantize: Optional[str],
+               delta_base: Optional[str],
+               hash_pieces: Optional[bool],
+               contents: str) -> CheckpointWriter:
+    """The shared save core: snapshot this process's pieces of ``flat``
+    (the only blocking part), then tensor/index/meta write-then-rename
+    on the (possibly async) writer."""
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8', got "
+                         f"{quantize!r}")
     p = jax.process_index()
-    step = int(jax.device_get(state.step))
 
     # -- snapshot: the ONLY step-blocking part — device→host copies of
     # this process's pieces (a consistent point-in-time image the writer
@@ -325,7 +363,8 @@ def save_checkpoint_distributed(path: str, state: TrainState, *,
             with open(tmp, "w") as f:
                 json.dump({"step": step, "format_version": 2,
                            "framework": "hetu_tpu",
-                           "layout": "sharded"}, f)
+                           "layout": "sharded",
+                           "contents": contents}, f)
             os.replace(tmp, os.path.join(path, _META_FILE))
         # GC this host's stamped files no longer referenced by the NEW
         # index — but keep everything the PREVIOUS index needed, so the
@@ -498,9 +537,36 @@ def load_checkpoint_distributed(path: str, model, opt, plan=None
         raise FileNotFoundError(
             f"{path} is not a sharded checkpoint (layout="
             f"{meta.get('layout')!r}) — use utils.checkpoint.load_checkpoint")
+    if meta.get("contents", "state") != "state":
+        raise ValueError(
+            f"{path} holds {meta['contents']!r} only (a weight-push "
+            f"artifact) — load it with load_params_distributed")
     reader = _PieceReader(path, expected_step=meta["step"])
     try:
         return _load_with_reader(reader, meta, model, opt, plan)
+    finally:
+        reader.close()
+
+
+def load_params_distributed(path: str, model, plan=None):
+    """Load a params pytree from a sharded save — full-state
+    checkpoints and :func:`save_params_distributed` artifacts both
+    work (only the model prefix is read). Each destination device
+    shard reads only its overlapping byte ranges, exactly like the
+    full-state loader; this is the replica-side leg of the
+    ``dist_ckpt`` fleet weight-push transport."""
+    with open(os.path.join(path, _META_FILE)) as f:
+        meta = json.load(f)
+    if meta.get("layout") != "sharded":
+        raise FileNotFoundError(
+            f"{path} is not a sharded checkpoint (layout="
+            f"{meta.get('layout')!r})")
+    reader = _PieceReader(path, expected_step=meta["step"])
+    try:
+        shardings = plan.state_shardings.params \
+            if plan is not None else None
+        return _build_tree(reader, _MODEL_PREFIX,
+                           model.abstract_params(), shardings)
     finally:
         reader.close()
 
@@ -518,38 +584,41 @@ def checkpoint_step(path: str) -> Optional[int]:
     return int(meta.get("step", 0))
 
 
+def _build_tree(reader, prefix, template, shardings):
+    """Assemble one pytree from the piece index: sharded leaves via
+    ``jax.make_array_from_callback`` (each shard reads only its
+    overlapping byte ranges), unsharded leaves as full host arrays."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    shard_leaves = None
+    if shardings is not None:
+        shard_leaves = jax.tree_util.tree_flatten(shardings)[0]
+    leaves = []
+    for i, (kpath, tmpl) in enumerate(paths):
+        key = prefix + ".".join(_key_str(k) for k in kpath)
+        shape, dtype = tuple(tmpl.shape), tmpl.dtype
+        if tuple(reader.global_shape(key)) != shape:
+            raise ValueError(
+                f"{key}: checkpoint shape {reader.global_shape(key)} "
+                f"!= expected {shape}")
+        if shard_leaves is not None:
+            sharding = shard_leaves[i]
+            leaves.append(jax.make_array_from_callback(
+                shape, sharding,
+                lambda idx, key=key, shape=shape, dtype=dtype:
+                    reader.read(key, idx, shape, dtype)))
+        else:
+            full = (slice(None),) * len(shape)
+            leaves.append(reader.read(key, full, shape, dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def _load_with_reader(reader, meta, model, opt, plan) -> TrainState:
     params_struct = model.abstract_params()
     opt_struct = jax.eval_shape(opt.init, params_struct)
-
-    def build(prefix, template, shardings):
-        paths, treedef = jax.tree_util.tree_flatten_with_path(template)
-        shard_leaves = None
-        if shardings is not None:
-            shard_leaves = jax.tree_util.tree_flatten(shardings)[0]
-        leaves = []
-        for i, (kpath, tmpl) in enumerate(paths):
-            key = prefix + ".".join(_key_str(k) for k in kpath)
-            shape, dtype = tuple(tmpl.shape), tmpl.dtype
-            if tuple(reader.global_shape(key)) != shape:
-                raise ValueError(
-                    f"{key}: checkpoint shape {reader.global_shape(key)} "
-                    f"!= expected {shape}")
-            if shard_leaves is not None:
-                sharding = shard_leaves[i]
-                leaves.append(jax.make_array_from_callback(
-                    shape, sharding,
-                    lambda idx, key=key, shape=shape, dtype=dtype:
-                        reader.read(key, idx, shape, dtype)))
-            else:
-                full = (slice(None),) * len(shape)
-                leaves.append(reader.read(key, full, shape, dtype))
-        return jax.tree_util.tree_unflatten(treedef, leaves)
-
     p_sh = o_sh = None
     if plan is not None:
         p_sh = plan.state_shardings.params
         o_sh = plan.state_shardings.opt_state
-    params = build(_MODEL_PREFIX, params_struct, p_sh)
-    opt_state = build(_OPT_PREFIX, opt_struct, o_sh)
+    params = _build_tree(reader, _MODEL_PREFIX, params_struct, p_sh)
+    opt_state = _build_tree(reader, _OPT_PREFIX, opt_struct, o_sh)
     return TrainState(np.int32(meta["step"]), params, opt_state)

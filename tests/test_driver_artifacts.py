@@ -190,6 +190,13 @@ def test_bench_chaos_emits_json_contract():
     assert by["live_reshard_delta_async"]["checkpoint_s"] \
         < 0.8 * by["live_reshard"]["checkpoint_s"], by
     assert by["live_reshard_delta_async"]["ckpt_reused_bytes"] > 0
+    # fleet soak (ISSUE 15): periodic ChaosMonkey SIGKILLs against the
+    # MULTI-PROCESS serving fleet — zero lost/duplicated/corrupted
+    soak = rec["fleet_soak"]
+    assert soak["kills"] >= 1 and soak["submitted"] > 0
+    assert soak["lost"] == 0 and soak["corrupted"] == 0
+    assert soak["completed"] == soak["submitted"]
+    assert set(soak["dead"]) <= {"r1", "r2"}     # r0 always survives
     with open(os.path.join(_ROOT, "BENCH_chaos.json")) as f:
         assert json.load(f) == rec
 
@@ -254,6 +261,39 @@ def test_bench_kernels_emits_json_contract():
     for k in ("fp32_ms", "w8a16_ms", "w8a8_ms"):
         assert rec["w8a8"][k] > 0
     with open(os.path.join(_ROOT, "BENCH_kernels.json")) as f:
+        assert json.load(f) == rec
+
+
+@pytest.mark.slow
+def test_bench_fleet_emits_json_contract():
+    """SATELLITE (ISSUE 15): ``python bench.py --fleet`` must exit 0
+    and write BENCH_fleet.json: in-process vs multi-process dispatch
+    overhead (all requests completing through the coordinator verbs)
+    and the colocated vs P/D-split comparison with KV blocks actually
+    streamed prefill→decode."""
+    env = dict(os.environ)
+    env["HETU_TPU_BENCH_PLATFORM"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--fleet"],
+        capture_output=True, text=True, timeout=580, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "in_process",
+                "multi_process", "pd"):
+        assert key in rec, (key, rec)
+    offered = rec["offered"]
+    # every lane completed its whole offered load — the transport works
+    assert rec["in_process"]["completed"] == offered
+    assert rec["multi_process"]["completed"] == offered
+    assert rec["pd"]["colocated"]["completed"] == offered
+    assert rec["pd"]["split"]["completed"] == offered
+    # the split lane really streamed KV (one handoff per request)
+    assert rec["pd"]["split"]["pd_handoffs"] >= offered
+    assert rec["pd"]["split"]["kv_stream_blocks"] >= offered
+    for lane in (rec["in_process"], rec["multi_process"],
+                 rec["pd"]["colocated"], rec["pd"]["split"]):
+        assert lane["total_ms_p50"] > 0
+    with open(os.path.join(_ROOT, "BENCH_fleet.json")) as f:
         assert json.load(f) == rec
 
 

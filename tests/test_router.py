@@ -376,7 +376,10 @@ class _SilentServer:
 def test_client_bounded_retry_and_timeout():
     """SATELLITE: serving verbs time out + retry with backoff instead
     of blocking forever on a dead socket — bounded wall clock, bounded
-    attempts, and SUBMIT (non-idempotent) never retries a timeout."""
+    attempts. Since ISSUE 15 SUBMIT carries an idempotency key the
+    server dedups on, so it retries response timeouts like any
+    idempotent verb (the old one-delivery carve-out is gone) — while
+    keyless engine verbs (EVICT) keep at-most-once delivery."""
     from hetu_tpu.rpc.client import CoordinatorClient
 
     srv = _SilentServer()
@@ -393,9 +396,16 @@ def test_client_bounded_retry_and_timeout():
         before = srv.connections
         with pytest.raises((TimeoutError, OSError)):
             cli.serving_submit([1, 2, 3], max_tokens=2)
-        # non-idempotent: ONE delivery attempt, no blind resubmit (the
-        # single new connection is the reconnect after the previous
-        # failure dropped the poisoned socket — not a retry)
+        # idempotency-keyed: the timeout IS retried now (bounded) — a
+        # duplicate delivery would join the original request
+        # server-side, so resubmission is safe
+        assert before + 2 <= srv.connections <= before + 1 + 2
+        before = srv.connections
+        with pytest.raises((TimeoutError, OSError)):
+            cli.serving_evict(0)
+        # keyless engine verb: ONE delivery attempt (the single new
+        # connection is the reconnect after the previous failure
+        # dropped the poisoned socket — not a retry)
         assert srv.connections == before + 1
         cli.close()
     finally:

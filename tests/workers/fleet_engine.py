@@ -1,0 +1,27 @@
+"""Engine builders for the multi-process fleet tests (ISSUE 15).
+
+Imported INSIDE each spawned engine process via
+``HETU_ENGINE_SPEC="fleet_engine:build_engine"`` (the launcher puts
+this directory on the child's PYTHONPATH). Deterministic by
+construction: every process inits the same tiny GPT from the same PRNG
+key, so the parent's one-shot ``generate`` reference is bit-exact
+against any replica — the fleet acceptance bar.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.serving import ServingEngine
+
+MAX_LEN = 32
+CHUNK = 8
+SLOTS = 2
+
+
+def build_engine(i: int) -> ServingEngine:
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.key(0), dtype=jnp.float32)
+    return ServingEngine(model, params, slots=SLOTS, max_len=MAX_LEN,
+                         prefill_chunk=CHUNK)

@@ -1,0 +1,28 @@
+"""Engine builder for multi-process fleet benches (ISSUE 15).
+
+``bench.py --fleet`` and the ``--chaos`` fleet-soak lane spawn engine
+processes with ``HETU_ENGINE_SPEC="workloads.fleet_replica:
+build_engine"`` — every process inits the same tiny GPT from the same
+PRNG key, so the parent's one-shot ``generate`` is a bit-exact oracle
+for any replica's greedy output. Shape knobs ride env vars so the
+bench can size the smoke without a second spec module.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.serving import ServingEngine
+
+
+def build_engine(i: int) -> ServingEngine:
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.key(0), dtype=jnp.float32)
+    return ServingEngine(
+        model, params,
+        slots=int(os.environ.get("HETU_FLEET_SLOTS", "4")),
+        max_len=int(os.environ.get("HETU_FLEET_MAX_LEN", "64")),
+        prefill_chunk=int(os.environ.get("HETU_FLEET_CHUNK", "16")))

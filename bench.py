@@ -1206,6 +1206,23 @@ def fleet_main():
         return ServingEngine(model, params, slots=slots,
                              max_len=max_len, prefill_chunk=chunk)
 
+    def _rpc_usage():
+        """Client-side wire counters (ISSUE 16): per-verb round-trip
+        summaries + the RESULT empty-poll count. Snapshotted around the
+        remote lane so BENCH_fleet.json records the measured
+        transport-vs-compute split, not a guess."""
+        snap = telemetry.get_registry().snapshot()
+        verbs = {}
+        for series, s in snap.items():
+            if series.startswith("rpc_client_verb_ms{") \
+                    and isinstance(s, dict):
+                verb = series.split('verb="', 1)[1].split('"', 1)[0]
+                verbs[verb] = {"count": int(s["count"]),
+                               "ms_total": round(float(s["sum"]), 2),
+                               "ms_p50": round(float(s["p50"]), 3)}
+        return verbs, float(snap.get("router_result_poll_empty_total",
+                                     0.0))
+
     # -- (1) in-process vs multi-process dispatch overhead
     fleet = launch_serving_fleet(mk_engine, 2, poll_s=0.002)
     local = run_through(fleet.router)
@@ -1218,9 +1235,34 @@ def fleet_main():
              "HETU_FLEET_MAX_LEN": str(max_len),
              "HETU_FLEET_CHUNK": str(chunk)},
         beat_timeout_s=5.0, poll_s=0.002)
+    rpc_before, polls_before = _rpc_usage()
     remote = run_through(fleet.router)
+    rpc_after, polls_after = _rpc_usage()
     fleet.stop()
     overhead = round(remote["total_ms_p50"] - local["total_ms_p50"], 2)
+
+    rpc_verbs = {}
+    for verb, after in sorted(rpc_after.items()):
+        before = rpc_before.get(verb, {"count": 0, "ms_total": 0.0})
+        n = after["count"] - before["count"]
+        if n <= 0:
+            continue
+        # p50 comes from the whole-run reservoir (percentiles do not
+        # delta); counts and totals are exact lane deltas
+        rpc_verbs[verb] = {
+            "count": n,
+            "ms_total": round(after["ms_total"] - before["ms_total"], 2),
+            "ms_p50": after["ms_p50"]}
+    empty = int(polls_after - polls_before)
+    result_polls = rpc_verbs.get("RESULT", {}).get("count", 0)
+    remote["rpc"] = {
+        "verbs": rpc_verbs,
+        "client_verb_ms_total": round(
+            sum(v["ms_total"] for v in rpc_verbs.values()), 2),
+        "empty_polls": empty,
+        "empty_poll_fraction": round(empty / result_polls, 4)
+        if result_polls else None,
+    }
 
     # -- (2) colocated vs P/D split at the same offered load
     fleet = launch_serving_fleet(mk_engine, 2, poll_s=0.002)

@@ -125,7 +125,8 @@ def chaos_point(name: str, **fields) -> None:
         _fired.append({"point": name, "hit": spec["hits"], **fields})
     # outside the lock: the flight record and the kill must not deadlock
     # a recorder used by other threads
-    flight_record("chaos_kill", target=name, action=action, **fields)
+    flight_record("chaos_kill", target=name, action=action,
+                  **_with_trace(fields))
     _count_kill(name)
     get_logger().warning(f"chaos: firing {action} at point {name!r}")
     if action == "sigkill":
@@ -138,6 +139,19 @@ def chaos_point(name: str, **fields) -> None:
             pass
         os.kill(os.getpid(), signal.SIGKILL)
     raise ChaosError(f"chaos point {name!r} fired")
+
+
+def _with_trace(fields: dict) -> dict:
+    """Stamp the process's active trace context (a fleet dispatch or a
+    weight push in flight) into a chaos event's fields, so
+    ``tools/fleet_trace.py`` can pin a latency spike on the kill that
+    caused it (ISSUE 16). No-op when no trace is active or the caller
+    already set one."""
+    if "trace" in fields:
+        return fields
+    from hetu_tpu.telemetry.tracecontext import current_traceparent
+    tp = current_traceparent()
+    return dict(fields, trace=tp) if tp else fields
 
 
 def _count_kill(target: str) -> None:
@@ -210,6 +224,7 @@ class ChaosMonkey:
         if name is None:
             name = self._rng.choice(sorted(self.targets))
         kill_fn = self.targets[name]
+        fields = _with_trace(fields)
         flight_record("chaos_kill", target=name, action="kill", **fields)
         _count_kill(name)
         self.kills.append({"target": name, "ts": time.time(), **fields})

@@ -17,6 +17,32 @@ import uuid
 from typing import Any, Optional
 
 
+def _rpc_registry():
+    """The process-global metric registry, lazily imported: this module
+    must stay importable without the rest of the framework, and the
+    disabled-registry fast path keeps the per-verb cost near zero."""
+    from hetu_tpu import telemetry
+    return telemetry.get_registry()
+
+
+def _rpc_observe(verb: str, dur_ms: float, tx: int, rx: int) -> None:
+    """Client-end wire instrumentation (ISSUE 16): per-verb latency +
+    payload bytes. ``dir`` uses tx/rx on the client (the server uses
+    in/out), so a test process hosting both ends keeps the series
+    distinct."""
+    reg = _rpc_registry()
+    reg.histogram(
+        "rpc_client_verb_ms",
+        "client-side wall ms per line-protocol verb (send + reply, "
+        "including retries and backoff)").observe(dur_ms, verb=verb)
+    c = reg.counter(
+        "rpc_payload_bytes_total",
+        "line-protocol bytes by verb and direction (client: tx/rx, "
+        "server: in/out)")
+    c.inc(tx, verb=verb, dir="tx")
+    c.inc(rx, verb=verb, dir="rx")
+
+
 class CoordinatorClient:
     """Line-protocol client.
 
@@ -99,6 +125,8 @@ class CoordinatorClient:
         yet) are retried. Idempotent verbs retry through a fresh socket
         regardless. Every raise path drops the connection so a late
         response can never poison the next command."""
+        verb = line.split(" ", 1)[0]
+        t0 = time.perf_counter()
         attempt = 0
         while True:
             sent = False
@@ -106,13 +134,22 @@ class CoordinatorClient:
                 if self._sock is None:       # prior reconnect failed
                     self._connect()
                 sent = True        # past here the line may be delivered
-                return self._cmd(line)
+                resp = self._cmd(line)
+                _rpc_observe(verb,
+                             (time.perf_counter() - t0) * 1e3,
+                             tx=len(line) + 1, rx=len(resp) + 1)
+                return resp
             except (TimeoutError, ConnectionError, OSError):
                 attempt += 1
                 if attempt > self._retries \
                         or (sent and not idempotent):
                     self._drop_sock()
                     raise
+                _rpc_registry().counter(
+                    "rpc_retries_total",
+                    "line-protocol retry attempts by verb (transport "
+                    "failures that reconnected and retried)").inc(
+                    verb=verb)
                 delay = min(self._backoff_max_s,
                             self._backoff_s * (2 ** (attempt - 1)))
                 time.sleep(delay * (0.5 + random.random()))  # jitter
@@ -179,16 +216,21 @@ class CoordinatorClient:
     def serving_submit_info(self, prompt, *,
                             idem_key: Optional[str] = None,
                             resume: Optional[dict] = None,
+                            traceparent: Optional[str] = None,
                             **sampling) -> dict:
         """:meth:`serving_submit` returning the full handshake:
         ``{"id", "trace_id", "resumed"}``. ``resume`` attaches a
         wire-format KV spill (``serving.fleet.spill_to_wire``) — the
         fleet proxy's resumable requeue; ``resumed`` reports whether
-        the engine accepted it (layout + weight version compatible)."""
+        the engine accepted it (layout + weight version compatible).
+        ``traceparent`` propagates the caller's trace context so the
+        remote request joins the fleet trace (ISSUE 16)."""
         payload = dict(sampling)
         payload["idem"] = idem_key or uuid.uuid4().hex
         if resume is not None:
             payload["resume"] = resume
+        if traceparent:
+            payload["traceparent"] = traceparent
         resp = self._cmd_retry(
             f"SUBMIT {self._serving_payload(prompt, **payload)}")
         if not resp.startswith("ID "):
@@ -212,6 +254,7 @@ class CoordinatorClient:
 
     def serving_generate(self, prompt, *,
                          idem_key: Optional[str] = None,
+                         traceparent: Optional[str] = None,
                          **sampling) -> dict:
         """Blocking generate over the line protocol (engine loop must
         be running server-side, e.g. ``ServingServer.start()``).
@@ -220,6 +263,8 @@ class CoordinatorClient:
         twice."""
         payload = dict(sampling)
         payload["idem"] = idem_key or uuid.uuid4().hex
+        if traceparent:
+            payload["traceparent"] = traceparent
         resp = self._cmd_retry(
             f"GENERATE {self._serving_payload(prompt, **payload)}")
         if not resp.startswith("VAL "):
@@ -250,20 +295,29 @@ class CoordinatorClient:
         return self._val_verb(f"CANCELQ {enc}", idempotent=False)
 
     def serving_evict(self, req_id: int,
-                      lock_timeout_s: Optional[float] = None) -> dict:
+                      lock_timeout_s: Optional[float] = None,
+                      traceparent: Optional[str] = None) -> dict:
         """Force one request out of the remote engine, salvaging its
-        resident KV: ``{"status", "spill": wire | None}``."""
+        resident KV: ``{"status", "spill": wire | None}``.
+        ``traceparent`` stamps the salvaged spill with the fleet trace
+        context when the remote request predates it."""
+        obj = {"id": int(req_id), "lock_timeout_s": lock_timeout_s}
+        if traceparent:
+            obj["traceparent"] = traceparent
         enc = urllib.parse.quote(json.dumps(
-            {"id": int(req_id), "lock_timeout_s": lock_timeout_s},
-            separators=(",", ":")), safe="")
+            obj, separators=(",", ":")), safe="")
         return self._val_verb(f"EVICT {enc}", idempotent=False)
 
-    def serving_prefill(self, prompt, **sampling) -> dict:
+    def serving_prefill(self, prompt, *,
+                        traceparent: Optional[str] = None,
+                        **sampling) -> dict:
         """Prefill-tier verb: admission + prefill on the remote engine,
         blocking until the KV is ready. Returns ``{"done": True,
         "result": ...}`` for requests that finished within their first
         token, else ``{"done": False, "id", "tokens", "spill": wire}``
         — the KV-block payload a decode replica resumes from."""
+        if traceparent:
+            sampling["traceparent"] = traceparent
         resp = self._cmd_retry(
             f"PREFILL {self._serving_payload(prompt, **sampling)}",
             idempotent=False)
@@ -271,13 +325,18 @@ class CoordinatorClient:
             raise RuntimeError(f"serving prefill failed: {resp}")
         return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
 
-    def serving_swap_weights(self, path: str, version: int) -> dict:
+    def serving_swap_weights(self, path: str, version: int,
+                             traceparent: Optional[str] = None) -> dict:
         """Remote leg of a dist-checkpoint weight push: the engine
         process loads ``path`` onto its own topology and swaps. NOT
-        retried on timeout — the load may already be in flight."""
+        retried on timeout — the load may already be in flight.
+        ``traceparent`` lets the push's trace context travel with the
+        swap so remote flight events correlate with it."""
+        obj = {"path": path, "version": int(version)}
+        if traceparent:
+            obj["traceparent"] = traceparent
         enc = urllib.parse.quote(json.dumps(
-            {"path": path, "version": int(version)},
-            separators=(",", ":")), safe="")
+            obj, separators=(",", ":")), safe="")
         return self._val_verb(f"SWAPWEIGHTS {enc}", idempotent=False)
 
     def serving_stop_engine(self) -> None:
@@ -307,6 +366,24 @@ class CoordinatorClient:
         resp = self._cmd_retry(f"RESUME {name}", idempotent=False)
         if resp != "OK":
             raise RuntimeError(f"fleet resume failed: {resp}")
+
+    def fleet_metrics_text(self) -> str:
+        """Federated Prometheus page from a Router front door: every
+        replica's series labeled ``replica="<name>"`` plus
+        pre-aggregated ``replica="_fleet"`` totals (ISSUE 16)."""
+        resp = self._cmd_retry("FLEETMETRICS")
+        if not resp.startswith("VAL "):
+            raise RuntimeError(f"fleet metrics failed: {resp}")
+        return urllib.parse.unquote(resp.split(" ", 1)[1])
+
+    def dump_obs(self) -> dict:
+        """The serving process's observability bundle (chrome trace +
+        flight ring + fleet identity) via the DUMPOBS verb — the wire
+        collection path of ``tools/fleet_trace.py``."""
+        resp = self._cmd_retry("DUMPOBS")
+        if not resp.startswith("VAL "):
+            raise RuntimeError(f"dump obs failed: {resp}")
+        return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
 
     # -- live observability (HEALTHZ / METRICS verbs) -----------------------
     def healthz(self) -> dict:

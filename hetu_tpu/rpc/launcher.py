@@ -461,6 +461,10 @@ def launch_serving_fleet(build_engine=None, n_replicas: int = 2, *,
                 "HETU_ENGINE_SPEC": engine_spec,
                 "HETU_REPLICA_INDEX": str(i),
                 "HETU_REPLICA_NAME": name,
+                # observability identity: flight-recorder dumps and
+                # DUMPOBS bundles are stamped with the replica's P/D
+                # role so obs_report/fleet_trace can group them
+                "HETU_REPLICA_ROLE": str(roles.get(name, "both")),
                 "HETU_ENGINE_PORT": str(eport),
                 # the engine ports must enforce the same token as the
                 # front door — an unauthenticated replica port would

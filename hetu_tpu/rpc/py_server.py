@@ -20,7 +20,28 @@ from typing import Optional
 _SERVING_VERBS = ("SUBMIT", "RESULT", "GENERATE",
                   "FLEET", "DRAIN", "RESUME",
                   "ESTATUS", "CANCELQ", "EVICT", "PREFILL",
-                  "SWAPWEIGHTS", "STOPENGINE")
+                  "SWAPWEIGHTS", "STOPENGINE",
+                  "DUMPOBS", "FLEETMETRICS")
+
+
+def _rpc_server_observe(verb: str, dur_ms: float,
+                        n_in: int, n_out: int) -> None:
+    """Server-end wire instrumentation (ISSUE 16): per-verb handling
+    latency + payload bytes. ``dir`` uses in/out here (the client uses
+    tx/rx) so both ends can share one registry in a single-process
+    test without colliding."""
+    from hetu_tpu import telemetry
+    reg = telemetry.get_registry()
+    reg.histogram(
+        "rpc_server_verb_ms",
+        "server-side handling ms per line-protocol verb (parse to "
+        "reply write)").observe(dur_ms, verb=verb)
+    c = reg.counter(
+        "rpc_payload_bytes_total",
+        "line-protocol bytes by verb and direction (client: tx/rx, "
+        "server: in/out)")
+    c.inc(n_in, verb=verb, dir="in")
+    c.inc(n_out, verb=verb, dir="out")
 
 
 class _State:
@@ -45,6 +66,10 @@ class _Handler(socketserver.StreamRequestHandler):
             if not parts:
                 continue
             cmd, args = parts[0], parts[1:]
+            # wire instrumentation window: _send() closes it (BARRIER
+            # deliberately includes its wait — that IS its wire cost)
+            self._verb, self._t0, self._rx_bytes = \
+                cmd, time.perf_counter(), len(line)
             # auth gate (same contract as coordinator.cpp): PING stays
             # open for liveness probes, everything else needs the token
             if token and cmd != "PING" and not authed:
@@ -129,6 +154,13 @@ class _Handler(socketserver.StreamRequestHandler):
                 doc = health_status(
                     serving=serving,
                     slo=getattr(serving, "slo", None))
+                if hasattr(serving, "fleet_healthz"):
+                    # Router front door: embed the federated rollup
+                    # that names the degraded replica (ISSUE 16)
+                    try:
+                        doc["fleet"] = serving.fleet_healthz()
+                    except Exception:       # noqa: BLE001
+                        pass
                 self._send("VAL " + urllib.parse.quote(
                     json.dumps(doc, separators=(",", ":")), safe=""))
             elif cmd == "METRICS":
@@ -152,6 +184,15 @@ class _Handler(socketserver.StreamRequestHandler):
     def _send(self, s: str):
         self.wfile.write((s + "\n").encode())
         self.wfile.flush()
+        verb = getattr(self, "_verb", None)
+        if verb is not None:
+            self._verb = None
+            try:
+                _rpc_server_observe(
+                    verb, (time.perf_counter() - self._t0) * 1e3,
+                    n_in=self._rx_bytes, n_out=len(s) + 1)
+            except Exception:               # noqa: BLE001
+                pass    # instrumentation must never break the protocol
 
 
 class PyCoordinatorServer:

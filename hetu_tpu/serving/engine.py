@@ -102,10 +102,7 @@ from hetu_tpu.serving.speculative import (
 )
 from hetu_tpu.telemetry.flight import HangWatchdog, flight_record
 from hetu_tpu.telemetry.slo import SLOEngine, default_serving_rules
-
-#: per-request Perfetto tracks: synthetic tids offset far above real
-#: thread ids so request timelines never collide with thread tracks
-REQ_TRACK_BASE = 1 << 40
+from hetu_tpu.telemetry.spans import REQ_TRACK_BASE  # noqa: F401 — re-export
 
 
 def sample_slots(logits, temperature, top_k, top_p, rng):
@@ -915,9 +912,20 @@ class ServingEngine:
         if not got:
             return None
         try:
-            return self._evict_request_steplocked(req)
+            entry = self._evict_request_steplocked(req)
         finally:
             self._step_lock.release()
+        if entry is not None and entry.traceparent is None:
+            # stamp the originating trace context onto the spill so the
+            # decode-tier resume joins the same fleet trace (ISSUE 16)
+            entry.traceparent = req.traceparent \
+                or telemetry.make_traceparent(req.trace_id)
+        if entry is not None and req.handoff:
+            # a parked (P/D handoff) request never reaches _finish in
+            # this process — emit its queued/prefill spans now so the
+            # prefill tier's fragment exists for fleet_trace to merge
+            self._emit_request_trace(req)
+        return entry
 
     def _evict_request_steplocked(self, req: Request
                                   ) -> Optional[SpillEntry]:
@@ -998,7 +1006,8 @@ class ServingEngine:
 
     def prefill_only(self, prompt: Sequence[int],
                      sampling: Optional[SamplingParams] = None, *,
-                     timeout_s: Optional[float] = None
+                     timeout_s: Optional[float] = None,
+                     traceparent: Optional[str] = None
                      ) -> tuple[Request, Optional[SpillEntry]]:
         """Prefill-tier entry point (P/D disaggregation): admit
         ``prompt``, run its prefill (packed or CP lane) through the
@@ -1012,7 +1021,8 @@ class ServingEngine:
 
         Works both driven (no background loop: iterations run here)
         and with :meth:`start` running (this just waits)."""
-        req = self.submit(prompt, sampling, handoff=True)
+        req = self.submit(prompt, sampling, handoff=True,
+                          traceparent=traceparent)
         if req.status == "rejected":
             return req, None
         deadline = None if timeout_s is None \
@@ -1034,7 +1044,8 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int],
                sampling: Optional[SamplingParams] = None, *,
                resume: Optional[SpillEntry] = None,
-               handoff: bool = False) -> Request:
+               handoff: bool = False,
+               traceparent: Optional[str] = None) -> Request:
         """Queue one request (deficit-selected by its priority class;
         pure FCFS when every request shares one class). Returns the
         live Request — poll ``req.done`` / :meth:`result`, or drive
@@ -1061,12 +1072,22 @@ class ServingEngine:
                 "handoff with resume makes no sense: a resumed "
                 "request's KV already exists — submit it to the "
                 "decode tier directly")
+        # adopt the wire trace context: an explicit traceparent wins,
+        # else the spill's (a decode-tier resume inherits the trace the
+        # prefill tier stamped into the KV stream) — ISSUE 16
+        tp = traceparent or (resume.traceparent
+                             if resume is not None else None)
         with self._lock:
             req = Request(id=self._next_id,
                           prompt=np.asarray(prompt, np.int32).ravel(),
                           sampling=sampling, submit_s=time.monotonic(),
                           handoff=bool(handoff))
             self._next_id += 1
+            if tp:
+                tid, _span = telemetry.parse_traceparent(tp)
+                if tid:
+                    req.trace_id = tid
+                    req.traceparent = tp
             if resume is not None and resume.compatible_with(
                     self.pool, self.weight_version):
                 req.spill = resume

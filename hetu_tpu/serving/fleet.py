@@ -59,6 +59,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from hetu_tpu import telemetry
 from hetu_tpu.serving.kv_pool import SpillEntry
 from hetu_tpu.serving.router import ReplicaHandle
 from hetu_tpu.serving.scheduler import SamplingParams
@@ -86,13 +87,16 @@ def spill_to_wire(entry: SpillEntry) -> dict:
     """Serialize a SpillEntry for the line protocol — the payload that
     moves KV blocks replica→replica through the coordinator (preemptive
     drains, kill salvage, prefill→decode streaming)."""
-    return {"req_id": entry.req_id,
-            "n_blocks": entry.n_blocks,
-            "block_size": entry.block_size,
-            "pos": entry.pos, "last_tok": entry.last_tok,
-            "tokens": [int(t) for t in entry.tokens],
-            "weight_version": entry.weight_version,
-            "data": [array_to_wire(a) for a in entry.data]}
+    d = {"req_id": entry.req_id,
+         "n_blocks": entry.n_blocks,
+         "block_size": entry.block_size,
+         "pos": entry.pos, "last_tok": entry.last_tok,
+         "tokens": [int(t) for t in entry.tokens],
+         "weight_version": entry.weight_version,
+         "data": [array_to_wire(a) for a in entry.data]}
+    if entry.traceparent:
+        d["traceparent"] = entry.traceparent
+    return d
 
 
 def spill_from_wire(d: dict) -> SpillEntry:
@@ -102,7 +106,8 @@ def spill_from_wire(d: dict) -> SpillEntry:
         n_blocks=int(d["n_blocks"]), block_size=int(d["block_size"]),
         pos=int(d["pos"]), last_tok=int(d["last_tok"]),
         tokens=[int(t) for t in d["tokens"]],
-        weight_version=int(d["weight_version"]))
+        weight_version=int(d["weight_version"]),
+        traceparent=d.get("traceparent"))
 
 
 # -- the remote request -------------------------------------------------------
@@ -119,7 +124,8 @@ class RemoteRequest:
     fills it in from RESULT payloads."""
 
     def __init__(self, prompt, sampling: SamplingParams, *,
-                 handoff: bool = False):
+                 handoff: bool = False,
+                 traceparent: Optional[str] = None):
         self.id: int = _next_provisional_id()
         self.prompt = [int(t) for t in prompt]
         self.sampling = sampling
@@ -130,7 +136,9 @@ class RemoteRequest:
         self.weight_version: int = 0
         self.first_token_s: Optional[float] = None
         self.finish_s: Optional[float] = None
-        self.trace_id = uuid.uuid4().hex[:12]
+        self.traceparent = traceparent
+        tid, _span = telemetry.parse_traceparent(traceparent)
+        self.trace_id = tid or uuid.uuid4().hex[:12]
         self.handoff = bool(handoff)
         self.spill: Optional[SpillEntry] = None
         self.done = threading.Event()
@@ -218,6 +226,10 @@ class RemoteEngineProxy:
         self._cli = None
         self._pending: dict[int, RemoteRequest] = {}
         self._status: dict = {}
+        #: wall-clock offset of the replica vs this process (replica
+        #: clock = ours + offset), from the latest ESTATUS handshake;
+        #: fleet_trace.py uses it to align merged spans
+        self.clock_offset_s: float = 0.0
         self._handle: Optional[ReplicaHandle] = None   # beat sink
         self._stop = None            # duck parity with ServingEngine
         self._thread: Optional[threading.Thread] = None
@@ -275,9 +287,13 @@ class RemoteEngineProxy:
     def submit(self, prompt: Sequence[int],
                sampling: Optional[SamplingParams] = None, *,
                resume: Optional[SpillEntry] = None,
-               handoff: bool = False) -> RemoteRequest:
+               handoff: bool = False,
+               traceparent: Optional[str] = None) -> RemoteRequest:
         sampling = sampling or SamplingParams()
-        rr = RemoteRequest(prompt, sampling, handoff=handoff)
+        if traceparent is None and resume is not None:
+            traceparent = resume.traceparent
+        rr = RemoteRequest(prompt, sampling, handoff=handoff,
+                           traceparent=traceparent)
         if handoff:
             # PREFILL blocks server-side until the KV is ready — run it
             # on its own connection + thread so dispatch stays snappy
@@ -290,6 +306,7 @@ class RemoteEngineProxy:
                 doc = self._client().serving_submit_info(
                     rr.prompt, resume=spill_to_wire(resume)
                     if resume is not None else None,
+                    traceparent=rr.traceparent,
                     **_sampling_kw(sampling))
         except Exception as e:                        # noqa: BLE001
             if _is_rejection(e):
@@ -319,6 +336,7 @@ class RemoteEngineProxy:
                                timeout=self._swap_timeout_s)
             try:
                 doc = cli.serving_prefill(rr.prompt,
+                                          traceparent=rr.traceparent,
                                           **_sampling_kw(rr.sampling))
             finally:
                 cli.close()
@@ -379,7 +397,9 @@ class RemoteEngineProxy:
         try:
             with self._lock:
                 doc = self._client().serving_evict(
-                    req.id, lock_timeout_s=lock_timeout_s)
+                    req.id, lock_timeout_s=lock_timeout_s,
+                    traceparent=getattr(req, "traceparent", None)
+                    or telemetry.make_traceparent(req.trace_id))
         except Exception:                             # noqa: BLE001
             self._drop_client()
             return None                # salvage is best-effort
@@ -397,7 +417,29 @@ class RemoteEngineProxy:
         large load must not block status polls."""
         cli = self._client(fresh=True, timeout=self._swap_timeout_s)
         try:
-            return cli.serving_swap_weights(path, version)
+            return cli.serving_swap_weights(
+                path, version,
+                traceparent=telemetry.current_traceparent())
+        finally:
+            cli.close()
+
+    # -- federation scrape (Router._tick → FLEETMETRICS/fleet HEALTHZ) -------
+    def metrics_text(self) -> str:
+        """This replica's Prometheus exposition page."""
+        with self._lock:
+            return self._client().metrics_text()
+
+    def healthz(self) -> dict:
+        with self._lock:
+            return self._client().healthz()
+
+    def dump_obs(self) -> dict:
+        """The replica's DUMPOBS bundle (chrome trace + flight ring) —
+        what ``tools/fleet_trace.py`` collects for the merge. Fresh
+        connection: a big trace dump must not starve status polls."""
+        cli = self._client(fresh=True, timeout=self._swap_timeout_s)
+        try:
+            return cli.dump_obs()
         finally:
             cli.close()
 
@@ -436,11 +478,29 @@ class RemoteEngineProxy:
     def _poll_once(self) -> bool:
         try:
             with self._lock:
+                t0 = time.time()
                 self._status = self._client().serving_estatus()
+                t1 = time.time()
         except Exception:                             # noqa: BLE001
             self._drop_client()
             self._mark_suspect()
             return False               # no beat: staleness accumulates
+        srv_ts = self._status.get("ts_unix")
+        if srv_ts is not None:
+            # NTP-style offset handshake (ISSUE 16): the replica
+            # stamped its wall clock mid-RTT, so its offset from ours
+            # is its stamp minus the RTT midpoint. Re-measured on every
+            # poll — the merge tool reads the freshest value and the
+            # skew gauge lets an operator spot a drifting host.
+            off = float(srv_ts) - 0.5 * (t0 + t1)
+            self.clock_offset_s = off
+            name = self._handle.name if self._handle is not None \
+                else f":{self.port}"
+            telemetry.get_registry().gauge(
+                "fleet_clock_skew_seconds",
+                "per-replica wall-clock offset vs this process, "
+                "measured at each status poll (replica label)").set(
+                round(off, 6), replica=name)
         if self._handle is not None:
             self._handle.last_beat = time.monotonic()
         for rid, rr in list(self._pending.items()):
@@ -456,6 +516,13 @@ class RemoteEngineProxy:
                 self._drop_client()
                 return False
             if doc is None:
+                # the poll cycle burned a RESULT round trip for nothing
+                # — the empty-poll fraction is the case for streaming
+                # RESULT (ROADMAP); bench.py --fleet records it
+                telemetry.get_registry().counter(
+                    "router_result_poll_empty_total",
+                    "RESULT polls that returned PEND (wasted round "
+                    "trips — the streaming-RESULT motivation)").inc()
                 continue
             rr._fill_from(doc)
             self._pending.pop(rid, None)
@@ -510,6 +577,8 @@ class RemoteReplicaHandle(ReplicaHandle):
         doc["beat_age_s"] = round(
             time.monotonic() - self.last_beat, 3) \
             if self.last_beat is not None else None
+        doc["clock_offset_s"] = round(
+            getattr(self.engine, "clock_offset_s", 0.0), 6)
         return doc
 
 
@@ -528,8 +597,12 @@ def replica_main() -> int:
       analogue of the launcher's ``build_engine(i)``)
     - ``HETU_REPLICA_INDEX`` — this replica's index (default 0)
     - ``HETU_REPLICA_NAME``  — this replica's fleet name
+    - ``HETU_REPLICA_ROLE``  — ``prefill``/``decode``/``both``
+      (observability identity only — the router owns actual placement)
     - ``HETU_ENGINE_PORT``   — the line-protocol port to serve on
     - ``HETU_ENGINE_TOKEN``  — optional bearer token
+    - ``HETU_TELEMETRY``     — ``1`` turns the tracer/registry on, so
+      DUMPOBS bundles carry real spans for ``tools/fleet_trace.py``
 
     Serves until SIGTERM (clean launcher teardown); SIGKILL is the
     chaos path — the router's heartbeat staleness handles it.
@@ -543,6 +616,12 @@ def replica_main() -> int:
     port = int(os.environ["HETU_ENGINE_PORT"])
     name = os.environ.get("HETU_REPLICA_NAME", f"r{idx}")
     token = os.environ.get("HETU_ENGINE_TOKEN", "")
+    if os.environ.get("HETU_TELEMETRY", "") not in ("", "0"):
+        telemetry.enable(True)
+    # stamp fleet identity into the flight recorder BEFORE the engine
+    # builds, so even a crash-during-init dump says who it was
+    telemetry.get_flight_recorder().set_identity(
+        replica=name, role=os.environ.get("HETU_REPLICA_ROLE"))
     mod_name, fn_name = spec.split(":")
     build = getattr(importlib.import_module(mod_name), fn_name)
     engine = build(idx)

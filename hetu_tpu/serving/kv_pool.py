@@ -241,6 +241,11 @@ class SpillEntry:
     tokens: list                     # emitted so far (replayed on a
     #                                  cross-engine resume's Request)
     weight_version: int
+    traceparent: Optional[str] = None  # originating trace context — a
+    #                                  decode-tier resume adopts it so
+    #                                  the cross-process spans share one
+    #                                  trace_id (ISSUE 16); absent on
+    #                                  wire docs from older peers
 
     def nbytes(self) -> int:
         return sum(int(a.nbytes) for a in self.data)

@@ -63,6 +63,7 @@ from hetu_tpu import telemetry
 from hetu_tpu.serving.engine import ServingEngine
 from hetu_tpu.serving.scheduler import Request, SamplingParams
 from hetu_tpu.telemetry.flight import flight_record
+from hetu_tpu.telemetry.spans import REQ_TRACK_BASE
 
 
 @dataclasses.dataclass
@@ -93,6 +94,10 @@ class RouterRequest:
     resumed_dispatches: int = 0          # dispatches that carried KV
     trace_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex[:12])
+    traceparent: Optional[str] = None    # inbound wire context — when
+    #                                      set, trace_id matches it and
+    #                                      every dispatch propagates it
+    #                                      downstream (ISSUE 16)
     inner: Optional[Request] = dataclasses.field(
         default=None, repr=False, compare=False)
     done: threading.Event = dataclasses.field(
@@ -182,7 +187,8 @@ class Router:
                  affinity_slack: int = 2,
                  beat_timeout_s: float = 2.0,
                  max_attempts: int = 5,
-                 poll_s: float = 0.002):
+                 poll_s: float = 0.002,
+                 scrape_every_s: float = 1.0):
         self.affinity_tokens = int(affinity_tokens)
         #: a sticky (prefix-affinity) pick is honored only while its
         #: load is within this many requests of the least-loaded
@@ -200,6 +206,13 @@ class Router:
         self._monitor: Optional[threading.Thread] = None
         self._stop_ev: Optional[threading.Event] = None
         self.slo = None          # HEALTHZ duck-type parity with engines
+        # -- metrics/health federation (ISSUE 16): the monitor scrapes
+        # each replica's METRICS/HEALTHZ on this cadence; FLEETMETRICS
+        # and the fleet HEALTHZ rollup serve from the cache
+        self.scrape_every_s = float(scrape_every_s)
+        self._fed_lock = threading.Lock()
+        self._fed: dict[str, dict] = {}      # name → {metrics, health}
+        self._fed_ts = 0.0                   # monotonic of last scrape
 
     # -- replica lifecycle --------------------------------------------------
     def register(self, name: str, engine: ServingEngine, *,
@@ -442,13 +455,18 @@ class Router:
         if picked is None:
             return False
         h, reason = picked
+        # every dispatch hop mints a fresh span id under the request's
+        # one trace id — the replica's local spans and flight events
+        # then join the fleet trace (ISSUE 16)
+        tp = telemetry.make_traceparent(rreq.trace_id)
+        t0 = time.perf_counter()
         if handoff:
             reason = "pd_prefill"
             inner = h.engine.submit(rreq.prompt, rreq.sampling,
-                                    handoff=True)
+                                    handoff=True, traceparent=tp)
         else:
             inner = h.engine.submit(rreq.prompt, rreq.sampling,
-                                    resume=rreq.spill)
+                                    resume=rreq.spill, traceparent=tp)
         if rreq.spill is not None:
             if inner.spill is rreq.spill:     # the peer took the KV
                 rreq.resumed_dispatches += 1
@@ -489,7 +507,25 @@ class Router:
                       trace=rreq.trace_id, replica=h.name,
                       reason=reason, attempt=rreq.attempts,
                       load=h.load)
+        self._trace_req_span(rreq, "dispatch", t0,
+                             replica=h.name, reason=reason)
         return True
+
+    def _trace_req_span(self, rreq: RouterRequest, name: str,
+                        t0: float, **attrs) -> None:
+        """Emit a span on the request's Perfetto track in THIS process:
+        the router-side fragments (dispatch, KV handoff) that
+        ``tools/fleet_trace.py`` merges with the replicas' queued /
+        prefill / decode fragments into one cross-process request
+        timeline keyed by ``trace_id`` (ISSUE 16)."""
+        tracer = telemetry.get_tracer()
+        if not tracer.enabled:
+            return
+        tid = REQ_TRACK_BASE + rreq.id
+        tracer.name_track(tid, f"req {rreq.trace_id}")
+        tracer.complete(name, time.perf_counter() - t0, cat="request",
+                        tid=tid, trace_id=rreq.trace_id, req=rreq.id,
+                        **attrs)
 
     def _requeue_locked(self, rreq: RouterRequest, *,
                         from_replica: str, reason: str) -> None:
@@ -525,15 +561,24 @@ class Router:
 
     # -- request surface (same shape as ServingEngine's) --------------------
     def submit(self, prompt: Sequence[int],
-               sampling: Optional[SamplingParams] = None) -> RouterRequest:
+               sampling: Optional[SamplingParams] = None, *,
+               traceparent: Optional[str] = None) -> RouterRequest:
         """Dispatch one request to the fleet; parks it pending when no
-        replica is live (the monitor places it as soon as one is)."""
+        replica is live (the monitor places it as soon as one is).
+        ``traceparent`` adopts an upstream trace context (a front-door
+        SUBMIT that already carries one) instead of minting a fresh
+        trace id."""
         sampling = sampling or SamplingParams()
         with self._lock:
             rreq = RouterRequest(
                 id=self._next_id, prompt=[int(t) for t in prompt],
                 sampling=sampling, submit_s=time.monotonic())
             self._next_id += 1
+            if traceparent:
+                tid, _span = telemetry.parse_traceparent(traceparent)
+                if tid:
+                    rreq.trace_id = tid
+                    rreq.traceparent = traceparent
             if not self._dispatch_locked(rreq):
                 self._pending.append(rreq)
         return rreq
@@ -591,6 +636,7 @@ class Router:
         spill riding along, so the decode replica resumes it with ZERO
         prefill-lane work."""
         inner = rreq.inner
+        t0 = time.perf_counter()
         try:
             entry = h.engine.evict_request(inner, lock_timeout_s=5.0)
         except Exception:                             # noqa: BLE001
@@ -617,6 +663,9 @@ class Router:
                           trace=rreq.trace_id, from_replica=h.name,
                           blocks=entry.n_blocks,
                           tokens=len(entry.tokens))
+            self._trace_req_span(rreq, "kv_handoff", t0,
+                                 from_replica=h.name,
+                                 blocks=entry.n_blocks)
         self._requeue_locked(rreq, from_replica=h.name,
                              reason="pd_handoff")
 
@@ -706,6 +755,12 @@ class Router:
             def loop():
                 while not self._stop_ev.is_set():
                     self._tick()
+                    # federation scrape on its own (slower) cadence —
+                    # outside _tick's lock: remote scrapes do network
+                    # I/O and must not stall dispatch
+                    if time.monotonic() - self._fed_ts \
+                            >= self.scrape_every_s:
+                        self._scrape_replicas()
                     self._stop_ev.wait(self.poll_s)
 
             self._monitor = threading.Thread(target=loop, daemon=True,
@@ -744,6 +799,85 @@ class Router:
                     {r["weight_version"] for r in reps.values()
                      if r["state"] != "dead"}),
             }
+
+    # -- metrics/health federation (ISSUE 16) -------------------------------
+    def _scrape_replicas(self) -> None:
+        """One federation round: pull METRICS/HEALTHZ from every remote
+        replica and snapshot local replica health. Runs WITHOUT the
+        router lock (network I/O must not stall dispatch); the handle
+        list is snapshotted under it."""
+        with self._lock:
+            targets = list(self._replicas.items())
+        reg = telemetry.get_registry()
+        results: dict[str, dict] = {}
+        for name, h in targets:
+            if h.state == "dead":
+                results[name] = {"metrics": None,
+                                 "health": {"status": "dead",
+                                            "state": "dead"}}
+                continue
+            if getattr(h, "remote", False):
+                try:
+                    text = h.engine.metrics_text()
+                    health = dict(h.engine.healthz())
+                    health.setdefault("status", "ok")
+                    outcome = "ok"
+                except Exception as e:                # noqa: BLE001
+                    text = None
+                    health = {"status": "unreachable",
+                              "error": f"{type(e).__name__}: {e}"}
+                    outcome = "error"
+                reg.counter(
+                    "fleet_scrapes_total",
+                    "federation scrape rounds per remote replica, by "
+                    "outcome").inc(replica=name, outcome=outcome)
+            else:
+                # in-process replicas share THIS process's registry —
+                # their series are included once, under "_local", by
+                # fleet_metrics_text(); here only health is per-replica
+                text = None
+                health = dict(h.status())
+                health["status"] = "ok" if h.state == "live" \
+                    else "degraded"
+            if h.state == "draining":
+                health["status"] = "degraded"
+            results[name] = {"metrics": text, "health": health}
+        with self._fed_lock:
+            self._fed = results
+            self._fed_ts = time.monotonic()
+
+    def _fed_fresh(self, max_age_s: Optional[float]) -> dict:
+        """The federation cache, scraping first when stale (or never
+        scraped) — keeps FLEETMETRICS correct before the monitor's
+        first cadence tick and in externally-driven routers."""
+        max_age = self.scrape_every_s if max_age_s is None \
+            else float(max_age_s)
+        if time.monotonic() - self._fed_ts > max_age or not self._fed:
+            self._scrape_replicas()
+        with self._fed_lock:
+            return dict(self._fed)
+
+    def fleet_metrics_text(self, *,
+                           max_age_s: Optional[float] = None) -> str:
+        """Fleet-scoped Prometheus page (the FLEETMETRICS verb): every
+        remote replica's series labeled ``replica="<name>"``, the local
+        process registry once under ``replica="_local"`` (in-process
+        replicas share it), plus pre-aggregated ``replica="_fleet"``
+        totals."""
+        fed = self._fed_fresh(max_age_s)
+        texts = {name: doc["metrics"] for name, doc in fed.items()
+                 if doc.get("metrics")}
+        texts["_local"] = telemetry.get_registry().to_prometheus()
+        return telemetry.merge_prometheus(texts)
+
+    def fleet_healthz(self, *,
+                      max_age_s: Optional[float] = None) -> dict:
+        """Fleet HEALTHZ rollup naming the degraded replicas — embedded
+        into the front door's HEALTHZ document when a Router is
+        attached."""
+        fed = self._fed_fresh(max_age_s)
+        return telemetry.health_rollup(
+            {name: doc["health"] for name, doc in fed.items()})
 
 
 def jax_tree_leaves(tree):
@@ -892,6 +1026,17 @@ class WeightPublisher:
         (per-replica durations + flush counts)."""
         params = getattr(state_or_params, "params", state_or_params)
         t0 = time.perf_counter()
+        # the push gets its own trace context, active for the whole
+        # rolling walk: drain/swap flight events (and a concurrent
+        # chaos kill) stamp it, so fleet_trace.py can pin a TTFT spike
+        # on the push that caused it (ISSUE 16)
+        push_tp = telemetry.make_traceparent(uuid.uuid4().hex[:12])
+        with telemetry.use_trace(push_tp):
+            return self._publish_traced(params, t0, push_tp,
+                                        version=version)
+
+    def _publish_traced(self, params, t0: float, push_tp: str, *,
+                        version: Optional[int]) -> dict:
         reg = telemetry.get_registry()
         with self.router._lock:
             names = sorted(n for n, h in self.router._replicas.items()
@@ -938,6 +1083,7 @@ class WeightPublisher:
         reg.counter("weight_pushes_total",
                     "rolling fleet weight pushes completed").inc()
         flight_record("weight_push", version=version,
-                      replicas=len(per), ms=round(dur_ms, 3))
+                      replicas=len(per), ms=round(dur_ms, 3),
+                      trace=push_tp)
         return {"version": version, "replicas": per,
-                "duration_ms": round(dur_ms, 3)}
+                "duration_ms": round(dur_ms, 3), "trace": push_tp}

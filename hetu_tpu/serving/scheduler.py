@@ -111,6 +111,13 @@ class Request:
     #                                    path instead of prefill
     trace_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex[:12])
+    traceparent: Optional[str] = dataclasses.field(
+        default=None, repr=False, compare=False)  # inbound wire context
+    #                                    ("<trace_id>-<span_id>", ISSUE
+    #                                    16) — when set, trace_id above
+    #                                    is overridden to match it so
+    #                                    every process stamps the
+    #                                    originating id
     events: list = dataclasses.field(default_factory=list,
                                      repr=False, compare=False)
     done: threading.Event = dataclasses.field(

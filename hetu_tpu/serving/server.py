@@ -15,7 +15,9 @@ matching ``serving_*`` calls in ``rpc/client.py``.
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 import urllib.parse
 from typing import Optional
 
@@ -97,12 +99,16 @@ def _prune_request_map(m: dict) -> None:
 #: router-only (fleet lifecycle over the wire); ESTATUS/CANCELQ/EVICT/
 #: PREFILL/SWAPWEIGHTS/STOPENGINE are the engine-process verbs the
 #: fleet's RemoteEngineProxy drives (docs/SERVING.md "Disaggregated
-#: fleet"). ``rpc/py_server.py`` mirrors this tuple (it must stay
-#: importable without jax) — a quick-tier test keeps them in sync.
+#: fleet"); DUMPOBS ships this process's observability bundle (chrome
+#: trace + flight ring) to ``tools/fleet_trace.py`` and FLEETMETRICS
+#: serves the router's federated Prometheus page (ISSUE 16).
+#: ``rpc/py_server.py`` mirrors this tuple (it must stay importable
+#: without jax) — a quick-tier test keeps them in sync.
 SERVING_COMMANDS = ("SUBMIT", "RESULT", "GENERATE",
                     "FLEET", "DRAIN", "RESUME",
                     "ESTATUS", "CANCELQ", "EVICT", "PREFILL",
-                    "SWAPWEIGHTS", "STOPENGINE")
+                    "SWAPWEIGHTS", "STOPENGINE",
+                    "DUMPOBS", "FLEETMETRICS")
 
 
 _idem_init_lock = threading.Lock()
@@ -151,6 +157,8 @@ def _submit_from_payload(engine, p: dict):
     if p.get("resume") is not None:
         from hetu_tpu.serving.fleet import spill_from_wire
         kw["resume"] = spill_from_wire(p["resume"])
+    if p.get("traceparent"):
+        kw["traceparent"] = p["traceparent"]
     return engine.submit(p["prompt"], sampling_from_payload(p), **kw)
 
 
@@ -166,12 +174,17 @@ def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
         return None
     if engine is None:
         return "ERR serving disabled"
-    if cmd in ("FLEET", "DRAIN", "RESUME"):
+    if cmd in ("FLEET", "DRAIN", "RESUME", "FLEETMETRICS"):
         if not hasattr(engine, "fleet_status"):
             return "ERR not a fleet (attach a serving.router.Router)"
         try:
             if cmd == "FLEET":
                 return f"VAL {encode_payload(engine.fleet_status())}"
+            if cmd == "FLEETMETRICS":
+                # federated Prometheus text (replica-labeled + _fleet
+                # aggregates) — URL-quoted, like METRICS/HEALTHZ
+                return "VAL " + urllib.parse.quote(
+                    engine.fleet_metrics_text(), safe="")
             if cmd == "DRAIN":
                 n = engine.drain(args[0])
                 return f"VAL {encode_payload({'requeued': n})}"
@@ -254,11 +267,28 @@ def _handle_engine_command(engine, cmd: str, args: list) -> str:
         doc = {"load": getattr(engine, "load", 0),
                "weight_version": getattr(engine, "weight_version", 0),
                "has_work": engine.has_work()
-               if hasattr(engine, "has_work") else False}
+               if hasattr(engine, "has_work") else False,
+               # wall-clock stamp mid-RTT: the caller's NTP-style
+               # offset handshake (fleet clock alignment, ISSUE 16)
+               "ts_unix": round(time.time(), 6)}
         sched = getattr(engine, "scheduler", None)
         doc["depth"] = getattr(sched, "depth", 0) if sched else 0
         doc["occupancy"] = round(getattr(sched, "occupancy", 0.0), 4) \
             if sched else 0.0
+        return f"VAL {encode_payload(doc)}"
+    if cmd == "DUMPOBS":
+        # this process's observability bundle — local chrome trace +
+        # flight ring + identity; fleet_trace.py merges bundles from
+        # every process into one clock-aligned Perfetto trace
+        from hetu_tpu import telemetry
+        rec = telemetry.get_flight_recorder()
+        tracer = telemetry.get_tracer()
+        doc = {"pid": os.getpid(), "ts_unix": round(time.time(), 6),
+               "rank": rec.rank, "replica": rec.replica,
+               "role": rec.role,
+               "epoch_unix": round(tracer.epoch_unix, 6),
+               "chrome": tracer.to_chrome(),
+               "flight": rec.events()}
         return f"VAL {encode_payload(doc)}"
     if cmd == "STOPENGINE":
         engine.stop()
@@ -281,6 +311,11 @@ def _handle_engine_command(engine, cmd: str, args: list) -> str:
         req = engine._requests_by_id.get(int(p["id"]))
         if req is None:
             return "ERR unknown request id"
+        if p.get("traceparent") and \
+                getattr(req, "traceparent", None) is None:
+            # a request submitted before tracing reached it still gets
+            # its spill stamped with the router's context
+            req.traceparent = p["traceparent"]
         entry = engine.evict_request(
             req, lock_timeout_s=p.get("lock_timeout_s"))
         if req.status == "evicted":
@@ -291,7 +326,8 @@ def _handle_engine_command(engine, cmd: str, args: list) -> str:
             return "ERR not an engine"
         p = decode_payload(args[0])
         req, entry = engine.prefill_only(p["prompt"],
-                                         sampling_from_payload(p))
+                                         sampling_from_payload(p),
+                                         traceparent=p.get("traceparent"))
         if req.status == "rejected":
             return f"ERR rejected: {req.error}"
         if entry is None:
@@ -303,11 +339,15 @@ def _handle_engine_command(engine, cmd: str, args: list) -> str:
         return f"VAL {encode_payload(doc)}"
     if cmd == "SWAPWEIGHTS":
         p = decode_payload(args[0])
+        from hetu_tpu import telemetry
         from hetu_tpu.utils.dist_checkpoint import (
             load_params_distributed,
         )
-        params = load_params_distributed(p["path"], engine.model,
-                                         plan=engine._plan)
-        info = engine.swap_params(params, version=p.get("version"))
+        # activate the push's trace for the swap's duration: flight
+        # events recorded meanwhile (incl. a chaos kill) can stamp it
+        with telemetry.use_trace(p.get("traceparent")):
+            params = load_params_distributed(p["path"], engine.model,
+                                             plan=engine._plan)
+            info = engine.swap_params(params, version=p.get("version"))
         return f"VAL {encode_payload(info)}"
     return "ERR unknown command"

@@ -29,6 +29,9 @@ from hetu_tpu.telemetry.aggregate import (
     aggregate_snapshots, cluster_aggregate, collect_snapshots,
     publish_snapshot,
 )
+from hetu_tpu.telemetry.federation import (
+    health_rollup, merge_prometheus, parse_prometheus,
+)
 from hetu_tpu.telemetry.flight import (
     FlightRecorder, HangWatchdog, atomic_write_text, flight_record,
     get_flight_recorder, install_crash_handlers,
@@ -46,6 +49,10 @@ from hetu_tpu.telemetry.slo import (
 )
 from hetu_tpu.telemetry.spans import (
     DEFAULT_COUNTER_TRACK_PREFIXES, NULL_SPAN, SpanEvent, Tracer,
+)
+from hetu_tpu.telemetry.tracecontext import (
+    TRACEPARENT_VERBS, current_traceparent, make_traceparent,
+    new_span_id, parse_traceparent, use_trace,
 )
 
 _TRACER = Tracer(enabled=False)
@@ -136,6 +143,9 @@ __all__ = [
     "flight_record", "get_flight_recorder", "install_crash_handlers",
     "SLOEngine", "Alert", "default_training_rules",
     "default_serving_rules", "health_status",
+    "TRACEPARENT_VERBS", "make_traceparent", "parse_traceparent",
+    "new_span_id", "current_traceparent", "use_trace",
+    "parse_prometheus", "merge_prometheus", "health_rollup",
     "get_tracer", "get_registry", "enable", "enabled", "reset", "span",
     "export_dir",
 ]

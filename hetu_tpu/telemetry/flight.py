@@ -106,6 +106,12 @@ class FlightRecorder:
         self.rank = _default_rank() if rank is None else int(rank)
         self.enabled = True
         self.dump_dir: Optional[str] = None
+        # fleet identity (ISSUE 16): N engine processes on one host all
+        # see rank 0 — the replica name/role disambiguate their dumps
+        self.replica: Optional[str] = os.environ.get(
+            "HETU_REPLICA_NAME") or None
+        self.role: Optional[str] = os.environ.get(
+            "HETU_REPLICA_ROLE") or None
         self.epoch_unix = time.time()
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._seq = 0
@@ -145,10 +151,24 @@ class FlightRecorder:
         with self._lock:
             return len(self._ring)
 
+    def set_identity(self, *, replica: Optional[str] = None,
+                     role: Optional[str] = None) -> None:
+        """Stamp this process's fleet identity (replica name / role)
+        into future dump headers. Idempotent; ``None`` leaves a field
+        unchanged."""
+        if replica is not None:
+            self.replica = replica
+        if role is not None:
+            self.role = role
+
     # -- dumping ------------------------------------------------------------
     def default_path(self, dir: Optional[str] = None) -> str:
+        # pid in the name: N engine processes on one host all see rank
+        # 0, and without it the last dump silently clobbers the rest
+        # (ISSUE 16 satellite). obs_report globs *flight*.jsonl, so the
+        # extra component stays discoverable.
         d = dir or self.dump_dir or "."
-        return os.path.join(d, f"flight_{self.rank}.jsonl")
+        return os.path.join(d, f"flight_{self.rank}.{os.getpid()}.jsonl")
 
     def dump(self, path: Optional[str] = None, *, reason: str = "manual",
              stacks: bool = False, extra: Optional[dict] = None) -> str:
@@ -163,6 +183,10 @@ class FlightRecorder:
                   "epoch_unix": round(self.epoch_unix, 6),
                   "events_total": total, "events_dropped": dropped,
                   "argv": list(sys.argv)}
+        if self.replica is not None:
+            header["replica"] = self.replica
+        if self.role is not None:
+            header["role"] = self.role
         if extra:
             header.update(extra)
         lines = [json.dumps(header)]

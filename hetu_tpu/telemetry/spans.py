@@ -112,6 +112,14 @@ class _Span:
         return False
 
 
+#: per-request Perfetto tracks: synthetic tids offset far above real
+#: thread ids so request timelines never collide with thread tracks.
+#: Shared by serving.engine (replica-side phases) and serving.router
+#: (dispatch / KV-handoff fragments) so tools/fleet_trace.py can merge
+#: every process's ``req <trace_id>`` track into one fleet timeline.
+REQ_TRACK_BASE = 1 << 40
+
+
 class Tracer:
     """Collects nested SpanEvents; exports Chrome trace / JSONL records."""
 

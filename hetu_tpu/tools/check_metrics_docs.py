@@ -65,17 +65,43 @@ def missing_from_docs(doc_path: str = _DOC,
             if name not in doc}
 
 
+def missing_traceparent_verbs(doc_path: str = _DOC) -> list[str]:
+    """Every line-protocol verb that carries a ``traceparent``
+    (``telemetry.tracecontext.TRACEPARENT_VERBS``) must appear as a row
+    in the doc's verb-instrumentation tables — a verb that propagates
+    trace context but is absent from the operator tables is exactly the
+    hop nobody can explain in a merged fleet trace. A table row is a
+    markdown line whose first cell starts with the verb name."""
+    from hetu_tpu.telemetry.tracecontext import TRACEPARENT_VERBS
+    with open(doc_path) as f:
+        doc = f.read()
+    missing = []
+    for verb in TRACEPARENT_VERBS:
+        if not re.search(rf"^\|\s*`?{verb}`?\b", doc, re.MULTILINE):
+            missing.append(verb)
+    return missing
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     missing = missing_from_docs()
-    if not missing:
+    verbs = missing_traceparent_verbs()
+    if not missing and not verbs:
         print(f"check_metrics_docs: all "
               f"{len(registered_metric_names())} registered metric "
-              f"names documented in docs/OBSERVABILITY.md")
+              f"names documented in docs/OBSERVABILITY.md; every "
+              f"traceparent-carrying verb has a doc table row")
         return 0
-    print("check_metrics_docs: metrics registered in code but missing "
-          "from docs/OBSERVABILITY.md:", file=sys.stderr)
-    for name, sites in missing.items():
-        print(f"  {name}  ({', '.join(sites[:3])})", file=sys.stderr)
+    if missing:
+        print("check_metrics_docs: metrics registered in code but "
+              "missing from docs/OBSERVABILITY.md:", file=sys.stderr)
+        for name, sites in missing.items():
+            print(f"  {name}  ({', '.join(sites[:3])})", file=sys.stderr)
+    if verbs:
+        print("check_metrics_docs: traceparent-carrying verbs without "
+              "a verb-table row in docs/OBSERVABILITY.md:",
+              file=sys.stderr)
+        for verb in verbs:
+            print(f"  {verb}", file=sys.stderr)
     return 1
 
 

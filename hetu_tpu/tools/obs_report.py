@@ -75,9 +75,15 @@ def flight_report(path: str, *, tail: int = 30) -> list[str]:
                    if r.get("kind") == "thread_stacks"), None)
     lines = [f"== flight record ({path}) =="]
     if header:
+        who = ""
+        if header.get("replica"):
+            who = f"   replica {header['replica']}"
+            if header.get("role"):
+                who += f" ({header['role']})"
         lines.append(
             f"reason {header.get('reason', '?')}   rank "
-            f"{header.get('rank', '?')}   pid {header.get('pid', '?')}   "
+            f"{header.get('rank', '?')}   pid {header.get('pid', '?')}"
+            f"{who}   "
             f"events {header.get('events_total', len(events))} "
             f"({header.get('events_dropped', 0)} dropped)")
         if header.get("watchdog"):
@@ -153,9 +159,38 @@ def slo_report(path: str) -> Optional[list[str]]:
     return lines
 
 
+def fleet_overview(flights: list[str]) -> list[str]:
+    """One line per process when a directory holds dumps from SEVERAL
+    processes (a multi-process fleet run: pid-suffixed names stop the
+    dumps clobbering each other; the headers carry replica/role
+    identity). Single-process directories render nothing extra."""
+    rows = []
+    for fp in flights:
+        try:
+            with open(fp) as f:
+                header = json.loads(f.readline())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if header.get("kind") != "flight_header":
+            continue
+        rows.append((
+            header.get("replica") or f"rank{header.get('rank', '?')}",
+            header.get("role") or "-", header.get("pid", "?"),
+            header.get("reason", "?"), header.get("events_total", 0),
+            os.path.basename(fp)))
+    if len(rows) < 2:
+        return []
+    lines = [f"== fleet overview ({len(rows)} processes) =="]
+    for name, role, pid, reason, n, base in sorted(rows):
+        lines.append(f"  {name:<12} role {role:<8} pid {pid!s:<8} "
+                     f"reason {reason:<12} events {n}  [{base}]")
+    lines.append("")
+    return lines
+
+
 def report(path: str, *, tail: int = 30) -> str:
     flights, tj = find_artifacts(path)
-    parts: list[str] = []
+    parts: list[str] = list(fleet_overview(flights))
     for fp in flights:
         parts.extend(flight_report(fp, tail=tail))
         parts.append("")

@@ -293,6 +293,16 @@ def test_bench_fleet_emits_json_contract():
     for lane in (rec["in_process"], rec["multi_process"],
                  rec["pd"]["colocated"], rec["pd"]["split"]):
         assert lane["total_ms_p50"] > 0
+    # ISSUE 16: the multi-process lane records its transport/compute
+    # split per verb from the RPC wire instrumentation
+    rpc = rec["multi_process"]["rpc"]
+    assert rpc["client_verb_ms_total"] > 0
+    assert "SUBMIT" in rpc["verbs"], rpc["verbs"]
+    for verb, row in rpc["verbs"].items():
+        assert row["count"] > 0 and row["ms_total"] >= 0, (verb, row)
+    assert rpc["empty_polls"] >= 0
+    frac = rpc["empty_poll_fraction"]
+    assert frac is None or 0.0 <= frac <= 1.0
     with open(os.path.join(_ROOT, "BENCH_fleet.json")) as f:
         assert json.load(f) == rec
 

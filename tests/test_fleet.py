@@ -82,7 +82,7 @@ class _StubEngine:
         return self.load > 0
 
     def submit(self, prompt, sampling=None, *, resume=None,
-               handoff=False):
+               handoff=False, traceparent=None):
         sampling = sampling or SamplingParams()
         with self._lock:
             req = Request(id=self._next,
@@ -90,6 +90,11 @@ class _StubEngine:
                           sampling=sampling, submit_s=time.monotonic())
             self._next += 1
             self.submits += 1
+        if traceparent:
+            tid, _span = telemetry.parse_traceparent(traceparent)
+            if tid:
+                req.trace_id = tid
+                req.traceparent = traceparent
         if resume is not None:
             req.spill = resume
             req.tokens = list(resume.tokens)

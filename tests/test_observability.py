@@ -114,7 +114,7 @@ def test_crash_handlers_dump_on_excepthook_and_sigterm(tmp_path):
             raise ValueError("boom")
         except ValueError:
             sys.excepthook(*sys.exc_info())
-        path = str(tmp_path / "flight_0.jsonl")
+        path = str(tmp_path / f"flight_0.{os.getpid()}.jsonl")
         recs = [json.loads(ln) for ln in open(path)]
         assert recs[0]["reason"] == "crash"
         assert any(r.get("event") == "crash"
@@ -140,7 +140,7 @@ def test_crash_handlers_dump_on_excepthook_and_sigterm(tmp_path):
         fr2.dump_dir = str(tmp_path)
         fr2.record("step", step=1)
         _dump_at_exit(fr2)
-        recs = [json.loads(ln) for ln in open(tmp_path / "flight_7.jsonl")]
+        recs = [json.loads(ln) for ln in open(tmp_path / f"flight_7.{os.getpid()}.jsonl")]
         assert recs[0]["reason"] == "atexit"
         # a DAEMON-thread crash (serving loop, prefetcher) dumps too —
         # sys.excepthook never fires for those
@@ -175,7 +175,7 @@ def test_sigterm_handler_preserves_sig_ign(tmp_path):
         handler = signal.getsignal(signal.SIGTERM)
         handler(signal.SIGTERM, None)        # no SystemExit
         recs = [json.loads(ln)
-                for ln in open(tmp_path / "flight_5.jsonl")]
+                for ln in open(tmp_path / f"flight_5.{os.getpid()}.jsonl")]
         assert recs[0]["reason"] == "sigterm"
     finally:
         sys.excepthook = prev_hook
@@ -219,7 +219,7 @@ def test_watchdog_trips_on_injected_hang(tmp_path, telem):
         time.sleep(0.3)
         assert wd.trips == 1
         # the dump: parseable, reason=watchdog, stacks present
-        path = str(tmp_path / "flight_0.jsonl")
+        path = str(tmp_path / f"flight_0.{os.getpid()}.jsonl")
         recs = [json.loads(ln) for ln in open(path)]
         assert recs[0]["reason"] == "watchdog"
         assert recs[0]["watchdog"] == "train"
@@ -228,7 +228,7 @@ def test_watchdog_trips_on_injected_hang(tmp_path, telem):
         stacks = [r for r in recs if r["kind"] == "thread_stacks"]
         assert stacks and len(stacks[0]["stacks"]) >= 2  # main + monitor
         # faulthandler sidecar exists and names a thread
-        side = open(str(tmp_path / "flight_0.stacks")).read()
+        side = open(str(tmp_path / f"flight_0.{os.getpid()}.stacks")).read()
         assert "Thread" in side or "thread" in side
         # recovery: a beat clears the latch; a new stall trips again
         wd.beat()
@@ -671,7 +671,7 @@ def test_serving_loop_watchdog_trips_on_stalled_step(telem, tmp_path):
             "watchdog_trips_total").value(name="serving") >= 1
         # the postmortem exists and records the serving lifecycle
         recs = [json.loads(ln)
-                for ln in open(tmp_path / "flight_0.jsonl")]
+                for ln in open(tmp_path / f"flight_0.{os.getpid()}.jsonl")]
         assert recs[0]["reason"] == "watchdog"
         evs = {r.get("event") for r in recs}
         assert "serving_submit" in evs and "watchdog_trip" in evs

@@ -72,7 +72,8 @@ def init_kv_caches(model, batch: int, max_len: int, dtype=jnp.float32):
 
 def decode(model, params, input_ids, positions, caches, *,
            slot_mask=None, block_tables=None, row_mask=None,
-           attn_kernel: str = "reference", w8a8_mask=None):
+           attn_kernel: str = "reference", w8a8_mask=None,
+           w8a8_wq=None):
     """Run a chunk through the model in decode mode.
 
     ``positions`` (b, s) absolute positions. Without ``slot_mask`` they
@@ -88,8 +89,10 @@ def decode(model, params, input_ids, positions, caches, *,
     blocks. ``attn_kernel`` ("reference" | "paged") picks the paged
     arena's attention read path (Pallas kernel vs XLA gather — see
     ``ops.paged_pallas``); ``w8a8_mask`` ((layers,) bool) flips decode
-    FFNs to the W8A8 int8 lane per layer. Returns (logits (b, s, V),
-    new caches)."""
+    FFNs to the W8A8 int8 lane per layer, and ``w8a8_wq`` (a stacked
+    ``prequantize`` tree) feeds that lane pre-quantized int8 weights
+    so the per-step weight quantize disappears. Returns (logits
+    (b, s, V), new caches)."""
     h = model.embed(params, input_ids, positions=positions)
     h, caches = model.blocks.decode(params["blocks"], h, caches,
                                     positions=positions,
@@ -97,7 +100,8 @@ def decode(model, params, input_ids, positions, caches, *,
                                     block_tables=block_tables,
                                     row_mask=row_mask,
                                     attn_kernel=attn_kernel,
-                                    w8a8_mask=w8a8_mask)
+                                    w8a8_mask=w8a8_mask,
+                                    w8a8_wq=w8a8_wq)
     h = model.hidden_norm(params, h)
     w = _head_weight(model, params)
     logits = jnp.einsum("bse,ve->bsv", h.astype(jnp.float32),

@@ -107,7 +107,7 @@ class GPTBlock(Module):
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
                  block_tables=None, row_mask=None, attn_kernel="reference",
-                 pack=None, w8a8=None, dropout_key=None,
+                 pack=None, w8a8=None, w8a8_wq=None, dropout_key=None,
                  return_kv=False):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
@@ -127,10 +127,13 @@ class GPTBlock(Module):
                 # expert FFNs instead of the dense oracle's O(rows·E));
                 # aux is train-only. One-shot generate and the serving
                 # engine's fused step both land here, so their tokens
-                # match by construction. (W8A8 covers dense FFNs only.)
-                h = self.mlp.decode(params["mlp"], mlp_in)
+                # match by construction. W8A8 rides the same knobs as
+                # the dense FFN lane (int8 expert gathers + einsums).
+                h = self.mlp.decode(params["mlp"], mlp_in,
+                                    w8a8=w8a8, wq=w8a8_wq)
             else:
-                h = self.mlp(params["mlp"], mlp_in, w8a8=w8a8)
+                h = self.mlp(params["mlp"], mlp_in, w8a8=w8a8,
+                             w8a8_wq=w8a8_wq)
             return x + h, new_cache
         # positions only matter for decode (GPT's learned position
         # embedding is applied in embed(), not per block)

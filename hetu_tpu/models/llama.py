@@ -101,7 +101,7 @@ class LlamaBlock(Module):
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
                  block_tables=None, row_mask=None, attn_kernel="reference",
-                 pack=None, w8a8=None, dropout_key=None,
+                 pack=None, w8a8=None, w8a8_wq=None, dropout_key=None,
                  return_kv=False):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
@@ -119,10 +119,13 @@ class LlamaBlock(Module):
             if self.returns_aux:
                 # MoE decode: per-row top-k through gathered local-
                 # expert einsums (MoEMLP.decode); aux is train-only.
-                # (W8A8 covers dense FFNs only.)
-                h = self.mlp.decode(params["mlp"], mlp_in)
+                # W8A8 rides the same knobs as the dense FFN lane
+                # (int8 expert gathers + einsums).
+                h = self.mlp.decode(params["mlp"], mlp_in,
+                                    w8a8=w8a8, wq=w8a8_wq)
             else:
-                h = self.mlp(params["mlp"], mlp_in, w8a8=w8a8)
+                h = self.mlp(params["mlp"], mlp_in, w8a8=w8a8,
+                             w8a8_wq=w8a8_wq)
             return x + h, new_cache
         ka = k1 = k2 = None
         if dropout_key is not None and self.attn_pdrop > 0:

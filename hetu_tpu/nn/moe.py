@@ -511,7 +511,26 @@ class MoEMLP(Module):
         return out, aux
 
     # -- decode path (serving / autoregressive generation) ------------------
-    def decode(self, params, x):
+    def prequantize(self, params, *, stacked: bool = False):
+        """Quantize the expert FFN stacks ONCE into the W8A8 decode
+        lane's ``{name: {"q": int8, "scale": fp32}}`` tree.
+
+        Per-(expert, output-channel) symmetric scales over each
+        einsum's contraction axis: ``wi``/``wg`` (E, d, H) quantize
+        over d (scale (E, 1, H)), ``wo`` (E, H, d) over H (scale
+        (E, 1, d)); a stacked (L, E, ...) tree shifts the axis by one.
+        The decode gather then moves int8 expert slices — 1/4 the HBM
+        bytes of the fp32 gather, which is where MoE decode time goes."""
+        from hetu_tpu.ops.quantization import quantize_int8
+        axis = 2 if stacked else 1
+        names = ["wi", "wo"] + (["wg"] if self.gated else [])
+        return {
+            name: dict(zip(("q", "scale"),
+                           quantize_int8(params[name], axis=axis)))
+            for name in names
+        }
+
+    def decode(self, params, x, *, w8a8=None, wq=None):
         """Per-row top-k through GATHERED local-expert einsums — the
         decode-mode twin of the dense oracle that computes only the k
         selected experts per token (O(T·k) FFNs instead of O(T·E)).
@@ -524,7 +543,15 @@ class MoEMLP(Module):
         ``Σ_j w_j·expert_{idx_j}(x)`` the dense oracle produces (k ≤ 2
         keeps fp addition commutative), so greedy serving tokens match
         one-shot generation. Returns the output only — aux is
-        train-only."""
+        train-only.
+
+        ``w8a8`` (traced bool) + ``wq`` (a :meth:`prequantize` tree)
+        select the quantized-compute lane per call: expert slices
+        gather as int8, activations quantize per token, and both
+        expert einsums contract int8×int8 with int32 accumulation —
+        the MoE extension of ``ParallelMLP``'s W8A8 decode lane. The
+        gate always routes in fp (routing flips would change WHICH
+        experts run, not just their arithmetic)."""
         if getattr(self.gate, "batch_coupled", False):
             raise NotImplementedError(
                 f"MoEMLP.decode needs a per-token gate; "
@@ -537,17 +564,50 @@ class MoEMLP(Module):
         idx, wgt, _ = self.gate(params["gate"], xf)
         dt = self.compute_dtype()
         xc = xf.astype(dt)
-        wi = jnp.take(params["wi"], idx, axis=0).astype(dt)   # (T,k,d,H)
-        h = jnp.einsum("td,tkdh->tkh", xc, wi)
-        if self.gated:
-            wg = jnp.take(params["wg"], idx, axis=0).astype(dt)
-            g = jnp.einsum("td,tkdh->tkh", xc, wg)
-            h = self.activation(g, h)
+
+        def fp_lane(params, xc):
+            wi = jnp.take(params["wi"], idx, axis=0).astype(dt)  # (T,k,d,H)
+            h = jnp.einsum("td,tkdh->tkh", xc, wi)
+            if self.gated:
+                wg = jnp.take(params["wg"], idx, axis=0).astype(dt)
+                g = jnp.einsum("td,tkdh->tkh", xc, wg)
+                h = self.activation(g, h)
+            else:
+                h = self.activation(h)
+            wo = jnp.take(params["wo"], idx, axis=0).astype(dt)  # (T,k,H,d)
+            y = jnp.einsum("tkh,tkhd->tkd", h, wo)
+            return jnp.sum(wgt[..., None] * y.astype(jnp.float32), axis=1)
+
+        def q_lane(params, xc):
+            from hetu_tpu.ops.quantization import quantize_int8
+            xq, xs = quantize_int8(xc, axis=-1)          # (T,d), (T,1)
+
+            def up(name):
+                wq_e = jnp.take(wq[name]["q"], idx, axis=0)      # int8
+                ws_e = jnp.take(wq[name]["scale"], idx, axis=0)  # (T,k,1,H)
+                acc = jnp.einsum("td,tkdh->tkh", xq, wq_e,
+                                 preferred_element_type=jnp.int32)
+                return (acc.astype(jnp.float32)
+                        * xs[:, :, None] * ws_e[:, :, 0, :])
+
+            h = up("wi")
+            if self.gated:
+                h = self.activation(up("wg"), h)
+            else:
+                h = self.activation(h)
+            hq, hs = quantize_int8(h, axis=-1)           # (T,k,H), (T,k,1)
+            wo_q = jnp.take(wq["wo"]["q"], idx, axis=0)
+            wo_s = jnp.take(wq["wo"]["scale"], idx, axis=0)  # (T,k,1,d)
+            acc = jnp.einsum("tkh,tkhd->tkd", hq, wo_q,
+                             preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * hs * wo_s[:, :, 0, :]
+            return jnp.sum(wgt[..., None] * y, axis=1)
+
+        if w8a8 is None or wq is None:
+            out = fp_lane(params, xc)
         else:
-            h = self.activation(h)
-        wo = jnp.take(params["wo"], idx, axis=0).astype(dt)   # (T,k,H,d)
-        y = jnp.einsum("tkh,tkhd->tkd", h, wo)
-        out = jnp.sum(wgt[..., None] * y.astype(jnp.float32), axis=1)
+            out = jax.lax.cond(
+                w8a8, lambda p, v: q_lane(p, v), fp_lane, params, xc)
         return out.reshape(b, s, d).astype(x.dtype)
 
     # -- dense oracle (single device / no ep axis): every expert computes

@@ -237,7 +237,7 @@ class ParallelMLP(Module):
         self.fc_out = RowParallelLinear(hidden, features, bias=bias,
                                         axis="mlp")
 
-    def __call__(self, params, x, *, w8a8=None):
+    def __call__(self, params, x, *, w8a8=None, w8a8_wq=None):
         """``w8a8`` (None | traced bool) selects the quantized-COMPUTE
         lane per call: activations quantize per token, weights per
         output channel, and both matmuls contract in int8 with one
@@ -245,11 +245,38 @@ class ParallelMLP(Module):
         flag rides ``lax.cond`` so the serving engine can A/B the lane
         PER LAYER as data (``StackedBlocks.decode(w8a8_mask=)``);
         ``None`` (the default, and every training path) is exactly the
-        historical fp lane — no cond, bit-for-bit unchanged."""
+        historical fp lane — no cond, bit-for-bit unchanged.
+
+        ``w8a8_wq`` (a :meth:`prequantize` tree for THIS layer) skips
+        the per-call weight quantization: only the per-token activation
+        quant remains on the hot path — the serving engine quantizes
+        once at construction / weight swap."""
         if w8a8 is None:
             return self._fp_lane(params, x)
-        return jax.lax.cond(w8a8, self._w8a8_lane, self._fp_lane,
-                            params, x)
+        if w8a8_wq is None:
+            return jax.lax.cond(w8a8, self._w8a8_lane, self._fp_lane,
+                                params, x)
+        return jax.lax.cond(
+            w8a8, lambda p, v: self._w8a8_lane(p, v, wq=w8a8_wq),
+            self._fp_lane, params, x)
+
+    def prequantize(self, params, *, stacked: bool = False):
+        """Quantize this MLP's weight matrices ONCE into the W8A8
+        lane's ``{name: {"q": int8, "scale": fp32}}`` tree (per-output-
+        channel scales over the contraction axis — ``axis=1`` for a
+        ``StackedBlocks`` (L, in, out) param tree, ``axis=0`` for a
+        single layer). Feed the result back via ``w8a8_wq=`` so the
+        decode lane stops paying the per-step quantize of weights that
+        never change between steps."""
+        from hetu_tpu.ops.quantization import quantize_int8
+        axis = 1 if stacked else 0
+        names = (["gate_proj", "up_proj"] if self.gated
+                 else ["fc_in"]) + ["fc_out"]
+        return {
+            name: dict(zip(("q", "scale"), quantize_int8(
+                params[name]["weight"], axis=axis)))
+            for name in names
+        }
 
     def _fp_lane(self, params, x):
         if self.gated:
@@ -260,32 +287,41 @@ class ParallelMLP(Module):
         h = act_constrain(h, "hidden")
         return self.fc_out(params["fc_out"], h)
 
-    def _w8a8_lane(self, params, x):
+    def _w8a8_lane(self, params, x, wq=None):
         """Both FFN matmuls in int8 (W8A8). Biases and the activation
         stay fp; the canonical activation cut points keep their
         ``act_constrain`` layouts so GSPMD shards the lane like the fp
-        one. Weights quantize at trace time from the live fp params
-        (pre-quantized weight trees are a future optimization — the
-        lane's point is the int8 CONTRACTION, which is where decode
-        FFN time goes)."""
-        from hetu_tpu.ops.quantization import int8_w8a8_matmul
+        one. Weights quantize at trace time from the live fp params —
+        or, when ``wq`` carries a :meth:`prequantize` tree, stream
+        pre-quantized int8 weights straight into the contraction
+        (halving the lane's weight reads: no fp load + int8 re-store
+        per step)."""
+        from hetu_tpu.ops.quantization import (
+            int8_w8a8_matmul, int8_w8a8_matmul_prequant,
+        )
         dt = self.compute_dtype()
         x = x.astype(dt)
 
-        def lin(mod, p):
-            y = int8_w8a8_matmul(x, p["weight"].astype(dt), dtype=dt)
+        def mm(v, p, name):
+            if wq is not None:
+                return int8_w8a8_matmul_prequant(
+                    v, wq[name]["q"], wq[name]["scale"], dtype=dt)
+            return int8_w8a8_matmul(v, p["weight"].astype(dt), dtype=dt)
+
+        def lin(mod, p, name):
+            y = mm(x, p, name)
             if mod.use_bias:
                 y = y + p["bias"].astype(dt)
             return act_constrain(y, "hidden")
 
         if self.gated:
-            h = self.activation(lin(self.gate_proj, params["gate_proj"]),
-                                lin(self.up_proj, params["up_proj"]))
+            h = self.activation(
+                lin(self.gate_proj, params["gate_proj"], "gate_proj"),
+                lin(self.up_proj, params["up_proj"], "up_proj"))
         else:
-            h = self.activation(lin(self.fc_in, params["fc_in"]))
+            h = self.activation(lin(self.fc_in, params["fc_in"], "fc_in"))
         h = act_constrain(h, "hidden")
-        y = int8_w8a8_matmul(h, params["fc_out"]["weight"].astype(dt),
-                             dtype=dt)
+        y = mm(h, params["fc_out"], "fc_out")
         y = act_constrain(y, "tokens")
         if self.fc_out.use_bias:
             y = y + params["fc_out"]["bias"].astype(dt)
@@ -579,14 +615,16 @@ class ParallelAttention(Module):
         if paged and attn_kernel == "paged" and self.causal:
             # the Pallas kernel streams arena tiles through the block
             # tables — no materialized gather, dead lanes skipped, int8
-            # pages dequantized per tile in VMEM
-            from hetu_tpu.ops.paged_pallas import paged_attention_pallas
+            # pages dequantized per tile in VMEM; the _auto wrapper
+            # shard_maps the call over a tp-sharded plan's head axis
+            # (Mosaic kernels cannot be GSPMD-auto-partitioned)
+            from hetu_tpu.ops.paged_pallas import paged_attention_auto
             if quant:
-                out = paged_attention_pallas(
+                out = paged_attention_auto(
                     q, kq_b, vq_b, block_tables, index,
                     k_scale=ks_b, v_scale=vs_b)
             else:
-                out = paged_attention_pallas(
+                out = paged_attention_auto(
                     q, k_buf, v_buf, block_tables, index)
         elif paged:
             if attn_kernel == "paged":
@@ -694,7 +732,7 @@ class ParallelAttention(Module):
 
         from hetu_tpu.ops.attention import attention_with_lse
         from hetu_tpu.ops.paged_pallas import (
-            combine_attention_lse, paged_attention_pallas,
+            combine_attention_lse, paged_attention_auto,
             paged_attention_reference,
         )
         intra, lse_i = attention_with_lse(
@@ -710,7 +748,7 @@ class ParallelAttention(Module):
             arena = {}
             ka, va = k_b, v_b
         if attn_kernel == "paged":
-            hist, lse_h = paged_attention_pallas(
+            hist, lse_h = paged_attention_auto(
                 qh, ka, va, block_tables, hist_off, return_lse=True,
                 **arena)
         else:
@@ -1009,7 +1047,8 @@ class StackedBlocks(Module):
             carry = seg_prefetch(carry, 0, n_layers)
         return carry
 
-    def decode(self, params, x, caches, *, w8a8_mask=None, **kwargs):
+    def decode(self, params, x, caches, *, w8a8_mask=None,
+               w8a8_wq=None, **kwargs):
         """Incremental decoding: scan layers threading per-layer KV caches
         (leaves shaped (layers, b, max_len, hkv, d)).
 
@@ -1018,7 +1057,10 @@ class StackedBlocks(Module):
         ``w8a8_mask[l]`` (``ParallelMLP.__call__(w8a8=...)``) — the
         per-layer A/B knob for quantized decode compute. ``None`` (the
         default) never touches the flag and stays bit-identical to the
-        historical path."""
+        historical path. ``w8a8_wq`` (optional, a stacked
+        ``prequantize`` tree with (layers, ...) leaves) also rides the
+        scan as xs so each layer streams its pre-quantized int8
+        weights instead of re-quantizing per step."""
         if w8a8_mask is None:
             def body(h, inputs):
                 layer_params, cache = inputs
@@ -1031,14 +1073,26 @@ class StackedBlocks(Module):
 
         w8a8_mask = jnp.asarray(w8a8_mask, bool)
 
+        if w8a8_wq is None:
+            def body(h, inputs):
+                layer_params, cache, flag = inputs
+                h, new_cache = self._block(layer_params, h,
+                                           kv_cache=cache,
+                                           w8a8=flag, **kwargs)
+                return h, new_cache
+
+            x, new_caches = jax.lax.scan(body, x,
+                                         (params, caches, w8a8_mask))
+            return x, new_caches
+
         def body(h, inputs):
-            layer_params, cache, flag = inputs
+            layer_params, cache, flag, wq = inputs
             h, new_cache = self._block(layer_params, h, kv_cache=cache,
-                                       w8a8=flag, **kwargs)
+                                       w8a8=flag, w8a8_wq=wq, **kwargs)
             return h, new_cache
 
-        x, new_caches = jax.lax.scan(body, x,
-                                     (params, caches, w8a8_mask))
+        x, new_caches = jax.lax.scan(
+            body, x, (params, caches, w8a8_mask, w8a8_wq))
         return x, new_caches
 
     def prefill(self, params, x, *, positions=None, segment_ids=None,

@@ -64,17 +64,21 @@ def kernel_fallbacks() -> dict:
 
 
 def resolve_decode_kernel(requested: str, *, tp: int = 1,
-                          site: str = "decode") -> str:
+                          site: str = "decode",
+                          num_heads: Optional[int] = None,
+                          num_kv_heads: Optional[int] = None) -> str:
     """Resolve an ``attn_kernel`` request to the path that will run.
 
     ``"auto"`` → the paged Pallas kernel on TPU, the XLA-gather
     reference elsewhere (interpret-mode Pallas loses to the XLA-fused
     gather on CPU — the same heuristic ``flash_attention`` uses).
-    An explicit ``"paged"`` is honored everywhere EXCEPT under a
-    tp-sharded GSPMD activation context with real Mosaic lowering
-    ("Mosaic kernels cannot be automatically partitioned"); interpret
-    mode lowers to partitionable jax ops and stays honored, so CPU
-    parity tests cover the kernel under any mesh."""
+    Under tp > 1 the paged call is wrapped in a shard_map over the
+    plan's tp axis (``paged_pallas.paged_attention_auto``) — Mosaic
+    kernels cannot be GSPMD-auto-partitioned, so each shard runs the
+    kernel on its local head slice. That only works when BOTH head
+    counts divide by tp; a non-divisible model (or unknown head
+    counts) still degrades to the gather path, counted at the ``tp``
+    site."""
     if requested not in ("auto", "paged", "reference"):
         raise ValueError(
             f"attn_kernel must be auto|paged|reference, got {requested!r}")
@@ -82,15 +86,20 @@ def resolve_decode_kernel(requested: str, *, tp: int = 1,
     if resolved == "auto":
         resolved = "paged" if jax.default_backend() == "tpu" \
             else "reference"
-    # the tp guard applies to BOTH an explicit "paged" and an
-    # auto-derived one — a tp-sharded TPU plan must degrade to the
-    # gather path, never hand GSPMD a raw Mosaic call
+    # tp > 1: honor "paged" only when the shard_map wrapper can slice
+    # the head axis evenly across the tp axis — a raw Mosaic call must
+    # never be handed to GSPMD for auto-partitioning
     if resolved == "paged" and tp > 1:
-        from hetu_tpu.ops.flash_pallas import _interpret_default
-        if not _interpret_default():
+        if num_heads is None or num_kv_heads is None:
             record_kernel_fallback(
-                site, f"tp={tp} GSPMD context cannot auto-partition a "
-                      f"Mosaic kernel (wrap-in-shard_map is future work)")
+                site, f"tp={tp} with unknown head counts — cannot "
+                      f"prove the shard_map head slice is even")
+            return "reference"
+        if num_heads % tp or num_kv_heads % tp:
+            record_kernel_fallback(
+                site, f"tp={tp} does not divide heads "
+                      f"(q={num_heads}, kv={num_kv_heads}) — the "
+                      f"shard_map head slice would be ragged")
             return "reference"
     return resolved
 

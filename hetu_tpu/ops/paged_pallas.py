@@ -280,6 +280,75 @@ def paged_attention_pallas(q, k, v, block_tables, q_offset, *,
     return out
 
 
+def paged_attention_auto(q, k, v, block_tables, q_offset, *,
+                         k_scale=None, v_scale=None,
+                         scale: Optional[float] = None,
+                         pages_per_step: Optional[int] = None,
+                         interpret: Optional[bool] = None,
+                         return_lse: bool = False):
+    """:func:`paged_attention_pallas`, tp-aware.
+
+    Mosaic kernels cannot be GSPMD-auto-partitioned, so under a
+    tp-sharded activation context the raw call would not compile — the
+    historical fallback was the gather path (the ``tp`` fallback site).
+    This wrapper closes that gap: when the current plan binds a tp axis
+    of size > 1 and both head counts divide it, the kernel call is
+    wrapped in ``shard_map`` over that axis — each shard streams only
+    its LOCAL head slice of the paged arena (block tables and offsets
+    ride replicated; the GQA group layout is head-major, so an even
+    hkv split keeps q-head groups contiguous per shard). Everything
+    else (no context, tp == 1, ragged heads — which
+    ``resolve_decode_kernel`` already degrades) is the plain call."""
+    from hetu_tpu.parallel.sharding import (
+        _axis_size, current_act_sharding,
+    )
+
+    def plain(q=q, k=k, v=v, tbl=block_tables, off=q_offset,
+              ks=k_scale, vs=v_scale):
+        return paged_attention_pallas(
+            q, k, v, tbl, off, k_scale=ks, v_scale=vs, scale=scale,
+            pages_per_step=pages_per_step, interpret=interpret,
+            return_lse=return_lse)
+
+    ctx = current_act_sharding()
+    if ctx is None:
+        return plain()
+    mesh = ctx.mesh
+    head_ax = ctx.tp if isinstance(ctx.tp, str) else None
+    nh = _axis_size(mesh, head_ax)
+    if nh <= 1:
+        return plain()
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % nh or hkv % nh:
+        # resolve_decode_kernel degrades ragged head counts before the
+        # trace ever reaches here; keep the plain call as the safe twin
+        return plain()
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    head_spec = P(None, None, head_ax, None)   # q/out/arena: heads dim 2
+    in_specs = (head_spec,) * 3 + (P(None, None), P(None))
+    args = (q, k, v, block_tables, jnp.asarray(q_offset, jnp.int32))
+    if k_scale is not None:
+        in_specs += (head_spec, head_spec)
+        args += (k_scale, v_scale)
+    out_specs = (head_spec, P(None, head_ax, None)) if return_lse \
+        else head_spec
+
+    def local(q, k, v, tbl, off, *scales):
+        ks, vs = scales if scales else (None, None)
+        return paged_attention_pallas(
+            q, k, v, tbl, off, k_scale=ks, v_scale=vs, scale=scale,
+            pages_per_step=pages_per_step, interpret=interpret,
+            return_lse=return_lse)
+
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, axis_names=set(mesh.shape),
+                   check_vma=False)
+    return fn(*args)
+
+
 def paged_attention_reference(q, k, v, block_tables, q_offset, *,
                               k_scale=None, v_scale=None,
                               scale: Optional[float] = None,

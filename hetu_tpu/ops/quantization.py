@@ -81,8 +81,21 @@ def int8_w8a8_matmul(x, w, *, dtype=None):
     vectors FUSE into one rank-1 rescale of the int32 result:
     ``out = acc * x_scale ⊗ w_scale``. Output in ``x``'s dtype (or
     ``dtype``)."""
-    xq, xs = quantize_int8(x, axis=-1)           # (..., 1) per-token
     wq, ws = quantize_int8(w, axis=0)            # (1, out) per-channel
+    return int8_w8a8_matmul_prequant(x, wq, ws, dtype=dtype)
+
+
+def int8_w8a8_matmul_prequant(x, wq, ws, *, dtype=None):
+    """W8A8 matmul against an ALREADY-quantized weight (``wq`` int8,
+    ``ws`` fp32 per-output-channel scale from
+    ``quantize_int8(w, axis=0)``).
+
+    The decode lane's weights never change between steps, so
+    quantizing them inside every fused step is pure waste: half the
+    weight reads (fp32 load + int8 store per step) plus the abs/max
+    reduction. Pre-quantize ONCE (engine construction / weight swap)
+    and only the per-token activation quant remains on the hot path."""
+    xq, xs = quantize_int8(x, axis=-1)           # (..., 1) per-token
     acc = jax.lax.dot_general(
         xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)

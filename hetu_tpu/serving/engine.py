@@ -98,7 +98,8 @@ from hetu_tpu.serving.kv_pool import (
 from hetu_tpu.serving.prefix_cache import PrefixCache
 from hetu_tpu.serving.scheduler import Request, SamplingParams, Scheduler
 from hetu_tpu.serving.speculative import (
-    ModelDraftsman, NgramDraftsman, check_draft_depth,
+    ModelDraftsman, NgramDraftsman, adjust_logits, check_draft_depth,
+    check_sampled_draft, speculative_verify,
 )
 from hetu_tpu.telemetry.flight import HangWatchdog, flight_record
 from hetu_tpu.telemetry.slo import SLOEngine, default_serving_rules
@@ -110,28 +111,13 @@ def sample_slots(logits, temperature, top_k, top_p, rng):
     → (S,) int32 tokens. Mirrors ``generation._sample`` semantics
     (greedy at temperature 0, top-k keeps values >= the kth, nucleus
     keeps the smallest prefix whose prior mass < top_p) but every knob
-    is data, not Python — one compile covers every request mix."""
-    S, V = logits.shape
+    is data, not Python — one compile covers every request mix. The
+    masking arithmetic lives in ``speculative.adjust_logits`` so the
+    rejection-sampling verify lane's target distribution p is bitwise
+    THIS sampler's."""
+    S = logits.shape[0]
     greedy = jnp.argmax(logits, axis=-1)
-    t = jnp.where(temperature > 0.0, temperature, 1.0)
-    scaled = logits / t[:, None].astype(logits.dtype)
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-    kth = jnp.take_along_axis(
-        sorted_desc, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=1)
-    keep_k = (top_k <= 0)[:, None] | (scaled >= kth)
-    masked = jnp.where(keep_k, scaled, -jnp.inf)
-    # the k-mask only replaces a value-SUFFIX of the sorted order with
-    # -inf, so the sorted masked distribution is derivable — no second
-    # O(V log V) sort on the decode hot path
-    sd = jnp.where((top_k <= 0)[:, None] | (sorted_desc >= kth),
-                   sorted_desc, -jnp.inf)
-    probs = jax.nn.softmax(sd, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = cum - probs < top_p[:, None]    # mass *before* this token
-    cutoff = jnp.min(jnp.where(keep, sd, jnp.inf), axis=-1,
-                     keepdims=True)
-    use_p = ((top_p > 0.0) & (top_p < 1.0))[:, None]
-    masked = jnp.where(use_p & (masked < cutoff), -jnp.inf, masked)
+    masked = adjust_logits(logits, temperature, top_k, top_p)
     drawn = jax.vmap(jax.random.categorical)(
         jax.random.split(rng, S), masked)
     return jnp.where(temperature == 0.0, greedy, drawn).astype(jnp.int32)
@@ -280,7 +266,8 @@ class ServingEngine:
                     "to enable the verify lane")
             self._draftsman = ModelDraftsman(
                 draft_model, draft_params, slots=self.pool.slots,
-                max_len=max_len, spec_depth=self.spec_depth)
+                max_len=max_len, spec_depth=self.spec_depth,
+                target_vocab=model.cfg.vocab_size)
         elif self.spec_depth:
             if draft != "ngram":
                 raise ValueError(f"unknown draft source {draft!r} "
@@ -323,12 +310,19 @@ class ServingEngine:
         self._slot_req: list[Optional[Request]] = [None] * S
         self._prefilling: list[dict] = []        # FCFS in-flight prefills
         self._cp_pending: list[dict] = []        # admitted CP-lane reqs
-        self._cp_seed = 0                        # lane sampling stream
         #: max requests that can FINISH prefill in one iteration (each
         #: needs >= 1 pack token) — the prefill lane's head/sample width
         self._fin_cap = max(1, min(S, self.prefill_chunk))
         self._evictions_synced = 0               # scheduler ledger → ctr
         self._key = jax.random.key(seed)
+        # per-slot commit-key state (raw jax.random.key_data layout):
+        # the sampled lane's traced PRNG stream — one split consumed
+        # per committed token, exactly generate()'s discipline, so an
+        # identical-seed sampled request replays bit-for-bit. Admission
+        # seeds it (SamplingParams.seed, else engine seed + req id);
+        # the fused step returns the advanced state every iteration.
+        self._kw = int(jax.random.key_data(self._key).shape[-1])
+        self._key_state = np.zeros((S, self._kw), np.uint32)
         self._iter = 0
         self._next_id = 0
         self._requests_by_id: dict[int, Request] = {}  # RPC poll map
@@ -363,8 +357,11 @@ class ServingEngine:
         # compiled step, so the 1-compile audit is untouched.
         from hetu_tpu.ops.attention import resolve_decode_kernel
         tp = plan.strategy.tp if plan is not None else 1
+        _attn_mod = model.blocks.block.attn
         self.attn_kernel = resolve_decode_kernel(
-            attn_kernel, tp=tp, site="serving_decode")
+            attn_kernel, tp=tp, site="serving_decode",
+            num_heads=_attn_mod.num_heads,
+            num_kv_heads=_attn_mod.num_kv_heads)
         # prefill lanes: "flash" packs the chunk as ONE row — intra-pack
         # flash attention with segment isolation, LSE-combined with each
         # token's arena history through its block table; "reference" is
@@ -407,6 +404,11 @@ class ServingEngine:
                     mask[np.asarray(list(w8a8), int)] = True
             self._w8a8_mask = jnp.asarray(mask) if mask is not None \
                 else None
+        # pre-quantized W8A8 weight tree: the decode lane's weights
+        # never change between steps, so quantize ONCE here (and again
+        # on every swap_params — stale int8 weights would silently
+        # serve old parameters) instead of per fused step
+        self._w8a8_wq = self._prequantize_decode_weights()
 
         self._fn = self._build_step()
         self._cp_fn = self._build_cp_prefill() \
@@ -439,6 +441,18 @@ class ServingEngine:
 
         return (jax.jit(spill), jax.jit(resume, donate_argnums=(0,)))
 
+    def _prequantize_decode_weights(self):
+        """Build the decode lane's pre-quantized W8A8 weight tree from
+        the CURRENT params (None when the lane is off). The tree rides
+        the fused step as a traced operand — not a closure — so
+        :meth:`swap_params` only has to rebuild the tree, never the
+        compiled step."""
+        if self._w8a8_mask is None:
+            return None
+        mlp = self.model.blocks.block.mlp
+        return mlp.prequantize(self.params["blocks"]["mlp"],
+                               stacked=True)
+
     # -- the jit-once fused step --------------------------------------------
     def _build_step(self):
         model = self.model
@@ -448,11 +462,15 @@ class ServingEngine:
         w8a8_mask = self._w8a8_mask
         flash_lane = self.prefill_attn != "reference"
         pack_impl = self._pack_impl
+        # the draftsman's q rows: host-only draftsmen (and no
+        # draftsman) propose deterministically, so q is the one-hot of
+        # the draft — synthesized on-device; a device draftsman ships
+        # its sampled softmax rows through spec["q"]
+        host_q = self._draftsman is None \
+            or getattr(self._draftsman, "host_only", True)
 
-        def step(params, caches, ctl, pf, bt, cow, spec, key, it):
+        def step(params, caches, ctl, pf, bt, cow, spec, wq):
             record_trace("serving_step")    # churn must never re-enter
-            rng = jax.random.fold_in(key, it)
-            rng_dec, rng_pf = jax.random.split(rng)
 
             # copy-on-write block copies for this iteration's partial
             # prefix hits: dst indexes are the arena size (dropped) on
@@ -471,9 +489,14 @@ class ServingEngine:
             # the decode lane is a VERIFY lane (speculative decoding):
             # every slot feeds its last token plus up to K drafted
             # tokens as K+1 q rows spanning positions pos..pos+K — one
-            # forward both writes their KV and yields each row's greedy
-            # continuation, so a draft is ACCEPTED iff it equals what
-            # sequential decode would have emitted there. Per-slot
+            # forward both writes their KV and yields each row's target
+            # distribution, and ``speculative_verify`` runs the
+            # rejection-sampling acceptance rule per slot: draft i
+            # survives with prob min(1, p/q) (exactly the greedy
+            # leading-match rule at temperature 0, where q is one-hot),
+            # and the first rejection resamples from the normalized
+            # residual max(0, p - q) — so the committed stream is
+            # distributed exactly as sequential sampling. Per-slot
             # draft depth (spec["len"]) is DATA: depth 0 reduces to the
             # classic one-token decode, bit for bit. Rows past a slot's
             # depth are masked from writing (row_mask) — their
@@ -492,34 +515,36 @@ class ServingEngine:
                     model, params, tok_in, positions, caches,
                     slot_mask=ctl["active"], block_tables=bt,
                     row_mask=row_valid, attn_kernel=kern,
-                    w8a8_mask=w8a8_mask)
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                # leading-match acceptance: draft i commits iff drafts
-                # 1..i all matched (cumprod) and i < depth
-                match = (spec["tok"] == greedy[:, :K]) \
-                    & (lane[:, :K] < spec["len"][:, None])
-                a = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
-                                        axis=1), axis=1)
-                # the bonus token samples from row a's logits — the
-                # first unconfirmed position; at depth 0 this is row 0,
-                # exactly the pre-speculation decode sample
-                lg_bonus = jnp.take_along_axis(
-                    logits, a[:, None, None], axis=1)[:, 0]
-                bonus = sample_slots(lg_bonus, ctl["temp"],
-                                     ctl["topk"], ctl["topp"], rng_dec)
-                cols = jnp.arange(K + 1)[None, :]
-                committed = jnp.where(cols < a[:, None], greedy, 0)
-                committed = jnp.where(cols == a[:, None],
-                                      bonus[:, None], committed)
-                return (caches, committed,
-                        (a + 1).astype(jnp.int32), bonus)
+                    w8a8_mask=w8a8_mask, w8a8_wq=wq)
+                # proposal probs q: host draftsmen propose
+                # deterministically — their q is the one-hot of the
+                # draft, synthesized here so the host never ships a
+                # (S, K, V) table; a device draftsman's sampled
+                # softmax rows ride in through spec["q"]
+                V = logits.shape[-1]
+                if host_q:
+                    qprobs = jax.nn.one_hot(spec["tok"], V,
+                                            dtype=jnp.float32)
+                else:
+                    qprobs = spec["q"].astype(jnp.float32)
+                committed, ncommit, last_tok, new_kd = jax.vmap(
+                    speculative_verify)(
+                    logits, spec["tok"], spec["len"], qprobs,
+                    ctl["temp"], ctl["topk"], ctl["topp"],
+                    ctl["key"])
+                # inactive slots must not burn PRNG state — their
+                # sampling stream has to match one-shot generate
+                new_kd = jnp.where(ctl["active"][:, None],
+                                   new_kd, ctl["key"])
+                return caches, committed, ncommit, last_tok, new_kd
 
             def no_decode(caches):
                 S = ctl["pos"].shape[0]
                 z = jnp.zeros((S,), jnp.int32)
-                return caches, jnp.zeros((S, K + 1), jnp.int32), z, z
+                return (caches, jnp.zeros((S, K + 1), jnp.int32),
+                        z, z, ctl["key"])
 
-            caches, committed, ncommit, bonus = jax.lax.cond(
+            caches, committed, ncommit, last_tok, new_kd = jax.lax.cond(
                 ctl["active"].any(), do_decode, no_decode, caches)
 
             # packed prefill: a C-token budget shared by every
@@ -568,29 +593,55 @@ class ServingEngine:
                 lg = jnp.einsum("bse,ve->bsv", hf.astype(jnp.float32),
                                 w.astype(jnp.float32))[:, 0]
                 fs = pf["fin_slot"]
-                firsts = sample_slots(
+
+                # first-token sampling mirrors generate's prefill
+                # exactly: split the slot's key once, draw with the
+                # sub — so an identical-seed request's whole sampling
+                # stream is bitwise the one-shot generate stream
+                def sample_row(lg_row, temp, tk, tp, kdr):
+                    k = jax.random.wrap_key_data(kdr)
+                    k, sub = jax.random.split(k)
+                    masked = adjust_logits(lg_row, temp, tk, tp)
+                    drawn = jax.random.categorical(sub, masked)
+                    tok = jnp.where(temp == 0.0,
+                                    jnp.argmax(lg_row, axis=-1),
+                                    drawn)
+                    return (tok.astype(jnp.int32),
+                            jax.random.key_data(k))
+
+                firsts, pf_kd = jax.vmap(sample_row)(
                     lg, jnp.take(ctl["temp"], fs),
                     jnp.take(ctl["topk"], fs),
-                    jnp.take(ctl["topp"], fs), rng_pf)
-                return caches, firsts
+                    jnp.take(ctl["topp"], fs),
+                    jnp.take(ctl["key"], fs, axis=0))
+                return caches, firsts, pf_kd
 
             def no_prefill(caches):
-                return caches, jnp.zeros((R,), jnp.int32)
+                return (caches, jnp.zeros((R,), jnp.int32),
+                        jnp.take(ctl["key"], pf["fin_slot"], axis=0))
 
-            caches, first_toks = jax.lax.cond(
+            caches, first_toks, pf_kd = jax.lax.cond(
                 pf["run"], do_prefill, no_prefill, caches)
+            # prefill completions ADOPT their post-sample key state:
+            # scatter the <= R finished rows' keys over the slot axis
+            # (unused fin rows target S and drop)
+            S = ctl["pos"].shape[0]
+            scat = jnp.where(pf["run"] & pf["fin_valid"],
+                             pf["fin_slot"], S)
+            new_key = new_kd.at[scat].set(pf_kd, mode="drop")
             # device-resident control advance: every active slot
-            # committed ncommit tokens (accepted drafts + the bonus —
-            # their KV landed at pos..pos+ncommit-1), so pos+ncommit /
-            # last_tok=bonus — returned so the host can reuse the
-            # control vectors NEXT iteration without re-uploading them
-            # (it falls back to a host rebuild only when an admission /
-            # prefill completion / finish rewrote control state)
+            # committed ncommit tokens (accepted drafts + the verify
+            # token — their KV landed at pos..pos+ncommit-1), so
+            # pos+ncommit / last_tok — returned so the host can reuse
+            # the control vectors NEXT iteration without re-uploading
+            # them (it falls back to a host rebuild only when an
+            # admission / prefill completion / finish rewrote control
+            # state)
             new_pos = ctl["pos"] + jnp.where(ctl["active"], ncommit, 0)
-            new_last = jnp.where(ctl["active"], bonus,
+            new_last = jnp.where(ctl["active"], last_tok,
                                  ctl["last_tok"])
             return (caches, committed, ncommit, first_toks,
-                    new_pos, new_last)
+                    new_pos, new_last, new_key)
 
         return jax.jit(step, donate_argnums=(1,))
 
@@ -667,8 +718,16 @@ class ServingEngine:
             w = generation._head_weight(model, params)
             lg = jnp.einsum("bse,ve->bsv", hf.astype(jnp.float32),
                             w.astype(jnp.float32))[:, 0]
-            tok = sample_slots(lg, temp, topk, topp, key)
-            return caches, tok[0]
+            # per-request key chain, same as the packed lane: split
+            # once, draw with the sub, return the advanced state
+            k = jax.random.wrap_key_data(key)
+            k, sub = jax.random.split(k)
+            masked = adjust_logits(lg[0], temp[0], topk[0], topp[0])
+            drawn = jax.random.categorical(sub, masked)
+            tok = jnp.where(temp[0] == 0.0,
+                            jnp.argmax(lg[0], axis=-1), drawn)
+            return caches, tok.astype(jnp.int32), \
+                jax.random.key_data(k)
 
         return jax.jit(cp_prefill, donate_argnums=(1,))
 
@@ -691,12 +750,13 @@ class ServingEngine:
             from hetu_tpu.data.packing import zigzag_permute
             tokens = zigzag_permute(tokens, self._cp, axis=1)
             positions = zigzag_permute(positions, self._cp, axis=1)
-        self._cp_seed += 1
         return {"req": req, "slot": slot, "P": P, "bucket": L,
                 "tokens": tokens, "positions": positions,
                 "table": self._bt[slot:slot + 1].copy(),
-                "key": jax.random.fold_in(self._key,
-                                          0x7CF00000 + self._cp_seed)}
+                # the slot's admission-seeded commit key (raw state):
+                # the CP lane samples the first token from the SAME
+                # per-request stream the packed lane would have
+                "key": self._key_state[slot].copy()}
 
     def _exec_cp_prefill(self, job: dict, t0: float, reg) -> None:
         """Run one prepared CP-lane prefill. The device call happens
@@ -710,7 +770,7 @@ class ServingEngine:
         ctx = self._plan.act if self._plan is not None \
             else contextlib.nullcontext()
         with ctx:
-            caches, tok = self._cp_fn(
+            caches, tok, kd = self._cp_fn(
                 self.params, self.pool.caches, job["tokens"],
                 job["positions"], job["table"], np.int32(P - 1),
                 np.asarray([sp.temperature], np.float32),
@@ -719,6 +779,7 @@ class ServingEngine:
         self.pool.caches = caches
         now = time.monotonic()
         with self._lock:
+            self._key_state[slot] = np.asarray(kd)
             self._pos[slot] = P
             self._active[slot] = True
             self._ctl_dirty = True
@@ -818,7 +879,8 @@ class ServingEngine:
                 pos=int(self._pos[slot]),
                 last_tok=int(self._last_tok[slot]),
                 tokens=list(req.tokens),
-                weight_version=req.weight_version)
+                weight_version=req.weight_version,
+                key_state=self._key_state[slot].copy())
             self.spill_arena.put(entry)
             req.spill = entry
             req.preemptions += 1
@@ -868,6 +930,10 @@ class ServingEngine:
             req.resumed_blocks += nb
             self._pos[slot] = entry.pos
             self._last_tok[slot] = entry.last_tok
+            if entry.key_state is not None:
+                # the commit-key stream resumes mid-request: sampling
+                # continues bit-for-bit where the spill cut it
+                self._key_state[slot] = np.asarray(entry.key_state)
             self._active[slot] = True
             self._ctl_dirty = True
             req.status = "decode"
@@ -977,7 +1043,8 @@ class ServingEngine:
             spill_plan = {"slot": slot, "nb": nb,
                           "ids": self._bt[slot].copy(),
                           "pos": int(self._pos[slot]),
-                          "last_tok": int(self._last_tok[slot])}
+                          "last_tok": int(self._last_tok[slot]),
+                          "key_state": self._key_state[slot].copy()}
         # the device gather runs without self._lock (submit()/load
         # stay responsive) but under the iteration lock we hold
         data = self._spill_blocks(spill_plan["ids"],
@@ -990,7 +1057,8 @@ class ServingEngine:
                 pos=spill_plan["pos"],
                 last_tok=spill_plan["last_tok"],
                 tokens=list(req.tokens),
-                weight_version=req.weight_version)
+                weight_version=req.weight_version,
+                key_state=spill_plan["key_state"])
             self._detach_locked(req, spill_plan["slot"])
             req.status = "evicted"
             req.spilled_blocks += spill_plan["nb"]
@@ -1067,6 +1135,12 @@ class ServingEngine:
         (``prefill_only`` / the fleet router) evicts the KV and resumes
         it on a decode-tier replica."""
         sampling = sampling or SamplingParams()
+        if sampling.temperature > 0 and self.spec_depth \
+                and self._draftsman is not None:
+            # sampled speculation runs the rejection-sampling verify
+            # lane, which needs the draftsman's proposal probs (q) —
+            # fail the submit loudly instead of silently mis-sampling
+            check_sampled_draft(self._draftsman)
         if handoff and resume is not None:
             raise ValueError(
                 "handoff with resume makes no sense: a resumed "
@@ -1178,6 +1252,9 @@ class ServingEngine:
                         ": in-flight KV was prefilled under the old "
                         "weights")
                 self.params = params
+                # stale int8 decode weights would silently serve the
+                # OLD parameters — re-quantize from the new tree
+                self._w8a8_wq = self._prequantize_decode_weights()
                 self.weight_version = int(version) \
                     if version is not None else self.weight_version + 1
                 self.pool.weight_version = self.weight_version
@@ -1220,6 +1297,13 @@ class ServingEngine:
             self._temp[slot] = sp.temperature
             self._topk[slot] = sp.top_k
             self._topp[slot] = sp.top_p
+            # seed the slot's commit-key stream: an explicit
+            # SamplingParams.seed replays bit-for-bit against one-shot
+            # generate(rng=jax.random.key(seed)); otherwise derive a
+            # per-request stream from the engine seed
+            k0 = jax.random.key(int(sp.seed)) if sp.seed is not None \
+                else jax.random.fold_in(self._key, req.id)
+            self._key_state[slot] = np.asarray(jax.random.key_data(k0))
             self._slot_req[slot] = req
             plan = req.admit
             self._bt[slot, :] = 0
@@ -1310,23 +1394,31 @@ class ServingEngine:
             # operands rebuilt every iteration. Depth clamps: never
             # beyond the request's remaining token budget - 1 (so
             # commits can't blow past max_tokens or the slot's
-            # allocated blocks), and zero for sampled (temperature > 0)
-            # slots — speculation is a greedy-lane optimization. The
-            # n-gram index is host-only and proposes here; the model
-            # draftsman's DEVICE step runs between the lock windows
-            # below (submit()/load stay responsive through it — the
-            # iteration lock we hold keeps its inputs frozen).
+            # allocated blocks). Sampled (temperature > 0) slots
+            # speculate too — the rejection-sampling verify lane keeps
+            # their output distribution exact (``speculative_verify``).
+            # The n-gram index is host-only and proposes here; the
+            # model draftsman's DEVICE step runs between the lock
+            # windows below (submit()/load stay responsive through it —
+            # the iteration lock we hold keeps its inputs frozen).
             d_tok = np.zeros((S, K), np.int32)
             d_len = np.zeros(S, np.int32)
+            d_q = None
+            if K and self._draftsman is not None \
+                    and not self._draftsman.host_only:
+                # device draftsman: its q rows ride the spec operand —
+                # ALWAYS present so the step's pytree signature (and
+                # the 1-compile audit) never depends on churn
+                d_q = np.zeros((S, K, self.model.cfg.vocab_size),
+                               np.float32)
             model_draft_in = None
             if K and active_prev.size:
                 budget = np.zeros(S, np.int32)
                 for r in active_prev:
                     req = self._slot_req[r]
                     sp = req.sampling
-                    if sp.temperature == 0.0:
-                        budget[r] = max(0, min(
-                            K, sp.max_tokens - len(req.tokens) - 1))
+                    budget[r] = max(0, min(
+                        K, sp.max_tokens - len(req.tokens) - 1))
                 if self._draftsman is not None and budget.any():
                     if self._draftsman.host_only:
                         for r in active_prev:
@@ -1345,13 +1437,22 @@ class ServingEngine:
                             seqs[r] = req.prompt.tolist() \
                                 + list(req.tokens)
                         model_draft_in = (seqs, self._pos.copy(),
-                                          self._active.copy(), budget)
+                                          self._active.copy(), budget,
+                                          self._temp.copy(),
+                                          self._topk.copy(),
+                                          self._topp.copy(),
+                                          self._key_state.copy())
         if model_draft_in is not None:
-            d_tok, d_len = self._draftsman.propose_all(*model_draft_in)
-            d_len = np.minimum(d_len, model_draft_in[3])
+            d_tok, d_len, dq = self._draftsman.propose_all(
+                *model_draft_in[:4], temps=model_draft_in[4],
+                topks=model_draft_in[5], topps=model_draft_in[6],
+                keys=model_draft_in[7])
+            d_tok = np.asarray(d_tok)
+            d_len = np.minimum(np.asarray(d_len), model_draft_in[3])
+            d_q = np.asarray(dq, np.float32)
             # a zoo draft model may have a larger vocab than the
-            # target: clamp (a clamped draft that still matches greedy
-            # is by definition the token sequential decode would emit)
+            # target: clamp (the draftsman already masks its sampling
+            # to the target vocab; this guards legacy draft paths)
             v = getattr(self.model.cfg, "vocab_size", None)
             if v:
                 np.clip(d_tok, 0, v - 1, out=d_tok)
@@ -1362,7 +1463,8 @@ class ServingEngine:
                                  "active": jnp.asarray(self._active),
                                  "temp": jnp.asarray(self._temp),
                                  "topk": jnp.asarray(self._topk),
-                                 "topp": jnp.asarray(self._topp)}
+                                 "topp": jnp.asarray(self._topp),
+                                 "key": jnp.asarray(self._key_state)}
                 self._bt_dev = jnp.asarray(self._bt)
                 self._ctl_dirty = False
             ctl = self._ctl_dev
@@ -1379,6 +1481,7 @@ class ServingEngine:
             thist = np.zeros(C, np.int32)        # per-token chunk start
             fin_row = np.zeros(R, np.int32)
             fin_slot = np.zeros(R, np.int32)
+            fin_valid = np.zeros(R, bool)        # rows really finishing
             fills: list[tuple[dict, int]] = []   # (entry, n) this iter
             fin_ents: list[dict] = []            # completes this iter
             used = 0
@@ -1401,13 +1504,14 @@ class ServingEngine:
                 if off + n >= len(req.prompt):
                     fin_row[len(fin_ents)] = used + n - 1
                     fin_slot[len(fin_ents)] = ent["slot"]
+                    fin_valid[len(fin_ents)] = True
                     fin_ents.append(ent)
                 fills.append((ent, n))
                 used += n
             pf = {"run": np.bool_(used > 0), "tokens": tokens,
                   "pos": tpos, "slot": tslot, "valid": tvalid,
-                  "seg": tseg, "hist": thist,
-                  "fin_row": fin_row, "fin_slot": fin_slot}
+                  "seg": tseg, "hist": thist, "fin_row": fin_row,
+                  "fin_slot": fin_slot, "fin_valid": fin_valid}
             # CoW lanes: unused dst = n_blocks scatters out of bounds
             cow_src = np.zeros(S, np.int32)
             cow_dst = np.full(S, self.pool.n_blocks, np.int32)
@@ -1420,11 +1524,13 @@ class ServingEngine:
         ctx = self._plan.act if self._plan is not None \
             else contextlib.nullcontext()
         spec = {"tok": d_tok, "len": d_len}
+        if d_q is not None:
+            spec["q"] = d_q
         with ctx:
             (caches, committed, ncommit, first_toks, pos_dev,
-             last_dev) = self._fn(
+             last_dev, key_dev) = self._fn(
                 self.params, self.pool.caches, ctl, pf, bt, cow, spec,
-                self._key, np.int32(self._iter))
+                self._w8a8_wq)
         self.pool.caches = caches
         em = np.asarray(committed)               # (S, K+1)
         nc = np.asarray(ncommit)                 # (S,)
@@ -1433,6 +1539,11 @@ class ServingEngine:
 
         with self._lock:
             self._iter += 1
+            # the host mirror of the per-slot commit keys always tracks
+            # the device: the step advanced them (verify consumption +
+            # prefill first-token draws) for exactly the slots that
+            # sampled this iteration
+            self._key_state[:] = np.asarray(key_dev)
             if active_prev.size:
                 reg.counter(
                     "serving_decode_slot_steps_total",
@@ -1479,6 +1590,7 @@ class ServingEngine:
                     # drafts — an EOS mid-commit discards the tail,
                     # and the acceptance ledgers must not claim it
                     kept = min(taken, n - 1)
+                    sampled = float(self._temp[r]) > 0.0
                     req.drafted += dr
                     req.accepted += kept
                     reg.counter(
@@ -1491,6 +1603,21 @@ class ServingEngine:
                             "draft tokens the verify lane accepted "
                             "(committed without their own decode "
                             "iteration)").inc(kept)
+                        if sampled:
+                            reg.counter(
+                                "serving_sampled_accepted_tokens_total",
+                                "draft tokens accepted by the "
+                                "rejection-sampling verify lane "
+                                "(temperature > 0 slots)").inc(kept)
+                    if sampled and n - 1 < dr:
+                        # the device rejected draft column n-1 and
+                        # drew the commit token from the normalized
+                        # residual max(0, p - q)
+                        reg.counter(
+                            "serving_resample_tokens_total",
+                            "tokens drawn from the rejection-"
+                            "sampling residual after a draft was "
+                            "rejected (sampled speculation)").inc(1)
             # prefill progress for every request that got pack tokens
             for ent, n in fills:
                 ent["off"] += n
@@ -1526,7 +1653,7 @@ class ServingEngine:
             # _ctl_dirty, which forces a rebuild from the np mirrors.
             if not self._ctl_dirty:
                 self._ctl_dev = dict(self._ctl_dev, pos=pos_dev,
-                                     last_tok=last_dev)
+                                     last_tok=last_dev, key=key_dev)
             self._record_gauges()
         step_s = time.monotonic() - t0
         reg.histogram("serving_step_seconds",
@@ -1597,8 +1724,11 @@ class ServingEngine:
             reg.histogram(
                 "serving_draft_acceptance_ratio",
                 "per-request accepted/drafted ratio at finish (the "
-                "speculation win tracks this)").observe(
-                req.accepted / req.drafted)
+                "speculation win tracks this), split by verify path "
+                "(greedy match vs rejection sampling)").observe(
+                req.accepted / req.drafted,
+                path="sampled" if req.sampling.temperature > 0
+                else "greedy")
         # a finished request can still own a spill entry (preempted,
         # resumed elsewhere... or cancelled paths) — never leak it
         if req.spill is not None \

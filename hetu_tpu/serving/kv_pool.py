@@ -246,6 +246,11 @@ class SpillEntry:
     #                                  the cross-process spans share one
     #                                  trace_id (ISSUE 16); absent on
     #                                  wire docs from older peers
+    key_state: Optional[object] = None  # (KW,) uint32 raw PRNG key
+    #                                  state at spill time — a sampled
+    #                                  request must resume its commit
+    #                                  key stream exactly where it
+    #                                  stopped or its replay diverges
 
     def nbytes(self) -> int:
         return sum(int(a.nbytes) for a in self.data)

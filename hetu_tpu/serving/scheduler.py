@@ -49,6 +49,12 @@ class SamplingParams:
     eos_id: Optional[int] = None
     max_tokens: int = 16
     priority: int = 1
+    #: per-request PRNG seed for sampled decoding (temperature > 0):
+    #: the engine derives the slot's traced key stream from it, so a
+    #: sampled run replays bit-for-bit — and matches one-shot
+    #: ``generate(rng=jax.random.key(seed))``. None derives a stream
+    #: from the engine seed + request id (reproducible per engine).
+    seed: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -316,9 +322,13 @@ class Scheduler:
 
     def blocks_needed(self, req: Request) -> int:
         """Worst-case NEW blocks ``req`` needs (gross of prefix
-        sharing — the preemption planner's conservative bound)."""
+        sharing — the preemption planner's conservative bound).
+        Handoff requests decode elsewhere: a prefill-tier replica only
+        ever writes the prompt + the first token before releasing the
+        reservation, so price P+1 instead of P+max_tokens."""
         bs = self.block_size or self.max_len
-        return -(-(len(req.prompt) + req.sampling.max_tokens) // bs)
+        tail = 1 if req.handoff else req.sampling.max_tokens
+        return -(-(len(req.prompt) + tail) // bs)
 
     def preemption_victim(self, candidate: Request,
                           running) -> Optional[int]:
@@ -397,7 +407,12 @@ class Scheduler:
         allocated) and charged to this request."""
         bs = self.block_size
         P = len(req.prompt)
-        total = -(-(P + req.sampling.max_tokens) // bs)   # worst case
+        # handoff requests never decode here: the prefill tier writes
+        # the prompt + first token, ships the KV, and releases the
+        # blocks — reserving max_tokens of decode room would only
+        # throttle this tier's admission for space it never uses
+        tail = 1 if req.handoff else req.sampling.max_tokens
+        total = -(-(P + tail) // bs)                      # worst case
         shared: list[int] = []
         partial = None
         # CP-lane requests skip the prefix cache: their prefill is one

@@ -29,9 +29,22 @@ module is where drafts come from:
   arena uses.
 
 Both draftsmen are PROPOSERS only: the engine's verify lane accepts a
-draft token iff it equals what sequential greedy decode would have
-emitted, so a bad draftsman can only cost speed, never correctness
-(``docs/SERVING.md`` — "Speculation + QoS").
+draft token iff the rejection-sampling test passes — at temperature 0
+that reduces to "equals what sequential greedy decode would have
+emitted"; at temperature > 0 the Leviathan et al. correction accepts a
+draft with probability ``min(1, p_target/q_draft)`` and resamples the
+first rejection from the normalized residual ``max(0, p - q)``, which
+provably preserves the target sampler's output distribution. Either
+way a bad draftsman can only cost speed, never correctness
+(``docs/SERVING.md`` — "Speculation + QoS" / "Sampled speculation").
+
+To support the sampled lane, draftsmen surface per-token proposal
+probabilities ``q`` (``surfaces_q = True``): :class:`NgramDraftsman`
+proposals are deterministic so their q is a degenerate one-hot (the
+engine synthesizes it on-device); :class:`ModelDraftsman` SAMPLES its
+draft chain from its own adjusted softmax at the request's knobs and
+returns those rows — drafts must be distributed ~q for the accept
+test to be exact.
 """
 
 from __future__ import annotations
@@ -39,6 +52,160 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import numpy as np
+
+
+def adjust_logits(logits, temperature, top_k, top_p):
+    """Apply the serving sampler's temperature/top-k/top-p masking to
+    ``logits`` (..., V) and return the masked, scaled logits.
+
+    This is the single source of truth for BOTH the fused verify lane's
+    target distribution p and the draft models' proposal distribution q
+    — bitwise identical arithmetic to the engine's ``sample_slots`` (and
+    value-identical to ``generation._sample``), so a sampled serving
+    token drawn from these logits matches the one-shot reference.
+
+    ``temperature``/``top_k``/``top_p`` are traced scalars or arrays
+    broadcastable against the leading dims of ``logits`` — knob churn
+    is DATA, never a recompile."""
+    import jax
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / t[..., None].astype(logits.dtype)
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(
+        sorted_desc,
+        jnp.broadcast_to(jnp.clip(top_k - 1, 0, V - 1)[..., None],
+                         scaled.shape[:-1] + (1,)),
+        axis=-1)
+    keep_k = (top_k <= 0)[..., None] | (scaled >= kth)
+    masked = jnp.where(keep_k, scaled, -jnp.inf)
+    sd = jnp.where((top_k <= 0)[..., None] | (sorted_desc >= kth),
+                   sorted_desc, -jnp.inf)
+    probs = jax.nn.softmax(sd, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < top_p[..., None]
+    cutoff = jnp.min(jnp.where(keep, sd, jnp.inf), axis=-1,
+                     keepdims=True)
+    use_p = ((top_p > 0) & (top_p < 1))[..., None]
+    return jnp.where(use_p & (masked < cutoff), -jnp.inf, masked)
+
+
+def speculative_verify(logits, drafts, depth, q, temperature, top_k,
+                       top_p, key_data):
+    """Rejection-sampling verify for ONE slot — traced, vmapped over
+    the slot axis by the engine's fused step.
+
+    Inputs: ``logits`` (K+1, V) target rows over the draft window,
+    ``drafts`` (K,) proposed tokens, ``depth`` scalar per-slot draft
+    length, ``q`` (K, V) proposal probabilities the drafts were sampled
+    from, scalar sampling knobs, and ``key_data`` (KW,) the slot's raw
+    PRNG key state (``jax.random.key_data`` layout).
+
+    Per Leviathan et al.: draft i is accepted with probability
+    ``min(1, p_i[d_i] / q_i[d_i])`` (evaluated as ``u * q < p`` with an
+    independent uniform); at the first rejection the token is resampled
+    from the normalized residual ``max(0, p - q)``; if every draft is
+    accepted the bonus token is a fresh sample from the last row. At
+    temperature 0 the accept test collapses to ``draft == argmax`` and
+    the emitted values are bitwise the greedy verify lane's.
+
+    PRNG discipline mirrors ``generation.generate``: exactly ONE
+    ``jax.random.split`` is consumed per COMMITTED token (so a slot
+    that speculates is stream-compatible with one that does not, and a
+    no-draft sampled slot is bitwise identical to the one-shot
+    reference at the same seed); accept uniforms and residual draws
+    ride fold_in side-channels off the per-token subkeys.
+
+    Returns ``(committed (K+1,) int32, ncommit scalar int32,
+    last_tok scalar int32, new_key_data (KW,))``."""
+    import jax
+    import jax.numpy as jnp
+
+    K = drafts.shape[0]
+    V = logits.shape[-1]
+    temperature = jnp.asarray(temperature, jnp.float32)
+
+    # one split per potentially-committed token: ks[i] is the carry
+    # after i+1 splits (the new key state if i+1 tokens commit),
+    # subs[i] the subkey that samples token i
+    carry = jax.random.wrap_key_data(key_data)
+    ks, subs, accept_u = [], [], []
+    for i in range(K + 1):
+        carry, sub = jax.random.split(carry)
+        ks.append(jax.random.key_data(carry))
+        subs.append(jax.random.key_data(sub))
+        if i < K:
+            accept_u.append(jax.random.uniform(
+                jax.random.fold_in(sub, 0xACC)))
+    ks = jnp.stack(ks)                       # (K+1, KW)
+    subs = jnp.stack(subs)                   # (K+1, KW)
+    u = jnp.stack(accept_u) if K else jnp.zeros((0,), jnp.float32)
+
+    masked = adjust_logits(logits, temperature, top_k, top_p)
+    p = jax.nn.softmax(masked.astype(jnp.float32), axis=-1)  # (K+1, V)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # (K+1,)
+
+    lane = jnp.arange(K)
+    p_d = jnp.take_along_axis(p[:K], drafts[:, None], axis=-1)[:, 0]
+    q_d = jnp.take_along_axis(q, drafts[:, None], axis=-1)[:, 0]
+    samp_ok = u * q_d < p_d
+    greedy_ok = drafts == greedy[:K]
+    ok = jnp.where(temperature > 0, samp_ok, greedy_ok) \
+        & (lane < depth)
+    a = jnp.sum(jnp.cumprod(ok.astype(jnp.int32)))  # accepted count
+
+    # token at column a: greedy / residual-resample / fresh sample
+    q_pad = jnp.concatenate([q, jnp.zeros((1, V), q.dtype)], axis=0)
+    p_a = jnp.take(p, a, axis=0)
+    residual = jnp.maximum(p_a - jnp.take(q_pad, a, axis=0), 0.0)
+    r_sum = jnp.sum(residual)
+    use_resid = (temperature > 0) & (a < depth) & (r_sum > 0)
+    sub_a = jax.random.wrap_key_data(jnp.take(subs, a, axis=0))
+    masked_a = jnp.take(masked, a, axis=0)
+    drawn_full = jax.random.categorical(sub_a, masked_a)
+    resid_logits = jnp.where(residual > 0, jnp.log(residual), -jnp.inf)
+    drawn_resid = jax.random.categorical(sub_a, resid_logits)
+    tok_a = jnp.where(
+        temperature == 0.0, jnp.take(greedy, a),
+        jnp.where(use_resid, drawn_resid, drawn_full)).astype(jnp.int32)
+
+    cols = jnp.arange(K + 1)
+    drafts_pad = jnp.concatenate(
+        [drafts.astype(jnp.int32), jnp.zeros((1,), jnp.int32)])
+    committed = jnp.where(cols < a, drafts_pad, 0)
+    committed = jnp.where(cols == a, tok_a, committed)
+    new_key_data = jnp.take(ks, a, axis=0)
+    return (committed.astype(jnp.int32), (a + 1).astype(jnp.int32),
+            tok_a, new_key_data)
+
+
+def check_sampled_draft(draftsman) -> None:
+    """Refuse speculation at temperature > 0 with a draftsman that
+    cannot satisfy the sampled-verify contract.
+
+    The rejection-sampling accept test needs per-token proposal
+    probabilities ``q`` (``surfaces_q = True`` on the draftsman) and a
+    per-request PRNG key (seeded via ``SamplingParams.seed``) so
+    sampled runs are reproducible; a draftsman without q would force
+    the engine to guess the proposal distribution and silently skew
+    the output distribution — fail loudly at submit instead."""
+    if draftsman is None:
+        return
+    if not getattr(draftsman, "surfaces_q", False):
+        raise SpeculativeConfigError(
+            f"draftsman {type(draftsman).__name__} does not surface "
+            f"per-token proposal probabilities (q): speculation at "
+            f"temperature > 0 runs the rejection-sampling accept test "
+            f"min(1, p/q), which needs the draftsman's q rows "
+            f"(surfaces_q = True) and a per-request seed "
+            f"(SamplingParams.seed) for a reproducible PRNG stream — "
+            f"add q support to the draftsman or submit the request "
+            f"with temperature == 0")
 
 
 class SpeculativeConfigError(ValueError):
@@ -120,6 +287,11 @@ class NgramDraftsman:
     #: device work per iteration" (cheap enough to run under the lock)
     host_only = True
 
+    #: proposals are deterministic (a history lookup), so the proposal
+    #: distribution is a one-hot on the drafted token — the engine
+    #: synthesizes that q on-device, no host work here
+    surfaces_q = True
+
     def __init__(self, slots: int, *, ngram: int = 3):
         self.ngram = max(1, int(ngram))
         self._index: list[dict] = [dict() for _ in range(slots)]
@@ -189,8 +361,14 @@ class ModelDraftsman:
 
     host_only = False
 
+    #: sampled drafting: the chain is SAMPLED from the draft model's
+    #: adjusted softmax at the request's knobs and those rows are
+    #: returned as q — the rejection test's proposal distribution
+    surfaces_q = True
+
     def __init__(self, model, params, *, slots: int, max_len: int,
-                 spec_depth: int, cache_dtype=None):
+                 spec_depth: int, cache_dtype=None,
+                 target_vocab: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -199,6 +377,11 @@ class ModelDraftsman:
         check_draft_model(model)
         self.model = model
         self.params = params
+        # the verify lane's p lives over the TARGET vocab; q rows must
+        # match it, so draft logits past target_vocab are masked to
+        # -inf before sampling (a draft model may pad its vocab)
+        self.target_vocab = (int(target_vocab)
+                            if target_vocab is not None else None)
         self.K = int(spec_depth)
         self.H = self.K + 1                  # catch-up window width
         self.slots = int(slots)
@@ -224,13 +407,43 @@ class ModelDraftsman:
 
     def _build(self, jax, jnp):
         model, K, H = self.model, self.K, self.H
-        n_rows = (self.slots + 1) * self.row_len
+        Vt = self.target_vocab
 
         def draft_step(params, caches, hist_tok, hist_pos, hist_len,
-                       active, tables):
+                       active, tables, temps, topks, topps, keys):
             from hetu_tpu.engine.train_step import record_trace
             from hetu_tpu.models import generation
             record_trace("serving_draft_step")   # 1 compile, ever
+            # per-slot draft PRNG: a fold_in side-channel off the
+            # slot's commit key (which advances every committed token,
+            # so draft draws differ across iterations without touching
+            # the commit stream the verify lane replays)
+            kbase = jax.vmap(lambda kd: jax.random.fold_in(
+                jax.random.wrap_key_data(kd), 0xD4AF7))(keys)
+
+            def pick(lg_rows, j):
+                """Sample draft token j from the adjusted softmax (or
+                argmax at temperature 0) and return (tok, q_row)."""
+                Vd = lg_rows.shape[-1]
+                Vq = Vt if Vt is not None else Vd
+                if Vt is not None and Vd > Vt:
+                    lg_rows = jnp.where(
+                        jnp.arange(Vd) < Vt, lg_rows, -jnp.inf)
+                masked = adjust_logits(lg_rows, temps, topks, topps)
+                g = jnp.argmax(lg_rows, axis=-1).astype(jnp.int32)
+                kj = jax.vmap(lambda k: jax.random.fold_in(k, j))(kbase)
+                drawn = jax.vmap(jax.random.categorical)(kj, masked)
+                tok = jnp.where(temps == 0.0, g, drawn).astype(jnp.int32)
+                pq = jax.nn.softmax(masked.astype(jnp.float32), axis=-1)
+                if Vd > Vq:
+                    pq = pq[..., :Vq]       # masked rows carry 0 there
+                elif Vd < Vq:
+                    pq = jnp.pad(pq, ((0, 0), (0, Vq - Vd)))
+                qrow = jnp.where(
+                    (temps == 0.0)[:, None],
+                    jax.nn.one_hot(tok, Vq, dtype=jnp.float32), pq)
+                return tok, qrow
+
             lane = jnp.arange(H)[None, :]
             positions = hist_pos[:, None] + lane
             valid = (lane < hist_len[:, None]) & active[:, None] \
@@ -241,11 +454,11 @@ class ModelDraftsman:
             seed_row = jnp.clip(hist_len - 1, 0, H - 1)
             lg = jnp.take_along_axis(
                 logits, seed_row[:, None, None], axis=1)[:, 0]
-            first = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            first, q1 = pick(lg, 0)
             base = hist_pos + hist_len            # first draft's write
 
             def body(carry, j):
-                caches, tok = carry
+                caches, tok, qrow = carry
                 pos = (base + j)[:, None]
                 # rows that consumed nothing this call have no seed —
                 # their scan output is garbage and must not write
@@ -254,17 +467,20 @@ class ModelDraftsman:
                 lg, caches = generation.decode(
                     model, params, tok[:, None], pos, caches,
                     slot_mask=active, block_tables=tables, row_mask=ok)
-                nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
-                return (caches, nxt), tok
+                nxt, qn = pick(lg[:, 0], j + 1)
+                return (caches, nxt, qn), (tok, qrow)
 
             if K > 1:
-                (caches, last), toks = jax.lax.scan(
-                    body, (caches, first), jnp.arange(K - 1))
+                (caches, last, q_last), (toks, qs) = jax.lax.scan(
+                    body, (caches, first, q1), jnp.arange(K - 1))
                 drafts = jnp.concatenate(
                     [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+                q = jnp.concatenate(
+                    [jnp.moveaxis(qs, 0, 1), q_last[:, None]], axis=1)
             else:
                 drafts = first[:, None]
-            return caches, drafts                  # (S, K)
+                q = q1[:, None]
+            return caches, drafts, q           # (S, K), (S, K, Vq)
 
         return jax.jit(draft_step, donate_argnums=(1,))
 
@@ -278,15 +494,20 @@ class ModelDraftsman:
 
     def propose_all(self, seqs: list[Optional[Sequence[int]]],
                     pos: np.ndarray, active: np.ndarray,
-                    budget: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+                    budget: np.ndarray, *, temps=None, topks=None,
+                    topps=None, keys=None):
         """One draft pass for the whole slot pool.
 
         ``seqs[r]`` is slot r's full committed history (prompt +
         emitted tokens, ``None`` for empty slots), ``pos[r]`` the
         target's next KV write index (history[pos] is the not-yet-fed
         last token), ``budget[r]`` the engine's per-slot depth clamp.
-        Returns ``(draft_tok (S, K) int32, draft_len (S,) int32)`` —
-        zero length for cold (still catching up) or inactive slots."""
+        ``temps``/``topks``/``topps`` are the per-slot sampling knobs
+        (defaults: greedy) and ``keys`` the per-slot raw commit-key
+        state ``(S, KW) uint32`` the sampled chain derives its draws
+        from. Returns ``(draft_tok (S, K) int32, draft_len (S,) int32,
+        q (S, K, V) device array)`` — zero length for cold (still
+        catching up) or inactive slots."""
         import numpy as _np
         S, H = self.slots, self.H
         hist_tok = _np.zeros((S, H), _np.int32)
@@ -306,9 +527,23 @@ class ModelDraftsman:
             hist_len[r] = h
             self.draft_pos[r] = lo + h
             warm[r] = (lo + h) == int(pos[r]) + 1
-        self.caches, drafts = self._fn(
+        if temps is None:
+            temps = _np.zeros(S, _np.float32)
+        if topks is None:
+            topks = _np.zeros(S, _np.int32)
+        if topps is None:
+            topps = _np.zeros(S, _np.float32)
+        if keys is None:
+            import jax
+            kw = jax.random.key_data(jax.random.key(0)).shape[-1]
+            keys = _np.zeros((S, kw), _np.uint32)
+        self.caches, drafts, q = self._fn(
             self.params, self.caches, hist_tok, hist_pos, hist_len,
-            active, self._tables)
+            active, self._tables,
+            _np.asarray(temps, _np.float32),
+            _np.asarray(topks, _np.int32),
+            _np.asarray(topps, _np.float32),
+            _np.asarray(keys, _np.uint32))
         drafts = _np.asarray(drafts)
         draft_len = _np.where(warm & active, budget, 0).astype(_np.int32)
-        return drafts.astype(_np.int32), draft_len
+        return drafts.astype(_np.int32), draft_len, q

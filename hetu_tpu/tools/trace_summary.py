@@ -366,6 +366,8 @@ _SERVING_PLANE_SERIES = (
     "serving_ttft_seconds", "serving_tpot_seconds",
     "serving_step_seconds",
     "serving_draft_tokens_total", "serving_accepted_tokens_total",
+    "serving_sampled_accepted_tokens_total",
+    "serving_resample_tokens_total",
     "serving_decode_slot_steps_total", "serving_preemptions_total",
     "serving_kv_spilled_blocks_total", "serving_kv_resumed_blocks_total",
 )
@@ -429,6 +431,15 @@ def serving_plane_summary(records: list[dict]) -> Optional[list[str]]:
                 f"({100.0 * ac / dr:.0f}%)")
         if steps:
             line += f"  {1.0 + ac / steps:.2f} tok/slot-step"
+        # sampled/greedy split: accepted tokens that went through the
+        # rejection-sampling verify lane vs the greedy-match rule
+        sac = sum(by_label.get(
+            "serving_sampled_accepted_tokens_total", {}).values())
+        if sac:
+            res = sum(by_label.get(
+                "serving_resample_tokens_total", {}).values())
+            line += (f"  [sampled {int(sac)} / greedy "
+                     f"{int(ac - sac)}; {int(res)} resampled]")
         lines.append("speculation".ljust(width) + line)
     pre = by_label.get("serving_preemptions_total", {})
     if pre:

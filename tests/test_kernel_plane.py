@@ -216,15 +216,15 @@ def test_resolve_decode_kernel_and_fallback_counter(monkeypatch):
     )
     assert resolve_decode_kernel("auto") == "reference"   # CPU backend
     assert resolve_decode_kernel("reference") == "reference"
-    # interpret lowering partitions fine → tp>1 stays honored on CPU
-    assert resolve_decode_kernel("paged", tp=2) == "paged"
+    # tp>1 holds "paged" when the shard_map head slice is provably even
+    assert resolve_decode_kernel("paged", tp=2, num_heads=4,
+                                 num_kv_heads=2) == "paged"
     with pytest.raises(ValueError, match="auto\\|paged\\|reference"):
         resolve_decode_kernel("fast")
-    # real Mosaic lowering under tp>1 → loud fallback, counted
+    # unknown / tp-ragged head counts → loud fallback, counted
     telemetry.reset()
     telemetry.enable(True)
     try:
-        monkeypatch.setenv("HETU_PALLAS_INTERPRET", "0")
         before = kernel_fallbacks().get("t_site", 0)
         with pytest.warns(UserWarning, match="fell back"):
             assert resolve_decode_kernel("paged", tp=2,
@@ -233,15 +233,20 @@ def test_resolve_decode_kernel_and_fallback_counter(monkeypatch):
         reg = telemetry.get_registry()
         assert reg.counter("attn_kernel_fallback_total").value(
             site="t_site") >= 1
-        # warn-once: the second fallback counts but stays quiet
-        resolve_decode_kernel("paged", tp=2, site="t_site")
+        # warn-once: the second fallback (here a RAGGED head split)
+        # counts but stays quiet
+        resolve_decode_kernel("paged", tp=2, num_heads=3,
+                              num_kv_heads=3, site="t_site")
         assert kernel_fallbacks()["t_site"] == before + 2
         # an AUTO-derived "paged" hits the same tp guard (a tp-sharded
-        # TPU default must degrade, never hand GSPMD a Mosaic call)
+        # TPU default must degrade when the split is unprovable — never
+        # hand GSPMD a raw Mosaic call)
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
         assert resolve_decode_kernel("auto", tp=2,
                                      site="t_site") == "reference"
         assert kernel_fallbacks()["t_site"] == before + 3
+        assert resolve_decode_kernel("auto", tp=2, num_heads=8,
+                                     num_kv_heads=8) == "paged"
         assert resolve_decode_kernel("auto", tp=1) == "paged"
     finally:
         telemetry.enable(False)
@@ -448,3 +453,40 @@ def test_engine_w8a8_serves_and_counts(gpt):
     finally:
         telemetry.enable(False)
         telemetry.reset()
+
+
+@pytest.mark.slow
+def test_tp2_paged_kernel_no_fallback_greedy_identical(gpt):
+    """TENTPOLE ACCEPTANCE (tp lane, ISSUE 17): a tp=2 plan with
+    divisible head counts runs the PAGED kernel — shard_map over the
+    plan's tp axis, each shard streaming its local head slice — instead
+    of degrading to the gather path. The serving-site fallback counter
+    stays at zero and the tokens are identical to the single-device
+    reference engine (and the one-shot oracle)."""
+    from hetu_tpu import optim
+    from hetu_tpu.engine import make_plan, trace_counts
+    from hetu_tpu.ops.attention import kernel_fallbacks
+    from hetu_tpu.parallel.sharding import shard_params
+    from hetu_tpu.parallel.strategy import Strategy
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params = gpt
+    prompts = _prompts(cfg, (5, 11, 3, 8), seed=23)
+    sp = SamplingParams(max_tokens=6)
+    ref_eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                            prefill_chunk=CHUNK, block_size=BLOCK)
+    want = ref_eng.generate_many(prompts, sp)
+
+    plan = make_plan(model, optim.adamw(1e-3), Strategy(tp=2))
+    sp_params = shard_params(params, plan.mesh, plan.param_specs)
+    fb_before = kernel_fallbacks().get("serving_decode", 0)
+    before = trace_counts().get("serving_step", 0)
+    eng = ServingEngine(model, sp_params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, block_size=BLOCK,
+                        attn_kernel="paged", plan=plan)
+    # divisible heads (4 q / 4 kv over tp=2): NO fallback at resolve
+    assert eng.attn_kernel == "paged"
+    assert kernel_fallbacks().get("serving_decode", 0) == fb_before
+    assert eng.generate_many(prompts, sp) == want
+    assert trace_counts().get("serving_step", 0) - before == 1
+    assert want == [_ref(model, params, p, 6) for p in prompts]

@@ -184,6 +184,37 @@ def test_admission_pins_matched_blocks_against_eviction():
     assert mgr.refs[chain[0]] == 1 and mgr.free_blocks == 7
 
 
+def test_handoff_requests_price_one_decode_token():
+    """SATELLITE (ISSUE 17): a handoff (prefill-tier) request only ever
+    writes prompt + first token before shipping the KV downstream —
+    pricing it at P + max_tokens throttles this tier's admission for
+    decode room it never uses. Both the preemption bound
+    (blocks_needed) and the admission plan (_page_plan) charge P+1."""
+    from hetu_tpu.serving.scheduler import Request, Scheduler
+
+    prompt = np.arange(1, 8, dtype=np.int32)          # P = 7
+    plain = Request(0, prompt, SamplingParams(max_tokens=8),
+                    submit_s=0.0)
+    hand = Request(1, prompt.copy(), SamplingParams(max_tokens=8),
+                   submit_s=0.0, handoff=True)
+
+    sched = Scheduler(2, MAX_LEN, blocks=BlockManager(3),  # 2 usable
+                      block_size=4)
+    assert sched.blocks_needed(plain) == 4            # ceil((7+8)/4)
+    assert sched.blocks_needed(hand) == 2             # ceil((7+1)/4)
+
+    # two free blocks: the plain request can't fit and waits...
+    assert sched.submit(plain)
+    assert sched.next_admission() is None
+    # ...but an identical handoff request admits into the same pool,
+    # and its table holds exactly the P+1 worst case
+    sched2 = Scheduler(2, MAX_LEN, blocks=BlockManager(3), block_size=4)
+    assert sched2.submit(hand)
+    got = sched2.next_admission()
+    assert got is not None
+    assert len(hand.admit["table"]) == 2
+
+
 # -- engine acceptance -------------------------------------------------------
 
 def test_cache_on_off_identical_across_arrival_permutations(gpt):

@@ -247,6 +247,32 @@ def test_swap_flushes_stale_prefix_cache(gpt):
     assert list(r4.tokens) == list(r3.tokens)
 
 
+def test_swap_rebuilds_prequantized_w8a8_tree(gpt):
+    """REGRESSION (ISSUE 17): the decode lane's pre-quantized W8A8
+    weight tree is built once at construction — a weight swap that
+    left it stale would silently serve the OLD parameters through the
+    int8 FFN lane. ``swap_params`` must re-quantize from the new
+    tree."""
+    from hetu_tpu.ops.quantization import quantize_int8
+
+    cfg, model, params0, params1 = gpt
+    eng = ServingEngine(model, params0, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, cache_dtype=jnp.int8,
+                        w8a8=True)
+    assert eng._w8a8_wq is not None
+    w_new = params1["blocks"]["mlp"]["fc_in"]["weight"]
+    wq_want, ws_want = quantize_int8(w_new, axis=1)   # stacked layers
+    before = np.asarray(eng._w8a8_wq["fc_in"]["q"])
+    assert not np.array_equal(before, np.asarray(wq_want)), \
+        "fixture params identical — test can't observe staleness"
+    eng.swap_params(params1)
+    np.testing.assert_array_equal(
+        np.asarray(eng._w8a8_wq["fc_in"]["q"]), np.asarray(wq_want))
+    np.testing.assert_allclose(
+        np.asarray(eng._w8a8_wq["fc_in"]["scale"]),
+        np.asarray(ws_want))
+
+
 def test_swap_on_busy_engine_raises(gpt):
     """swap_params must refuse a non-drained engine: in-flight KV was
     prefilled under the old weights."""

@@ -90,6 +90,151 @@ def test_speculative_config_errors_are_named():
 
 
 # ---------------------------------------------------------------------------
+# host-side: rejection-sampling verify math (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_rejection_sampling_verify_matches_target_distribution():
+    """TENTPOLE math: the verify lane's committed-token marginal equals
+    softmax(adjust_logits(target)) — for a smooth proposal q (drafts
+    sampled ~ q, the ModelDraftsman contract) AND for one-hot q (host
+    draftsmen with deterministic proposals), which Leviathan rejection
+    sampling keeps exact for ANY proposal. Monte Carlo over PRNG keys,
+    total-variation distance on a tiny vocab."""
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_tpu.serving.speculative import (
+        adjust_logits, speculative_verify,
+    )
+
+    V, K, N = 5, 2, 8192
+    rng = np.random.default_rng(7)
+    logits = jnp.asarray(rng.normal(0.0, 1.5, (K + 1, V)), jnp.float32)
+    temp, topk, topp = 0.7, 0, 1.0
+    target = np.asarray(jax.nn.softmax(
+        adjust_logits(logits, temp, topk, topp)[0].astype(jnp.float32)))
+
+    keys = np.asarray(jax.vmap(
+        lambda s: jax.random.key_data(jax.random.key(s)))(jnp.arange(N)))
+    verify = jax.jit(jax.vmap(
+        speculative_verify,
+        in_axes=(None, 0, None, 0, None, None, None, 0)))
+
+    def marginal(drafts, q):
+        committed, n, _, _ = verify(
+            logits, jnp.asarray(drafts, jnp.int32), jnp.int32(K),
+            jnp.asarray(q, jnp.float32), jnp.float32(temp),
+            jnp.int32(topk), jnp.float32(topp), jnp.asarray(keys))
+        first = np.asarray(committed[:, 0])
+        emp = np.bincount(first, minlength=V) / N
+        return emp, np.asarray(n)
+
+    # smooth q: drafts sampled from an (intentionally wrong) proposal
+    q_probs = np.asarray(jax.nn.softmax(
+        jnp.asarray(rng.normal(0.0, 1.0, (K, V)), jnp.float32)))
+    drafts = np.stack(
+        [rng.choice(V, size=N, p=q_probs[i]) for i in range(K)], axis=1)
+    emp, _ = marginal(drafts, np.broadcast_to(q_probs, (N, K, V)))
+    assert 0.5 * np.abs(emp - target).sum() < 0.04
+
+    # one-hot q: a deterministic draftsman proposing a FIXED token is
+    # still exact (accept w.p. p(d); residual renormalizes to
+    # p(y)/(1-p(d)) for y != d — the marginal telescopes back to p)
+    d_fix = np.full((N, K), 3, np.int64)
+    onehot = np.zeros((N, K, V), np.float32)
+    onehot[..., 3] = 1.0
+    emp1, n1 = marginal(d_fix, onehot)
+    assert 0.5 * np.abs(emp1 - target).sum() < 0.04
+    # ...and the lane-0 accept rate is exactly p(draft)
+    assert abs(float((n1 >= 2).mean()) - target[3]) < 0.03
+
+
+def test_sampled_verify_reduces_bitwise_to_greedy_at_temp0():
+    """At temperature 0 the accept test collapses to draft == argmax
+    and the outputs are exactly the greedy verify lane's: leading-match
+    acceptance plus the argmax bonus, for every accept/reject pattern,
+    with the key advanced one split per committed token."""
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_tpu.serving.speculative import speculative_verify
+
+    V, K = 7, 3
+    rng = np.random.default_rng(11)
+    logits = jnp.asarray(rng.normal(0.0, 2.0, (K + 1, V)), jnp.float32)
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    q = jnp.zeros((K, V), jnp.float32)       # ignored at temp 0
+    kd = jax.random.key_data(jax.random.key(42))
+
+    for pattern in range(2 ** K):
+        drafts = np.asarray(
+            [greedy[i] if (pattern >> i) & 1 else (greedy[i] + 1) % V
+             for i in range(K)], np.int32)
+        committed, n, last, new_kd = speculative_verify(
+            logits, jnp.asarray(drafts), jnp.int32(K), q,
+            jnp.float32(0.0), jnp.int32(0), jnp.float32(1.0), kd)
+        a = 0
+        while a < K and drafts[a] == greedy[a]:
+            a += 1
+        want = list(drafts[:a]) + [greedy[a]]
+        got = np.asarray(committed)[:a + 1]
+        assert int(n) == a + 1 and got.tolist() == want
+        assert int(last) == greedy[a]
+        # PRNG stream parity: exactly ncommit splits consumed
+        carry = jax.random.wrap_key_data(kd)
+        for _ in range(a + 1):
+            carry, _sub = jax.random.split(carry)
+        np.testing.assert_array_equal(
+            np.asarray(new_kd), np.asarray(jax.random.key_data(carry)))
+
+
+def test_check_sampled_draft_names_the_contract():
+    """SATELLITE: the submit-time guard names every lever of the
+    sampled-speculation contract (q rows, surfaces_q, seed) so a
+    misconfigured draftsman fails loudly, and the shipped draftsmen
+    both satisfy it."""
+    from hetu_tpu.serving.speculative import (
+        ModelDraftsman, check_sampled_draft,
+    )
+
+    check_sampled_draft(None)                         # spec off: fine
+    check_sampled_draft(NgramDraftsman(1))
+    assert NgramDraftsman.surfaces_q and ModelDraftsman.surfaces_q
+
+    class NoQ:
+        pass
+
+    with pytest.raises(SpeculativeConfigError) as ei:
+        check_sampled_draft(NoQ())
+    msg = str(ei.value)
+    for needle in ("NoQ", "surfaces_q", "SamplingParams.seed",
+                   "temperature"):
+        assert needle in msg
+
+
+def test_adjust_logits_matches_generation_sampler():
+    """adjust_logits + categorical is BITWISE generation._sample for
+    the full temperature/top-k/top-p grid — the serving sampler and the
+    one-shot reference share one masking arithmetic."""
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_tpu.models.generation import _sample
+    from hetu_tpu.serving.speculative import adjust_logits
+
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(0.0, 2.0, (4, 17)), jnp.float32)
+    for i, (t, k, p) in enumerate([(0.7, 0, 0.0), (1.0, 5, 0.0),
+                                   (0.6, 0, 0.9), (1.3, 4, 0.8),
+                                   (0.25, 1, 0.0), (2.0, 17, 0.999)]):
+        key = jax.random.key(100 + i)
+        want = _sample(logits, temperature=t, top_k=k, top_p=p, rng=key)
+        got = jax.random.categorical(
+            key, adjust_logits(logits, t, k, p), axis=-1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
 # host-side: QoS scheduler
 # ---------------------------------------------------------------------------
 
@@ -339,6 +484,9 @@ def test_spec_greedy_token_identical_all_patterns(gpt):
     # tokens — outputs must be bit-identical, speed is all it can lose
     class Hostile:
         host_only = True
+        # deterministic proposals → one-hot q, synthesized on-device:
+        # the sampled-lane contract a host draftsman declares
+        surfaces_q = True
 
         def reset(self, *a):
             pass
@@ -357,7 +505,8 @@ def test_spec_greedy_token_identical_all_patterns(gpt):
     eng2 = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
                         prefill_chunk=CHUNK, spec_depth=1)
     assert eng2.generate_many(prompts, sp) == want
-    # sampled requests coexist (depth clamps to 0 for them, in range)
+    # sampled requests coexist (they speculate through the rejection-
+    # sampling verify lane; tokens stay in range)
     mixed = [SamplingParams(max_tokens=6),
              SamplingParams(max_tokens=6, temperature=1.0, top_k=10)]
     outs = eng.generate_many(prompts[:2], mixed)
@@ -473,6 +622,76 @@ def test_preempt_with_speculation_churn_one_compile(gpt):
     assert list(lo.tokens) == _ref(model, params, lo_p, 12)
     assert list(hi.tokens) == _ref(model, params, hi_p, 4)
     assert trace_counts().get("serving_step", 0) - before <= 1
+
+
+@pytest.mark.slow
+def test_sampled_engine_matches_one_shot_generate_bitwise(gpt):
+    """TENTPOLE ACCEPTANCE: identical-seed sampled serving equals
+    one-shot sampled ``generate`` BITWISE across the
+    temperature/top-k/top-p grid and arrival churn — the engine walks
+    the same PRNG stream (one split per committed token off the
+    per-request key) and the same masking arithmetic as the reference.
+    Speculation stays off here: accepted drafts commit several tokens
+    per iteration, which is distribution-equal (the host math test) but
+    consumes the stream differently. One fused-step compile covers the
+    whole knob grid — sampling knobs and keys are traced data."""
+    import jax
+
+    from hetu_tpu.engine import trace_counts
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params = gpt
+    prompts = _corpus(cfg, seed=5)
+    knobs = [(0.7, 0, 0.0, 11), (1.0, 10, 0.0, 12), (0.8, 0, 0.9, 13),
+             (1.2, 6, 0.85, 14), (0.0, 0, 0.0, 15)]
+    before = trace_counts().get("serving_step", 0)
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK)
+    reqs = []
+    for p, (t, k, tp_, s) in zip(prompts, knobs):
+        reqs.append(eng.submit(p, SamplingParams(
+            max_tokens=6, temperature=t, top_k=k, top_p=tp_, seed=s)))
+        eng.step()                              # stagger arrivals
+    eng.run_until_drained()
+    assert trace_counts().get("serving_step", 0) - before == 1
+    for r, p, (t, k, tp_, s) in zip(reqs, prompts, knobs):
+        want = _ref(model, params, p, 6, temperature=t, top_k=k,
+                    top_p=tp_, rng=jax.random.key(s))
+        assert list(r.tokens) == want, (t, k, tp_, s)
+
+
+@pytest.mark.slow
+def test_sampled_speculation_beats_one_token_per_slot_step(gpt):
+    """SATELLITE CONTRACT: sampled slots actually SPECULATE — at
+    temperature > 0 with a self-drafting model (q == p, the acceptance
+    ceiling: accept prob min(1, p/q) == 1) the engine commits more
+    than one token per decode slot-step, with the sampled-lane
+    counters flowing."""
+    from hetu_tpu import telemetry
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params = gpt
+    prompts = _corpus(cfg, seed=6)[:3]
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                            prefill_chunk=CHUNK, spec_depth=3,
+                            draft_model=model, draft_params=params)
+        sps = [SamplingParams(max_tokens=8, temperature=0.7,
+                              seed=100 + i) for i in range(len(prompts))]
+        outs = eng.generate_many(prompts, sps)
+        assert all(0 <= t < cfg.vocab_size for o in outs for t in o)
+        reg = telemetry.get_registry()
+        acc = reg.counter(
+            "serving_sampled_accepted_tokens_total").value()
+        steps = reg.counter("serving_decode_slot_steps_total").value()
+        assert acc > 0, "no sampled drafts accepted"
+        tokens_per_slot_step = 1.0 + acc / steps
+        assert tokens_per_slot_step > 1.0
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()
 
 
 @pytest.mark.slow

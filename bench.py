@@ -1326,6 +1326,124 @@ def fleet_main():
     split["pd_handoffs"] = int(snap.get("fleet_pd_handoffs_total", 0))
     fleet.stop()
 
+    # -- (3) fleet-global KV plane (ISSUE 18): shared-prefix sweep.
+    # All prompts share two whole 16-token blocks of system prompt.
+    # Cold: the first request prefills it on one replica. Then that
+    # replica DRAINS (routing-state only) so every later request lands
+    # on the OTHER replica — with kv_pull on, the prefix directory
+    # pulls the cached blocks across (export → wire → import) instead
+    # of re-prefilling; with kv_pull off, the second replica pays the
+    # full cold prefill again. Same drain trick both lanes, so the
+    # TTFT delta isolates the pull.
+    shared = rng.integers(1, cfg.vocab_size, (32,)).tolist()
+    kv_prompts = [shared + rng.integers(
+        1, cfg.vocab_size, (int(rng.integers(4, 12)),)).tolist()
+        for _ in range(8)]
+    _KV_SERIES = ("fleet_prefix_hit_tokens_total",
+                  "fleet_prefix_miss_tokens_total",
+                  "fleet_kv_pull_blocks_total",
+                  "fleet_kv_pull_bytes_total")
+
+    def kv_snap():
+        snap = telemetry.get_registry().snapshot()
+        return {k: float(snap.get(k, 0.0)) for k in _KV_SERIES}
+
+    def kv_lane(kv_pull):
+        fleet = launch_serving_fleet(mk_engine, 2, poll_s=0.002,
+                                     kv_pull=kv_pull)
+        router = fleet.router
+        # off-prefix warmup: compiles the step off the measured path
+        router.generate_many(prompts[:2], SamplingParams(max_tokens=2))
+        before = kv_snap()
+        r0 = router.submit(kv_prompts[0], sp)
+        r0.done.wait(300.0)
+        d0 = r0.result()
+        router.drain(d0["replica"], timeout_s=60.0)
+        reqs = [router.submit(p, sp) for p in kv_prompts[1:]]
+        for r in reqs:
+            r.done.wait(300.0)
+        docs = [r.result() for r in reqs]
+        after = kv_snap()
+        delta = {k: after[k] - before[k] for k in _KV_SERIES}
+        cross = [d["timing"]["ttft_ms"] for d in docs
+                 if d["timing"].get("ttft_ms") is not None]
+        out = {
+            "completed": sum(d["status"] == "done" for d in docs)
+            + (d0["status"] == "done"),
+            "cold_ttft_ms": d0["timing"].get("ttft_ms"),
+            "cross_replica_ttft_ms_p50": round(
+                float(np.median(cross)), 3) if cross else None,
+            "prefix_hit_tokens": int(
+                delta["fleet_prefix_hit_tokens_total"]),
+            "prefix_miss_tokens": int(
+                delta["fleet_prefix_miss_tokens_total"]),
+            "pull_blocks": int(delta["fleet_kv_pull_blocks_total"]),
+            "pull_bytes": int(delta["fleet_kv_pull_bytes_total"]),
+        }
+        fleet.stop()
+        return out
+
+    kv_warm = kv_lane(True)
+    kv_cold = kv_lane(False)
+
+    # -- (4) decode-KV replication: recovery delta under SIGKILL.
+    # A 2-engine-PROCESS fleet decodes the shared-prefix load; mid-
+    # decode one replica is SIGKILLed. With replicate_kv on, its buddy
+    # holds the victims' streamed KV and the requeue RESUMES them
+    # (RESULT carries resumed=true); off, they replay from the prompt.
+    # The recorded delta is kill → last request done.
+    def recovery_lane(replicate):
+        fleet = launch_serving_fleet(
+            n_replicas=2, remote=True,
+            engine_spec="workloads.fleet_replica:build_engine",
+            env={"PYTHONPATH": repo,
+                 "HETU_FLEET_SLOTS": str(slots),
+                 "HETU_FLEET_MAX_LEN": str(max_len),
+                 "HETU_FLEET_CHUNK": str(chunk)},
+            beat_timeout_s=1.0, poll_s=0.002,
+            replicate_kv=replicate, replicate_cadence_s=0.01)
+        router = fleet.router
+        router.generate_many(prompts[:2], SamplingParams(max_tokens=2))
+        rec_before = float(telemetry.get_registry().snapshot().get(
+            "fleet_kv_recoveries_total", 0.0))
+        reqs = [router.submit(p, SamplingParams(max_tokens=16))
+                for p in kv_prompts[:6]]
+        # kill whichever replica carries inflight work once decode has
+        # had a beat to stream at least one whole block
+        victim = None
+        deadline = time.monotonic() + 20.0
+        while victim is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+            if all(r.done.is_set() for r in reqs):
+                break                  # finished before we could kill
+            st = router.fleet_status()["replicas"]
+            busy = [(v["inflight"], n) for n, v in st.items()
+                    if v["state"] == "live" and v["inflight"]]
+            if busy:
+                victim = max(busy)[1]
+        t_kill = time.perf_counter()
+        if victim is not None:
+            fleet.kill_replica_process(victim)
+        for r in reqs:
+            r.done.wait(300.0)
+        recovery_s = time.perf_counter() - t_kill
+        docs = [r.result() for r in reqs]
+        out = {
+            "completed": sum(d["status"] == "done" for d in docs),
+            "killed": victim,
+            "recovery_s": round(recovery_s, 3),
+            "resumed": sum(bool(d["timing"].get("resumed"))
+                           for d in docs),
+            "kv_recoveries": int(float(
+                telemetry.get_registry().snapshot().get(
+                    "fleet_kv_recoveries_total", 0.0)) - rec_before),
+        }
+        fleet.stop()
+        return out
+
+    rec_on = recovery_lane(True)
+    rec_off = recovery_lane(False)
+
     result = {
         "metric": "fleet_dispatch_overhead_ms_cpu_smoke",
         "value": overhead, "unit": "ms_p50_per_request",
@@ -1335,11 +1453,17 @@ def fleet_main():
         "in_process": local,
         "multi_process": remote,
         "pd": {"colocated": colocated, "split": split},
+        "fleet_kv": {"pull_on": kv_warm, "pull_off": kv_cold},
+        "recovery": {"replicate_on": rec_on, "replicate_off": rec_off},
         "note": "multi-process dispatch rides SUBMIT/RESULT/ESTATUS "
                 "coordinator verbs; P/D split streams KV blocks "
-                "prefill→decode over the same transport. CPU smoke — "
-                "absolute latencies are meaningless off-TPU, the "
-                "contract is completion + the transport working.",
+                "prefill→decode over the same transport. fleet_kv: "
+                "shared-prefix sweep, cross-replica warm (directory "
+                "pull) vs cold TTFT; recovery: SIGKILL mid-decode "
+                "with/without buddy replication, kill→last-done "
+                "seconds. CPU smoke — absolute latencies are "
+                "meaningless off-TPU, the contract is completion + "
+                "the transport working.",
     }
     with open(_BENCH_FLEET_PATH, "w") as f:
         json.dump(result, f, indent=1)

@@ -426,6 +426,29 @@ def size_spill_arena(cfg, *, host_budget_bytes: float, block_size: int,
     return blocks
 
 
+def size_spill_tiers(cfg, *, host_budget_bytes: float,
+                     peer_budget_bytes: float = 0.0, block_size: int,
+                     cache_dtype: str = "fp32", tp: int = 1) -> dict:
+    """Per-tier block capacities for a chained spill store
+    (device→host→peer, ISSUE 18): ``{"host": n, "peer": m}``.
+
+    Both tiers are priced with the SAME :func:`kv_bytes_per_block`
+    arithmetic as :func:`size_spill_arena`, so demotion accounting
+    stays in arena blocks end to end — a block demoted to the peer
+    tier frees on the host exactly what it costs the peer. The host
+    tier must fit at least one block (same contract as
+    :func:`size_spill_arena`); a zero peer budget prices an unchained
+    arena (``peer: 0``)."""
+    host = size_spill_arena(cfg, host_budget_bytes=host_budget_bytes,
+                            block_size=block_size,
+                            cache_dtype=cache_dtype, tp=tp)
+    per_block = kv_bytes_per_block(cfg, block_size=block_size,
+                                   cache_dtype=cache_dtype, tp=tp)
+    peer = int(float(peer_budget_bytes) // per_block) \
+        if peer_budget_bytes else 0
+    return {"host": host, "peer": peer}
+
+
 def size_kv_pool(cfg, *, hbm_budget_bytes: float, max_len: int,
                  cache_dtype: str = "fp32", tp: int = 1,
                  param_bytes_per_el: float = 4.0,

@@ -344,6 +344,60 @@ class CoordinatorClient:
         if resp != "OK":
             raise RuntimeError(f"stop engine failed: {resp}")
 
+    # -- fleet-global KV verbs (ISSUE 18) -----------------------------------
+    def serving_kv_export(self, tokens) -> dict:
+        """Gather the remote replica's cached whole-block prefix of
+        ``tokens``: ``{"spill": wire | None}``. Read-only (the prefix
+        cache keeps its refs) — safe to retry."""
+        enc = urllib.parse.quote(json.dumps(
+            {"tokens": [int(t) for t in tokens]},
+            separators=(",", ":")), safe="")
+        return self._val_verb(f"KVEXPORT {enc}")
+
+    def serving_kv_import(self, spill_wire: dict) -> dict:
+        """Map a peer-exported prefix into the remote replica's prefix
+        cache: ``{"ok": bool}`` (False = refused — stale version or
+        layout mismatch — the caller prefills instead). Idempotent by
+        construction: re-importing an already-cached prefix is a
+        no-op."""
+        enc = urllib.parse.quote(json.dumps(
+            {"spill": spill_wire}, separators=(",", ":")), safe="")
+        return self._val_verb(f"KVIMPORT {enc}")
+
+    def serving_kv_put(self, doc: dict) -> None:
+        """Deliver one decode-KV replication shipment to the remote
+        buddy's replica store. Idempotent: shipments overwrite by
+        (trace_id, block index)."""
+        enc = urllib.parse.quote(json.dumps(
+            doc, separators=(",", ":")), safe="")
+        resp = self._cmd_retry(f"KVREPL {enc}")
+        if resp != "OK":
+            raise RuntimeError(f"kv put failed: {resp}")
+
+    def serving_kv_fetch(self, trace_id: str) -> dict:
+        """Assemble the buddy-held replica set for ``trace_id``:
+        ``{"spill": wire | None}`` — the recovery path's resume
+        payload."""
+        enc = urllib.parse.quote(json.dumps(
+            {"trace_id": str(trace_id)},
+            separators=(",", ":")), safe="")
+        return self._val_verb(f"KVFETCH {enc}")
+
+    def serving_kv_buddy(self, host: Optional[str], port: int = 0, *,
+                         token: Optional[str] = None, origin: str = "",
+                         cadence_s: float = 0.02) -> None:
+        """Point the remote engine's replication stream at a buddy
+        replica (``host=None`` disables replication)."""
+        obj = {"host": host, "port": int(port), "origin": origin,
+               "cadence_s": float(cadence_s)}
+        if token:
+            obj["token"] = token
+        enc = urllib.parse.quote(json.dumps(
+            obj, separators=(",", ":")), safe="")
+        resp = self._cmd_retry(f"KVBUDDY {enc}")
+        if resp != "OK":
+            raise RuntimeError(f"kv buddy failed: {resp}")
+
     # -- fleet verbs (coordinator with a serving.router.Router) -------------
     def fleet_status(self) -> dict:
         """Fleet-wide aggregation: per-replica state/load/version,

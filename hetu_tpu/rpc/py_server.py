@@ -21,7 +21,9 @@ _SERVING_VERBS = ("SUBMIT", "RESULT", "GENERATE",
                   "FLEET", "DRAIN", "RESUME",
                   "ESTATUS", "CANCELQ", "EVICT", "PREFILL",
                   "SWAPWEIGHTS", "STOPENGINE",
-                  "DUMPOBS", "FLEETMETRICS")
+                  "DUMPOBS", "FLEETMETRICS",
+                  "KVEXPORT", "KVIMPORT", "KVREPL", "KVFETCH",
+                  "KVBUDDY")
 
 
 def _rpc_server_observe(verb: str, dur_ms: float,

@@ -144,6 +144,7 @@ class ServingEngine:
                  draft_model=None, draft_params=None,
                  preempt: bool = True,
                  spill_host_budget_bytes: Optional[float] = None,
+                 spill_peer=None,
                  class_weights: Optional[dict] = None,
                  attn_kernel: str = "auto",
                  prefill_attn: str = "auto",
@@ -287,8 +288,28 @@ class ServingEngine:
                 tp=plan.strategy.tp if plan is not None else 1)
         else:
             max_blocks = None
-        self.spill_arena = HostSpillArena(max_blocks)
+        # ``spill_peer`` chains a second spill tier behind the host
+        # arena (device→host→peer, ISSUE 18): any object with the
+        # arena's put/pop/get/can_fit surface — another HostSpillArena
+        # in-process, or a wire-backed store. LRU demotion + promotion
+        # live in the arena; ``engine/memory.size_spill_tiers`` prices
+        # both tiers in the same arena blocks.
+        self.spill_arena = HostSpillArena(max_blocks, peer=spill_peer)
         self._resume_pending: list[dict] = []    # admitted spill-resumes
+
+        # -- decode-KV replication (ISSUE 18): a background thread
+        # streams newly committed blocks of decoding slots to a
+        # rendezvous-chosen buddy (the router wires the sink);
+        # ``kv_replica_store`` is OUR buddy-side accumulator for peers
+        # replicating here. Jax-free import — fleet.py has no jax.
+        from hetu_tpu.serving.fleet import KVReplicaStore
+        self.kv_replica_store = KVReplicaStore()
+        self._repl_sink = None          # callable(doc) or None = off
+        self._repl_origin = ""
+        self._repl_cadence_s = 0.02
+        self._repl_sent: dict[int, tuple] = {}   # req id -> (blocks, tid)
+        self._repl_thread: Optional[threading.Thread] = None
+        self._repl_stop: Optional[threading.Event] = None
 
         S = self.pool.slots
         W = self.pool.table_width
@@ -1072,6 +1093,254 @@ class ServingEngine:
                       blocks=spill_plan["nb"])
         return entry
 
+    # -- fleet-global KV plane (ISSUE 18) -----------------------------------
+    def export_prefix(self, tokens: Sequence[int], *,
+                      lock_timeout_s: Optional[float] = 2.0
+                      ) -> Optional[SpillEntry]:
+        """Gather this engine's cached whole-block prefix of ``tokens``
+        into a :class:`SpillEntry` for a peer pull (the KVEXPORT verb).
+
+        Read-only: the prefix cache keeps its refs and LRU order is the
+        only state touched — the gather runs under the iteration lock,
+        which freezes all block churn (admission, eviction and the trie
+        flush all run step-locked), so no pin/unpin dance is needed.
+        None on a whole-block miss or a wedged step (``lock_timeout_s``
+        bounds the wait — a pull is best-effort, the puller prefills)."""
+        if self.prefix_cache is None:
+            return None
+        got = self._step_lock.acquire(
+            timeout=-1 if lock_timeout_s is None else lock_timeout_s)
+        if not got:
+            return None
+        try:
+            with self._lock:
+                toks = [int(t) for t in tokens]
+                shared, _partial = self.prefix_cache.match(toks)
+                nb = min(len(shared), self.pool.table_width)
+                if nb == 0:
+                    return None
+                version = self.weight_version
+                ids = np.asarray(shared[:nb], np.int32)
+            data = self._spill_blocks(ids, nb)
+        finally:
+            self._step_lock.release()
+        bs = self.pool.block_size
+        entry = SpillEntry(
+            req_id=-1, data=data, n_blocks=nb, block_size=bs,
+            pos=nb * bs, last_tok=0, tokens=toks[:nb * bs],
+            weight_version=version)
+        flight_record("fleet_kv_export", blocks=nb, tokens=nb * bs)
+        return entry
+
+    def import_prefix(self, entry: Optional[SpillEntry], *,
+                      lock_timeout_s: Optional[float] = 2.0) -> bool:
+        """Map a peer-exported prefix into THIS engine's prefix cache
+        (the KVIMPORT verb): allocate fresh arena blocks, scatter the
+        wire data in, insert the token runs into the radix trie — from
+        here on it is an ordinary same-replica prefix hit (refcounted,
+        CoW rules unchanged, LRU-evictable like any cached prefix).
+
+        Refuses — returns False, caller falls back to a plain
+        prefill — an entry whose weight version or arena layout does
+        not match (:meth:`SpillEntry.compatible_with` is the staleness
+        rule: a weight push between export and import MUST degrade to
+        a prefill, never silently serve old weights' KV), and degrades
+        the same way when no blocks can be freed."""
+        if self.prefix_cache is None or entry is None:
+            return False
+        if not entry.compatible_with(self.pool, self.weight_version):
+            flight_record("fleet_kv_import_refused",
+                          blocks=entry.n_blocks,
+                          entry_version=entry.weight_version,
+                          our_version=self.weight_version)
+            return False
+        toks = [int(t) for t in entry.tokens]
+        nb = entry.n_blocks
+        if nb < 1 or len(toks) < nb * self.pool.block_size:
+            return False
+        got = self._step_lock.acquire(
+            timeout=-1 if lock_timeout_s is None else lock_timeout_s)
+        if not got:
+            return False
+        try:
+            with self._lock:
+                shared, _partial = self.prefix_cache.match(toks)
+                if len(shared) >= nb:
+                    return True          # already fleet-warm here
+                new_ids = []
+                for _ in range(nb):
+                    b = self.blocks.alloc()
+                    if b is None and self.prefix_cache.evict(
+                            nb - len(new_ids)):
+                        b = self.blocks.alloc()
+                    if b is None:        # arena genuinely full of
+                        for x in new_ids:    # pinned work: no import
+                            self.blocks.release(x)
+                        return False
+                    new_ids.append(b)
+            # scatter outside self._lock (submit/load stay responsive)
+            # but under the iteration lock we hold — the resume jit
+            # DONATES the arena, exactly like _exec_resume
+            W = self.pool.table_width
+            lane_ids = np.full(W, self.pool.n_blocks, np.int32)
+            lane_ids[:nb] = new_ids
+            data = []
+            for src in entry.data:
+                pad = np.zeros((src.shape[0], W) + src.shape[2:],
+                               src.dtype)
+                pad[:, :nb] = src
+                data.append(pad)
+            ctx = self._plan.act if self._plan is not None \
+                else contextlib.nullcontext()
+            with ctx:
+                self.pool.caches = self._resume_fn(
+                    self.pool.caches, tuple(data),
+                    jnp.asarray(lane_ids))
+            with self._lock:
+                self.prefix_cache.insert(
+                    toks[:nb * self.pool.block_size], new_ids)
+                # insert() took the trie's own ref on every node it
+                # adopted; dropping ours leaves the trie sole holder
+                # (LRU-evictable). A block whose token run was cached
+                # concurrently goes straight back to the free list.
+                for b in new_ids:
+                    self.blocks.release(b)
+        finally:
+            self._step_lock.release()
+        flight_record("fleet_kv_import", blocks=nb)
+        return True
+
+    # -- decode-KV replication, origin side (ISSUE 18) ----------------------
+    def configure_replication(self, sink, *, origin: str = "",
+                              cadence_s: float = 0.02) -> None:
+        """Point this engine's decode-KV replication stream at ``sink``
+        — a callable taking one JSON-safe shipment doc (in-process: the
+        buddy's ``KVReplicaStore.put``; cross-process: a KVREPL wire
+        closure installed by the KVBUDDY verb). ``sink=None`` stops the
+        stream. The router (re)wires this whenever rendezvous buddy
+        assignment changes."""
+        with self._lock:
+            self._repl_sink = sink
+            self._repl_origin = origin
+            self._repl_cadence_s = float(cadence_s)
+            if sink is None:
+                self._repl_sent.clear()
+        if sink is None:
+            if self._repl_stop is not None:
+                self._repl_stop.set()
+            self._repl_thread = None
+            return
+        if self._repl_thread is None or not self._repl_thread.is_alive():
+            self._repl_stop = threading.Event()
+            self._repl_thread = threading.Thread(
+                target=self._repl_loop, args=(self._repl_stop,),
+                daemon=True, name="serving-kv-repl")
+            self._repl_thread.start()
+
+    def _repl_loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            try:
+                self._replicate_once()
+            except Exception as e:                    # noqa: BLE001
+                from hetu_tpu.utils.logging import get_logger
+                get_logger().debug(f"kv replication cadence: {e}")
+            stop.wait(self._repl_cadence_s)
+
+    def _replicate_once(self) -> None:
+        """One replication cadence: for every decoding slot with a new
+        COMPLETE block since its last shipment, ship the delta range
+        (plus the partial tail block and a consistent pos/tokens/PRNG
+        snapshot, captured in the same step-locked breath), then
+        tombstone finished requests on the buddy. The step lock is held
+        only for the snapshot + device→host gather — never across the
+        sink's wire I/O — and is acquired with a cadence-sized timeout
+        so a busy step just skips a beat."""
+        if self._repl_sink is None:
+            return
+        bs = self.pool.block_size
+        got = self._step_lock.acquire(timeout=self._repl_cadence_s)
+        if not got:
+            return
+        jobs, drops = [], []
+        try:
+            with self._lock:
+                sink = self._repl_sink
+                if sink is None:
+                    return
+                live_ids = set()
+                for slot, req in enumerate(self._slot_req):
+                    if req is None or not self._active[slot] \
+                            or req.handoff:
+                        continue
+                    live_ids.add(req.id)
+                    pos = int(self._pos[slot])
+                    complete = pos // bs
+                    rec = self._repl_sent.get(req.id)
+                    sent = rec[0] if rec is not None else -1
+                    if sent >= 0 and complete <= sent:
+                        continue    # no new whole block: nothing to do
+                    start = max(0, sent)    # re-ship the old tail block
+                    cur = max(1, -(-pos // bs))
+                    jobs.append({
+                        "req": req, "start": start, "cur": cur,
+                        "pos": pos, "complete": complete,
+                        "last_tok": int(self._last_tok[slot]),
+                        "tokens": list(req.tokens),
+                        "key_state": self._key_state[slot].copy(),
+                        "ids": self._bt[slot, start:cur].copy()})
+                for rid, rec in list(self._repl_sent.items()):
+                    if rid not in live_ids:
+                        drops.append(rec[1])
+                        self._repl_sent.pop(rid, None)
+            # device→host gathers still under the step lock (the fused
+            # step DONATES the arena — unsynchronized reads race)
+            for job in jobs:
+                job["data"] = self._spill_blocks(
+                    job["ids"], job["cur"] - job["start"])
+        finally:
+            self._step_lock.release()
+        if not jobs and not drops:
+            return
+        from hetu_tpu.serving.fleet import array_to_wire
+        reg = telemetry.get_registry()
+        sink = self._repl_sink
+        if sink is None:
+            return
+        for job in jobs:
+            req = job["req"]
+            doc = {"trace_id": req.trace_id,
+                   "origin": self._repl_origin,
+                   "req_id": req.id,
+                   "weight_version": req.weight_version,
+                   "block_size": bs, "pos": job["pos"],
+                   "last_tok": job["last_tok"],
+                   "tokens": job["tokens"], "start": job["start"],
+                   "key_state": array_to_wire(job["key_state"]),
+                   "traceparent": req.traceparent
+                   or telemetry.make_traceparent(req.trace_id),
+                   "data": [array_to_wire(a) for a in job["data"]]}
+            try:
+                sink(doc)
+            except Exception:                         # noqa: BLE001
+                continue      # buddy unreachable: same range retries
+            with self._lock:
+                self._repl_sent[req.id] = (job["complete"],
+                                           req.trace_id)
+            reg.counter(
+                "fleet_kv_replicated_blocks_total",
+                "decode-KV blocks streamed to the rendezvous buddy "
+                "replica (block-granular cadence — the recovery set "
+                "SIGKILL resumes from)").inc(job["cur"] - job["start"])
+            flight_record("fleet_kv_replicate", req=req.id,
+                          trace=req.trace_id, start=job["start"],
+                          blocks=job["cur"] - job["start"],
+                          pos=job["pos"])
+        for tid in drops:
+            try:
+                sink({"drop": tid})
+            except Exception:                         # noqa: BLE001
+                pass          # cap-bounded store ages it out instead
+
     def prefill_only(self, prompt: Sequence[int],
                      sampling: Optional[SamplingParams] = None, *,
                      timeout_s: Optional[float] = None,
@@ -1785,6 +2054,14 @@ class ServingEngine:
                   "KV blocks parked in the host spill arena "
                   "(preempted requests awaiting resume)").set(
             self.spill_arena.blocks_held)
+        tiers = dict(self.spill_arena.tier_counts())
+        tiers["replica"] = self.kv_replica_store.blocks_held
+        g = reg.gauge(
+            "spill_tier_blocks",
+            "KV blocks parked per spill tier (host arena, peer tier, "
+            "buddy replica store) — the tier chain of ISSUE 18")
+        for tier, n in tiers.items():
+            g.set(n, tier=tier)
 
     def run_until_drained(self, max_steps: int = 1_000_000) -> int:
         """Drive :meth:`step` until queue + slots are empty; returns the
@@ -1870,6 +2147,11 @@ class ServingEngine:
         self._thread.start()
 
     def stop(self) -> None:
+        if self._repl_stop is not None:   # decode-KV replication stream
+            self._repl_stop.set()
+            if self._repl_thread is not None:
+                self._repl_thread.join(timeout=5.0)
+            self._repl_thread = None
         if self._thread is None:
             return
         self._stop.set()

@@ -96,10 +96,16 @@ def spill_to_wire(entry: SpillEntry) -> dict:
          "data": [array_to_wire(a) for a in entry.data]}
     if entry.traceparent:
         d["traceparent"] = entry.traceparent
+    if entry.key_state is not None:
+        # a sampled request's PRNG commit-key state must travel with
+        # its KV — a cross-process resume without it would fork the
+        # sample stream and diverge from the undisturbed run
+        d["key_state"] = array_to_wire(np.asarray(entry.key_state))
     return d
 
 
 def spill_from_wire(d: dict) -> SpillEntry:
+    ks = d.get("key_state")
     return SpillEntry(
         req_id=int(d["req_id"]),
         data=tuple(array_from_wire(a) for a in d["data"]),
@@ -107,7 +113,103 @@ def spill_from_wire(d: dict) -> SpillEntry:
         pos=int(d["pos"]), last_tok=int(d["last_tok"]),
         tokens=[int(t) for t in d["tokens"]],
         weight_version=int(d["weight_version"]),
-        traceparent=d.get("traceparent"))
+        traceparent=d.get("traceparent"),
+        key_state=array_from_wire(ks) if ks is not None else None)
+
+
+# -- decode-KV replication: the buddy-side store ------------------------------
+
+
+class KVReplicaStore:
+    """Buddy-side accumulator of a decoding peer's replicated KV
+    (ISSUE 18).
+
+    The origin engine streams newly committed blocks on a
+    block-granular cadence (``ServingEngine.configure_replication``);
+    each shipment is a JSON-safe doc carrying a contiguous block range
+    ``[start, start+n)`` per cache leaf plus a CONSISTENT metadata
+    snapshot (pos / tokens / last_tok / PRNG key state, captured under
+    the origin's step lock in the same breath as the blocks). Entries
+    are keyed by ``trace_id`` — the one identity that survives the
+    origin's death and any number of requeues — and :meth:`fetch`
+    assembles a full :class:`SpillEntry` the recovery path feeds to
+    ``submit(resume=)`` on a live peer: bit-identical to a local
+    preemption resume, because it IS one.
+
+    Jax-free and lock-cheap: ``put`` runs on the buddy's verb-handler
+    thread (wire) or the origin's replication thread (in-process) and
+    only touches numpy."""
+
+    def __init__(self, max_traces: int = 256):
+        self.max_traces = int(max_traces)
+        self._lock = threading.Lock()
+        self._by_trace: dict[str, dict] = {}     # insertion order = LRU
+        self.put_total = 0
+
+    @property
+    def blocks_held(self) -> int:
+        with self._lock:
+            return sum(len(e["blocks"])
+                       for e in self._by_trace.values())
+
+    def __contains__(self, trace_id: str) -> bool:
+        with self._lock:
+            return trace_id in self._by_trace
+
+    def put(self, doc: dict) -> None:
+        """Absorb one replication shipment (or a ``{"drop": tid}``
+        tombstone when the origin finished the request)."""
+        tid = doc.get("drop")
+        if tid:
+            with self._lock:
+                self._by_trace.pop(tid, None)
+            return
+        tid = doc["trace_id"]
+        data = [array_from_wire(a) for a in doc["data"]]
+        start = int(doc["start"])
+        with self._lock:
+            ent = self._by_trace.pop(tid, None) or {"blocks": {}}
+            self._by_trace[tid] = ent            # refresh LRU position
+            for j in range(int(data[0].shape[1])):
+                ent["blocks"][start + j] = [a[:, j:j + 1] for a in data]
+            ent["meta"] = {k: doc.get(k) for k in (
+                "origin", "req_id", "weight_version", "block_size",
+                "pos", "last_tok", "tokens", "key_state",
+                "traceparent")}
+            self.put_total += 1
+            while len(self._by_trace) > self.max_traces:
+                self._by_trace.pop(next(iter(self._by_trace)))
+
+    def fetch(self, trace_id: str) -> Optional[SpillEntry]:
+        """Assemble the replica set into a resumable SpillEntry, or
+        ``None`` while coverage is incomplete (a request that died
+        before its first shipment simply replays from the prompt)."""
+        with self._lock:
+            ent = self._by_trace.get(trace_id)
+            if ent is None or "meta" not in ent:
+                return None
+            m = ent["meta"]
+            bs, pos = int(m["block_size"]), int(m["pos"])
+            nb = max(1, -(-pos // bs))
+            blocks = ent["blocks"]
+            if any(i not in blocks for i in range(nb)):
+                return None
+            data = tuple(
+                np.concatenate([blocks[i][leaf] for i in range(nb)],
+                               axis=1)
+                for leaf in range(len(blocks[0])))
+        ks = m.get("key_state")
+        return SpillEntry(
+            req_id=int(m["req_id"]), data=data, n_blocks=nb,
+            block_size=bs, pos=pos, last_tok=int(m["last_tok"]),
+            tokens=[int(t) for t in m["tokens"]],
+            weight_version=int(m["weight_version"]),
+            traceparent=m.get("traceparent"),
+            key_state=array_from_wire(ks) if ks is not None else None)
+
+    def drop(self, trace_id: str) -> None:
+        with self._lock:
+            self._by_trace.pop(trace_id, None)
 
 
 # -- the remote request -------------------------------------------------------
@@ -215,15 +317,27 @@ class RemoteEngineProxy:
 
     def __init__(self, port: int, host: str = "127.0.0.1", *,
                  token: Optional[str] = None,
-                 poll_s: float = 0.05, timeout_s: float = 5.0,
+                 poll_s: float = 0.05, poll_max_s: float = 0.25,
+                 timeout_s: float = 5.0,
                  swap_timeout_s: float = 300.0):
         self.port, self.host = int(port), host
         self._token = token
         self._poll_s = float(poll_s)
+        # adaptive RESULT-poll backoff (ISSUE 18 satellite): ESTATUS
+        # keeps its fixed cadence (it IS the heartbeat — backing it off
+        # would trip the router's staleness reaper), but the per-request
+        # RESULT polls back off exponentially toward ``poll_max_s``
+        # while they keep answering PEND, and snap back to ``poll_s``
+        # on any activity (a result adopted, a new submit)
+        self._poll_max_s = max(float(poll_max_s), self._poll_s)
+        self._result_delay = self._poll_s
+        self._next_result_poll = 0.0
         self._timeout_s = float(timeout_s)
         self._swap_timeout_s = float(swap_timeout_s)
         self._lock = threading.RLock()
         self._cli = None
+        self._kv_lock = threading.Lock()
+        self._kv_cli = None              # dedicated replication socket
         self._pending: dict[int, RemoteRequest] = {}
         self._status: dict = {}
         #: wall-clock offset of the replica vs this process (replica
@@ -281,6 +395,12 @@ class RemoteEngineProxy:
     def weight_version(self) -> int:
         return int(self._status.get("weight_version", 0))
 
+    @property
+    def block_size(self) -> int:
+        """The remote arena's block size (0 until the first ESTATUS
+        answers) — the prefix directory hashes at this granularity."""
+        return int(self._status.get("block_size", 0))
+
     def has_work(self) -> bool:
         return bool(self._status.get("has_work", False))
 
@@ -328,6 +448,7 @@ class RemoteEngineProxy:
             rr.spill = resume          # identity marker the router reads
         rr.status = "dispatched"
         self._pending[rr.id] = rr
+        self._reset_result_backoff()   # fresh work: poll eagerly again
         return rr
 
     def _prefill_call(self, rr: RemoteRequest) -> None:
@@ -423,6 +544,92 @@ class RemoteEngineProxy:
         finally:
             cli.close()
 
+    # -- fleet-global KV plane (ISSUE 18) ------------------------------------
+    def export_prefix(self, tokens) -> Optional[SpillEntry]:
+        """KVEXPORT: gather this replica's cached whole-block prefix of
+        ``tokens`` into a SpillEntry (None on miss / transport loss —
+        a pull is always best-effort, the puller just prefills)."""
+        try:
+            with self._lock:
+                doc = self._client().serving_kv_export(
+                    [int(t) for t in tokens])
+        except Exception:                             # noqa: BLE001
+            self._drop_client()
+            return None
+        if not doc or doc.get("spill") is None:
+            return None
+        return spill_from_wire(doc["spill"])
+
+    def import_prefix(self, entry: SpillEntry) -> bool:
+        """KVIMPORT: map a peer-exported prefix into the remote
+        replica's prefix cache. False = refused (stale weight version,
+        layout mismatch, arena full) or transport loss — the caller
+        falls back to a plain prefill."""
+        try:
+            with self._lock:
+                doc = self._client().serving_kv_import(
+                    spill_to_wire(entry))
+        except Exception:                             # noqa: BLE001
+            self._drop_client()
+            return False
+        return bool(doc and doc.get("ok"))
+
+    def _kv_client(self):
+        from hetu_tpu.rpc.client import CoordinatorClient
+        if self._kv_cli is None:
+            # replication is a steady block stream — give it its own
+            # socket so big shipments never starve the status poller
+            self._kv_cli = CoordinatorClient(
+                self.port, host=self.host, token=self._token,
+                timeout=self._timeout_s, retries=1, backoff_s=0.02)
+        return self._kv_cli
+
+    def kv_put(self, doc: dict) -> None:
+        """KVREPL: deliver one replication shipment to the remote
+        buddy's :class:`KVReplicaStore`. Raises on transport loss —
+        the origin's replication thread absorbs and retries next
+        cadence."""
+        with self._kv_lock:
+            try:
+                self._kv_client().serving_kv_put(doc)
+            except Exception:
+                if self._kv_cli is not None:
+                    try:
+                        self._kv_cli.close()
+                    except OSError:
+                        pass
+                    self._kv_cli = None
+                raise
+
+    def kv_fetch(self, trace_id: str) -> Optional[SpillEntry]:
+        """KVFETCH: assemble the buddy-held replica set for
+        ``trace_id`` into a resumable SpillEntry (None = no/partial
+        coverage — recovery replays from the prompt instead)."""
+        try:
+            with self._lock:
+                doc = self._client().serving_kv_fetch(trace_id)
+        except Exception:                             # noqa: BLE001
+            self._drop_client()
+            return None
+        if not doc or doc.get("spill") is None:
+            return None
+        return spill_from_wire(doc["spill"])
+
+    def set_kv_buddy(self, host: Optional[str], port: int = 0, *,
+                     token: Optional[str] = None, origin: str = "",
+                     cadence_s: float = 0.02) -> bool:
+        """KVBUDDY: point the remote engine's replication stream at a
+        buddy replica (``host=None`` disables it)."""
+        try:
+            with self._lock:
+                self._client().serving_kv_buddy(
+                    host, port, token=token, origin=origin,
+                    cadence_s=cadence_s)
+            return True
+        except Exception:                             # noqa: BLE001
+            self._drop_client()
+            return False
+
     # -- federation scrape (Router._tick → FLEETMETRICS/fleet HEALTHZ) -------
     def metrics_text(self) -> str:
         """This replica's Prometheus exposition page."""
@@ -468,6 +675,13 @@ class RemoteEngineProxy:
         except Exception:                             # noqa: BLE001
             pass                       # the process may already be gone
         self._drop_client()
+        with self._kv_lock:
+            if self._kv_cli is not None:
+                try:
+                    self._kv_cli.close()
+                except OSError:
+                    pass
+                self._kv_cli = None
 
     # -- the poller ----------------------------------------------------------
     def _poll_loop(self) -> None:
@@ -503,11 +717,15 @@ class RemoteEngineProxy:
                 round(off, 6), replica=name)
         if self._handle is not None:
             self._handle.last_beat = time.monotonic()
+        if time.monotonic() < self._next_result_poll:
+            return True                # RESULT lane is backing off
+        adopted = polled = 0
         for rid, rr in list(self._pending.items()):
             if rr.done.is_set() or rr.status in ("prefilled",
                                                  "evicted",
                                                  "cancelled"):
                 continue
+            polled += 1
             try:
                 with self._lock:
                     doc = self._client().serving_result(rid,
@@ -527,7 +745,20 @@ class RemoteEngineProxy:
             rr._fill_from(doc)
             self._pending.pop(rid, None)
             rr.done.set()
+            adopted += 1
+        if adopted:
+            self._reset_result_backoff()
+        elif polled:
+            # every in-flight RESULT answered PEND: widen the gap
+            self._result_delay = min(self._poll_max_s,
+                                     self._result_delay * 2)
+            self._next_result_poll = time.monotonic() \
+                + self._result_delay
         return True
+
+    def _reset_result_backoff(self) -> None:
+        self._result_delay = self._poll_s
+        self._next_result_poll = 0.0
 
 
 def _sampling_kw(sp: SamplingParams) -> dict:

@@ -276,29 +276,78 @@ class HostSpillArena:
     prices from a host-byte budget), so the scheduler's preemption
     planner can gate an eviction with the same arithmetic the resume
     will be charged. ``max_blocks=None`` = unbounded (the default for
-    in-process fleets where host RAM dwarfs the arena)."""
+    in-process fleets where host RAM dwarfs the arena).
 
-    def __init__(self, max_blocks: Optional[int] = None):
+    ``peer`` chains a second tier behind this one (device→host→peer):
+    when the host tier is full, the LEAST-RECENTLY-SPILLED entries are
+    demoted whole into the peer store, and an oversized entry that
+    cannot fit the host tier at all passes straight through. ``pop``
+    and ``get`` look through to the peer, so callers never care which
+    tier holds an entry. Any object speaking the arena's
+    put/pop/get/can_fit/``blocks_held`` surface works as a peer —
+    another ``HostSpillArena`` in-process, or a wire-backed store."""
+
+    def __init__(self, max_blocks: Optional[int] = None,
+                 peer: Optional["HostSpillArena"] = None):
         self.max_blocks = int(max_blocks) if max_blocks else None
         self._entries: dict[int, SpillEntry] = {}
+        self._peer = peer
         self.blocks_held = 0
         self.spilled_total = 0           # host ledgers (telemetry syncs)
         self.resumed_total = 0
+        self.demoted_total = 0           # blocks pushed down the chain
+        self.promoted_total = 0          # blocks pulled back up
+
+    def attach_peer(self, peer) -> None:
+        self._peer = peer
+
+    def _demotion_plan(self, n_blocks: int):
+        """Entry ids to demote (oldest first) so a put of ``n_blocks``
+        fits the host tier, ``None`` if no placement exists. A put that
+        fits as-is plans ``[]``; an entry wider than the whole host
+        tier plans a pass-through (also ``[]``) if the peer takes it."""
+        if self.max_blocks is None \
+                or self.blocks_held + n_blocks <= self.max_blocks:
+            return []
+        if self._peer is None:
+            return None
+        if n_blocks > self.max_blocks:      # pass straight through
+            return [] if self._peer.can_fit(n_blocks) else None
+        plan, freed = [], 0
+        need = self.blocks_held + n_blocks - self.max_blocks
+        for rid, e in self._entries.items():     # insertion order = LRU
+            if freed >= need:
+                break
+            plan.append(rid)
+            freed += e.n_blocks
+        if freed < need or not self._peer.can_fit(freed):
+            return None
+        return plan
 
     def can_fit(self, n_blocks: int) -> bool:
-        return self.max_blocks is None \
-            or self.blocks_held + int(n_blocks) <= self.max_blocks
+        return self._demotion_plan(int(n_blocks)) is not None
 
     def put(self, entry: SpillEntry) -> None:
-        if not self.can_fit(entry.n_blocks):
+        plan = self._demotion_plan(entry.n_blocks)
+        if plan is None:
             raise ValueError(
                 f"spill arena full: {self.blocks_held} + "
                 f"{entry.n_blocks} blocks exceed max_blocks="
                 f"{self.max_blocks}")
-        if entry.req_id in self._entries:
+        if entry.req_id in self:
             raise ValueError(f"request {entry.req_id} already spilled")
-        self._entries[entry.req_id] = entry
-        self.blocks_held += entry.n_blocks
+        for rid in plan:
+            old = self._entries.pop(rid)
+            self.blocks_held -= old.n_blocks
+            self._peer.put(old)
+            self.demoted_total += old.n_blocks
+        if self.max_blocks is not None \
+                and entry.n_blocks > self.max_blocks:
+            self._peer.put(entry)        # oversized: pass-through
+            self.demoted_total += entry.n_blocks
+        else:
+            self._entries[entry.req_id] = entry
+            self.blocks_held += entry.n_blocks
         self.spilled_total += entry.n_blocks
 
     def pop(self, req_id: int, *, resumed: bool = True
@@ -306,19 +355,35 @@ class HostSpillArena:
         """Remove an entry: ``resumed=True`` counts it in the resume
         ledger (a real map-back); ``resumed=False`` is a detach (the
         router pulled the request to a peer — that engine's resume
-        counts it there)."""
+        counts it there). Looks through to the peer tier."""
         entry = self._entries.pop(req_id, None)
-        if entry is not None:
+        if entry is None and self._peer is not None:
+            entry = self._peer.pop(req_id, resumed=False)
+            if entry is not None:
+                self.promoted_total += entry.n_blocks
+        elif entry is not None:
             self.blocks_held -= entry.n_blocks
-            if resumed:
-                self.resumed_total += entry.n_blocks
+        if entry is not None and resumed:
+            self.resumed_total += entry.n_blocks
         return entry
 
     def get(self, req_id: int) -> Optional[SpillEntry]:
-        return self._entries.get(req_id)
+        entry = self._entries.get(req_id)
+        if entry is None and self._peer is not None:
+            entry = self._peer.get(req_id)
+        return entry
+
+    def tier_counts(self) -> dict:
+        """Blocks held per tier, for the ``spill_tier_blocks`` gauge."""
+        out = {"host": self.blocks_held}
+        if self._peer is not None:
+            out["peer"] = int(self._peer.blocks_held)
+        return out
 
     def __contains__(self, req_id: int) -> bool:
-        return req_id in self._entries
+        return req_id in self._entries \
+            or (self._peer is not None and req_id in self._peer)
 
     def __len__(self) -> int:
-        return len(self._entries)
+        return len(self._entries) \
+            + (len(self._peer) if self._peer is not None else 0)

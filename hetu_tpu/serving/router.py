@@ -119,6 +119,98 @@ class RouterRequest:
                 "timing": timing}
 
 
+class FleetPrefixDirectory:
+    """Router-owned map from whole-block prompt-prefix hashes to the
+    replica whose radix prefix cache holds that prefix (ISSUE 18).
+
+    Entries are ``(replica, weight_version)``-tagged: the version rides
+    every publish, and :meth:`flush_stale` atomically invalidates a
+    replica's entries on a weight push or its death — the directory can
+    then never route a pull at KV prefilled under superseded weights
+    (the engine's ``SpillEntry.compatible_with`` gate is the second,
+    engine-side line of defense). Purely host-side bookkeeping: the
+    directory holds hashes, never KV bytes, so a wrong entry costs one
+    failed pull and a plain prefill — never a wrong token.
+
+    One publish records every whole-block boundary of the prompt (k
+    blocks for k = 1..nb), so a later prompt sharing only PART of the
+    prefix still finds its longest cached span. Capacity is a FIFO cap
+    on total entries; re-publishing refreshes an entry's position."""
+
+    def __init__(self, max_entries: int = 4096):
+        self.max_entries = int(max_entries)
+        #: hash(block_size, token prefix) → (replica, weight_version,
+        #: n_blocks, block_size); insertion-ordered = FIFO eviction
+        self._entries: dict[str, tuple[str, int, int, int]] = {}
+        self._block_sizes: set[int] = set()
+        self.published_total = 0         # host ledgers (tests/bench)
+        self.flushed_total = 0
+
+    @staticmethod
+    def _key(tokens: Sequence[int], n_tokens: int,
+             block_size: int) -> str:
+        h = hashlib.blake2b(digest_size=16)
+        h.update(f"{block_size}|".encode())
+        h.update(",".join(str(int(t))
+                          for t in tokens[:n_tokens]).encode())
+        return h.hexdigest()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def publish(self, replica: str, tokens: Sequence[int], *,
+                block_size: int, weight_version: int) -> int:
+        """Record that ``replica`` holds every whole-block prefix of
+        ``tokens`` under ``weight_version``; returns blocks recorded."""
+        bs = int(block_size)
+        nb = 0 if bs <= 0 else len(tokens) // bs
+        if nb <= 0:
+            return 0
+        self._block_sizes.add(bs)
+        for k in range(1, nb + 1):
+            key = self._key(tokens, k * bs, bs)
+            self._entries.pop(key, None)     # refresh FIFO position
+            self._entries[key] = (replica, int(weight_version), k, bs)
+        self.published_total += nb
+        while len(self._entries) > self.max_entries:
+            self._entries.pop(next(iter(self._entries)))
+        return nb
+
+    def lookup(self, tokens: Sequence[int]
+               ) -> Optional[tuple[str, int, int]]:
+        """Longest directory-known whole-block prefix of ``tokens`` →
+        ``(replica, n_blocks, block_size)``, or None. Longest-first so
+        a pull moves the maximal cached span in one hop."""
+        best: Optional[tuple[str, int, int]] = None
+        for bs in self._block_sizes:
+            for k in range(len(tokens) // bs, 0, -1):
+                if best is not None and k * bs <= best[1] * best[2]:
+                    break                # cannot beat the current best
+                ent = self._entries.get(self._key(tokens, k * bs, bs))
+                if ent is None:
+                    continue
+                best = (ent[0], k, bs)
+                break
+        return best
+
+    def flush_stale(self, *, replica: Optional[str] = None,
+                    below_version: Optional[int] = None) -> int:
+        """Atomically drop entries for ``replica`` and/or entries whose
+        tagged version is below ``below_version`` — the invalidation
+        hook weight pushes and replica deaths call. Returns dropped."""
+        doomed = [k for k, (rep, ver, _nb, _bs) in self._entries.items()
+                  if (replica is not None and rep == replica)
+                  or (below_version is not None
+                      and ver < int(below_version))]
+        for k in doomed:
+            self._entries.pop(k, None)
+        self.flushed_total += len(doomed)
+        return len(doomed)
+
+    def drop_replica(self, replica: str) -> int:
+        return self.flush_stale(replica=replica)
+
+
 class ReplicaHandle:
     """Router-side view of one registered replica."""
 
@@ -188,7 +280,11 @@ class Router:
                  beat_timeout_s: float = 2.0,
                  max_attempts: int = 5,
                  poll_s: float = 0.002,
-                 scrape_every_s: float = 1.0):
+                 scrape_every_s: float = 1.0,
+                 kv_pull: bool = True,
+                 replicate_kv: bool = False,
+                 replicate_cadence_s: float = 0.02,
+                 directory_max_entries: int = 4096):
         self.affinity_tokens = int(affinity_tokens)
         #: a sticky (prefix-affinity) pick is honored only while its
         #: load is within this many requests of the least-loaded
@@ -213,6 +309,17 @@ class Router:
         self._fed_lock = threading.Lock()
         self._fed: dict[str, dict] = {}      # name → {metrics, health}
         self._fed_ts = 0.0                   # monotonic of last scrape
+        # -- fleet-global KV plane (ISSUE 18) --------------------------
+        #: consult the prefix directory at dispatch and pull a fleet-hot
+        #: prefix onto a miss replica instead of re-prefilling it
+        self.kv_pull = bool(kv_pull)
+        #: stream every decoding request's newly committed KV blocks to
+        #: a rendezvous-chosen buddy replica, so a SIGKILLed replica's
+        #: mid-decode requests resume from the buddy's replica set
+        self.replicate_kv = bool(replicate_kv)
+        self.replicate_cadence_s = float(replicate_cadence_s)
+        self._directory = FleetPrefixDirectory(directory_max_entries)
+        self._buddy_of: dict[str, str] = {}  # origin → buddy name
 
     # -- replica lifecycle --------------------------------------------------
     def register(self, name: str, engine: ServingEngine, *,
@@ -385,9 +492,25 @@ class Router:
                             rreq.inner, lock_timeout_s=2.0)
                     except Exception:            # salvage is best-effort
                         rreq.spill = None
+                # second line of defense (ISSUE 18): when the arena is
+                # unreachable (SIGKILLed remote process, wedged step),
+                # the rendezvous buddy's replica set still holds the
+                # request's streamed decode KV — fetch it by trace_id
+                # so the requeue RESUMES mid-decode instead of
+                # replaying the prompt
+                if rreq.spill is None and self.replicate_kv \
+                        and rreq.inner is not None:
+                    rreq.spill = self._fetch_buddy_kv_locked(h, rreq)
                 self._requeue_locked(rreq, from_replica=h.name,
                                      reason=reason)
                 n += 1
+        # the dead replica's prefix-directory entries and buddy wiring
+        # are void; origins that replicated TO it rewire next tick
+        self._directory.drop_replica(h.name)
+        self._buddy_of.pop(h.name, None)
+        for origin, b in list(self._buddy_of.items()):
+            if b == h.name:
+                self._buddy_of.pop(origin, None)
         flight_record("router_replica", replica=h.name, state="dead",
                       event=reason, requeued=n)
         return n
@@ -419,6 +542,20 @@ class Router:
             loads[h.name],
             h.ttft_ewma_s if h.ttft_ewma_s is not None else 0.0,
             h.name))
+        # the fleet prefix directory outranks rendezvous affinity: it
+        # records where the prefix ACTUALLY sits (affinity only guesses
+        # where it should), under the same load-slack rule so a fleet-
+        # hot prefix cannot starve the fleet. Past the slack the
+        # dispatch falls through — and _pull_prefix_locked moves the
+        # prefix to wherever the request lands instead.
+        if self.kv_pull and tier != "prefill":
+            hit = self._directory.lookup(prompt)
+            if hit is not None:
+                owner = self._replicas.get(hit[0])
+                if owner is not None and owner.name in loads \
+                        and loads[owner.name] <= loads[least.name] \
+                        + self.affinity_slack:
+                    return owner, "directory"
         sticky = self._affinity_pick(prompt, live)
         if loads[sticky.name] <= loads[least.name] + self.affinity_slack:
             return sticky, "affinity"
@@ -455,6 +592,26 @@ class Router:
         if picked is None:
             return False
         h, reason = picked
+        # fleet-global prefix plane (ISSUE 18): a fresh request landing
+        # off the directory's owner first PULLS the cached prefix onto
+        # its replica (export → wire → import), so a fleet-hot prefix
+        # prefills ONCE per weight version no matter where load-
+        # balancing scatters its requests. Hit/miss token ledgers feed
+        # the bench + acceptance asserts.
+        if not handoff and rreq.spill is None and self.kv_pull:
+            warm = self._pull_prefix_locked(h, rreq)
+            reg0 = telemetry.get_registry()
+            reg0.counter(
+                "fleet_prefix_hit_tokens_total",
+                "prompt tokens covered by the fleet prefix directory "
+                "at dispatch (served from cached KV — locally or via "
+                "a cross-replica pull — not the prefill lane)").inc(
+                warm)
+            reg0.counter(
+                "fleet_prefix_miss_tokens_total",
+                "prompt tokens the fleet prefix directory could not "
+                "cover at dispatch (prefilled from scratch)").inc(
+                max(0, len(rreq.prompt) - warm))
         # every dispatch hop mints a fresh span id under the request's
         # one trace id — the replica's local spans and flight events
         # then join the fleet trace (ISSUE 16)
@@ -526,6 +683,174 @@ class Router:
         tracer.complete(name, time.perf_counter() - t0, cat="request",
                         tid=tid, trace_id=rreq.trace_id, req=rreq.id,
                         **attrs)
+
+    # -- fleet-global KV plane (ISSUE 18) ------------------------------------
+    @staticmethod
+    def _replica_block_size(h: ReplicaHandle) -> int:
+        """The replica's KV block size — straight off the pool for an
+        in-process engine, off the last ESTATUS poll for a remote one
+        (0 until the first poll lands: publication just waits)."""
+        try:
+            if getattr(h, "remote", False):
+                return int(getattr(h.engine, "block_size", 0) or 0)
+            return int(h.engine.pool.block_size)
+        except Exception:                             # noqa: BLE001
+            return 0
+
+    def _pull_prefix_locked(self, h: ReplicaHandle,
+                            rreq: RouterRequest) -> int:
+        """Consult the directory for ``rreq.prompt`` and, when the
+        owner is a DIFFERENT live replica, pull the cached span onto
+        ``h`` (owner export → wire → ``h`` import) before the submit.
+        Returns the prompt tokens now warm on ``h`` (0 = cold: the
+        request prefills normally). Every failure mode — dead owner,
+        export miss, stale weight version, full arena — degrades to
+        that plain prefill; a pull can cost time, never correctness."""
+        hit = self._directory.lookup(rreq.prompt)
+        if hit is None:
+            return 0
+        owner_name, nb, bs = hit
+        span = nb * bs
+        if owner_name == h.name:
+            return span              # dispatch landed ON the owner
+        owner = self._replicas.get(owner_name)
+        if owner is None or owner.state == "dead":
+            self._directory.drop_replica(owner_name)
+            return 0
+        t0 = time.perf_counter()
+        try:
+            entry = owner.engine.export_prefix(rreq.prompt[:span])
+        except Exception:                             # noqa: BLE001
+            entry = None
+        if entry is None:
+            # the owner no longer holds it (LRU churn, weight swap
+            # flush, wedged step): the directory lied — retract it
+            self._directory.flush_stale(replica=owner_name)
+            return 0
+        try:
+            ok = h.engine.import_prefix(entry)
+        except Exception:                             # noqa: BLE001
+            ok = False
+        if not ok:
+            return 0     # version-stale or no free blocks: prefill
+        reg = telemetry.get_registry()
+        reg.counter(
+            "fleet_kv_pull_blocks_total",
+            "KV blocks pulled between replicas by the fleet prefix "
+            "directory (a fleet-hot prefix prefills once, then "
+            "travels)").inc(entry.n_blocks)
+        reg.counter(
+            "fleet_kv_pull_bytes_total",
+            "KV bytes moved by fleet prefix-directory pulls").inc(
+            entry.nbytes())
+        # the pulled span is now cached HERE too — future lookups may
+        # land on either copy
+        self._directory.publish(
+            h.name, list(entry.tokens), block_size=entry.block_size,
+            weight_version=entry.weight_version)
+        flight_record("fleet_kv_pull", req=rreq.id,
+                      trace=rreq.trace_id, owner=owner_name,
+                      to=h.name, blocks=entry.n_blocks,
+                      bytes=entry.nbytes())
+        self._trace_req_span(rreq, "kv_pull", t0, owner=owner_name,
+                             to=h.name, blocks=entry.n_blocks)
+        return entry.n_blocks * entry.block_size
+
+    def _buddy_pick(self, origin: ReplicaHandle,
+                    candidates: list) -> ReplicaHandle:
+        """Rendezvous hash over (origin, candidate) pairs: stable under
+        churn — a replica joining/dying reshuffles only the origins
+        that hashed to it."""
+        return max(candidates, key=lambda p: hashlib.blake2b(
+            f"{origin.name}|{p.name}".encode(),
+            digest_size=8).digest())
+
+    def _assign_buddies_locked(self) -> None:
+        """Keep every decode-capable replica's replication stream
+        pointed at its rendezvous buddy; rewires only on membership
+        change. A REMOTE origin replicates only to a remote buddy (its
+        engine process needs a coordinator address to ship to — the
+        router process is not one); in-process origins take any peer."""
+        live = [h for h in self._replicas.values()
+                if h.state in ("live", "draining")]
+        for h in live:
+            if h.role == "prefill":
+                continue         # the prefill tier holds no decode KV
+            peers = [p for p in live if p is not h
+                     and p.role in ("both", "decode")]
+            if getattr(h, "remote", False):
+                peers = [p for p in peers
+                         if getattr(p, "remote", False)]
+            cur = self._buddy_of.get(h.name)
+            if not peers:
+                if cur is not None:
+                    self._wire_buddy(h, None)
+                continue
+            buddy = self._buddy_pick(h, peers)
+            if buddy.name != cur:
+                self._wire_buddy(h, buddy)
+
+    def _wire_buddy(self, h: ReplicaHandle,
+                    buddy: Optional[ReplicaHandle]) -> None:
+        try:
+            if buddy is None:
+                if getattr(h, "remote", False):
+                    h.engine.set_kv_buddy(None)
+                else:
+                    h.engine.configure_replication(None)
+                self._buddy_of.pop(h.name, None)
+                return
+            if getattr(h, "remote", False):
+                # KVBUDDY: the origin's engine process opens its own
+                # socket to the buddy's front door and streams KVREPL
+                if not h.engine.set_kv_buddy(
+                        buddy.engine.host, buddy.engine.port,
+                        token=buddy.engine._token, origin=h.name,
+                        cadence_s=self.replicate_cadence_s):
+                    return               # retried next monitor tick
+            elif getattr(buddy, "remote", False):
+                h.engine.configure_replication(
+                    buddy.engine.kv_put, origin=h.name,
+                    cadence_s=self.replicate_cadence_s)
+            else:
+                h.engine.configure_replication(
+                    buddy.engine.kv_replica_store.put, origin=h.name,
+                    cadence_s=self.replicate_cadence_s)
+            self._buddy_of[h.name] = buddy.name
+            flight_record("fleet_kv_buddy", origin=h.name,
+                          buddy=buddy.name)
+        except Exception:                             # noqa: BLE001
+            pass          # wire failure: reassignment retries next tick
+
+    def _fetch_buddy_kv_locked(self, h: ReplicaHandle,
+                               rreq: RouterRequest):
+        """Recover a dead replica's mid-decode request from its buddy's
+        replica set, keyed by the fleet-stable ``trace_id``. None when
+        the buddy is gone or never got a complete shipment — the
+        requeue then replays from the prompt (greedy decoding keeps
+        that token-identical, just slower)."""
+        buddy = self._replicas.get(self._buddy_of.get(h.name, ""))
+        if buddy is None or buddy.state == "dead":
+            return None
+        try:
+            if getattr(buddy, "remote", False):
+                entry = buddy.engine.kv_fetch(rreq.trace_id)
+            else:
+                entry = buddy.engine.kv_replica_store.fetch(
+                    rreq.trace_id)
+        except Exception:                             # noqa: BLE001
+            return None
+        if entry is not None:
+            telemetry.get_registry().counter(
+                "fleet_kv_recoveries_total",
+                "mid-decode requests resumed from a buddy's "
+                "replicated KV after their replica died (no prefill "
+                "replay)").inc()
+            flight_record("fleet_kv_recover", req=rreq.id,
+                          trace=rreq.trace_id, victim=h.name,
+                          buddy=buddy.name, blocks=entry.n_blocks,
+                          pos=entry.pos)
+        return entry
 
     def _requeue_locked(self, rreq: RouterRequest, *,
                         from_replica: str, reason: str) -> None:
@@ -626,6 +951,16 @@ class Router:
             ttft = inner.first_token_s - inner.submit_s
             h.ttft_ewma_s = ttft if h.ttft_ewma_s is None \
                 else 0.8 * h.ttft_ewma_s + 0.2 * ttft
+        # a finished request leaves its prompt's whole-block prefix in
+        # the replica's radix cache — publish that to the fleet
+        # directory (version-tagged) so peers can pull it (ISSUE 18)
+        if self.kv_pull and rreq.status == "done" \
+                and h.state != "dead":
+            bs = self._replica_block_size(h)
+            if bs > 0:
+                self._directory.publish(
+                    h.name, rreq.prompt, block_size=bs,
+                    weight_version=int(rreq.weight_version or 0))
         rreq.done.set()
 
     def _handoff_locked(self, h: ReplicaHandle, inner_id: int,
@@ -717,6 +1052,10 @@ class Router:
                         self._requeue_locked(
                             rreq, from_replica=h.name,
                             reason="transport_failed")
+            # keep decode-KV replication streams pointed at the
+            # current rendezvous buddies (ISSUE 18)
+            if self.replicate_kv:
+                self._assign_buddies_locked()
             # place parked requests as capacity (re)appears
             still: deque[RouterRequest] = deque()
             while self._pending:
@@ -798,6 +1137,12 @@ class Router:
                 "weight_versions": sorted(
                     {r["weight_version"] for r in reps.values()
                      if r["state"] != "dead"}),
+                "prefix_directory": {
+                    "entries": len(self._directory),
+                    "published_total":
+                        self._directory.published_total,
+                    "flushed_total": self._directory.flushed_total},
+                "kv_buddies": dict(self._buddy_of),
             }
 
     # -- metrics/health federation (ISSUE 16) -------------------------------
@@ -1067,6 +1412,12 @@ class WeightPublisher:
                 per.append({"replica": name, "skipped": "drain_timeout"})
                 continue
             info = self._swap_replica(h, params, path, version, reg)
+            # the swap flushed the replica's version-stale prefix
+            # cache; flush the ROUTER's directory view of it in the
+            # same breath, so no peer pulls at superseded KV (the
+            # engine-side compatible_with gate would refuse the entry
+            # anyway — this keeps the directory honest, not just safe)
+            self.router._directory.flush_stale(replica=name)
             self.router.resume(name)
             per.append({"replica": name, "requeued": requeued,
                         "flushed_blocks": info.get("flushed_blocks", 0),

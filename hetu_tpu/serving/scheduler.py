@@ -169,6 +169,10 @@ class Request:
         out["preemptions"] = self.preemptions
         out["spilled_blocks"] = self.spilled_blocks
         out["resumed_blocks"] = self.resumed_blocks
+        # the chaos-soak contract (ISSUE 18): a request recovered from
+        # a buddy's replicated KV reports that it RESUMED mid-decode
+        # rather than replaying the prompt — RESULT carries the proof
+        out["resumed"] = any(p == "resumed" for p, _, _ in self.events)
         return out
 
     def result(self) -> dict:

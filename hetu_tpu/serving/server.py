@@ -104,11 +104,17 @@ def _prune_request_map(m: dict) -> None:
 #: serves the router's federated Prometheus page (ISSUE 16).
 #: ``rpc/py_server.py`` mirrors this tuple (it must stay importable
 #: without jax) — a quick-tier test keeps them in sync.
+#: KVEXPORT/KVIMPORT move whole-block prefix KV between replicas for
+#: the fleet prefix directory; KVREPL/KVFETCH/KVBUDDY are the
+#: decode-KV replication lane (ship to buddy / assemble for recovery /
+#: point the stream) — all ISSUE 18, docs/SERVING.md "Fleet-global KV".
 SERVING_COMMANDS = ("SUBMIT", "RESULT", "GENERATE",
                     "FLEET", "DRAIN", "RESUME",
                     "ESTATUS", "CANCELQ", "EVICT", "PREFILL",
                     "SWAPWEIGHTS", "STOPENGINE",
-                    "DUMPOBS", "FLEETMETRICS")
+                    "DUMPOBS", "FLEETMETRICS",
+                    "KVEXPORT", "KVIMPORT", "KVREPL", "KVFETCH",
+                    "KVBUDDY")
 
 
 _idem_init_lock = threading.Lock()
@@ -275,6 +281,10 @@ def _handle_engine_command(engine, cmd: str, args: list) -> str:
         doc["depth"] = getattr(sched, "depth", 0) if sched else 0
         doc["occupancy"] = round(getattr(sched, "occupancy", 0.0), 4) \
             if sched else 0.0
+        # arena granularity: the router's prefix directory hashes
+        # whole-block prefixes at this replica's block size (ISSUE 18)
+        doc["block_size"] = int(getattr(
+            getattr(engine, "pool", None), "block_size", 0) or 0)
         return f"VAL {encode_payload(doc)}"
     if cmd == "DUMPOBS":
         # this process's observability bundle — local chrome trace +
@@ -337,6 +347,65 @@ def _handle_engine_command(engine, cmd: str, args: list) -> str:
                "weight_version": req.weight_version,
                "spill": spill_to_wire(entry)}
         return f"VAL {encode_payload(doc)}"
+    if cmd == "KVEXPORT":
+        if not hasattr(engine, "export_prefix"):
+            return "ERR not an engine"
+        p = decode_payload(args[0])
+        entry = engine.export_prefix(p["tokens"])
+        return f"VAL {encode_payload({'spill': spill_to_wire(entry) if entry is not None else None})}"
+    if cmd == "KVIMPORT":
+        if not hasattr(engine, "import_prefix"):
+            return "ERR not an engine"
+        from hetu_tpu.serving.fleet import spill_from_wire
+        p = decode_payload(args[0])
+        ok = engine.import_prefix(spill_from_wire(p["spill"]))
+        return f"VAL {encode_payload({'ok': bool(ok)})}"
+    if cmd == "KVREPL":
+        store = getattr(engine, "kv_replica_store", None)
+        if store is None:
+            return "ERR no replica store"
+        store.put(decode_payload(args[0]))
+        return "OK"
+    if cmd == "KVFETCH":
+        store = getattr(engine, "kv_replica_store", None)
+        if store is None:
+            return "ERR no replica store"
+        p = decode_payload(args[0])
+        entry = store.fetch(p["trace_id"])
+        return f"VAL {encode_payload({'spill': spill_to_wire(entry) if entry is not None else None})}"
+    if cmd == "KVBUDDY":
+        if not hasattr(engine, "configure_replication"):
+            return "ERR not an engine"
+        p = decode_payload(args[0])
+        host = p.get("host")
+        if not host:
+            engine.configure_replication(None)
+            return "OK"
+        from hetu_tpu.rpc.client import CoordinatorClient
+        cli_box = {}
+
+        def sink(doc, _p=p, _box=cli_box):
+            # lazy, sticky connection owned by the replication thread;
+            # dropped on any failure so the next cadence reconnects
+            cli = _box.get("cli")
+            if cli is None:
+                cli = CoordinatorClient(int(_p["port"]), host=_p["host"],
+                                        token=_p.get("token") or None,
+                                        timeout=5.0, retries=1)
+                _box["cli"] = cli
+            try:
+                cli.serving_kv_put(doc)
+            except Exception:
+                _box.pop("cli", None)
+                try:
+                    cli.close()
+                except OSError:
+                    pass
+                raise
+        engine.configure_replication(
+            sink, origin=p.get("origin", ""),
+            cadence_s=float(p.get("cadence_s", 0.02)))
+        return "OK"
     if cmd == "SWAPWEIGHTS":
         p = decode_payload(args[0])
         from hetu_tpu import telemetry

@@ -561,6 +561,12 @@ _FLEET_PLANE_SERIES = (
     "weight_pushes_total", "weight_push_bytes_total",
     "router_replicas_live", "fleet_replica_beat_age_seconds",
     "serving_idem_dedup_total",
+    # fleet-global KV plane (ISSUE 18): directory hit ratio, pull
+    # volume, buddy replication and recoveries, spill-tier occupancy
+    "fleet_prefix_hit_tokens_total", "fleet_prefix_miss_tokens_total",
+    "fleet_kv_pull_blocks_total", "fleet_kv_pull_bytes_total",
+    "fleet_kv_replicated_blocks_total", "fleet_kv_recoveries_total",
+    "spill_tier_blocks",
 )
 
 
@@ -631,6 +637,34 @@ def fleet_plane_summary(records: list[dict]) -> Optional[list[str]]:
             line += (f"  (stalest remote beat: {worst[0]} "
                      f"{worst[1] * 1e3:.0f}ms)")
         lines.append("replicas".ljust(width) + line)
+    # fleet KV (ISSUE 18): directory effectiveness + buddy replication
+    hit = sum(by_label.get("fleet_prefix_hit_tokens_total",
+                           {}).values())
+    miss = sum(by_label.get("fleet_prefix_miss_tokens_total",
+                            {}).values())
+    if hit or miss:
+        pulls = sum(by_label.get("fleet_kv_pull_blocks_total",
+                                 {}).values())
+        pull_mb = sum(by_label.get("fleet_kv_pull_bytes_total",
+                                   {}).values()) / 1e6
+        lines.append(
+            "fleet KV prefix".ljust(width)
+            + f"{int(hit)}/{int(hit + miss)} prompt tokens warm "
+            f"({hit / max(1.0, hit + miss):.0%}), "
+            f"{int(pulls)} blocks pulled ({pull_mb:.1f}MB)")
+    repl = sum(by_label.get("fleet_kv_replicated_blocks_total",
+                            {}).values())
+    if repl:
+        rec = sum(by_label.get("fleet_kv_recoveries_total",
+                               {}).values())
+        lines.append("fleet KV buddies".ljust(width)
+                     + f"{int(repl)} blocks replicated, "
+                     f"{int(rec)} mid-decode recoveries")
+    tiers = by_label.get("spill_tier_blocks", {})
+    if any(tiers.values()):
+        parts = " / ".join(f"{t}:{int(v)}"
+                           for t, v in sorted(tiers.items()))
+        lines.append("spill tiers".ljust(width) + parts)
     return lines or None
 
 

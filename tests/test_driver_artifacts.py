@@ -286,16 +286,18 @@ def test_bench_fleet_emits_json_contract():
     and write BENCH_fleet.json: in-process vs multi-process dispatch
     overhead (all requests completing through the coordinator verbs)
     and the colocated vs P/D-split comparison with KV blocks actually
-    streamed prefill→decode."""
+    streamed prefill→decode. ISSUE 18 folds in the fleet-KV sweep:
+    the shared-prefix lanes (directory pull on/off) and the SIGKILL
+    recovery lanes (buddy replication on/off)."""
     env = dict(os.environ)
     env["HETU_TPU_BENCH_PLATFORM"] = "cpu"
     r = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "bench.py"), "--fleet"],
-        capture_output=True, text=True, timeout=580, env=env, cwd=_ROOT)
+        capture_output=True, text=True, timeout=840, env=env, cwd=_ROOT)
     assert r.returncode == 0, r.stderr[-2000:]
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     for key in ("metric", "value", "unit", "in_process",
-                "multi_process", "pd"):
+                "multi_process", "pd", "fleet_kv", "recovery"):
         assert key in rec, (key, rec)
     offered = rec["offered"]
     # every lane completed its whole offered load — the transport works
@@ -319,6 +321,22 @@ def test_bench_fleet_emits_json_contract():
     assert rpc["empty_polls"] >= 0
     frac = rpc["empty_poll_fraction"]
     assert frac is None or 0.0 <= frac <= 1.0
+    # ISSUE 18: the fleet-KV shared-prefix lanes. Both complete the
+    # whole load; with the directory on, the drained owner's prefix
+    # really travelled (blocks pulled, hit tokens counted) and the off
+    # lane pulled nothing — the delta the warm-TTFT column measures.
+    warm, cold = rec["fleet_kv"]["pull_on"], rec["fleet_kv"]["pull_off"]
+    assert warm["completed"] == 8 and cold["completed"] == 8
+    assert warm["pull_blocks"] > 0 and warm["prefix_hit_tokens"] > 0
+    assert cold["pull_blocks"] == 0 and cold["prefix_hit_tokens"] == 0
+    assert warm["pull_bytes"] > 0
+    # ISSUE 18: SIGKILL recovery lanes — zero lost requests either way
+    # (the router's requeue contract); recovery times recorded
+    ron, roff = rec["recovery"]["replicate_on"], \
+        rec["recovery"]["replicate_off"]
+    assert ron["completed"] == 6 and roff["completed"] == 6
+    assert ron["recovery_s"] > 0 and roff["recovery_s"] > 0
+    assert ron["resumed"] >= ron["kv_recoveries"] >= 0
     with open(os.path.join(_ROOT, "BENCH_fleet.json")) as f:
         assert json.load(f) == rec
 

@@ -537,23 +537,32 @@ def test_pd_split_remote_streams_kv_over_the_wire(gpt, tmp_path, tele):
 
 @pytest.mark.slow
 def test_fleet_chaos_soak_periodic_kills(gpt, tmp_path):
-    """SATELLITE (ROADMAP PR 12 residual): ``ChaosMonkey.start``
-    periodically SIGKILLs replicas of a live multi-process fleet while
-    a request stream runs — zero lost, zero duplicated, every token
-    correct. One replica is never targeted, so capacity survives."""
+    """SATELLITE (ROADMAP PR 12 residual, extended by ISSUE 18):
+    ``ChaosMonkey.start`` periodically SIGKILLs replicas of a live
+    multi-process fleet — WITH decode-KV buddy replication enabled —
+    while a request stream runs: zero lost, zero duplicated, every
+    token correct, and any request recovered from a buddy's replica
+    set reports ``resumed`` in its RESULT timing (proof it resumed
+    mid-decode instead of replaying the prompt). One replica is never
+    targeted, so capacity survives."""
     from hetu_tpu.engine.chaos import ChaosMonkey
     from hetu_tpu.rpc.launcher import launch_serving_fleet
     cfg, model, params0, _ = gpt
     fleet = launch_serving_fleet(
         n_replicas=3, remote=True, engine_spec=_SPEC, env=_FLEET_ENV,
         log_dir=str(tmp_path / "logs"), beat_timeout_s=2.0,
-        poll_s=0.005)
+        poll_s=0.005, replicate_kv=True, replicate_cadence_s=0.01)
     router = fleet.router
     try:
         sp = SamplingParams(max_tokens=4)
+        long_sp = SamplingParams(max_tokens=12)   # long decodes give
+        #                        the kills something to land mid-decode
         prompts = _prompts(cfg, [5, 9, 3, 7, 6, 4], seed=5)
         want = [_ref(model, params0, p) for p in prompts]
+        want_long = [_ref(model, params0, p, 12) for p in prompts]
         router.generate_many(prompts[:3], sp)      # warm the compiles
+        rec0 = telemetry.get_registry().snapshot().get(
+            "fleet_kv_recoveries_total", 0)
         monkey = ChaosMonkey(
             {n: (lambda n=n: fleet.kill_replica_process(n))
              for n in ("r1", "r2")},               # r0 always survives
@@ -564,20 +573,30 @@ def test_fleet_chaos_soak_periodic_kills(gpt, tmp_path):
             deadline = time.monotonic() + 6.0
             i = 0
             while time.monotonic() < deadline:
-                reqs.append((i % len(prompts),
-                             router.submit(prompts[i % len(prompts)],
-                                           sp)))
+                idx = i % len(prompts)
+                is_long = i % 3 == 0
+                reqs.append((idx, is_long, router.submit(
+                    prompts[idx], long_sp if is_long else sp)))
                 i += 1
                 time.sleep(0.05)
         finally:
             monkey.stop()
-        for idx, r in reqs:
+        resumed = 0
+        for idx, is_long, r in reqs:
             assert r.done.wait(120.0), f"request #{r.id} lost in soak"
             assert r.status == "done"
-            assert list(r.tokens) == want[idx], "soak corrupted tokens"
+            assert list(r.tokens) == \
+                (want_long if is_long else want)[idx], \
+                "soak corrupted tokens"
+            resumed += bool(r.result()["timing"].get("resumed"))
         assert len(monkey.kills) >= 1, "soak never killed anything"
         dead = [n for n, h in router._replicas.items()
                 if h.state == "dead"]
         assert set(dead) <= {"r1", "r2"} and dead, dead
+        # ISSUE 18: every buddy-KV recovery the router performed must
+        # surface as a resumed=true RESULT — the wire carries the proof
+        recoveries = telemetry.get_registry().snapshot().get(
+            "fleet_kv_recoveries_total", 0) - rec0
+        assert resumed >= recoveries, (resumed, recoveries)
     finally:
         fleet.stop()

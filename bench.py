@@ -1271,46 +1271,85 @@ def fleet_main():
         return verbs, float(snap.get("router_result_poll_empty_total",
                                      0.0))
 
-    # -- (1) in-process vs multi-process dispatch overhead
+    # -- (1) in-process vs multi-process dispatch overhead. The remote
+    # lane runs TWICE — legacy RESULT polling vs the streaming control
+    # plane (ISSUE 19) — so the push lane's dispatch win is a recorded
+    # number, not a claim.
     fleet = launch_serving_fleet(mk_engine, 2, poll_s=0.002)
     local = run_through(fleet.router)
     fleet.stop()
-    fleet = launch_serving_fleet(
-        n_replicas=2, remote=True,
-        engine_spec="workloads.fleet_replica:build_engine",
-        env={"PYTHONPATH": repo,
-             "HETU_FLEET_SLOTS": str(slots),
-             "HETU_FLEET_MAX_LEN": str(max_len),
-             "HETU_FLEET_CHUNK": str(chunk)},
-        beat_timeout_s=5.0, poll_s=0.002)
-    rpc_before, polls_before = _rpc_usage()
-    remote = run_through(fleet.router)
-    rpc_after, polls_after = _rpc_usage()
-    fleet.stop()
-    overhead = round(remote["total_ms_p50"] - local["total_ms_p50"], 2)
 
-    rpc_verbs = {}
-    for verb, after in sorted(rpc_after.items()):
-        before = rpc_before.get(verb, {"count": 0, "ms_total": 0.0})
-        n = after["count"] - before["count"]
-        if n <= 0:
-            continue
-        # p50 comes from the whole-run reservoir (percentiles do not
-        # delta); counts and totals are exact lane deltas
-        rpc_verbs[verb] = {
-            "count": n,
-            "ms_total": round(after["ms_total"] - before["ms_total"], 2),
-            "ms_p50": after["ms_p50"]}
-    empty = int(polls_after - polls_before)
-    result_polls = rpc_verbs.get("RESULT", {}).get("count", 0)
-    remote["rpc"] = {
-        "verbs": rpc_verbs,
-        "client_verb_ms_total": round(
-            sum(v["ms_total"] for v in rpc_verbs.values()), 2),
-        "empty_polls": empty,
-        "empty_poll_fraction": round(empty / result_polls, 4)
-        if result_polls else None,
-    }
+    _STREAM_SERIES = ("serving_stream_subscribes_total",
+                      "serving_stream_fallbacks_total",
+                      "serving_stream_subscriber_drops_total")
+
+    def _stream_usage():
+        """Router-process streaming counters (subscriptions, fallbacks,
+        drops + received ev frames); the engine-side push counters live
+        in the replica processes."""
+        snap = telemetry.get_registry().snapshot()
+        tot = {k: 0.0 for k in _STREAM_SERIES}
+        tot["stream_ev_frames_rx"] = 0.0
+        for series, v in snap.items():
+            if not isinstance(v, (int, float)):
+                continue
+            base = series.split("{")[0]
+            if base in _STREAM_SERIES:
+                tot[base] += v
+            elif base == "rpc_stream_frames_total" \
+                    and 'kind="ev"' in series and 'dir="rx"' in series:
+                tot["stream_ev_frames_rx"] += v
+        return tot
+
+    def remote_lane(use_stream):
+        fleet = launch_serving_fleet(
+            n_replicas=2, remote=True,
+            engine_spec="workloads.fleet_replica:build_engine",
+            env={"PYTHONPATH": repo,
+                 "HETU_FLEET_SLOTS": str(slots),
+                 "HETU_FLEET_MAX_LEN": str(max_len),
+                 "HETU_FLEET_CHUNK": str(chunk)},
+            beat_timeout_s=5.0, poll_s=0.002,
+            proxy_kw={"use_stream": use_stream})
+        rpc_before, polls_before = _rpc_usage()
+        s_before = _stream_usage()
+        out = run_through(fleet.router)
+        rpc_after, polls_after = _rpc_usage()
+        s_after = _stream_usage()
+        fleet.stop()
+        rpc_verbs = {}
+        for verb, after in sorted(rpc_after.items()):
+            before = rpc_before.get(verb, {"count": 0, "ms_total": 0.0})
+            n = after["count"] - before["count"]
+            if n <= 0:
+                continue
+            # p50 comes from the whole-run reservoir (percentiles do
+            # not delta); counts and totals are exact lane deltas
+            rpc_verbs[verb] = {
+                "count": n,
+                "ms_total": round(
+                    after["ms_total"] - before["ms_total"], 2),
+                "ms_p50": after["ms_p50"]}
+        empty = int(polls_after - polls_before)
+        result_polls = rpc_verbs.get("RESULT", {}).get("count", 0)
+        out["rpc"] = {
+            "verbs": rpc_verbs,
+            "client_verb_ms_total": round(
+                sum(v["ms_total"] for v in rpc_verbs.values()), 2),
+            "empty_polls": empty,
+            "empty_poll_fraction": round(empty / result_polls, 4)
+            if result_polls else None,
+        }
+        if use_stream:
+            out["stream"] = {k: int(s_after[k] - s_before[k])
+                             for k in s_after}
+        return out
+
+    remote_polling = remote_lane(False)     # the PR-15 baseline
+    remote = remote_lane(True)              # streaming control plane
+    overhead_polling = round(
+        remote_polling["total_ms_p50"] - local["total_ms_p50"], 2)
+    overhead = round(remote["total_ms_p50"] - local["total_ms_p50"], 2)
 
     # -- (2) colocated vs P/D split at the same offered load
     fleet = launch_serving_fleet(mk_engine, 2, poll_s=0.002)
@@ -1452,18 +1491,32 @@ def fleet_main():
         "slots": slots, "max_len": max_len, "max_tokens": max_tokens,
         "in_process": local,
         "multi_process": remote,
+        "multi_process_polling": remote_polling,
+        "streaming": {
+            "overhead_ms_p50": overhead,
+            "polling_overhead_ms_p50": overhead_polling,
+            "overhead_vs_polling": round(overhead / overhead_polling, 4)
+            if overhead_polling > 0 else None,
+            "empty_result_polls": remote["rpc"]["empty_polls"],
+            "polling_empty_result_polls":
+                remote_polling["rpc"]["empty_polls"],
+            "events": remote.get("stream", {}),
+        },
         "pd": {"colocated": colocated, "split": split},
         "fleet_kv": {"pull_on": kv_warm, "pull_off": kv_cold},
         "recovery": {"replicate_on": rec_on, "replicate_off": rec_off},
-        "note": "multi-process dispatch rides SUBMIT/RESULT/ESTATUS "
-                "coordinator verbs; P/D split streams KV blocks "
+        "note": "multi-process dispatch rides the streaming control "
+                "plane (push-based RESULT delivery over a persistent "
+                "multiplexed channel); the polling lane re-measures "
+                "the legacy SUBMIT/RESULT/ESTATUS poll loop as the "
+                "baseline. P/D split streams KV blocks "
                 "prefill→decode over the same transport. fleet_kv: "
                 "shared-prefix sweep, cross-replica warm (directory "
                 "pull) vs cold TTFT; recovery: SIGKILL mid-decode "
                 "with/without buddy replication, kill→last-done "
-                "seconds. CPU smoke — absolute latencies are "
-                "meaningless off-TPU, the contract is completion + "
-                "the transport working.",
+                "seconds (streaming transport on). CPU smoke — "
+                "absolute latencies are meaningless off-TPU, the "
+                "contract is completion + the transport working.",
     }
     with open(_BENCH_FLEET_PATH, "w") as f:
         json.dump(result, f, indent=1)

@@ -271,6 +271,129 @@ class CoordinatorClient:
             raise RuntimeError(f"serving generate failed: {resp}")
         return json.loads(urllib.parse.unquote(resp.split(" ", 1)[1]))
 
+    # -- streaming (ISSUE 19) -----------------------------------------------
+    def _stream_channel(self):
+        """This client's persistent multiplexed channel (lazy,
+        recreated after a loss)."""
+        ch = getattr(self, "_stream", None)
+        if ch is not None and ch.alive:
+            return ch
+        from hetu_tpu.rpc.stream import StreamChannel
+        ch = StreamChannel(self._port, host=self._host,
+                           token=self._token or "",
+                           connect_timeout=self._timeout)
+        self._stream = ch
+        return ch
+
+    @staticmethod
+    def _count_stream_fallback(reason: str) -> None:
+        try:
+            from hetu_tpu.serving.streaming import count_fallback
+            count_fallback(reason)
+        except Exception:                             # noqa: BLE001
+            pass
+
+    def generate_stream(self, prompt, *,
+                        idem_key: Optional[str] = None,
+                        traceparent: Optional[str] = None,
+                        event_timeout_s: float = 60.0,
+                        max_reconnects: int = 3,
+                        **sampling):
+        """Streaming generate: yields event dicts ``{"tokens":
+        [newly committed ids], "first": bool, "done": bool}`` as the
+        engine commits them; the final event adds ``"result"`` — the
+        full result with the trailing timing payload, byte-identical
+        to what :meth:`serving_generate` returns for the same request.
+
+        Rides the persistent multiplexed channel end to end (router →
+        engine → here). Self-healing: a dead socket reconnects and
+        resubscribes at the token offset already received (the
+        idempotency key re-joins the original request even when the
+        loss predates the ack), and after ``max_reconnects`` losses —
+        or a server-side drop — the tail degrades to RESULT polling,
+        loudly counted. Every path yields each token exactly once."""
+        payload = dict(sampling)
+        payload["idem"] = idem_key or uuid.uuid4().hex
+        if traceparent:
+            payload["traceparent"] = traceparent
+        received: list[int] = []
+        req_id: Optional[int] = None
+        reconnects = 0
+        while reconnects <= max_reconnects:
+            import queue as _queue
+            q: "_queue.Queue" = _queue.Queue()
+            try:
+                ch = self._stream_channel()
+                if req_id is None:
+                    ack = ch.stream_submit(
+                        self._serving_payload(prompt, **payload),
+                        sink=q.put, offset=len(received))
+                    req_id = int(ack["id"])
+                else:
+                    ch.subscribe(req_id, offset=len(received),
+                                 sink=q.put)
+            except RuntimeError:
+                raise                  # admission rejection: terminal
+            except Exception:                         # noqa: BLE001
+                reconnects += 1
+                continue
+            degrade = False
+            while not degrade:
+                try:
+                    fr = q.get(timeout=event_timeout_s)
+                except _queue.Empty:
+                    degrade = True     # silent stream: stop trusting it
+                    break
+                kind = fr.get("k")
+                if kind == "ev":
+                    off = int(fr.get("off", 0))
+                    toks = [int(t) for t in fr.get("toks", [])]
+                    skip = len(received) - off
+                    if skip < 0:       # lost frame — never guess
+                        degrade = True
+                        break
+                    if skip:
+                        toks = toks[skip:]
+                    received.extend(toks)
+                    out = {"tokens": toks,
+                           "first": bool(fr.get("first")),
+                           "done": bool(fr.get("done"))}
+                    if fr.get("done"):
+                        out["result"] = fr.get("result")
+                        yield out
+                        return
+                    if fr.get("end"):
+                        degrade = True     # evicted/cancelled: poll
+                        break              # the router for the retry
+                    if toks:
+                        yield out
+                elif kind == "lost":
+                    reconnects += 1
+                    break              # reconnect + resubscribe-at-
+                #                        offset on a fresh channel
+                else:                  # drop / err: server said stop
+                    degrade = True
+                    break
+            if degrade:
+                break
+        # -- loud fallback: the RESULT poll lane finishes the request --
+        self._count_stream_fallback("client_poll")
+        if req_id is None:
+            # the loss predates the ack — the idempotency key makes
+            # this re-delivery join the original request if it landed
+            doc = self.serving_generate(prompt,
+                                        idem_key=payload["idem"],
+                                        traceparent=traceparent,
+                                        **sampling)
+        else:
+            doc = None
+            while doc is None:
+                doc = self.serving_result(req_id, timeout_ms=500)
+        tail = [int(t) for t in doc.get("tokens", [])][len(received):]
+        received.extend(tail)
+        yield {"tokens": tail, "first": False, "done": True,
+               "result": doc}
+
     # -- fleet engine verbs (serving.fleet.RemoteEngineProxy) ---------------
     def _val_verb(self, line: str, *, idempotent: bool = True) -> dict:
         resp = self._cmd_retry(line, idempotent=idempotent)
@@ -464,5 +587,12 @@ class CoordinatorClient:
         self._cmd("SHUTDOWN")
 
     def close(self):
+        ch = getattr(self, "_stream", None)
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception:                         # noqa: BLE001
+                pass
+            self._stream = None
         if self._sock is not None:
             self._sock.close()

@@ -404,6 +404,7 @@ def launch_serving_fleet(build_engine=None, n_replicas: int = 2, *,
                          log_dir: Optional[str] = None,
                          spawn_timeout_s: float = 120.0,
                          beat_timeout_s: Optional[float] = None,
+                         proxy_kw: Optional[dict] = None,
                          **router_kw) -> FleetHandle:
     """Bring up a serving fleet: N replicas, one load-aware Router over
     them, and — when ``port`` is given — a coordinator speaking the
@@ -429,6 +430,10 @@ def launch_serving_fleet(build_engine=None, n_replicas: int = 2, *,
     (``ElasticWorkerPool.CPU_SIM_ENV``); pass ``{}`` to inherit (real
     TPU hosts). ``roles`` maps replica name → ``prefill|decode|both``
     for P/D disaggregation (both modes).
+
+    ``proxy_kw`` forwards extra keyword arguments to every
+    ``RemoteEngineProxy`` (e.g. ``{"use_stream": False}`` to force the
+    legacy RESULT-polling transport — the bench's polling baseline).
 
     Lazy imports keep the launcher importable without jax.
     """
@@ -514,7 +519,8 @@ def launch_serving_fleet(build_engine=None, n_replicas: int = 2, *,
                             f":{eport} within {spawn_timeout_s}s")
                     time.sleep(0.1)
                 router.register(
-                    name, RemoteEngineProxy(eport, token=token or None),
+                    name, RemoteEngineProxy(eport, token=token or None,
+                                            **(proxy_kw or {})),
                     role=roles.get(name, "both"))
         except BaseException:
             handle.stop()             # SIGTERM spawned procs, close

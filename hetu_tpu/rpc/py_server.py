@@ -60,10 +60,19 @@ class _Handler(socketserver.StreamRequestHandler):
         st: _State = self.server.state  # type: ignore[attr-defined]
         token: str = self.server.token  # type: ignore[attr-defined]
         authed = False
+        first = True
         while True:
             line = self.rfile.readline()
             if not line:
                 return
+            if first:
+                first = False
+                # protocol sniff (ISSUE 19): a client whose first line
+                # is the stream hello flips this connection into the
+                # length-framed multiplexed mode; everything else stays
+                # on the unchanged line protocol.
+                if line.split(b" ", 1)[0].rstrip() == b"HSTRM1":
+                    return self._stream_session(line)
             parts = line.decode().strip().split()
             if not parts:
                 continue
@@ -182,6 +191,209 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             else:
                 self._send("ERR unknown command")
+
+    # -- streaming mode (ISSUE 19) -------------------------------------------
+    def _stream_session(self, hello: bytes) -> None:
+        """One persistent multiplexed connection: frames in, frames
+        out (``rpc/stream.py`` documents the kinds). The read loop
+        stays single-threaded; one-shot verbs run on short-lived
+        threads (a slow GENERATE must not block the channel) and every
+        subscription gets its own drainer thread pulling events off
+        the engine's bounded queue — all socket writes serialize on
+        one lock, so frames never tear."""
+        from hetu_tpu.rpc.stream import read_frame, write_frame
+        wlock = threading.Lock()
+        parts = hello.decode(errors="replace").split()
+        token: str = self.server.token  # type: ignore[attr-defined]
+        if token:
+            import hmac
+            if len(parts) < 2 or not hmac.compare_digest(parts[1],
+                                                         token):
+                try:
+                    write_frame(self.wfile, wlock,
+                                {"k": "err", "sid": 0,
+                                 "msg": "auth required"},
+                                direction="out")
+                except (OSError, ValueError):
+                    pass
+                return
+        write_frame(self.wfile, wlock, {"k": "hello", "sid": 0, "v": 1},
+                    direction="out")
+        try:
+            from hetu_tpu.rpc.stream import _count_connect
+            _count_connect("server")
+        except Exception:                             # noqa: BLE001
+            pass
+        subs: dict[int, object] = {}
+        closed = threading.Event()
+        try:
+            while True:
+                fr = read_frame(self.rfile, direction="in")
+                if fr is None:
+                    return
+                kind = fr.get("k")
+                sid = int(fr.get("sid", 0))
+                if kind == "req":
+                    threading.Thread(
+                        target=self._stream_req, args=(fr, wlock),
+                        daemon=True).start()
+                elif kind == "sub":
+                    self._stream_sub(fr, wlock, subs, closed)
+                elif kind == "stream":
+                    self._stream_submit(fr, wlock, subs, closed)
+                elif kind == "unsub":
+                    sub = subs.pop(sid, None)
+                    if sub is not None:
+                        sub.close()
+                elif kind == "ping":
+                    write_frame(self.wfile, wlock,
+                                {"k": "pong", "sid": sid},
+                                direction="out")
+        except (OSError, ValueError):
+            return                      # client gone / corrupt stream
+        finally:
+            closed.set()
+            for sub in subs.values():
+                try:
+                    sub.close()
+                except Exception:                     # noqa: BLE001
+                    pass
+
+    def _stream_req(self, fr: dict, wlock: threading.Lock) -> None:
+        """One multiplexed one-shot verb: same dispatch as the line
+        loop for the serving family (+ PING), answered by a ``res``
+        frame carrying the exact response line."""
+        from hetu_tpu.rpc.stream import write_frame
+        line = str(fr.get("line", ""))
+        parts = line.strip().split()
+        t0 = time.perf_counter()
+        if not parts:
+            resp = "ERR empty"
+        elif parts[0] == "PING":
+            resp = "PONG"
+        elif parts[0] in _SERVING_VERBS:
+            from hetu_tpu.serving.server import handle_serving_command
+            try:
+                resp = handle_serving_command(
+                    getattr(self.server, "serving", None),
+                    parts[0], parts[1:]) or "ERR unknown command"
+            except Exception as e:                    # noqa: BLE001
+                resp = f"ERR {type(e).__name__}: {e}"
+        else:
+            resp = "ERR verb not multiplexable"
+        try:
+            write_frame(self.wfile, wlock,
+                        {"k": "res", "sid": fr.get("sid", 0),
+                         "line": resp}, direction="out")
+            if parts:
+                _rpc_server_observe(
+                    parts[0], (time.perf_counter() - t0) * 1e3,
+                    n_in=len(line), n_out=len(resp))
+        except (OSError, ValueError):
+            pass                        # connection died mid-reply
+
+    def _start_sub(self, req, fr: dict, wlock: threading.Lock,
+                   subs: dict, closed: threading.Event) -> None:
+        """Attach one subscription (shared by ``sub`` and ``stream``):
+        the serving object replays from the requested token offset,
+        then a drainer thread forwards events as they land."""
+        from hetu_tpu.rpc.stream import write_frame
+        serving = getattr(self.server, "serving", None)
+        sid = int(fr.get("sid", 0))
+        off = max(0, int(fr.get("off", 0)))
+        if serving is None or not hasattr(serving, "stream_subscribe"):
+            write_frame(self.wfile, wlock,
+                        {"k": "drop", "sid": sid,
+                         "reason": "unsupported"}, direction="out")
+            return
+        try:
+            sub = serving.stream_subscribe(req, offset=off)
+        except Exception as e:                        # noqa: BLE001
+            write_frame(self.wfile, wlock,
+                        {"k": "err", "sid": sid,
+                         "msg": f"{type(e).__name__}: {e}"},
+                        direction="out")
+            return
+        try:
+            from hetu_tpu.serving.streaming import count_subscribe
+            count_subscribe("resume" if off > 0 else "new")
+        except Exception:                             # noqa: BLE001
+            pass
+        subs[sid] = sub
+        threading.Thread(
+            target=self._stream_drain, args=(sid, sub, wlock, closed),
+            daemon=True,
+            name=f"stream-drain-{getattr(req, 'id', '?')}").start()
+
+    def _stream_sub(self, fr: dict, wlock: threading.Lock,
+                    subs: dict, closed: threading.Event) -> None:
+        from hetu_tpu.rpc.stream import write_frame
+        serving = getattr(self.server, "serving", None)
+        sid = int(fr.get("sid", 0))
+        req = None
+        if serving is not None:
+            req = getattr(serving, "_requests_by_id", {}).get(
+                int(fr.get("id", -1)))
+        if req is None:
+            write_frame(self.wfile, wlock,
+                        {"k": "drop", "sid": sid,
+                         "reason": "unknown_request"}, direction="out")
+            return
+        self._start_sub(req, fr, wlock, subs, closed)
+
+    def _stream_submit(self, fr: dict, wlock: threading.Lock,
+                       subs: dict, closed: threading.Event) -> None:
+        """``stream`` = SUBMIT (idempotency-keyed payload) + subscribe
+        in one frame, acked with the request/trace ids before the
+        first event."""
+        from hetu_tpu.rpc.stream import write_frame
+        serving = getattr(self.server, "serving", None)
+        sid = int(fr.get("sid", 0))
+        if serving is None:
+            write_frame(self.wfile, wlock,
+                        {"k": "err", "sid": sid,
+                         "msg": "serving disabled"}, direction="out")
+            return
+        from hetu_tpu.serving.server import handle_stream_submit
+        req, err = handle_stream_submit(serving,
+                                        str(fr.get("payload", "")))
+        if err is not None:
+            write_frame(self.wfile, wlock,
+                        {"k": "err", "sid": sid, "msg": err},
+                        direction="out")
+            return
+        write_frame(self.wfile, wlock,
+                    {"k": "ack", "sid": sid, "id": int(req.id),
+                     "trace": req.trace_id}, direction="out")
+        self._start_sub(req, fr, wlock, subs, closed)
+
+    def _stream_drain(self, sid: int, sub, wlock: threading.Lock,
+                      closed: threading.Event) -> None:
+        """Per-subscription drainer: pulls events OFF the step lock's
+        bounded queue and writes frames. A queue overflow (slow
+        consumer) sends one ``drop`` frame and stops — the client
+        falls back to RESULT polling."""
+        from hetu_tpu.rpc.stream import write_frame
+        try:
+            while not closed.is_set():
+                ev = sub.get(timeout=0.25)
+                if ev is None:
+                    if sub.dropped:
+                        write_frame(self.wfile, wlock,
+                                    {"k": "drop", "sid": sid,
+                                     "reason": "slow"},
+                                    direction="out")
+                        return
+                    if sub.closed:
+                        return
+                    continue
+                write_frame(self.wfile, wlock,
+                            {"k": "ev", "sid": sid, **ev},
+                            direction="out")
+                if ev.get("done") or ev.get("end"):
+                    return
+        except (OSError, ValueError):
+            sub.close()                 # connection gone — stop feeding
 
     def _send(self, s: str):
         self.wfile.write((s + "\n").encode())

@@ -353,6 +353,11 @@ class ServingEngine:
         # (the start() background loop + a direct run_until_drained)
         # must never interleave an iteration
         self._step_lock = threading.Lock()
+        # push subscriptions (ISSUE 19): req id → (request, [subs]);
+        # fed enqueue-only at the end of every step, drained by the
+        # coordinator's per-connection writer threads OFF the step lock
+        self._stream_subs: dict[int, tuple] = {}
+        self._stream_lock = threading.Lock()
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
         # production-observability side-band: a hang watchdog fed by the
@@ -1002,6 +1007,8 @@ class ServingEngine:
             entry = self._evict_request_steplocked(req)
         finally:
             self._step_lock.release()
+        if req.status in ("evicted", "cancelled"):
+            self._stream_interrupt(req)
         if entry is not None and entry.traceparent is None:
             # stamp the originating trace context onto the spill so the
             # decode-tier resume joins the same fleet trace (ISSUE 16)
@@ -1453,6 +1460,69 @@ class ServingEngine:
         if not req.done.wait(timeout):
             return None
         return req.result()
+
+    # -- push subscriptions (ISSUE 19) --------------------------------------
+    def stream_subscribe(self, req: Request, *, offset: int = 0,
+                         max_queue: int = 256):
+        """Subscribe to ``req``'s token stream from token ``offset``:
+        the backlog past the offset is replayed immediately (an
+        already-finished request yields its single terminal event),
+        then the end-of-step pump feeds newly committed tokens. The
+        returned :class:`~hetu_tpu.serving.streaming.TokenSubscription`
+        is a bounded queue — a consumer that stops draining is dropped,
+        never waited on."""
+        from hetu_tpu.serving.streaming import (
+            TokenSubscription, push_delta,
+        )
+        sub = TokenSubscription(req.id, offset=offset,
+                                max_queue=max_queue)
+        with self._stream_lock:
+            push_delta(req, sub)        # replay (possibly terminal)
+            if not sub.closed:
+                ent = self._stream_subs.get(req.id)
+                if ent is None:
+                    self._stream_subs[req.id] = (req, [sub])
+                else:
+                    ent[1].append(sub)
+        return sub
+
+    def _pump_stream_subs(self) -> None:
+        """End-of-step push: fold each subscribed request's newly
+        committed tokens (and finish/interrupt markers) into its
+        subscriber queues. Enqueue-only, pure host work — the fused
+        step's 1-compile audit is untouched and a slow subscriber
+        overflows its own bounded queue instead of stalling the
+        iteration (drop-to-poll, counted)."""
+        if not self._stream_subs:
+            return
+        from hetu_tpu.serving.streaming import push_delta
+        with self._stream_lock:
+            for rid in list(self._stream_subs):
+                req, subs = self._stream_subs[rid]
+                for sub in subs:
+                    push_delta(req, sub)
+                live = [s for s in subs
+                        if not (s.closed or s.dropped)]
+                if live:
+                    self._stream_subs[rid] = (req, live)
+                else:
+                    del self._stream_subs[rid]
+
+    def _stream_interrupt(self, req: Request) -> None:
+        """Close ``req``'s subscriptions after an out-of-band exit
+        (evict / cancel, which happen between steps): the final delta
+        plus an ``end`` marker tells subscribers to fall back — the
+        router's requeue owns the request now."""
+        if not self._stream_subs:
+            return
+        from hetu_tpu.serving.streaming import push_delta
+        with self._stream_lock:
+            ent = self._stream_subs.pop(req.id, None)
+        if ent is None:
+            return
+        for sub in ent[1]:
+            push_delta(req, sub)
+            sub.close()
 
     # -- the host loop ------------------------------------------------------
     def has_work(self) -> bool:
@@ -1924,6 +1994,7 @@ class ServingEngine:
                 self._ctl_dev = dict(self._ctl_dev, pos=pos_dev,
                                      last_tok=last_dev, key=key_dev)
             self._record_gauges()
+        self._pump_stream_subs()
         step_s = time.monotonic() - t0
         reg.histogram("serving_step_seconds",
                       "one fused engine iteration").observe(step_s)

@@ -319,10 +319,26 @@ class RemoteEngineProxy:
                  token: Optional[str] = None,
                  poll_s: float = 0.05, poll_max_s: float = 0.25,
                  timeout_s: float = 5.0,
-                 swap_timeout_s: float = 300.0):
+                 swap_timeout_s: float = 300.0,
+                 use_stream: bool = True,
+                 heartbeat_s: float = 0.25):
         self.port, self.host = int(port), host
         self._token = token
         self._poll_s = float(poll_s)
+        # streaming control plane (ISSUE 19): subscribe to each
+        # submitted request's token stream over one persistent
+        # multiplexed channel instead of RESULT-polling it; the poll
+        # lane survives only as the loud fallback on stream loss
+        # (resubscribe-at-offset reconverges). With a healthy channel,
+        # ESTATUS stretches to ``heartbeat_s`` cadence — it stays the
+        # router's beat (a SIGKILLed replica is still reaped within
+        # ``beat_timeout_s``) but stops being per-tick load noise.
+        self.use_stream = bool(use_stream)
+        self._heartbeat_s = max(float(heartbeat_s), float(poll_s))
+        self._next_beat = 0.0
+        self._schan = None
+        self._schan_lock = threading.Lock()
+        self._schan_next_try = 0.0
         # adaptive RESULT-poll backoff (ISSUE 18 satellite): ESTATUS
         # keeps its fixed cadence (it IS the heartbeat — backing it off
         # would trip the router's staleness reaper), but the per-request
@@ -375,6 +391,108 @@ class RemoteEngineProxy:
                 except OSError:
                     pass
                 self._cli = None
+
+    # -- streaming lane (ISSUE 19) -------------------------------------------
+    def _stream_channel(self):
+        """The proxy's one persistent multiplexed channel (lazily
+        connected, throttled reconnect). Raises on connect failure —
+        callers degrade to the poll lane."""
+        with self._schan_lock:
+            ch = self._schan
+            if ch is not None and ch.alive:
+                return ch
+            now = time.monotonic()
+            if now < self._schan_next_try:
+                raise ConnectionError("stream reconnect backing off")
+            self._schan_next_try = now + 0.25
+            from hetu_tpu.rpc.stream import StreamChannel
+            ch = StreamChannel(self.port, host=self.host,
+                               token=self._token or "",
+                               connect_timeout=self._timeout_s)
+            self._schan = ch
+            return ch
+
+    def _subscribe_stream(self, rr: RemoteRequest, *,
+                          resume: bool = False) -> bool:
+        """Subscribe ``rr`` at its current token offset; False =
+        unavailable (the RESULT poll lane keeps it)."""
+        if not self.use_stream or rr.id < 0:
+            return False
+        from hetu_tpu.serving.streaming import (
+            count_fallback, count_subscribe,
+        )
+        try:
+            ch = self._stream_channel()
+            ch.subscribe(rr.id, offset=len(rr.tokens),
+                         sink=lambda ev, _rr=rr:
+                         self._on_stream_event(_rr, ev))
+        except Exception:                             # noqa: BLE001
+            count_fallback("subscribe_failed")
+            return False
+        rr._stream_ok = True
+        count_subscribe("resume" if resume else "new")
+        return True
+
+    def _on_stream_event(self, rr: RemoteRequest, ev: dict) -> None:
+        """Channel-reader-thread sink: fold one event into ``rr``.
+        Token deltas append at their offset (idempotent across replays
+        — a resubscribed stream clips the overlap); the ``done`` frame
+        adopts the full result exactly like a RESULT poll would; any
+        loss marker flips the request back to the poll lane, loudly."""
+        from hetu_tpu.serving.streaming import count_fallback
+        kind = ev.get("k")
+        if kind == "ev":
+            toks = [int(t) for t in ev.get("toks", [])]
+            off = int(ev.get("off", 0))
+            skip = len(rr.tokens) - off
+            if skip < 0:
+                # a gap means a lost frame — never guess: fall back
+                rr._stream_ok = False
+                count_fallback("gap")
+                self._reset_result_backoff()
+                return
+            if skip:
+                toks = toks[skip:]
+            if toks:
+                if rr.first_token_s is None:
+                    rr.first_token_s = time.monotonic()
+                rr.tokens.extend(toks)
+            if ev.get("done"):
+                rr._fill_from(ev.get("result") or {})
+                rr._stream_ok = False
+                self._pending.pop(rr.id, None)
+                rr.done.set()
+            elif ev.get("end"):
+                # evicted/cancelled server-side — the router's
+                # drain/requeue owns the request now
+                rr._stream_ok = False
+            for cb in list(getattr(rr, "_taps", ())):
+                try:
+                    cb(ev)
+                except Exception:                     # noqa: BLE001
+                    pass
+            return
+        if kind in ("drop", "lost", "err"):
+            rr._stream_ok = False
+            if kind == "drop" and ev.get("reason") in (
+                    "unsupported", "unknown_request"):
+                rr._stream_denied = True    # server can't stream this
+            if not rr.done.is_set():
+                count_fallback(str(ev.get("reason", kind)))
+                self._reset_result_backoff()   # poll lane, eagerly
+
+    def stream_tap(self, rr: RemoteRequest, cb) -> "callable":
+        """Register a callback on ``rr``'s live event feed (the
+        router's stream bridge). Returns the detach callable."""
+        taps = rr.__dict__.setdefault("_taps", [])
+        taps.append(cb)
+
+        def _detach(taps=taps, cb=cb):
+            try:
+                taps.remove(cb)
+            except ValueError:
+                pass
+        return _detach
 
     #: load reported while the engine is UNREACHABLE (a failed verb or
     #: status poll): effectively infinite, so least-loaded dispatch
@@ -448,7 +566,11 @@ class RemoteEngineProxy:
             rr.spill = resume          # identity marker the router reads
         rr.status = "dispatched"
         self._pending[rr.id] = rr
-        self._reset_result_backoff()   # fresh work: poll eagerly again
+        # push first: a healthy subscription delivers the result the
+        # step it commits; the eager poll reset only matters when the
+        # stream is unavailable (then the poll lane carries the load)
+        if not self._subscribe_stream(rr):
+            self._reset_result_backoff()
         return rr
 
     def _prefill_call(self, rr: RemoteRequest) -> None:
@@ -675,6 +797,13 @@ class RemoteEngineProxy:
         except Exception:                             # noqa: BLE001
             pass                       # the process may already be gone
         self._drop_client()
+        with self._schan_lock:
+            if self._schan is not None:
+                try:
+                    self._schan.close()
+                except Exception:                     # noqa: BLE001
+                    pass
+                self._schan = None
         with self._kv_lock:
             if self._kv_cli is not None:
                 try:
@@ -690,6 +819,17 @@ class RemoteEngineProxy:
             self._stop_ev.wait(self._poll_s)
 
     def _poll_once(self) -> bool:
+        # ESTATUS coalesced with stream liveness (ISSUE 19 satellite):
+        # with a healthy subscription channel the per-tick status poll
+        # stretches to heartbeat-only cadence. ESTATUS stays the beat —
+        # skipping it only delays the ``last_beat`` stamp by at most
+        # ``heartbeat_s``, which must stay well under the router's
+        # ``beat_timeout_s`` for SIGKILL reaping to keep its deadline.
+        now = time.monotonic()
+        ch = self._schan
+        if self.use_stream and ch is not None and ch.alive \
+                and now < self._next_beat:
+            return self._poll_results()
         try:
             with self._lock:
                 t0 = time.time()
@@ -699,6 +839,7 @@ class RemoteEngineProxy:
             self._drop_client()
             self._mark_suspect()
             return False               # no beat: staleness accumulates
+        self._next_beat = time.monotonic() + self._heartbeat_s
         srv_ts = self._status.get("ts_unix")
         if srv_ts is not None:
             # NTP-style offset handshake (ISSUE 16): the replica
@@ -717,6 +858,13 @@ class RemoteEngineProxy:
                 round(off, 6), replica=name)
         if self._handle is not None:
             self._handle.last_beat = time.monotonic()
+        return self._poll_results()
+
+    def _poll_results(self) -> bool:
+        """The RESULT lane: streamed requests are skipped (push owns
+        them); a request whose stream was lost first tries a
+        resubscribe-at-offset, then polls — loudly counted either
+        way."""
         if time.monotonic() < self._next_result_poll:
             return True                # RESULT lane is backing off
         adopted = polled = 0
@@ -725,6 +873,13 @@ class RemoteEngineProxy:
                                                  "evicted",
                                                  "cancelled"):
                 continue
+            if getattr(rr, "_stream_ok", False):
+                continue               # the push lane owns this one
+            if self.use_stream and rid >= 0 \
+                    and not getattr(rr, "_stream_denied", False) \
+                    and self._subscribe_stream(rr, resume=True):
+                continue               # back on the push lane, resumed
+            #                            exactly at len(rr.tokens)
             polled += 1
             try:
                 with self._lock:

@@ -119,6 +119,122 @@ class RouterRequest:
                 "timing": timing}
 
 
+class _StreamBridge:
+    """Joins one outward token subscription on a :class:`RouterRequest`
+    to whatever INNER request currently serves it (ISSUE 19).
+
+    The bridge owns a global token cursor (``sub.sent``): every attach
+    — first dispatch, or re-dispatch after a replica death — replays
+    the inner stream from that cursor and clips any overlap, so a
+    failover subscriber sees each token exactly once, in order. Inner
+    ``done``/``end`` markers are NOT forwarded: the terminal frame is
+    the router's to emit (``finalize``), carrying the router-level
+    result with ``router_total_ms`` and the attempt count."""
+
+    def __init__(self, rreq: "RouterRequest", sub):
+        self.rreq = rreq
+        self.sub = sub
+        self._detach_cb = None
+        self._emlock = threading.Lock()
+
+    @property
+    def dead(self) -> bool:
+        return self.sub.closed or self.sub.dropped
+
+    def attach(self, h: "ReplicaHandle") -> None:
+        """Feed the bridge from ``rreq.inner`` on ``h`` — remote
+        replicas tap the proxy's event fan-out, local engines get a
+        real subscription drained by a forwarder thread. Both degrade
+        silently (finalize still delivers everything)."""
+        self.detach()
+        inner = self.rreq.inner
+        if inner is None or self.dead:
+            return
+        try:
+            eng = h.engine
+            if getattr(h, "remote", False):
+                if hasattr(eng, "stream_tap"):
+                    # register the tap FIRST, then replay the backlog:
+                    # a racing live event is clipped, never lost
+                    self._detach_cb = eng.stream_tap(inner,
+                                                     self._on_inner)
+                    self._on_inner({"off": 0,
+                                    "toks": list(inner.tokens),
+                                    "done": False})
+            elif hasattr(eng, "stream_subscribe"):
+                isub = eng.stream_subscribe(inner, offset=self.sub.sent,
+                                            max_queue=1024)
+                stop = threading.Event()
+                threading.Thread(
+                    target=self._forward, args=(isub, stop),
+                    daemon=True,
+                    name=f"stream-bridge-{self.rreq.id}").start()
+
+                def _cb(isub=isub, stop=stop):
+                    stop.set()
+                    isub.close()
+                self._detach_cb = _cb
+        except Exception:                             # noqa: BLE001
+            self._detach_cb = None      # finalize-only degradation
+
+    def detach(self) -> None:
+        cb, self._detach_cb = self._detach_cb, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:                         # noqa: BLE001
+                pass
+
+    def _forward(self, isub, stop: threading.Event) -> None:
+        while not stop.is_set() and not self.dead:
+            ev = isub.get(timeout=0.2)
+            if ev is None:
+                if isub.closed or isub.dropped:
+                    return
+                continue
+            self._on_inner(ev)
+            if ev.get("done") or ev.get("end"):
+                return
+
+    def _on_inner(self, ev: dict) -> None:
+        """Forward one inner token delta outward, clipped at the global
+        cursor. Inner offsets ARE global offsets: a KV-resumed inner
+        request preloads the tokens generated before the failover."""
+        if ev.get("k") not in (None, "ev"):
+            return                       # drop/lost frames: reattach or
+        #                                  finalize will recover
+        toks = ev.get("toks") or []
+        off = int(ev.get("off", 0))
+        with self._emlock:
+            skip = self.sub.sent - off
+            if skip < 0 or skip >= len(toks):
+                return     # gap (wait for finalize) or full overlap
+            out_toks = [int(t) for t in toks[skip:]]
+            out = {"req": self.rreq.id, "trace": self.rreq.trace_id,
+                   "off": self.sub.sent, "toks": out_toks,
+                   "first": self.sub.sent == 0,
+                   "done": False,
+                   "ts": ev.get("ts", round(time.monotonic(), 6))}
+            if self.sub.emit(out):
+                self.sub.sent += len(out_toks)
+
+    def finalize(self) -> None:
+        """Terminal frame: the remaining delta + the ROUTER-level
+        result (trailing timing payload)."""
+        self.detach()
+        rreq = self.rreq
+        with self._emlock:
+            toks = [int(t) for t in rreq.tokens[self.sub.sent:]]
+            ev = {"req": rreq.id, "trace": rreq.trace_id,
+                  "off": self.sub.sent, "toks": toks,
+                  "first": self.sub.sent == 0 and bool(toks),
+                  "done": True, "result": rreq.result(),
+                  "ts": round(time.monotonic(), 6)}
+            self.sub.sent = len(rreq.tokens)
+            self.sub.emit(ev)
+            self.sub.close()
+
+
 class FleetPrefixDirectory:
     """Router-owned map from whole-block prompt-prefix hashes to the
     replica whose radix prefix cache holds that prefix (ISSUE 18).
@@ -320,6 +436,9 @@ class Router:
         self.replicate_cadence_s = float(replicate_cadence_s)
         self._directory = FleetPrefixDirectory(directory_max_entries)
         self._buddy_of: dict[str, str] = {}  # origin → buddy name
+        # streaming control plane (ISSUE 19): rreq id → bridges feeding
+        # outward token subscriptions across dispatches/failovers
+        self._stream_bridges: dict[int, list[_StreamBridge]] = {}
 
     # -- replica lifecycle --------------------------------------------------
     def register(self, name: str, engine: ServingEngine, *,
@@ -569,6 +688,7 @@ class Router:
                           f"attempts (replicas kept dying)")
             rreq.finish_s = time.monotonic()
             rreq.done.set()
+            self._stream_finish_locked(rreq)
             return True                      # terminal — not pending
         # P/D disaggregation: a FRESH request (no KV spill riding along)
         # goes to the prefill tier when one exists alongside a live
@@ -649,9 +769,12 @@ class Router:
             rreq.error = inner.error
             rreq.finish_s = time.monotonic()
             rreq.done.set()
+            self._stream_finish_locked(rreq)
             return True
         rreq.status = "dispatched"
         h.inflight[inner.id] = rreq
+        for br in self._stream_bridges.get(rreq.id, ()):
+            br.attach(h)                 # resume the push at the cursor
         h.dispatched += 1
         reg = telemetry.get_registry()
         reg.counter("router_requests_total",
@@ -854,6 +977,8 @@ class Router:
 
     def _requeue_locked(self, rreq: RouterRequest, *,
                         from_replica: str, reason: str) -> None:
+        for br in self._stream_bridges.get(rreq.id, ()):
+            br.detach()                  # stop feeding from the corpse
         rreq.inner = None                    # old replica's work is void
         rreq.status = "queued"
         reg = telemetry.get_registry()
@@ -914,6 +1039,34 @@ class Router:
             return None
         return req.result()
 
+    def stream_subscribe(self, rreq: RouterRequest, *,
+                         offset: int = 0, max_queue: int = 256):
+        """Duck-parity with :meth:`ServingEngine.stream_subscribe`
+        (the front door serves a Router and an engine through one
+        STREAM/SUBSCRIBE code path): a bounded token subscription fed
+        by whatever replica currently serves ``rreq``, surviving
+        requeues and failovers — every re-dispatch resumes the push at
+        the subscription's token cursor, so nothing is lost and
+        nothing replays (ISSUE 19)."""
+        from hetu_tpu.serving.streaming import TokenSubscription
+        sub = TokenSubscription(rreq.id, offset=offset,
+                                max_queue=max_queue)
+        br = _StreamBridge(rreq, sub)
+        with self._lock:
+            if rreq.done.is_set():
+                br.finalize()            # backlog + terminal, replayed
+                return sub
+            self._stream_bridges.setdefault(rreq.id, []).append(br)
+            if rreq.status == "dispatched" and rreq.inner is not None:
+                h = self._replicas.get(rreq.replica)
+                if h is not None:
+                    br.attach(h)
+        return sub
+
+    def _stream_finish_locked(self, rreq: RouterRequest) -> None:
+        for br in self._stream_bridges.pop(rreq.id, ()):
+            br.finalize()
+
     def generate_many(
             self, prompts: Sequence[Sequence[int]],
             sampling: Union[SamplingParams, Sequence[SamplingParams],
@@ -962,6 +1115,7 @@ class Router:
                     h.name, rreq.prompt, block_size=bs,
                     weight_version=int(rreq.weight_version or 0))
         rreq.done.set()
+        self._stream_finish_locked(rreq)
 
     def _handoff_locked(self, h: ReplicaHandle, inner_id: int,
                         rreq: RouterRequest, reg) -> None:

@@ -119,31 +119,101 @@ SERVING_COMMANDS = ("SUBMIT", "RESULT", "GENERATE",
 
 _idem_init_lock = threading.Lock()
 
+#: dedup-window TTL: a finished entry older than this can no longer be
+#: a retry-after-timeout join (clients give up in seconds) — evicting
+#: it bounds a long soak's memory instead of growing forever (ISSUE 19)
+_IDEM_TTL_S = 900.0
 
-def _idem_map(engine) -> tuple:
-    """Per-server ``(lock, idempotency-key → request)`` pair (attached
-    to the engine/router object the coordinator serves).
-    SUBMIT/GENERATE payloads carry an ``idem`` key; a duplicate
+
+class IdemMap:
+    """Bounded idempotency-key → request map with TTL + LRU eviction.
+
+    SUBMIT/GENERATE/STREAM payloads carry an ``idem`` key; a duplicate
     delivery — the client retrying after a response timeout, or two
     front ends racing one logical request — joins the ORIGINAL request
-    instead of queueing a second generation. The lock makes
-    check-and-insert atomic across the coordinator's handler threads."""
-    pair = getattr(engine, "_idem_requests", None)
-    if pair is None:
+    instead of queueing a second generation. PR 15's unbounded dict is
+    replaced by this structure: every hit refreshes recency, FINISHED
+    entries expire after ``ttl_s`` (the dedup window a retry could
+    still arrive in), and past ``max_entries`` the least-recently-used
+    entry goes — done entries first, in-flight ones only when nothing
+    else is left. Evictions are counted
+    (``serving_idem_evictions_total``). ``lock`` makes check-and-insert
+    atomic across the coordinator's handler threads; callers hold it
+    around get/put."""
+
+    def __init__(self, max_entries: int = _REQUEST_MAP_CAP,
+                 ttl_s: float = _IDEM_TTL_S):
+        self.lock = threading.Lock()
+        self.max_entries = int(max_entries)
+        self.ttl_s = float(ttl_s)
+        self._m: "dict[str, list]" = {}    # key -> [req, deadline]
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    @staticmethod
+    def _count_evict(reason: str, n: int = 1) -> None:
+        if not n:
+            return
+        from hetu_tpu import telemetry
+        telemetry.get_registry().counter(
+            "serving_idem_evictions_total",
+            "idempotency-map entries evicted (ttl: dedup window "
+            "expired; cap: LRU past max_entries) — the long-soak "
+            "growth bound, ISSUE 19").inc(n, reason=reason)
+
+    def get(self, key: str, now: Optional[float] = None):
+        ent = self._m.get(key)
+        if ent is None:
+            return None
+        now = time.monotonic() if now is None else now
+        ent[1] = now + self.ttl_s
+        # refresh recency: re-insert at the back of the dict's
+        # insertion order (the LRU order the cap eviction walks)
+        self._m[key] = self._m.pop(key)
+        return ent[0]
+
+    def put(self, key: str, req, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._m.pop(key, None)
+        self._m[key] = [req, now + self.ttl_s]
+        self.prune(now)
+
+    def prune(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        stale = [k for k, (r, dl) in self._m.items()
+                 if dl <= now and r.done.is_set()]
+        for k in stale:
+            del self._m[k]
+        try:
+            self._count_evict("ttl", len(stale))
+        except Exception:                             # noqa: BLE001
+            pass
+        dropped = 0
+        while len(self._m) > self.max_entries:
+            victim = next((k for k, (r, _dl) in self._m.items()
+                           if r.done.is_set()), None)
+            if victim is None:
+                victim = next(iter(self._m))
+            del self._m[victim]
+            dropped += 1
+        try:
+            self._count_evict("cap", dropped)
+        except Exception:                             # noqa: BLE001
+            pass
+
+
+def _idem_map(engine) -> IdemMap:
+    """Per-server :class:`IdemMap` (attached to the engine/router
+    object the coordinator serves)."""
+    m = getattr(engine, "_idem_requests", None)
+    if m is None:
         with _idem_init_lock:
-            pair = getattr(engine, "_idem_requests", None)
-            if pair is None:
-                pair = (threading.Lock(), {})
-                engine._idem_requests = pair
-    return pair
-
-
-def _prune_idem_map(m: dict) -> None:
-    if len(m) <= _REQUEST_MAP_CAP:
-        return
-    for key in [k for k, r in m.items()
-                if r.done.is_set()][:len(m) - _REQUEST_MAP_CAP]:
-        m.pop(key, None)
+            m = getattr(engine, "_idem_requests", None)
+            if m is None:
+                m = IdemMap()
+                engine._idem_requests = m
+    return m
 
 
 def _count_dedup(verb: str) -> None:
@@ -166,6 +236,42 @@ def _submit_from_payload(engine, p: dict):
     if p.get("traceparent"):
         kw["traceparent"] = p["traceparent"]
     return engine.submit(p["prompt"], sampling_from_payload(p), **kw)
+
+
+def _submit_with_idem(engine, p: dict, verb: str):
+    """The one idempotency-keyed submit path SUBMIT / GENERATE / the
+    stream frames all share: an ``idem``-keyed duplicate delivery joins
+    the original request, everything else queues fresh."""
+    key = p.get("idem")
+    if not key:
+        return _submit_from_payload(engine, p)
+    m = _idem_map(engine)
+    with m.lock:                        # atomic check-and-queue
+        req = m.get(key)
+        if req is not None:
+            _count_dedup(verb)
+            return req
+        req = _submit_from_payload(engine, p)
+        if req.status != "rejected":
+            m.put(key, req)
+    return req
+
+
+def handle_stream_submit(serving, payload: str):
+    """The ``stream`` frame's submit half (SUBMIT semantics — same
+    payload format, idempotency key and traceparent included).
+    Returns ``(request, None)`` or ``(None, "ERR ...")``; the caller
+    (``py_server._stream_submit``) acks and subscribes."""
+    try:
+        p = decode_payload(payload)
+        req = _submit_with_idem(serving, p, "STREAM")
+    except Exception as e:                            # noqa: BLE001
+        return None, f"ERR {type(e).__name__}: {e}"
+    if req.status == "rejected":
+        return None, f"ERR rejected: {req.error}"
+    serving._requests_by_id[req.id] = req
+    _prune_request_map(serving._requests_by_id)
+    return req, None
 
 
 def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
@@ -201,22 +307,7 @@ def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
     try:
         if cmd == "SUBMIT":
             p = decode_payload(args[0])
-            key = p.get("idem")
-            if key:
-                lock, m = _idem_map(engine)
-                with lock:              # atomic check-and-queue
-                    if key in m:
-                        req = m[key]
-                        _count_dedup("SUBMIT")
-                        tail = " R" if getattr(req, "spill", None) \
-                            is not None else ""
-                        return f"ID {req.id} {req.trace_id}{tail}"
-                    req = _submit_from_payload(engine, p)
-                    if req.status != "rejected":
-                        m[key] = req
-                        _prune_idem_map(m)
-            else:
-                req = _submit_from_payload(engine, p)
+            req = _submit_with_idem(engine, p, "SUBMIT")
             if req.status == "rejected":
                 return f"ERR rejected: {req.error}"
             engine._requests_by_id[req.id] = req
@@ -241,20 +332,7 @@ def handle_serving_command(engine: Optional[ServingEngine], cmd: str,
             # blocking submit + wait (the engine loop must be running —
             # ServingServer.start does that)
             p = decode_payload(args[0])
-            key = p.get("idem")
-            if key:
-                lock, m = _idem_map(engine)
-                with lock:              # atomic check-and-queue
-                    if key in m:
-                        _count_dedup("GENERATE")
-                        req = m[key]
-                    else:
-                        req = _submit_from_payload(engine, p)
-                        if req.status != "rejected":
-                            m[key] = req
-                            _prune_idem_map(m)
-            else:
-                req = _submit_from_payload(engine, p)
+            req = _submit_with_idem(engine, p, "GENERATE")
             r = req.result() if req.status == "rejected" \
                 else engine.result(req, timeout=None)
             return f"VAL {encode_payload(r)}"
@@ -312,6 +390,8 @@ def _handle_engine_command(engine, cmd: str, args: list) -> str:
         for r in moved:
             engine._requests_by_id.pop(r.id, None)
             r.status = "cancelled"
+            if hasattr(engine, "_stream_interrupt"):
+                engine._stream_interrupt(r)   # subscribers fall back
             out.append({"id": r.id,
                         "spill": spill_to_wire(r.spill)
                         if r.spill is not None else None})

@@ -86,6 +86,13 @@ _CONTROL_PLANE_COUNTERS = (
     "prefetch_restaged_total",
     "switch_fastpath_leaves_total", "switch_reassembled_leaves_total",
     "switches_total", "data_stall_seconds",
+    # streaming control plane (ISSUE 19): push-vs-poll split — direct
+    # evidence the subscription lane carries the tokens (pushes high,
+    # empty polls / fallbacks / drops ~0) or has degraded to polling
+    "serving_stream_events_total", "serving_stream_tokens_total",
+    "serving_stream_fallbacks_total",
+    "serving_stream_subscriber_drops_total",
+    "router_result_poll_empty_total",
 )
 
 
@@ -135,6 +142,18 @@ def control_plane_summary(records: list[dict]) -> Optional[list[str]]:
     if fast or slow:
         lines.append(f"switch leaves    {int(fast)} device_put fast path"
                      f" / {int(slow)} host-reassembled")
+    evs = vals.get("serving_stream_events_total", 0.0)
+    if evs:
+        lines.append(f"stream push      {int(evs)} events / "
+                     f"{int(vals.get('serving_stream_tokens_total', 0.0))}"
+                     f" tokens pushed")
+    falls = vals.get("serving_stream_fallbacks_total", 0.0)
+    drops = vals.get("serving_stream_subscriber_drops_total", 0.0)
+    empty = vals.get("router_result_poll_empty_total", 0.0)
+    if evs or falls or drops or empty:
+        lines.append(f"push vs poll     {int(empty)} empty RESULT polls"
+                     f" / {int(falls)} fallbacks / "
+                     f"{int(drops)} subscriber drops")
     return lines
 
 

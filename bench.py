@@ -677,6 +677,207 @@ def router_main():
     print(json.dumps(result))
 
 
+_BENCH_TENANTS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_tenants.json")
+
+
+def tenants_main():
+    """``bench.py --tenants``: the multi-tenant adapter plane (ISSUE
+    20). Three probes: (1) mixed-tenant decode TPOT against a tenancy-
+    free base engine draining the identical batch — the in-step
+    batched-BGMV tax; (2) adapter hot-swap latency — version pushes
+    onto a live arena page under a request trickle, no drain; (3)
+    noisy-neighbor isolation — an interactive tenant's per-request
+    latency alone vs alongside a slot-capped bulk tenant flooding the
+    queue, the QoS gate holding the delta."""
+    telemetry.enable(True)
+    if not probe_tpu():
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    import numpy as np
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+    from hetu_tpu.serving.tenancy import TenantPlane
+
+    if on_tpu:
+        cfg = GPTConfig.small()
+        slots, max_len, chunk, max_tokens = 8, 512, 64, 32
+        offered, rank = 24, 16
+    else:   # CPU smoke: tiny model, enough churn for the contracts
+        cfg = GPTConfig.tiny()
+        slots, max_len, chunk, max_tokens = 4, 64, 16, 8
+        offered, rank = 12, 4
+
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.key(0), dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    n_tenants = 3
+
+    def rand_adapter(projs=("q_proj", "v_proj")):
+        w = {}
+        for grp in ("attn", "mlp"):
+            for name, leaf in params["blocks"].get(grp, {}).items():
+                wt = leaf.get("weight") if isinstance(leaf, dict) \
+                    else None
+                if name not in projs or wt is None or wt.ndim != 3:
+                    continue
+                L, d_in, d_out = wt.shape
+                w[name] = {
+                    "A": (0.01 * rng.standard_normal(
+                        (L, d_in, rank))).astype(np.float32),
+                    "B": (0.01 * rng.standard_normal(
+                        (L, rank, d_out))).astype(np.float32)}
+        return w
+
+    def prompts(n, seed):
+        g = np.random.default_rng(seed)
+        return [g.integers(
+            1, cfg.vocab_size,
+            (int(g.integers(4, max_len - max_tokens)),)).tolist()
+            for _ in range(n)]
+
+    def drain(eng, batch, sps):
+        reg = telemetry.get_registry()
+        telemetry.reset()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, s) for p, s in zip(batch, sps)]
+        eng.run_until_drained()
+        wall = time.perf_counter() - t0
+        gen = reg.counter("serving_tokens_total").value(kind="generated")
+        assert all(r.status == "done" for r in reqs), \
+            [(r.status, r.error) for r in reqs if r.status != "done"]
+        conc = min(slots, len(batch))
+        return {"tokens_per_sec": round(gen / wall, 1),
+                "tpot_ms": round(1e3 * wall * conc / max(gen, 1), 3)}
+
+    batch = prompts(offered, seed=1)
+    base_sps = [SamplingParams(max_tokens=max_tokens) for _ in batch]
+    mixed_sps = [
+        SamplingParams(max_tokens=max_tokens,
+                       tenant=f"t{i % n_tenants}", adapter="tuned")
+        if i % 4 else SamplingParams(max_tokens=max_tokens)
+        for i in range(offered)]
+
+    # lane 1a: tenancy-free base engine — the TPOT reference
+    eng0 = ServingEngine(model, params, slots=slots, max_len=max_len,
+                         prefill_chunk=chunk)
+    drain(eng0, batch[:slots], base_sps[:slots])        # compile warm
+    base = drain(eng0, batch, base_sps)
+
+    # lane 1b: mixed-tenant batch through the adapter arena
+    plane = TenantPlane(max_adapters=n_tenants + 2, r=rank)
+    eng = ServingEngine(model, params, slots=slots, max_len=max_len,
+                        prefill_chunk=chunk, tenancy=plane)
+    for i in range(n_tenants):
+        eng.load_adapter(f"t{i}", "tuned", rand_adapter())
+    drain(eng, batch[:slots], mixed_sps[:slots])        # compile warm
+    mixed = drain(eng, batch, mixed_sps)
+
+    # lane 2: hot-swap latency under a live trickle — version pushes
+    # re-register + flush + rewrite the page with traffic in flight
+    import threading
+    stop_flag = threading.Event()
+    trickle = []
+
+    def submitter():
+        g = np.random.default_rng(9)
+        while not stop_flag.is_set():
+            p = g.integers(1, cfg.vocab_size, (6,)).tolist()
+            trickle.append(eng.submit(p, SamplingParams(
+                max_tokens=4, tenant="t0", adapter="tuned")))
+            time.sleep(0.003)
+
+    eng.start()
+    th = threading.Thread(target=submitter, daemon=True)
+    th.start()
+    swap_ms = []
+    try:
+        for _ in range(5):
+            t1 = time.perf_counter()
+            eng.load_adapter("t0", "tuned", rand_adapter())
+            swap_ms.append((time.perf_counter() - t1) * 1e3)
+            time.sleep(0.01)
+    finally:
+        stop_flag.set()
+        th.join()
+    for r in trickle:
+        r.done.wait(120.0)
+    swap = {
+        "pushes": len(swap_ms),
+        "p50_ms": round(sorted(swap_ms)[len(swap_ms) // 2], 3),
+        "max_ms": round(max(swap_ms), 3),
+        "trickle_submitted": len(trickle),
+        "trickle_completed": sum(r.status == "done" for r in trickle),
+        "trickle_rejected": sum(r.status == "rejected"
+                                for r in trickle),
+    }
+
+    # lane 3: noisy-neighbor isolation — interactive latency alone vs
+    # with a slot-capped bulk tenant flooding the queue
+    reg = telemetry.get_registry()
+
+    def interactive_lat(n=6):
+        g = np.random.default_rng(13)
+        lats = []
+        for _ in range(n):
+            p = g.integers(1, cfg.vocab_size, (6,)).tolist()
+            t1 = time.perf_counter()
+            r = eng.submit(p, SamplingParams(
+                max_tokens=4, tenant="t1", adapter="tuned"))
+            assert r.done.wait(120.0)
+            lats.append((time.perf_counter() - t1) * 1e3)
+        return lats
+
+    alone = interactive_lat()
+    plane.qos.configure("bulk", rate=None, max_slots=1)
+    telemetry.reset()
+    g = np.random.default_rng(17)
+    flood = [eng.submit(g.integers(1, cfg.vocab_size, (6,)).tolist(),
+                        SamplingParams(max_tokens=max_tokens,
+                                       tenant="bulk"))
+             for _ in range(3 * slots)]
+    noisy = interactive_lat()
+    for r in flood:
+        r.done.wait(120.0)
+    throttled = reg.counter("tenant_throttled_total").value(
+        tenant="bulk", reason="slots")
+    eng.stop()
+
+    med_a = sorted(alone)[len(alone) // 2]
+    med_n = sorted(noisy)[len(noisy) // 2]
+    isolation = {
+        "alone_p50_ms": round(med_a, 3),
+        "noisy_p50_ms": round(med_n, 3),
+        "isolation_delta": round(med_n / max(med_a, 1e-9), 3),
+        "bulk_offered": len(flood),
+        "bulk_completed": sum(r.status == "done" for r in flood),
+        "bulk_throttled_events": throttled,
+    }
+
+    result = {
+        "metric": "tenant_mixed_tokens_per_sec"
+        if on_tpu else "tenant_mixed_tokens_per_sec_cpu_smoke",
+        "value": mixed["tokens_per_sec"], "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "tenants": n_tenants, "rank": rank, "slots": slots,
+        "max_len": max_len, "offered": offered,
+        "base": base, "mixed": mixed,
+        "tpot_overhead": round(
+            mixed["tpot_ms"] / max(base["tpot_ms"], 1e-9), 3),
+        "adapter_swap": swap,
+        "isolation": isolation,
+    }
+    with open(_BENCH_TENANTS_PATH, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
 _BENCH_RAGGED_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_ragged.json")
 
@@ -2031,5 +2232,7 @@ if __name__ == "__main__":
         kernels_main()
     elif "--fleet" in sys.argv:
         fleet_main()
+    elif "--tenants" in sys.argv:
+        tenants_main()
     else:
         main()

@@ -449,6 +449,36 @@ def size_spill_tiers(cfg, *, host_budget_bytes: float,
     return {"host": host, "peer": peer}
 
 
+def size_adapter_arena(cfg, *, r: int, max_adapters: int,
+                       dtype_bytes: float = 4.0) -> int:
+    """Device bytes of the multi-tenant LoRA adapter arena
+    (``serving/tenancy.py``): per layer and per arena page, an
+    ``(in, r)`` A plus an ``(r, out)`` B for every adapter-targetable
+    projection — q/k/v/out always, plus the dense FFN matrices
+    (gated gate/up/down when the config carries ``intermediate_size``,
+    GPT fc_in/fc_out otherwise; MoE expert weights are not adapter
+    targets, so MoE FFNs price zero). This is what the serving
+    engine's admission gate subtracts from ``hbm_budget_bytes`` before
+    sizing the KV pool — adapter pages are HBM the KV arena can no
+    longer have."""
+    L = int(cfg.num_layers)
+    E = int(cfg.hidden_size)
+    heads = int(cfg.num_heads)
+    hd = int(getattr(cfg, "head_dim", None) or E // heads)
+    kvh = int(getattr(cfg, "num_kv_heads", None) or heads)
+    q_out, kv_out = heads * hd, kvh * hd
+    dims = [(E, q_out), (E, kv_out), (E, kv_out), (q_out, E)]
+    if getattr(cfg, "num_experts", 0) <= 0:
+        inter = getattr(cfg, "intermediate_size", None)
+        if inter is not None:
+            dims += [(E, int(inter)), (E, int(inter)), (int(inter), E)]
+        else:
+            hidden = int(getattr(cfg, "mlp_ratio", 4)) * E
+            dims += [(E, hidden), (hidden, E)]
+    per_page = sum((i + o) * int(r) for i, o in dims) * L
+    return int(per_page * int(max_adapters) * float(dtype_bytes))
+
+
 def size_kv_pool(cfg, *, hbm_budget_bytes: float, max_len: int,
                  cache_dtype: str = "fp32", tp: int = 1,
                  param_bytes_per_el: float = 4.0,

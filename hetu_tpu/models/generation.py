@@ -73,7 +73,7 @@ def init_kv_caches(model, batch: int, max_len: int, dtype=jnp.float32):
 def decode(model, params, input_ids, positions, caches, *,
            slot_mask=None, block_tables=None, row_mask=None,
            attn_kernel: str = "reference", w8a8_mask=None,
-           w8a8_wq=None):
+           w8a8_wq=None, lora=None):
     """Run a chunk through the model in decode mode.
 
     ``positions`` (b, s) absolute positions. Without ``slot_mask`` they
@@ -91,7 +91,11 @@ def decode(model, params, input_ids, positions, caches, *,
     ``ops.paged_pallas``); ``w8a8_mask`` ((layers,) bool) flips decode
     FFNs to the W8A8 int8 lane per layer, and ``w8a8_wq`` (a stacked
     ``prequantize`` tree) feeds that lane pre-quantized int8 weights
-    so the per-step weight quantize disappears. Returns (logits
+    so the per-step weight quantize disappears. ``lora`` (the
+    multi-tenant adapter arena — ``{"ids": (b, s) pages, "pages":
+    stacked (L, P, ...) A/B tree}``) adds the per-token batched
+    multi-adapter BGMV deltas (``nn.parallel.lora_apply``); None is
+    the historical base-only lane. Returns (logits
     (b, s, V), new caches)."""
     h = model.embed(params, input_ids, positions=positions)
     h, caches = model.blocks.decode(params["blocks"], h, caches,
@@ -101,7 +105,7 @@ def decode(model, params, input_ids, positions, caches, *,
                                     row_mask=row_mask,
                                     attn_kernel=attn_kernel,
                                     w8a8_mask=w8a8_mask,
-                                    w8a8_wq=w8a8_wq)
+                                    w8a8_wq=w8a8_wq, lora=lora)
     h = model.hidden_norm(params, h)
     w = _head_weight(model, params)
     logits = jnp.einsum("bse,ve->bsv", h.astype(jnp.float32),

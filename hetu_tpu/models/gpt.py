@@ -107,8 +107,8 @@ class GPTBlock(Module):
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
                  block_tables=None, row_mask=None, attn_kernel="reference",
-                 pack=None, w8a8=None, w8a8_wq=None, dropout_key=None,
-                 return_kv=False):
+                 pack=None, w8a8=None, w8a8_wq=None, lora=None,
+                 dropout_key=None, return_kv=False):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
                                      self.ln_1(params["ln_1"], x),
@@ -118,7 +118,7 @@ class GPTBlock(Module):
                                      block_tables=block_tables,
                                      row_mask=row_mask,
                                      attn_kernel=attn_kernel,
-                                     pack=pack)
+                                     pack=pack, lora=lora)
             x = x + a
             mlp_in = self.ln_2(params["ln_2"], x)
             if self.returns_aux:
@@ -133,7 +133,7 @@ class GPTBlock(Module):
                                     w8a8=w8a8, wq=w8a8_wq)
             else:
                 h = self.mlp(params["mlp"], mlp_in, w8a8=w8a8,
-                             w8a8_wq=w8a8_wq)
+                             w8a8_wq=w8a8_wq, lora=lora)
             return x + h, new_cache
         # positions only matter for decode (GPT's learned position
         # embedding is applied in embed(), not per block)

@@ -101,8 +101,8 @@ class LlamaBlock(Module):
     def __call__(self, params, x, *, positions=None, segment_ids=None,
                  attn_impl="auto", kv_cache=None, slot_mask=None,
                  block_tables=None, row_mask=None, attn_kernel="reference",
-                 pack=None, w8a8=None, w8a8_wq=None, dropout_key=None,
-                 return_kv=False):
+                 pack=None, w8a8=None, w8a8_wq=None, lora=None,
+                 dropout_key=None, return_kv=False):
         if kv_cache is not None:
             a, new_cache = self.attn(params["attn"],
                                      self.input_norm(
@@ -113,7 +113,7 @@ class LlamaBlock(Module):
                                      block_tables=block_tables,
                                      row_mask=row_mask,
                                      attn_kernel=attn_kernel,
-                                     pack=pack)
+                                     pack=pack, lora=lora)
             x = x + a
             mlp_in = self.post_attn_norm(params["post_attn_norm"], x)
             if self.returns_aux:
@@ -125,7 +125,7 @@ class LlamaBlock(Module):
                                     w8a8=w8a8, wq=w8a8_wq)
             else:
                 h = self.mlp(params["mlp"], mlp_in, w8a8=w8a8,
-                             w8a8_wq=w8a8_wq)
+                             w8a8_wq=w8a8_wq, lora=lora)
             return x + h, new_cache
         ka = k1 = k2 = None
         if dropout_key is not None and self.attn_pdrop > 0:

@@ -209,6 +209,36 @@ def _vocab_parallel_lookup(weight, ids, ctx):
     return lookup(weight, ids)
 
 
+def lora_apply(lora, name, x, y):
+    """Batched-gather LoRA (the Punica / S-LoRA "BGMV" shape): add the
+    per-token adapter delta for projection ``name`` to its base output
+    ``y``.
+
+    ``lora`` is ``{"ids": (b, s) int32 arena pages, "pages": {name:
+    {"A": (P, in, r), "B": (P, r, out)}}}`` — ONE layer's slice of the
+    device-resident adapter arena (the stacked (L, P, ...) tree rides
+    ``StackedBlocks.decode``'s scan as xs; the page ids close over the
+    scan body).  Each token gathers its page's A/B slice and two
+    batched einsums produce the delta; scaling is folded into B at
+    registry load time so no per-adapter scalars ride the step.
+
+    Page 0 is the base model's zero page: those tokens take ``y`` back
+    through a masked select rather than ``y + 0.0``, so base-only
+    tokens stay BITWISE identical to a build without the lane (``-0.0
+    + 0.0`` would flip sign bits).  ``lora`` None/empty or a projection
+    the arena does not carry returns ``y`` untouched — no extra ops.
+    """
+    if not lora or name not in lora["pages"]:
+        return y
+    ab = lora["pages"][name]
+    ids = lora["ids"]                               # (b, s) pages
+    a = ab["A"][ids]                                # (b, s, in, r)
+    bm = ab["B"][ids]                               # (b, s, r, out)
+    t = jnp.einsum("bsi,bsir->bsr", x.astype(a.dtype), a)
+    d = jnp.einsum("bsr,bsro->bso", t, bm)
+    return jnp.where((ids != 0)[..., None], y + d.astype(y.dtype), y)
+
+
 class ParallelMLP(Module):
     """Transformer MLP: column-parallel up, row-parallel down.
 
@@ -237,7 +267,8 @@ class ParallelMLP(Module):
         self.fc_out = RowParallelLinear(hidden, features, bias=bias,
                                         axis="mlp")
 
-    def __call__(self, params, x, *, w8a8=None, w8a8_wq=None):
+    def __call__(self, params, x, *, w8a8=None, w8a8_wq=None,
+                 lora=None):
         """``w8a8`` (None | traced bool) selects the quantized-COMPUTE
         lane per call: activations quantize per token, weights per
         output channel, and both matmuls contract in int8 with one
@@ -250,15 +281,21 @@ class ParallelMLP(Module):
         ``w8a8_wq`` (a :meth:`prequantize` tree for THIS layer) skips
         the per-call weight quantization: only the per-token activation
         quant remains on the hot path — the serving engine quantizes
-        once at construction / weight swap."""
+        once at construction / weight swap.
+
+        ``lora`` (a :func:`lora_apply` dict for THIS layer) adds the
+        batched multi-adapter BGMV delta to every targeted projection;
+        None is exactly the historical lane."""
         if w8a8 is None:
-            return self._fp_lane(params, x)
-        if w8a8_wq is None:
+            return self._fp_lane(params, x, lora=lora)
+        if w8a8_wq is None and lora is None:
             return jax.lax.cond(w8a8, self._w8a8_lane, self._fp_lane,
                                 params, x)
         return jax.lax.cond(
-            w8a8, lambda p, v: self._w8a8_lane(p, v, wq=w8a8_wq),
-            self._fp_lane, params, x)
+            w8a8,
+            lambda p, v: self._w8a8_lane(p, v, wq=w8a8_wq, lora=lora),
+            lambda p, v: self._fp_lane(p, v, lora=lora),
+            params, x)
 
     def prequantize(self, params, *, stacked: bool = False):
         """Quantize this MLP's weight matrices ONCE into the W8A8
@@ -278,16 +315,21 @@ class ParallelMLP(Module):
             for name in names
         }
 
-    def _fp_lane(self, params, x):
+    def _fp_lane(self, params, x, lora=None):
         if self.gated:
-            h = self.activation(self.gate_proj(params["gate_proj"], x),
-                                self.up_proj(params["up_proj"], x))
+            g = lora_apply(lora, "gate_proj", x,
+                           self.gate_proj(params["gate_proj"], x))
+            u = lora_apply(lora, "up_proj", x,
+                           self.up_proj(params["up_proj"], x))
+            h = self.activation(g, u)
         else:
-            h = self.activation(self.fc_in(params["fc_in"], x))
+            h = self.activation(lora_apply(
+                lora, "fc_in", x, self.fc_in(params["fc_in"], x)))
         h = act_constrain(h, "hidden")
-        return self.fc_out(params["fc_out"], h)
+        return lora_apply(lora, "fc_out", h,
+                          self.fc_out(params["fc_out"], h))
 
-    def _w8a8_lane(self, params, x, wq=None):
+    def _w8a8_lane(self, params, x, wq=None, lora=None):
         """Both FFN matmuls in int8 (W8A8). Biases and the activation
         stay fp; the canonical activation cut points keep their
         ``act_constrain`` layouts so GSPMD shards the lane like the fp
@@ -312,7 +354,7 @@ class ParallelMLP(Module):
             y = mm(x, p, name)
             if mod.use_bias:
                 y = y + p["bias"].astype(dt)
-            return act_constrain(y, "hidden")
+            return act_constrain(lora_apply(lora, name, x, y), "hidden")
 
         if self.gated:
             h = self.activation(
@@ -325,7 +367,7 @@ class ParallelMLP(Module):
         y = act_constrain(y, "tokens")
         if self.fc_out.use_bias:
             y = y + params["fc_out"]["bias"].astype(dt)
-        return y
+        return lora_apply(lora, "fc_out", h, y)
 
 
 class ParallelAttention(Module):
@@ -377,7 +419,7 @@ class ParallelAttention(Module):
                  attn_impl: str = "auto", kv_cache=None, slot_mask=None,
                  block_tables=None, row_mask=None, attn_kernel="reference",
                  pack=None, dropout_rate: float = 0.0, dropout_key=None,
-                 return_kv: bool = False):
+                 return_kv: bool = False, lora=None):
         """``return_kv=True`` (train path only) additionally returns the
         rotary-applied per-head ``(k, v)`` of this call — the exact
         values the decode path would have written to a KV cache — as
@@ -394,7 +436,8 @@ class ParallelAttention(Module):
                                 slot_mask=slot_mask,
                                 block_tables=block_tables,
                                 row_mask=row_mask,
-                                attn_kernel=attn_kernel, pack=pack)
+                                attn_kernel=attn_kernel, pack=pack,
+                                lora=lora)
         b, s, _ = x.shape
         q = self.q_proj(params["q_proj"], x).reshape(
             b, s, self.num_heads, self.head_dim)
@@ -468,7 +511,7 @@ class ParallelAttention(Module):
 
     def _decode(self, params, x, kv_cache, *, positions=None,
                 slot_mask=None, block_tables=None, row_mask=None,
-                attn_kernel: str = "reference", pack=None):
+                attn_kernel: str = "reference", pack=None, lora=None):
         """Incremental decoding with a KV cache.
 
         ``kv_cache``: (k_buf, v_buf) of shape (b, max_len, hkv, d); the
@@ -531,7 +574,8 @@ class ParallelAttention(Module):
                                        positions=positions,
                                        block_tables=block_tables,
                                        pack=pack,
-                                       attn_kernel=attn_kernel)
+                                       attn_kernel=attn_kernel,
+                                       lora=lora)
         quant = len(kv_cache) == 4
         b, s, _ = x.shape
         per_row = slot_mask is not None
@@ -546,11 +590,14 @@ class ParallelAttention(Module):
             index = positions[:, 0]                     # (b,) per-slot
         else:
             index = positions[0, 0] if positions is not None else 0
-        q = self.q_proj(params["q_proj"], x).reshape(
+        q = lora_apply(lora, "q_proj", x,
+                       self.q_proj(params["q_proj"], x)).reshape(
             b, s, self.num_heads, self.head_dim)
-        k = self.k_proj(params["k_proj"], x).reshape(
+        k = lora_apply(lora, "k_proj", x,
+                       self.k_proj(params["k_proj"], x)).reshape(
             b, s, self.num_kv_heads, self.head_dim)
-        v = self.v_proj(params["v_proj"], x).reshape(
+        v = lora_apply(lora, "v_proj", x,
+                       self.v_proj(params["v_proj"], x)).reshape(
             b, s, self.num_kv_heads, self.head_dim)
         if self._rope is not None:
             cos, sin = self._rope
@@ -654,10 +701,12 @@ class ParallelAttention(Module):
                 q, k_buf, v_buf, causal=self.causal,
                 q_offset=index, kv_offset=0)
         out = out.reshape(b, s, self.num_heads * self.head_dim)
-        return self.out_proj(params["out_proj"], out), new_cache
+        return lora_apply(lora, "out_proj", out,
+                          self.out_proj(params["out_proj"], out)), \
+            new_cache
 
     def _decode_packed(self, params, x, kv_cache, *, positions,
-                       block_tables, pack, attn_kernel):
+                       block_tables, pack, attn_kernel, lora=None):
         """Packed-prefill FLASH mode: the serving engine's prefill pack
         as ONE ``(1, C, embed)`` row instead of C one-token batch rows.
 
@@ -690,11 +739,14 @@ class ParallelAttention(Module):
         quant = len(kv_cache) == 4
         b, C, _ = x.shape
         n_blk, blk = kv_cache[0].shape[0], kv_cache[0].shape[1]
-        q = self.q_proj(params["q_proj"], x).reshape(
+        q = lora_apply(lora, "q_proj", x,
+                       self.q_proj(params["q_proj"], x)).reshape(
             b, C, self.num_heads, self.head_dim)
-        k = self.k_proj(params["k_proj"], x).reshape(
+        k = lora_apply(lora, "k_proj", x,
+                       self.k_proj(params["k_proj"], x)).reshape(
             b, C, self.num_kv_heads, self.head_dim)
-        v = self.v_proj(params["v_proj"], x).reshape(
+        v = lora_apply(lora, "v_proj", x,
+                       self.v_proj(params["v_proj"], x)).reshape(
             b, C, self.num_kv_heads, self.head_dim)
         if self._rope is not None:
             cos, sin = self._rope
@@ -759,7 +811,9 @@ class ParallelAttention(Module):
         lse_h = lse_h[:, :, 0].T[None]           # (C, hq, 1) → (1, hq, C)
         out = combine_attention_lse(intra, lse_i, hist, lse_h)
         out = out.reshape(b, C, self.num_heads * self.head_dim)
-        return self.out_proj(params["out_proj"], out), new_cache
+        return lora_apply(lora, "out_proj", out,
+                          self.out_proj(params["out_proj"], out)), \
+            new_cache
 
 
 def remat_policy(name: str):
@@ -1048,7 +1102,7 @@ class StackedBlocks(Module):
         return carry
 
     def decode(self, params, x, caches, *, w8a8_mask=None,
-               w8a8_wq=None, **kwargs):
+               w8a8_wq=None, lora=None, **kwargs):
         """Incremental decoding: scan layers threading per-layer KV caches
         (leaves shaped (layers, b, max_len, hkv, d)).
 
@@ -1060,39 +1114,37 @@ class StackedBlocks(Module):
         historical path. ``w8a8_wq`` (optional, a stacked
         ``prequantize`` tree with (layers, ...) leaves) also rides the
         scan as xs so each layer streams its pre-quantized int8
-        weights instead of re-quantizing per step."""
-        if w8a8_mask is None:
-            def body(h, inputs):
-                layer_params, cache = inputs
-                h, new_cache = self._block(layer_params, h,
-                                           kv_cache=cache, **kwargs)
-                return h, new_cache
+        weights instead of re-quantizing per step.
 
-            x, new_caches = jax.lax.scan(body, x, (params, caches))
-            return x, new_caches
-
-        w8a8_mask = jnp.asarray(w8a8_mask, bool)
-
-        if w8a8_wq is None:
-            def body(h, inputs):
-                layer_params, cache, flag = inputs
-                h, new_cache = self._block(layer_params, h,
-                                           kv_cache=cache,
-                                           w8a8=flag, **kwargs)
-                return h, new_cache
-
-            x, new_caches = jax.lax.scan(body, x,
-                                         (params, caches, w8a8_mask))
-            return x, new_caches
+        ``lora`` (optional) is the multi-tenant adapter arena:
+        ``{"ids": (b, s) int32 pages, "pages": {proj: {"A": (L, P, in,
+        r), "B": (L, P, r, out)}}}``. The stacked pages ride the scan
+        as xs (each layer sees its (P, ...) slice) while the per-token
+        page ids close over the body; each layer's targeted
+        projections add the :func:`lora_apply` BGMV delta."""
+        xs = {"p": params, "c": caches}
+        lora_ids = None
+        if w8a8_mask is not None:
+            xs["w8a8"] = jnp.asarray(w8a8_mask, bool)
+            if w8a8_wq is not None:
+                xs["wq"] = w8a8_wq
+        if lora:
+            xs["lora"] = lora["pages"]
+            lora_ids = lora["ids"]
 
         def body(h, inputs):
-            layer_params, cache, flag, wq = inputs
-            h, new_cache = self._block(layer_params, h, kv_cache=cache,
-                                       w8a8=flag, w8a8_wq=wq, **kwargs)
+            kw = dict(kwargs)
+            if "w8a8" in inputs:
+                kw["w8a8"] = inputs["w8a8"]
+            if "wq" in inputs:
+                kw["w8a8_wq"] = inputs["wq"]
+            if "lora" in inputs:
+                kw["lora"] = {"ids": lora_ids, "pages": inputs["lora"]}
+            h, new_cache = self._block(inputs["p"], h,
+                                       kv_cache=inputs["c"], **kw)
             return h, new_cache
 
-        x, new_caches = jax.lax.scan(
-            body, x, (params, caches, w8a8_mask, w8a8_wq))
+        x, new_caches = jax.lax.scan(body, x, xs)
         return x, new_caches
 
     def prefill(self, params, x, *, positions=None, segment_ids=None,

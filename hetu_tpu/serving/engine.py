@@ -101,6 +101,7 @@ from hetu_tpu.serving.speculative import (
     ModelDraftsman, NgramDraftsman, adjust_logits, check_draft_depth,
     check_sampled_draft, speculative_verify,
 )
+from hetu_tpu.serving.tenancy import AdapterArenaFull
 from hetu_tpu.telemetry.flight import HangWatchdog, flight_record
 from hetu_tpu.telemetry.slo import SLOEngine, default_serving_rules
 from hetu_tpu.telemetry.spans import REQ_TRACK_BASE  # noqa: F401 — re-export
@@ -154,7 +155,16 @@ class ServingEngine:
                  watchdog: bool = False, watchdog_factor: float = 8.0,
                  watchdog_min_timeout_s: float = 30.0,
                  slo: Union[bool, SLOEngine, None] = None,
-                 slo_every_s: float = 1.0):
+                 slo_every_s: float = 1.0,
+                 tenancy=None):
+        # -- multi-tenant adapter plane (serving/tenancy.py):
+        # tenancy=True mounts a default TenantPlane; pass a configured
+        # one for custom arena size / rank / QoS policies. None is the
+        # historical single-tenant engine, bit for bit.
+        if tenancy is True:
+            from hetu_tpu.serving.tenancy import TenantPlane
+            tenancy = TenantPlane()
+        self.tenancy = tenancy or None
         if block_size is None:
             # default paging: 16-token blocks when they divide max_len,
             # else one block per slot (degenerate = PR 5 slot arena)
@@ -200,6 +210,24 @@ class ServingEngine:
                     "kv_blocks= conflicts with hbm_budget_bytes= "
                     "sizing (the budget already fixes the arena) — "
                     "pass slots= alongside kv_blocks=")
+            if self.tenancy is not None:
+                # the adapter arena lives in the same HBM budget the
+                # KV arena is sized from — price it FIRST so the
+                # admission arithmetic stays honest (engine/memory
+                # ledger, like the CP-prefill activation check below)
+                from hetu_tpu.engine.memory import size_adapter_arena
+                arena = size_adapter_arena(
+                    model.cfg, r=self.tenancy.r,
+                    max_adapters=self.tenancy.max_adapters)
+                if arena >= 0.5 * hbm_budget_bytes:
+                    raise ValueError(
+                        f"adapter arena ({self.tenancy.max_adapters} "
+                        f"pages x rank {self.tenancy.r}) needs "
+                        f"~{arena / 1e9:.2f}GB — more than half the "
+                        f"{hbm_budget_bytes / 1e9:.2f}GB HBM budget; "
+                        f"shrink max_adapters / the arena rank, or "
+                        f"raise the budget")
+                hbm_budget_bytes = hbm_budget_bytes - arena
             tp = plan.strategy.tp if plan is not None else 1
             self.pool = KVPool.sized_for(
                 model, hbm_budget_bytes=hbm_budget_bytes,
@@ -329,6 +357,22 @@ class ServingEngine:
         self._bt_dev = None
         self._ctl_dirty = True
         self._slot_req: list[Optional[Request]] = [None] * S
+        # -- adapter arena (serving/tenancy.py): device-resident
+        # stacked A/B pages per projection — (L, P, in, r) /
+        # (L, P, r, out), page 0 all-zero (base). The registry rewrites
+        # SINGLE pages via functional .at[:, page].set, so adapter
+        # load/evict/hot-swap never changes a shape and never retraces
+        # the fused step; _adapter_page maps slot -> page and rides ctl
+        # as traced data.
+        self._adapter_page = np.zeros(S, np.int32)
+        self._lora_pages: dict = {}
+        self._throttle_logged: set = set()   # reqs in a throttle episode
+        self._wait_logged: set = set()       # reqs waiting on the arena
+        self._qos_admitted: set = set()      # req ids that paid on_admit
+        if self.tenancy is not None:
+            self._lora_pages = self._init_adapter_arena()
+            self.tenancy.registry.on_page_write = self._write_adapter_page
+            self.scheduler.admission_gate = self._admission_gate
         self._prefilling: list[dict] = []        # FCFS in-flight prefills
         self._cp_pending: list[dict] = []        # admitted CP-lane reqs
         #: max requests that can FINISH prefill in one iteration (each
@@ -495,7 +539,7 @@ class ServingEngine:
         host_q = self._draftsman is None \
             or getattr(self._draftsman, "host_only", True)
 
-        def step(params, caches, ctl, pf, bt, cow, spec, wq):
+        def step(params, caches, ctl, pf, bt, cow, spec, wq, lora):
             record_trace("serving_step")    # churn must never re-enter
 
             # copy-on-write block copies for this iteration's partial
@@ -537,11 +581,17 @@ class ServingEngine:
                 positions = ctl["pos"][:, None] + lane
                 row_valid = (lane <= spec["len"][:, None]) \
                     & ctl["active"][:, None]
+                # multi-tenant BGMV: every token row carries its slot's
+                # adapter arena page as DATA (page 0 = base, bitwise) —
+                # adapter load/evict/mixed-tenant churn never retraces
                 logits, caches = generation.decode(
                     model, params, tok_in, positions, caches,
                     slot_mask=ctl["active"], block_tables=bt,
                     row_mask=row_valid, attn_kernel=kern,
-                    w8a8_mask=w8a8_mask, w8a8_wq=wq)
+                    w8a8_mask=w8a8_mask, w8a8_wq=wq,
+                    lora={"ids": jnp.broadcast_to(
+                        ctl["adapter"][:, None], tok_in.shape),
+                        "pages": lora} if lora else None)
                 # proposal probs q: host draftsmen propose
                 # deterministically — their q is the one-hot of the
                 # draft, synthesized here so the host never ships a
@@ -598,7 +648,10 @@ class ServingEngine:
                         pack={"segment_ids": pf["seg"][None, :],
                               "hist": pf["hist"],
                               "valid": pf["valid"],
-                              "impl": pack_impl})
+                              "impl": pack_impl},
+                        lora={"ids": jnp.take(ctl["adapter"],
+                                              pf["slot"])[None, :],
+                              "pages": lora} if lora else None)
                     hrow = h[0]                              # (C, E)
                 else:
                     pos = pf["pos"][:, None]                 # (C, 1)
@@ -608,7 +661,10 @@ class ServingEngine:
                         params["blocks"], h, caches, positions=pos,
                         slot_mask=pf["valid"],
                         block_tables=jnp.take(bt, pf["slot"], axis=0),
-                        attn_kernel=kern)
+                        attn_kernel=kern,
+                        lora={"ids": jnp.take(ctl["adapter"],
+                                              pf["slot"])[:, None],
+                              "pages": lora} if lora else None)
                     hrow = h[:, 0]                           # (C, E)
                 # FIRST tokens for the <= R requests whose prefill
                 # completes this iteration: head only on their last
@@ -890,6 +946,7 @@ class ServingEngine:
         self._bt[slot, :] = 0
         self._active[slot] = False
         self._slot_req[slot] = None
+        self._adapter_page[slot] = 0
         self._ctl_dirty = True
 
     def _exec_spill(self, job: dict, reg) -> None:
@@ -906,7 +963,8 @@ class ServingEngine:
                 last_tok=int(self._last_tok[slot]),
                 tokens=list(req.tokens),
                 weight_version=req.weight_version,
-                key_state=self._key_state[slot].copy())
+                key_state=self._key_state[slot].copy(),
+                adapter=req.kv_adapter)
             self.spill_arena.put(entry)
             req.spill = entry
             req.preemptions += 1
@@ -1034,6 +1092,7 @@ class ServingEngine:
                         and self.spill_arena.get(req.id) is entry:
                     self.spill_arena.pop(req.id, resumed=False)
                 req.status = "evicted"
+                self._release_tenancy(req)
                 return entry
             for ent in list(self._resume_pending):
                 if ent["req"] is req:
@@ -1044,18 +1103,21 @@ class ServingEngine:
                         self.spill_arena.pop(req.id, resumed=False)
                     self._detach_locked(req, ent["slot"])
                     req.status = "evicted"
+                    self._release_tenancy(req)
                     return entry
             for ent in list(self._prefilling):
                 if ent["req"] is req:
                     self._prefilling.remove(ent)
                     self._detach_locked(req, ent["slot"])
                     req.status = "evicted"
+                    self._release_tenancy(req)
                     return None
             for ent in list(self._cp_pending):
                 if ent["req"] is req:
                     self._cp_pending.remove(ent)
                     self._detach_locked(req, ent["slot"])
                     req.status = "evicted"
+                    self._release_tenancy(req)
                     return None
             slot = req.slot
             # a "prefilled" request is PARKED (P/D handoff): its slot is
@@ -1086,9 +1148,11 @@ class ServingEngine:
                 last_tok=spill_plan["last_tok"],
                 tokens=list(req.tokens),
                 weight_version=req.weight_version,
-                key_state=spill_plan["key_state"])
+                key_state=spill_plan["key_state"],
+                adapter=req.kv_adapter)
             self._detach_locked(req, spill_plan["slot"])
             req.status = "evicted"
+            self._release_tenancy(req)
             req.spilled_blocks += spill_plan["nb"]
             telemetry.get_registry().counter(
                 "serving_kv_spilled_blocks_total",
@@ -1411,6 +1475,11 @@ class ServingEngine:
         (``prefill_only`` / the fleet router) evicts the KV and resumes
         it on a decode-tier replica."""
         sampling = sampling or SamplingParams()
+        if sampling.adapter is not None and self.tenancy is None:
+            raise ValueError(
+                "SamplingParams.adapter without tenancy= — construct "
+                "the engine with ServingEngine(..., tenancy=True) and "
+                "load_adapter first")
         if sampling.temperature > 0 and self.spec_depth \
                 and self._draftsman is not None:
             # sampled speculation runs the rejection-sampling verify
@@ -1438,12 +1507,42 @@ class ServingEngine:
                 if tid:
                     req.trace_id = tid
                     req.traceparent = tp
-            if resume is not None and resume.compatible_with(
-                    self.pool, self.weight_version):
-                req.spill = resume
-                req.tokens = list(resume.tokens)
-                req.weight_version = resume.weight_version
-            admitted = self.scheduler.submit(req)
+            exp_adapter = 0
+            if self.tenancy is not None and sampling.adapter is not None:
+                registry = self.tenancy.registry
+                if not registry.has(sampling.tenant, sampling.adapter):
+                    req.status = "rejected"
+                    req.error = (f"unknown adapter {sampling.tenant}/"
+                                 f"{sampling.adapter} — load_adapter "
+                                 f"first")
+                    req.done.set()
+                    admitted = False
+                else:
+                    exp_adapter = registry.kv_tag(
+                        registry.get(sampling.tenant, sampling.adapter))
+                    req.kv_adapter = exp_adapter
+            if req.status != "rejected":
+                if resume is not None and resume.compatible_with(
+                        self.pool, self.weight_version,
+                        adapter=exp_adapter):
+                    req.spill = resume
+                    req.tokens = list(resume.tokens)
+                    req.weight_version = resume.weight_version
+                admitted = self.scheduler.submit(req)
+                if admitted and req.cp_lane \
+                        and sampling.adapter is not None:
+                    # the CP-prefill lane is base-only (its one-pass
+                    # training-mode forward has no BGMV thread yet —
+                    # docs/SERVING.md): refuse loudly instead of
+                    # serving the base model under the tenant's name
+                    self.scheduler.queue.remove(req)
+                    req.status = "rejected"
+                    req.error = (
+                        "adapter requests cannot take the CP-prefill "
+                        "lane (base-only long-prompt path) — shorten "
+                        "the prompt or raise max_len")
+                    req.done.set()
+                    admitted = False
         reg = telemetry.get_registry()
         reg.counter("serving_requests_total",
                     "serving requests by outcome").inc(
@@ -1566,6 +1665,7 @@ class ServingEngine:
                 if r.spill is not None \
                         and self.spill_arena.get(r.id) is r.spill:
                     self.spill_arena.pop(r.id, resumed=False)
+                self._release_tenancy(r)
         return out
 
     def swap_params(self, params, *, version: Optional[int] = None) -> dict:
@@ -1613,6 +1713,258 @@ class ServingEngine:
         return {"version": self.weight_version,
                 "flushed_blocks": flushed}
 
+    # -- multi-tenant adapter plane (serving/tenancy.py) --------------------
+    def _init_adapter_arena(self) -> dict:
+        """Zero-filled device pages for every LoRA-targetable stacked
+        projection in the param tree: projection name → ``{"A":
+        (L, P, in, r), "B": (L, P, r, out)}`` float32, P =
+        ``max_adapters``. Page 0 stays all-zero forever — the base
+        model's delta is exactly 0.0, and ``lora_apply``'s masked
+        select keeps id-0 tokens BITWISE base. MoE FFNs carry no dense
+        fc_in/gate/up leaves, so expert weights are never paged —
+        attention adapters still apply there."""
+        from hetu_tpu.serving.tenancy import DEFAULT_TARGETS
+        P, r = self.tenancy.max_adapters, self.tenancy.r
+        pages: dict = {}
+        blocks = self.params.get("blocks", {})
+        for group in ("attn", "mlp"):
+            sub = blocks.get(group) if isinstance(blocks, dict) else None
+            if not isinstance(sub, dict):
+                continue
+            for name, node in sub.items():
+                if name not in DEFAULT_TARGETS \
+                        or not isinstance(node, dict):
+                    continue
+                w = node.get("weight")
+                if w is None or getattr(w, "ndim", 0) != 3:
+                    continue
+                L, d_in, d_out = w.shape
+                pages[name] = {
+                    "A": jnp.zeros((L, P, d_in, r), jnp.float32),
+                    "B": jnp.zeros((L, P, r, d_out), jnp.float32)}
+        if not pages:
+            raise ValueError(
+                "tenancy= on a model with no LoRA-targetable stacked "
+                "projections (expected blocks/attn/{q,k,v,out}_proj "
+                "and/or dense-FFN leaves in the param tree)")
+        return pages
+
+    def _write_adapter_page(self, page: int, spec) -> None:
+        """Registry hook: (re)write one arena page. ``spec`` None
+        zeroes the page (evict — a later gather of a freed page must
+        read exact zeros, not the evicted tenant's weights). Functional
+        ``.at[:, page].set`` builds NEW buffers and rebinds the tree —
+        an in-flight fused step keeps its own operands; the next
+        iteration picks up the rewrite. Shapes never change, so the
+        step never retraces."""
+        new = {}
+        for name, ab in self._lora_pages.items():
+            src = spec.weights.get(name) if spec is not None else None
+            if src is None:
+                new[name] = {"A": ab["A"].at[:, page].set(0.0),
+                             "B": ab["B"].at[:, page].set(0.0)}
+            else:
+                new[name] = {
+                    "A": ab["A"].at[:, page].set(
+                        jnp.asarray(src["A"], jnp.float32)),
+                    "B": ab["B"].at[:, page].set(
+                        jnp.asarray(src["B"], jnp.float32))}
+        self._lora_pages = new
+
+    def load_adapter(self, tenant: Optional[str], name: str,
+                     weights=None, *, path: Optional[str] = None,
+                     version: Optional[int] = None,
+                     scaling: float = 1.0) -> dict:
+        """Register (or hot-swap) a tenant's LoRA adapter and make it
+        arena-resident when a page can be had.
+
+        ``weights`` is projection → ``{"A": (L, in, ra), "B":
+        (L, ra, out)}`` host arrays (``peft.lora`` order — pass the
+        model's ``tenancy.lora_scaling`` as ``scaling`` for merge
+        parity); ``path=`` instead loads a
+        :func:`~hetu_tpu.serving.tenancy.save_adapter_distributed`
+        checkpoint (version/scaling from its manifest unless
+        overridden). Replacing a live version is safe under traffic:
+        the old version's page drains when its last in-flight request
+        releases, its prefix-cache spans flush eagerly, and the new
+        version's fresh uid means no stale KV can ever match."""
+        if self.tenancy is None:
+            raise RuntimeError(
+                "load_adapter on an engine without tenancy= — "
+                "construct with ServingEngine(..., tenancy=True)")
+        if (weights is None) == (path is None):
+            raise ValueError("pass exactly one of weights= or path=")
+        if path is not None:
+            from hetu_tpu.serving.tenancy import load_adapter_distributed
+            weights, fver, scaling = load_adapter_distributed(path)
+            if version is None:
+                version = fver
+        unknown = set(weights) - set(self._lora_pages)
+        if unknown:
+            raise ValueError(
+                f"adapter targets projections this model does not "
+                f"page: {sorted(unknown)} (arena pages: "
+                f"{sorted(self._lora_pages)})")
+        for proj, ab in weights.items():
+            pg = self._lora_pages[proj]
+            L, _, d_in, _ = pg["A"].shape
+            d_out = pg["B"].shape[-1]
+            a, b = np.asarray(ab["A"]), np.asarray(ab["B"])
+            if a.shape[0] != L or a.shape[1] != d_in \
+                    or b.shape[-1] != d_out:
+                raise ValueError(
+                    f"{proj}: adapter pages {a.shape}/{b.shape} do not "
+                    f"fit this model's ({L}, {d_in}, ·)/(·, {d_out}) "
+                    f"projection")
+        registry = self.tenancy.registry
+        with self._lock:
+            prev_uid = None
+            if registry.has(tenant, name):
+                prev_uid = registry.get(tenant, name).uid
+            spec = registry.register(tenant, name, weights,
+                                     version=version, scaling=scaling)
+            flushed = 0
+            if prev_uid is not None and self.prefix_cache is not None:
+                # the replaced version's cached spans are already
+                # unmatchable (fresh uid) but still pin blocks —
+                # return them to the free list now
+                flushed = self.prefix_cache.flush_adapter(prev_uid)
+            try:
+                registry.ensure_resident(tenant, name)
+            except AdapterArenaFull:
+                pass    # loads lazily at this adapter's first admission
+        if flushed:
+            telemetry.get_registry().counter(
+                "serving_prefix_flushed_total",
+                "prefix-cache blocks flushed because their KV "
+                "was computed under superseded weights").inc(flushed)
+        return {"tenant": tenant, "name": name,
+                "version": spec.version, "uid": spec.uid,
+                "page": spec.page, "flushed_blocks": flushed}
+
+    def evict_adapter(self, tenant: Optional[str], name: str) -> dict:
+        """Deregister a tenant's adapter: the arena page frees now when
+        idle (else when its last in-flight request releases), and its
+        prefix-cache spans return their blocks eagerly."""
+        if self.tenancy is None:
+            raise RuntimeError(
+                "evict_adapter on an engine without tenancy=")
+        registry = self.tenancy.registry
+        with self._lock:
+            uid = None
+            if registry.has(tenant, name):
+                uid = registry.get(tenant, name).uid
+            registry.deregister(tenant, name)
+            flushed = 0
+            if uid is not None and self.prefix_cache is not None:
+                flushed = self.prefix_cache.flush_adapter(uid)
+        return {"flushed_blocks": flushed}
+
+    def _admission_gate(self, req: Request) -> bool:
+        """Scheduler eligibility filter (installed when tenancy is on;
+        the scheduler calls it under the engine lock). False DEFERS the
+        request without burning its class's deficit credits: tenant
+        token-bucket / slot-cap throttles and adapter-arena-full waits
+        — so a throttled tenant's backlog never blocks other tenants.
+        Also refreshes ``req.kv_adapter`` so the page plan the
+        scheduler prices next matches the adapter version that will
+        actually serve the request (a hot-swap between submit and
+        admission re-tags it here)."""
+        sp = req.sampling
+        if req.adapter_ref is not None:
+            return True      # preempted resume: already pinned + paid
+        reason = None if req.id in self._qos_admitted \
+            else self.tenancy.qos.check(sp.tenant)
+        if reason is not None:
+            if req.id not in self._throttle_logged:
+                self._throttle_logged.add(req.id)
+                telemetry.get_registry().counter(
+                    "tenant_throttled_total",
+                    "admissions deferred by tenant QoS (token-bucket "
+                    "rate or concurrent-slot cap), one per throttle "
+                    "episode").inc(tenant=sp.tenant or "base",
+                                   reason=reason)
+                flight_record("tenant_throttle", req=req.id,
+                              tenant=sp.tenant, reason=reason)
+            return False
+        if sp.adapter is not None:
+            registry = self.tenancy.registry
+            if registry.has(sp.tenant, sp.adapter):
+                if not registry.resident(sp.tenant, sp.adapter) \
+                        and not registry.can_load():
+                    # every page pinned by in-flight requests: wait
+                    # (loud, once per episode) instead of failing
+                    if req.id not in self._wait_logged:
+                        self._wait_logged.add(req.id)
+                        flight_record("adapter_wait", req=req.id,
+                                      tenant=sp.tenant,
+                                      adapter=sp.adapter)
+                    return False
+                req.kv_adapter = registry.kv_tag(
+                    registry.get(sp.tenant, sp.adapter))
+        return True
+
+    def _bind_adapter_locked(self, req: Request, slot: int) -> bool:
+        """Pin the request's tenancy state at admission (caller holds
+        the lock): acquire an adapter-page ref — held across preemption,
+        so a resume is guaranteed the same uid/page — stamp the slot's
+        arena page + the request's KV-compat tag, and pay the tenant's
+        QoS admit exactly once per request lifetime. False = the
+        adapter vanished between submit and admission (deregistered):
+        the request fails loudly and its slot/blocks unwind."""
+        sp = req.sampling
+        reg_ = telemetry.get_registry()
+        if sp.adapter is not None and req.adapter_ref is None:
+            try:
+                spec = self.tenancy.registry.acquire(sp.tenant,
+                                                     sp.adapter)
+            except (KeyError, AdapterArenaFull) as err:
+                # KeyError: deregistered since submit. AdapterArenaFull
+                # is defensive — the admission gate defers requests the
+                # arena cannot page, so admission never sees it.
+                req.status, req.error = "rejected", str(err)
+                self.scheduler.release(
+                    slot, table=np.asarray(req.admit["table"],
+                                           np.int32))
+                reg_.counter("serving_requests_total",
+                             "serving requests by outcome").inc(
+                    outcome="rejected")
+                flight_record("serving_reject", req=req.id,
+                              trace=req.trace_id, reason=str(err))
+                req.done.set()
+                return False
+            req.adapter_ref = spec
+            req.kv_adapter = self.tenancy.registry.kv_tag(spec)
+        self._adapter_page[slot] = req.adapter_ref.page \
+            if req.adapter_ref is not None else 0
+        if req.id not in self._qos_admitted:
+            self._qos_admitted.add(req.id)
+            self.tenancy.qos.on_admit(sp.tenant)
+            reg_.counter("tenant_requests_total",
+                         "admitted serving requests per tenant").inc(
+                tenant=sp.tenant or "base")
+        self._throttle_logged.discard(req.id)
+        self._wait_logged.discard(req.id)
+        return True
+
+    def _release_tenancy(self, req: Request) -> None:
+        """Drop a request's tenancy holds as it leaves the engine
+        (finish, eviction out to the fleet, drain): the adapter-page
+        ref and the tenant's QoS slot. The slot's arena-page stamp is
+        cleared by ``_detach_locked``/``_finish``. Preemption does NOT
+        come through here — a preempted request keeps its ref so its
+        resume is guaranteed the same adapter uid."""
+        if self.tenancy is None:
+            return
+        if req.adapter_ref is not None:
+            self.tenancy.registry.release(req.adapter_ref)
+            req.adapter_ref = None
+        if req.id in self._qos_admitted:
+            self._qos_admitted.discard(req.id)
+            self.tenancy.qos.on_finish(req.sampling.tenant)
+        self._throttle_logged.discard(req.id)
+        self._wait_logged.discard(req.id)
+
     def step(self) -> bool:
         """One engine iteration; False when there was nothing to do.
         Safe to call while the :meth:`start` loop runs (iterations are
@@ -1631,6 +1983,9 @@ class ServingEngine:
             if adm is None:
                 break
             req, slot = adm
+            if self.tenancy is not None \
+                    and not self._bind_adapter_locked(req, slot):
+                continue
             req.weight_version = self.weight_version
             sp = req.sampling
             self._temp[slot] = sp.temperature
@@ -1803,7 +2158,9 @@ class ServingEngine:
                                  "temp": jnp.asarray(self._temp),
                                  "topk": jnp.asarray(self._topk),
                                  "topp": jnp.asarray(self._topp),
-                                 "key": jnp.asarray(self._key_state)}
+                                 "key": jnp.asarray(self._key_state),
+                                 "adapter": jnp.asarray(
+                                     self._adapter_page)}
                 self._bt_dev = jnp.asarray(self._bt)
                 self._ctl_dirty = False
             ctl = self._ctl_dev
@@ -1869,7 +2226,7 @@ class ServingEngine:
             (caches, committed, ncommit, first_toks, pos_dev,
              last_dev, key_dev) = self._fn(
                 self.params, self.pool.caches, ctl, pf, bt, cow, spec,
-                self._w8a8_wq)
+                self._w8a8_wq, self._lora_pages)
         self.pool.caches = caches
         em = np.asarray(committed)               # (S, K+1)
         nc = np.asarray(ncommit)                 # (S,)
@@ -1984,7 +2341,8 @@ class ServingEngine:
                 # cache (the trie takes refs, so they outlive the slot)
                 if self.prefix_cache is not None:
                     self.prefix_cache.insert(req.prompt.tolist(),
-                                             self._bt[slot])
+                                             self._bt[slot],
+                                             adapter=req.kv_adapter)
                 self._on_token(slot, int(ft[i]), now, reg)
                 self._prefilling.remove(ent)
             # steady decode: adopt the step's own control advance (no
@@ -2045,6 +2403,8 @@ class ServingEngine:
         self._active[slot] = False
         self._ctl_dirty = True               # slot turned off
         self._slot_req[slot] = None
+        self._adapter_page[slot] = 0
+        self._release_tenancy(req)
         # drop this slot's hold on every block it mapped; blocks the
         # prefix cache adopted stay resident (trie refs), the rest free
         self.scheduler.release(slot, table=self._bt[slot])
@@ -2133,6 +2493,11 @@ class ServingEngine:
             "buddy replica store) — the tier chain of ISSUE 18")
         for tier, n in tiers.items():
             g.set(n, tier=tier)
+        if self.tenancy is not None:
+            reg.gauge(
+                "adapter_pages_in_use",
+                "adapter arena pages holding a resident adapter").set(
+                self.tenancy.registry.pages_in_use)
 
     def run_until_drained(self, max_steps: int = 1_000_000) -> int:
         """Drive :meth:`step` until queue + slots are empty; returns the

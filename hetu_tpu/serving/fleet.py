@@ -917,9 +917,14 @@ class RemoteEngineProxy:
 
 
 def _sampling_kw(sp: SamplingParams) -> dict:
-    return {"temperature": sp.temperature, "top_k": sp.top_k,
-            "top_p": sp.top_p, "eos_id": sp.eos_id,
-            "max_tokens": sp.max_tokens, "priority": sp.priority}
+    kw = {"temperature": sp.temperature, "top_k": sp.top_k,
+          "top_p": sp.top_p, "eos_id": sp.eos_id,
+          "max_tokens": sp.max_tokens, "priority": sp.priority}
+    if getattr(sp, "tenant", None) is not None:
+        kw["tenant"] = sp.tenant
+    if getattr(sp, "adapter", None) is not None:
+        kw["adapter"] = sp.adapter
+    return kw
 
 
 def _is_rejection(e: Exception) -> bool:

@@ -37,21 +37,26 @@ from hetu_tpu.serving.kv_pool import NULL_BLOCK, BlockManager
 
 class _Node:
     """One cached whole block: edge label ``tokens`` (block_size ids),
-    payload ``block`` (arena id), LRU stamp ``last_use``, and the
-    ``version`` of the weights whose forward wrote the block's KV."""
+    payload ``block`` (arena id), LRU stamp ``last_use``, the
+    ``version`` of the weights whose forward wrote the block's KV, and
+    the ``adapter`` uid that forward ran under (0 = base — an
+    attention-targeting LoRA adapter writes DIFFERENT K/V for the same
+    tokens, so its spans only ever match requests of the same adapter
+    load; see ``serving/tenancy.py``)."""
 
     __slots__ = ("tokens", "block", "parent", "children", "last_use",
-                 "version")
+                 "version", "adapter")
 
     def __init__(self, tokens: tuple, block: int,
                  parent: Optional["_Node"], last_use: int,
-                 version: int = 0):
+                 version: int = 0, adapter: int = 0):
         self.tokens = tokens
         self.block = block
         self.parent = parent
         self.children: list[_Node] = []
         self.last_use = last_use
         self.version = version
+        self.adapter = adapter
 
 
 def _common_prefix_len(a: Sequence[int], b: Sequence[int]) -> int:
@@ -82,7 +87,7 @@ class PrefixCache:
         self.flushes = 0
 
     # -- lookup -------------------------------------------------------------
-    def match(self, tokens: Sequence[int]) -> tuple[
+    def match(self, tokens: Sequence[int], adapter: int = 0) -> tuple[
             list[int], Optional[tuple[int, int]]]:
         """Longest cached prefix of ``tokens``.
 
@@ -92,8 +97,15 @@ class PrefixCache:
         continues ``n_rows`` tokens into one more cached block (the
         engine copies it — CoW — because the request will write its own
         rows there). Takes NO refs — the caller shares what it actually
-        maps. Touches LRU stamps along the path."""
+        maps. Touches LRU stamps along the path.
+
+        ``adapter`` is the requesting stream's KV-compat uid: only
+        nodes written under the SAME adapter load match (0 = base;
+        cross-adapter spans hold different K/V for identical tokens,
+        so a mismatched hit would silently serve another tenant's
+        activations)."""
         bs = self.block_size
+        adapter = int(adapter)
         self._clock += 1
         shared: list[int] = []
         node = self._root
@@ -104,7 +116,8 @@ class PrefixCache:
             if len(key) == bs:
                 child = next(
                     (c for c in node.children if c.tokens == key
-                     and c.version == self.weight_version), None)
+                     and c.version == self.weight_version
+                     and c.adapter == adapter), None)
             if child is not None:
                 child.last_use = self._clock
                 shared.append(child.block)
@@ -113,10 +126,11 @@ class PrefixCache:
                 continue
             # partial tail: the child sharing the longest token prefix
             # (stale-version nodes hold KV from old weights — never
-            # matchable, whole or partial)
+            # matchable, whole or partial; same for foreign adapters)
             best, best_len = None, 0
             for c in node.children:
-                if c.version != self.weight_version:
+                if c.version != self.weight_version \
+                        or c.adapter != adapter:
                     continue
                 n = _common_prefix_len(c.tokens, key)
                 if n > best_len:
@@ -128,13 +142,17 @@ class PrefixCache:
         return shared, None
 
     # -- insertion ----------------------------------------------------------
-    def insert(self, tokens: Sequence[int], table: Sequence[int]) -> int:
+    def insert(self, tokens: Sequence[int], table: Sequence[int],
+               adapter: int = 0) -> int:
         """Cache ``tokens``' whole blocks, backed by the arena blocks in
         ``table`` (the request's block table, position-ordered). New
         nodes take a ref on their block so it survives the request's
         release; blocks already cached (the shared ones) are left
-        alone. Returns the number of new nodes."""
+        alone. ``adapter`` tags the nodes with the KV-compat uid the
+        forward ran under (0 = base). Returns the number of new
+        nodes."""
         bs = self.block_size
+        adapter = int(adapter)
         self._clock += 1
         node = self._root
         added = 0
@@ -142,13 +160,14 @@ class PrefixCache:
             key = tuple(tokens[j * bs:(j + 1) * bs])
             child = next(
                 (c for c in node.children if c.tokens == key
-                 and c.version == self.weight_version), None)
+                 and c.version == self.weight_version
+                 and c.adapter == adapter), None)
             if child is None:
                 blk = int(table[j])
                 if blk == NULL_BLOCK:
                     break
                 child = _Node(key, blk, node, self._clock,
-                              self.weight_version)
+                              self.weight_version, adapter)
                 node.children.append(child)
                 self.blocks.share(blk)      # the trie now holds it too
                 added += 1
@@ -223,6 +242,36 @@ class PrefixCache:
                     stack.append(c)
                 else:
                     # release the subtree rooted here (DFS, trie refs)
+                    sub = [c]
+                    while sub:
+                        v = sub.pop()
+                        sub.extend(v.children)
+                        self.blocks.release(v.block)
+                        freed += 1
+            node.children = keep
+        self.flushes += freed
+        return freed
+
+    def flush_adapter(self, adapter: int) -> int:
+        """Drop every node written under adapter uid ``adapter`` (an
+        evicted/replaced adapter's spans: already unmatchable — a new
+        load gets a fresh uid — but still pinning blocks; this returns
+        them eagerly instead of waiting on LRU pressure). Whole
+        subtrees go together: insert walks same-adapter chains, so a
+        node's descendants share its tag. Never flushes base (0)."""
+        adapter = int(adapter)
+        if adapter == 0:
+            return 0
+        freed = 0
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            keep: list[_Node] = []
+            for c in node.children:
+                if c.adapter != adapter:
+                    keep.append(c)
+                    stack.append(c)
+                else:
                     sub = [c]
                     while sub:
                         v = sub.pop()

@@ -648,7 +648,8 @@ class Router:
         return max(live, key=lambda h: hashlib.blake2b(
             f"{h.name}|{key}".encode(), digest_size=8).digest())
 
-    def _pick_locked(self, prompt, *, tier: str = "decode"
+    def _pick_locked(self, prompt, *, tier: str = "decode",
+                     sampling=None
                      ) -> Optional[tuple[ReplicaHandle, str]]:
         roles = ("prefill",) if tier == "prefill" \
             else ("both", "decode")
@@ -661,6 +662,34 @@ class Router:
             loads[h.name],
             h.ttft_ewma_s if h.ttft_ewma_s is not None else 0.0,
             h.name))
+        # adapter-affine dispatch (ISSUE 20): a request carrying a LoRA
+        # adapter prefers a replica whose arena already holds it — the
+        # landing there skips an ensure_resident load (and a possible
+        # LRU eviction churning some other tenant's page). Same load-
+        # slack discipline as the prefix planes: a hot adapter cannot
+        # starve the fleet, past the slack the pick falls through and
+        # the publisher/engine loads the adapter wherever the request
+        # lands.
+        adapter = getattr(sampling, "adapter", None) \
+            if sampling is not None else None
+        if adapter is not None:
+            tenant = getattr(sampling, "tenant", None)
+            holders = []
+            for h in live:
+                plane = getattr(h.engine, "tenancy", None)
+                if plane is None:
+                    continue
+                try:
+                    if plane.registry.resident(tenant, adapter):
+                        holders.append(h)
+                except Exception:   # remote proxy without the surface
+                    continue
+            if holders:
+                best = min(holders, key=lambda h: (loads[h.name],
+                                                   h.name))
+                if loads[best.name] <= loads[least.name] \
+                        + self.affinity_slack:
+                    return best, "adapter"
         # the fleet prefix directory outranks rendezvous affinity: it
         # records where the prefix ACTUALLY sits (affinity only guesses
         # where it should), under the same load-slack rule so a fleet-
@@ -703,12 +732,14 @@ class Router:
                 for h in self._replicas.values()) and any(
                 h.state == "live" and h.role in ("both", "decode")
                 for h in self._replicas.values()):
-            picked = self._pick_locked(rreq.prompt, tier="prefill")
+            picked = self._pick_locked(rreq.prompt, tier="prefill",
+                                       sampling=rreq.sampling)
             handoff = picked is not None
         else:
             picked = None
         if picked is None:
-            picked = self._pick_locked(rreq.prompt)
+            picked = self._pick_locked(rreq.prompt,
+                                       sampling=rreq.sampling)
         if picked is None:
             return False
         h, reason = picked
@@ -1592,3 +1623,75 @@ class WeightPublisher:
                       trace=push_tp)
         return {"version": version, "replicas": per,
                 "duration_ms": round(dur_ms, 3), "trace": push_tp}
+
+    # -- per-tenant adapter push (ISSUE 20) -----------------------------
+    def publish_adapter(self, tenant: str, name: str, weights=None, *,
+                        path: Optional[str] = None,
+                        version: Optional[int] = None,
+                        scaling: float = 1.0) -> dict:
+        """Push one tenant's LoRA adapter to every non-dead replica
+        WITHOUT draining anything: adapters hot-swap under live
+        traffic (the engine registers the new version, flushes the
+        superseded version's prefix spans, and in-flight requests
+        pinning the old page finish on it untouched). The base weights
+        — and every other tenant — are never disturbed. Pass
+        ``weights`` (the in-memory pages dict) or ``path`` (a
+        ``save_adapter_distributed`` directory each replica host can
+        reach)."""
+        t0 = time.perf_counter()
+        with self.router._lock:
+            names = sorted(n for n, h in self.router._replicas.items()
+                           if h.state != "dead")
+        per = []
+        for rname in names:
+            h = self.router._replicas.get(rname)
+            if h is None or h.state == "dead":
+                continue
+            if getattr(h.engine, "tenancy", None) is None:
+                per.append({"replica": rname, "skipped": "no_tenancy"})
+                continue
+            t1 = time.perf_counter()
+            try:
+                info = h.engine.load_adapter(
+                    tenant, name, weights, path=path,
+                    version=version, scaling=scaling)
+            except Exception as err:  # replica-local failure: keep
+                per.append({"replica": rname,     # walking the fleet
+                            "skipped": f"{type(err).__name__}: {err}"})
+                continue
+            per.append({"replica": rname, "version": info["version"],
+                        "uid": info["uid"],
+                        "flushed_blocks": info["flushed_blocks"],
+                        "ms": round((time.perf_counter() - t1) * 1e3,
+                                    3)})
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        telemetry.get_registry().counter(
+            "adapter_pushes_total",
+            "fleet-wide per-tenant adapter pushes completed (no "
+            "drain — adapters hot-swap under live traffic)").inc()
+        flight_record("adapter_push", tenant=tenant, adapter=name,
+                      replicas=len(per), ms=round(dur_ms, 3))
+        return {"tenant": tenant, "adapter": name, "replicas": per,
+                "duration_ms": round(dur_ms, 3)}
+
+    def evict_adapter(self, tenant: str, name: str) -> dict:
+        """Deregister ``(tenant, name)`` fleet-wide: each replica drops
+        the registry entry, frees its arena page once the last pinned
+        request finishes, and flushes the adapter's prefix spans."""
+        with self.router._lock:
+            names = sorted(n for n, h in self.router._replicas.items()
+                           if h.state != "dead")
+        per = []
+        for rname in names:
+            h = self.router._replicas.get(rname)
+            if h is None or h.state == "dead" \
+                    or getattr(h.engine, "tenancy", None) is None:
+                continue
+            try:
+                per.append({"replica": rname,
+                            **h.engine.evict_adapter(tenant, name)})
+            except KeyError:
+                per.append({"replica": rname, "skipped": "unknown"})
+        flight_record("adapter_evict_fleet", tenant=tenant,
+                      adapter=name, replicas=len(per))
+        return {"tenant": tenant, "adapter": name, "replicas": per}

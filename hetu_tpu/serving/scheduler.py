@@ -49,6 +49,14 @@ class SamplingParams:
     eos_id: Optional[int] = None
     max_tokens: int = 16
     priority: int = 1
+    #: multi-tenant serving (serving/tenancy.py): ``tenant`` names the
+    #: request's QoS identity (token-bucket rate limits + slot caps at
+    #: admission), ``adapter`` the tenant's LoRA adapter to decode
+    #: under (None = the shared base model). Both are host-side
+    #: routing/admission data — the traced step only ever sees the
+    #: adapter's arena page id, so tenant churn never recompiles.
+    tenant: Optional[str] = None
+    adapter: Optional[str] = None
     #: per-request PRNG seed for sampled decoding (temperature > 0):
     #: the engine derives the slot's traced key stream from it, so a
     #: sampled run replays bit-for-bit — and matches one-shot
@@ -115,6 +123,17 @@ class Request:
     #                                    its presence is what routes
     #                                    admission through the resume
     #                                    path instead of prefill
+    # -- multi-tenant adapter plane (serving/tenancy.py) --
+    adapter_ref: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False)  # AdapterSpec pinned at
+    #                                    admission (refcount held until
+    #                                    finish — the arena page cannot
+    #                                    be evicted under this request)
+    kv_adapter: int = 0                # adapter KV-compat uid this
+    #                                    request's KV is written under
+    #                                    (0 = base-compatible): tags its
+    #                                    prefix-cache inserts + spills
+    #                                    and filters its prefix matches
     trace_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex[:12])
     traceparent: Optional[str] = dataclasses.field(
@@ -243,6 +262,14 @@ class Scheduler:
         self.class_weights = dict(class_weights) if class_weights else {}
         self._credit: dict[int, float] = {}   # deficit counters by class
         self.preemptions_total = 0        # host ledger by-product
+        #: optional per-request admission gate (the engine's tenant
+        #: QoS hook, serving/tenancy.py): ``callable(req) -> bool``.
+        #: False = the request is NOT eligible this round (rate-limited
+        #: tenant, slot-capped tenant, adapter arena full) — the
+        #: deficit selection simply skips it, so a throttled tenant's
+        #: backlog never blocks other tenants' admissions (noisy-
+        #: neighbor isolation), and never burns its class's credits.
+        self.admission_gate = None
 
     # -- admission ----------------------------------------------------------
     def submit(self, req: Request) -> bool:
@@ -297,13 +324,24 @@ class Scheduler:
         # clamp to a tiny share instead (≈ "only when alone")
         return max(float(w), 1e-6)
 
-    def _select_class(self) -> tuple[Optional[int], Optional[dict]]:
+    def _eligible(self) -> list:
+        """The queue minus requests the admission gate defers (tenant
+        rate limits / slot caps / adapter waits) — the population the
+        deficit selection runs over this round."""
+        if self.admission_gate is None:
+            return list(self.queue)
+        return [r for r in self.queue if self.admission_gate(r)]
+
+    def _select_class(self, queue=None) -> tuple[
+            Optional[int], Optional[dict]]:
         """Deficit-weighted pick among classes present in the queue
         (pure — commits nothing). Every backlogged class earns its
         weight per round until one can afford an admission (credit
         >= 1); richest wins, urgency breaks ties. Returns
         ``(class, credits-after-accrual)``."""
-        present = {r.sampling.priority for r in self.queue}
+        if queue is None:
+            queue = self.queue
+        present = {r.sampling.priority for r in queue}
         if not present:
             return None, None
         eff = {c: self._credit.get(c, 0.0) for c in present}
@@ -318,10 +356,11 @@ class Scheduler:
         the deficit-selected class) — the engine's preemption planner
         asks this to decide whether a blocked urgent request justifies
         evicting a running batch one."""
-        win, _ = self._select_class()
+        eligible = self._eligible()
+        win, _ = self._select_class(eligible)
         if win is None:
             return None
-        return next(r for r in self.queue
+        return next(r for r in eligible
                     if r.sampling.priority == win)
 
     def blocks_needed(self, req: Request) -> int:
@@ -367,8 +406,11 @@ class Scheduler:
         engine refills from the host arena (no prefill lane work)."""
         if not self.queue or not self.free:
             return None
-        win, eff = self._select_class()
-        req = next(r for r in self.queue
+        eligible = self._eligible()
+        win, eff = self._select_class(eligible)
+        if win is None:
+            return None
+        req = next(r for r in eligible
                    if r.sampling.priority == win)
         plan = None
         if self.blocks is not None:
@@ -425,7 +467,8 @@ class Scheduler:
         # not insert on completion either — long-prompt prefix sharing
         # is future work (docs/SERVING.md)
         if self.cache is not None and not req.cp_lane:
-            shared, partial = self.cache.match(req.prompt.tolist())
+            shared, partial = self.cache.match(req.prompt.tolist(),
+                                               adapter=req.kv_adapter)
             shared = shared[:total]
         matched = len(shared) * bs + (partial[1] if partial else 0)
         # a FULL-prompt hit still recomputes the last token (its logits

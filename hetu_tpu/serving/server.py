@@ -42,7 +42,9 @@ def sampling_from_payload(p: dict) -> SamplingParams:
         top_p=float(p.get("top_p", 0.0)),
         eos_id=None if p.get("eos_id") is None else int(p["eos_id"]),
         max_tokens=int(p.get("max_tokens", 16)),
-        priority=int(p.get("priority", 1)))
+        priority=int(p.get("priority", 1)),
+        tenant=p.get("tenant"),
+        adapter=p.get("adapter"))
 
 
 def submit_payload(engine: ServingEngine, tok: str) -> Request:

@@ -1,0 +1,541 @@
+"""Multi-tenant adapter serving plane: host-side state.
+
+Many tenants share one base model; each tenant's LoRA adapters live in
+a device-resident *arena* of fixed-shape pages so the fused serving
+step never retraces when adapters load, evict, or mix within a batch
+(Punica / S-LoRA shaped batched-gather LoRA, "BGMV").  This module is
+the host half of that plane:
+
+* :class:`AdapterRegistry` — tenant → (adapter name, version) → arena
+  page.  Pages are refcounted by admitted requests and evicted LRU
+  among idle pages; every load gets a fresh monotonic *uid* so a stale
+  version can never alias a reused page (the same trick
+  ``PrefixCache`` / ``SpillEntry`` play with weight versions).
+* :class:`TenantQoS` — per-tenant token-bucket rate limits and
+  concurrent-slot caps, enforced at admission on the deficit
+  scheduler so a noisy neighbour cannot starve other tenants' TTFT.
+* :class:`TenantPlane` — the facade the engine mounts (registry + QoS
+  + per-tenant ledgers).
+* :func:`extract_adapter` / :func:`save_adapter_distributed` /
+  :func:`load_adapter_distributed` — pull stacked per-layer A/B pages
+  out of a ``peft.lora``-wrapped param tree and move them over the
+  existing dist-ckpt transport.
+
+Everything here is plain numpy + bookkeeping; the engine owns the
+device arena and rewrites pages via ``registry.on_page_write``.
+
+Page 0 is the base model: an all-zero page whose delta is exactly
+``0.0``, so adapter id 0 decodes bitwise identical to a build without
+tenancy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from hetu_tpu import telemetry
+from hetu_tpu.telemetry.flight import flight_record
+
+# Projections whose adapters perturb the written KV (q/k/v write the
+# cache directly; out_proj changes this block's output and therefore
+# every later block's K/V).  fc_*/gate/up adapters change hidden
+# states too, but only *after* the first block — the registry treats
+# MLP-only adapters as base-KV-compatible by default (the S-LoRA
+# sharing rule from the issue) and exposes ``mlp_shares_base_prefix``
+# to turn that off for exact multi-layer prefix semantics.
+ATTN_TARGETS = frozenset({"q_proj", "k_proj", "v_proj", "out_proj"})
+MLP_TARGETS = frozenset({"fc_in", "fc_out", "gate_proj", "up_proj"})
+DEFAULT_TARGETS = ("q_proj", "k_proj", "v_proj", "out_proj",
+                   "fc_in", "fc_out", "gate_proj", "up_proj")
+
+_ADAPTER_MANIFEST = "adapter.json"
+
+
+class AdapterArenaFull(RuntimeError):
+    """Every arena page is pinned by in-flight requests; the request
+    must wait at admission (loud flight event) instead of failing."""
+
+
+@dataclasses.dataclass
+class AdapterSpec:
+    """One loaded (tenant, name, version) adapter.
+
+    ``weights`` maps projection name → ``{"A": (L, in, r), "B":
+    (L, r, out)}`` float32 host arrays, already padded to the arena
+    rank and with the LoRA scaling folded into B, so the device lane
+    is a pure pair of einsums with no per-adapter scalars.
+    """
+    tenant: str
+    name: str
+    version: int
+    uid: int
+    r: int
+    targets: Tuple[str, ...]
+    weights: Dict[str, Dict[str, np.ndarray]]
+    page: Optional[int] = None
+    refs: int = 0
+    last_use: float = 0.0
+    stale: bool = False
+
+    @property
+    def attention_targeting(self) -> bool:
+        return bool(set(self.targets) & ATTN_TARGETS)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.tenant, self.name)
+
+
+class AdapterRegistry:
+    """Tenant → adapter → arena page, with refcounted LRU eviction.
+
+    The registry only does bookkeeping over host mirrors; whenever a
+    page's contents change it calls ``on_page_write(page, spec_or_None)``
+    so the owner (the engine) can rewrite the device arena slice with
+    ``.at[:, page].set(...)`` — shapes never change, so the fused step
+    never retraces.
+    """
+
+    def __init__(self, *, max_adapters: int = 8, r: int = 8,
+                 mlp_shares_base_prefix: bool = True,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_adapters < 2:
+            raise ValueError("max_adapters must be >= 2 "
+                             "(page 0 is reserved for the base model)")
+        if r < 1:
+            raise ValueError("adapter rank must be >= 1")
+        self.max_adapters = int(max_adapters)
+        self.r = int(r)
+        self.mlp_shares_base_prefix = bool(mlp_shares_base_prefix)
+        self._clock = clock
+        self._lock = threading.RLock()
+        # Latest version per (tenant, name); stale versions leave this
+        # map but stay in _resident until their refs drain.
+        self._store: Dict[Tuple[str, str], AdapterSpec] = {}
+        self._resident: Dict[int, AdapterSpec] = {}
+        self._free = set(range(1, self.max_adapters))
+        self._next_uid = 1
+        self.on_page_write = None  # callable(page, spec | None)
+
+    # -- registration ------------------------------------------------
+
+    def register(self, tenant: str, name: str,
+                 weights: Dict[str, Dict[str, np.ndarray]], *,
+                 version: Optional[int] = None,
+                 scaling: float = 1.0) -> AdapterSpec:
+        """Install (or replace) a tenant's adapter.
+
+        ``weights``: projection → ``{"A": (L, in, ra), "B":
+        (L, ra, out)}``; ``ra`` may be smaller than the arena rank
+        (zero-padded — mathematically exact) but never larger.
+        Replacing an existing (tenant, name) marks the old version
+        stale: a resident idle page is flushed immediately, a pinned
+        page drains when its last in-flight request releases.  The new
+        version gets a fresh uid, so version-tagged caches can never
+        serve the old weights.
+        """
+        folded = self._fold(weights, scaling)
+        with self._lock:
+            prev = self._store.get((tenant, name))
+            if version is None:
+                version = prev.version + 1 if prev is not None else 1
+            spec = AdapterSpec(
+                tenant=tenant, name=name, version=int(version),
+                uid=self._next_uid, r=self.r,
+                targets=tuple(sorted(folded)), weights=folded)
+            self._next_uid += 1
+            if prev is not None:
+                self._retire_locked(prev)
+            self._store[(tenant, name)] = spec
+            flight_record("adapter_register", tenant=tenant, name=name,
+                          version=spec.version, uid=spec.uid,
+                          targets=list(spec.targets))
+            return spec
+
+    def deregister(self, tenant: str, name: str) -> None:
+        with self._lock:
+            prev = self._store.pop((tenant, name), None)
+            if prev is not None:
+                self._retire_locked(prev)
+
+    def _retire_locked(self, spec: AdapterSpec) -> None:
+        spec.stale = True
+        if spec.page is not None and spec.refs == 0:
+            self._evict_locked(spec)
+
+    def _fold(self, weights, scaling):
+        folded: Dict[str, Dict[str, np.ndarray]] = {}
+        if not weights:
+            raise ValueError("adapter has no LoRA-bearing projections")
+        for proj, ab in sorted(weights.items()):
+            a = np.asarray(ab["A"], dtype=np.float32)
+            b = np.asarray(ab["B"], dtype=np.float32)
+            if a.ndim != 3 or b.ndim != 3:
+                raise ValueError(
+                    f"{proj}: expected stacked (layers, in, r)/(layers,"
+                    f" r, out) arrays, got {a.shape} / {b.shape}")
+            ra = a.shape[-1]
+            if ra != b.shape[1] or a.shape[0] != b.shape[0]:
+                raise ValueError(f"{proj}: A {a.shape} and B {b.shape} "
+                                 "disagree on rank or layer count")
+            if ra > self.r:
+                raise ValueError(
+                    f"{proj}: adapter rank {ra} exceeds arena rank "
+                    f"{self.r}")
+            if ra < self.r:  # zero-pad to arena rank — exact
+                a = np.concatenate(
+                    [a, np.zeros(a.shape[:2] + (self.r - ra,),
+                                 np.float32)], axis=-1)
+                b = np.concatenate(
+                    [b, np.zeros((b.shape[0], self.r - ra, b.shape[2]),
+                                 np.float32)], axis=1)
+            folded[proj] = {"A": a, "B": b * np.float32(scaling)}
+        return folded
+
+    # -- residency ---------------------------------------------------
+
+    def get(self, tenant: str, name: str) -> AdapterSpec:
+        with self._lock:
+            spec = self._store.get((tenant, name))
+            if spec is None:
+                raise KeyError(f"unknown adapter {tenant}/{name}")
+            return spec
+
+    def has(self, tenant: str, name: str) -> bool:
+        with self._lock:
+            return (tenant, name) in self._store
+
+    def resident(self, tenant: str, name: str) -> bool:
+        """True when the latest version is already on an arena page —
+        the router's adapter-affinity signal."""
+        with self._lock:
+            spec = self._store.get((tenant, name))
+            return spec is not None and spec.page is not None
+
+    def ensure_resident(self, tenant: str, name: str) -> AdapterSpec:
+        """Give the latest (tenant, name) an arena page, evicting an
+        idle LRU page if needed.  Raises :class:`AdapterArenaFull`
+        when every page is pinned by in-flight requests."""
+        with self._lock:
+            spec = self.get(tenant, name)
+            if spec.page is None:
+                self._load_locked(spec)
+            return spec
+
+    def can_load(self) -> bool:
+        """True when :meth:`ensure_resident` of a non-resident adapter
+        would succeed right now: a free page exists, or an idle
+        (refs == 0) resident can be evicted.  The engine's admission
+        gate defers adapter requests while this is False instead of
+        letting admission hit :class:`AdapterArenaFull`."""
+        with self._lock:
+            return bool(self._free) \
+                or self._lru_idle_locked() is not None
+
+    def acquire(self, tenant: str, name: str) -> AdapterSpec:
+        """Admission-side pin: make resident and take a reference."""
+        with self._lock:
+            spec = self.ensure_resident(tenant, name)
+            spec.refs += 1
+            spec.last_use = self._clock()
+            return spec
+
+    def release(self, spec: AdapterSpec) -> None:
+        with self._lock:
+            spec.refs = max(0, spec.refs - 1)
+            spec.last_use = self._clock()
+            if spec.stale and spec.refs == 0 and spec.page is not None:
+                self._evict_locked(spec)
+
+    def _load_locked(self, spec: AdapterSpec) -> None:
+        if self._free:
+            page = min(self._free)
+            self._free.discard(page)
+        else:
+            victim = self._lru_idle_locked()
+            if victim is None:
+                raise AdapterArenaFull(
+                    f"all {self.max_adapters - 1} adapter pages are "
+                    "pinned by in-flight requests")
+            page = victim.page
+            self._evict_locked(victim)
+            self._free.discard(page)
+        spec.page = page
+        spec.last_use = self._clock()
+        self._resident[page] = spec
+        telemetry.get_registry().counter(
+            "adapter_loads_total", "adapter arena page loads").inc()
+        flight_record("adapter_load", tenant=spec.tenant,
+                      name=spec.name, version=spec.version,
+                      uid=spec.uid, page=page)
+        if self.on_page_write is not None:
+            self.on_page_write(page, spec)
+
+    def _lru_idle_locked(self) -> Optional[AdapterSpec]:
+        idle = [s for s in self._resident.values() if s.refs == 0]
+        if not idle:
+            return None
+        return min(idle, key=lambda s: s.last_use)
+
+    def _evict_locked(self, spec: AdapterSpec) -> None:
+        page = spec.page
+        if page is None:
+            return
+        self._resident.pop(page, None)
+        self._free.add(page)
+        spec.page = None
+        telemetry.get_registry().counter(
+            "adapter_evictions_total", "adapter arena page evictions").inc()
+        flight_record("adapter_evict", tenant=spec.tenant,
+                      name=spec.name, version=spec.version,
+                      uid=spec.uid, page=page)
+        if self.on_page_write is not None:
+            self.on_page_write(page, None)
+
+    # -- cache-compat tags -------------------------------------------
+
+    def kv_tag(self, spec: Optional[AdapterSpec]) -> int:
+        """The adapter id that written-KV spans carry for prefix/spill
+        compatibility.  0 = base-compatible."""
+        if spec is None:
+            return 0
+        if not spec.attention_targeting and self.mlp_shares_base_prefix:
+            return 0
+        return spec.uid
+
+    # -- introspection ----------------------------------------------
+
+    @property
+    def pages_in_use(self) -> int:
+        with self._lock:
+            return len(self._resident)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "adapters": len(self._store),
+                "pages_in_use": len(self._resident),
+                "pages_free": len(self._free),
+                "pages_total": self.max_adapters - 1,
+                "pinned": sum(1 for s in self._resident.values()
+                              if s.refs > 0),
+            }
+
+
+# -- per-tenant QoS ---------------------------------------------------
+
+@dataclasses.dataclass
+class TenantPolicy:
+    """Admission policy for one tenant.  ``rate`` is a token-bucket
+    refill in requests/second (None = unlimited) with depth ``burst``
+    (defaults to max(1, ceil(rate))); ``max_slots`` caps concurrently
+    admitted decode slots."""
+    rate: Optional[float] = None
+    burst: Optional[int] = None
+    max_slots: Optional[int] = None
+
+    def bucket_depth(self) -> float:
+        if self.burst is not None:
+            return float(max(1, self.burst))
+        if self.rate is not None:
+            return float(max(1.0, float(np.ceil(self.rate))))
+        return float("inf")
+
+
+class TenantQoS:
+    """Token-bucket rate limits + concurrent-slot caps per tenant,
+    checked at admission on the deficit scheduler.  Tenants without a
+    policy (and the anonymous base tenant) are unlimited."""
+
+    def __init__(self, policies: Optional[Dict[str, TenantPolicy]] = None,
+                 *, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._policies: Dict[str, TenantPolicy] = dict(policies or {})
+        # tenant -> [tokens, last_refill_ts, active_slots]
+        self._state: Dict[str, list] = {}
+
+    def configure(self, tenant: str, *, rate: Optional[float] = None,
+                  burst: Optional[int] = None,
+                  max_slots: Optional[int] = None) -> None:
+        with self._lock:
+            self._policies[tenant] = TenantPolicy(
+                rate=rate, burst=burst, max_slots=max_slots)
+            self._state.pop(tenant, None)
+
+    def policy(self, tenant: Optional[str]) -> Optional[TenantPolicy]:
+        if tenant is None:
+            return None
+        with self._lock:
+            return self._policies.get(tenant)
+
+    def _bucket_locked(self, tenant: str, pol: TenantPolicy) -> list:
+        st = self._state.get(tenant)
+        now = self._clock()
+        if st is None:
+            st = self._state[tenant] = [pol.bucket_depth(), now, 0]
+        elif pol.rate is not None:
+            depth = pol.bucket_depth()
+            st[0] = min(depth, st[0] + (now - st[1]) * pol.rate)
+            st[1] = now
+        return st
+
+    def check(self, tenant: Optional[str]) -> Optional[str]:
+        """None when the tenant may admit one more request now, else
+        the throttle reason ("rate" | "slots").  Does not consume."""
+        pol = self.policy(tenant)
+        if pol is None:
+            return None
+        with self._lock:
+            st = self._bucket_locked(tenant, pol)
+            if pol.max_slots is not None and st[2] >= pol.max_slots:
+                return "slots"
+            if pol.rate is not None and st[0] < 1.0:
+                return "rate"
+            return None
+
+    def on_admit(self, tenant: Optional[str]) -> None:
+        pol = self.policy(tenant)
+        if pol is None:
+            return
+        with self._lock:
+            st = self._bucket_locked(tenant, pol)
+            if pol.rate is not None:
+                st[0] = max(0.0, st[0] - 1.0)
+            st[2] += 1
+
+    def on_finish(self, tenant: Optional[str]) -> None:
+        pol = self.policy(tenant)
+        if pol is None:
+            return
+        with self._lock:
+            st = self._bucket_locked(tenant, pol)
+            st[2] = max(0, st[2] - 1)
+
+    def active_slots(self, tenant: str) -> int:
+        with self._lock:
+            st = self._state.get(tenant)
+            return 0 if st is None else int(st[2])
+
+
+class TenantPlane:
+    """The facade a :class:`~hetu_tpu.serving.engine.ServingEngine`
+    mounts when tenancy is on: adapter registry + QoS + ledgers."""
+
+    def __init__(self, registry: Optional[AdapterRegistry] = None,
+                 qos: Optional[TenantQoS] = None, *,
+                 max_adapters: int = 8, r: int = 8,
+                 mlp_shares_base_prefix: bool = True):
+        self.registry = registry if registry is not None else \
+            AdapterRegistry(max_adapters=max_adapters, r=r,
+                            mlp_shares_base_prefix=mlp_shares_base_prefix)
+        self.qos = qos if qos is not None else TenantQoS()
+
+    @property
+    def max_adapters(self) -> int:
+        return self.registry.max_adapters
+
+    @property
+    def r(self) -> int:
+        return self.registry.r
+
+
+# -- adapter extraction / dist-ckpt transport -------------------------
+
+def lora_scaling(model) -> float:
+    """alpha/r of the first LoRA layer in ``model`` (the scaling
+    :func:`~hetu_tpu.peft.lora.merge_lora` applies)."""
+    from ..peft.lora import _first_lora_scaling
+    return _first_lora_scaling(model)
+
+
+def extract_adapter(params, *, task_id: int = 0):
+    """Pull one task's stacked A/B pages out of a
+    ``wrap_params_for_lora``-shaped param tree.
+
+    Returns projection → ``{"A": (L, in, r), "B": (L, r, out)}`` host
+    arrays, ready for :meth:`AdapterRegistry.register` (pass the
+    model's ``lora_scaling`` so merge parity holds).
+    """
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    blocks = params.get("blocks", {})
+    for group in ("attn", "mlp"):
+        sub = blocks.get(group)
+        if not isinstance(sub, dict):
+            continue
+        for proj, node in sub.items():
+            if not (isinstance(node, dict) and "lora_A" in node):
+                continue
+            a = np.asarray(node["lora_A"], dtype=np.float32)
+            b = np.asarray(node["lora_B"], dtype=np.float32)
+            if a.ndim == 4:  # (layers, tasks, in, r)
+                a, b = a[:, task_id], b[:, task_id]
+            elif a.ndim == 3:  # unstacked (tasks, in, r): single layer
+                a, b = a[None, task_id], b[None, task_id]
+            out[proj] = {"A": a, "B": b}
+    if not out:
+        raise ValueError("params carry no lora_A/lora_B leaves — "
+                         "inject_lora + wrap_params_for_lora first")
+    return out
+
+
+class _AdapterTreeModel:
+    """Duck model for :func:`load_params_distributed`: exposes the
+    saved adapter's abstract structure from the sidecar manifest."""
+
+    def __init__(self, manifest: dict):
+        self._m = manifest
+
+    def abstract_params(self):
+        import jax
+        return {
+            proj: {k: jax.ShapeDtypeStruct(tuple(v["shape"]),
+                                           np.dtype(v["dtype"]))
+                   for k, v in sorted(ab.items())}
+            for proj, ab in sorted(self._m["projections"].items())
+        }
+
+
+def save_adapter_distributed(path: str, weights, *, version: int = 1,
+                             scaling: float = 1.0) -> str:
+    """Persist an adapter over the dist-ckpt transport (same sharded
+    piece layout the base weight push uses) plus a tiny manifest so
+    the loader needs no model."""
+    from ..utils.dist_checkpoint import save_params_distributed
+    tree = {proj: {"A": np.asarray(ab["A"], np.float32),
+                   "B": np.asarray(ab["B"], np.float32)}
+            for proj, ab in sorted(weights.items())}
+    save_params_distributed(path, tree, version=version).wait()
+    manifest = {
+        "version": int(version),
+        "scaling": float(scaling),
+        "projections": {
+            proj: {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                   for k, v in ab.items()}
+            for proj, ab in tree.items()},
+    }
+    tmp = os.path.join(path, _ADAPTER_MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(path, _ADAPTER_MANIFEST))
+    return path
+
+
+def load_adapter_distributed(path: str):
+    """Load an adapter saved by :func:`save_adapter_distributed`.
+    Returns ``(weights, version, scaling)``."""
+    from ..utils.dist_checkpoint import load_params_distributed
+    with open(os.path.join(path, _ADAPTER_MANIFEST)) as f:
+        manifest = json.load(f)
+    tree = load_params_distributed(path, _AdapterTreeModel(manifest))
+    weights = {proj: {"A": np.asarray(ab["A"]),
+                      "B": np.asarray(ab["B"])}
+               for proj, ab in tree.items()}
+    return weights, int(manifest["version"]), float(manifest["scaling"])

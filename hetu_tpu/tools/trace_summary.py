@@ -687,6 +687,79 @@ def fleet_plane_summary(records: list[dict]) -> Optional[list[str]]:
     return lines or None
 
 
+#: tenant-plane series (serving/tenancy.py + the engine's adapter
+#: arena): per-tenant request/throttle flow, adapter page pressure and
+#: load/evict churn — the direct evidence the multi-tenant QoS gate and
+#: the LRU arena are (or are not) isolating tenants (docs/SERVING.md
+#: "Multi-tenant adapters").
+_TENANT_PLANE_SERIES = (
+    "tenant_requests_total", "tenant_throttled_total",
+    "adapter_loads_total", "adapter_evictions_total",
+    "adapter_pages_in_use", "adapter_pushes_total",
+)
+
+
+def tenant_plane_summary(records: list[dict]) -> Optional[list[str]]:
+    """Lines for the multi-tenant adapter section, or None when no
+    snapshot carries tenant/adapter series. Reads the LAST snapshot
+    (counters are cumulative, gauges last-write-wins)."""
+    snap: Optional[dict] = None
+    for rec in records:
+        cand = rec.get("metrics") if rec.get("kind") == "metrics_snapshot" \
+            else rec.get("telemetry")
+        if isinstance(cand, dict) and any(
+                k.split("{")[0] in _TENANT_PLANE_SERIES for k in cand):
+            snap = cand
+    if snap is None:
+        return None
+    reqs: dict[str, float] = {}
+    throttled: dict[str, float] = {}
+    vals: dict[str, float] = {}
+    for series, v in snap.items():
+        base = series.split("{")[0]
+        if base not in _TENANT_PLANE_SERIES \
+                or not isinstance(v, (int, float)):
+            continue
+        if base == "tenant_requests_total":
+            m = re.search(r'tenant="([^"]*)"', series)
+            t = m.group(1) if m else "?"
+            reqs[t] = reqs.get(t, 0.0) + v
+        elif base == "tenant_throttled_total":
+            m = re.search(r'tenant="([^"]*)"', series)
+            t = m.group(1) if m else "?"
+            throttled[t] = throttled.get(t, 0.0) + v
+        else:
+            vals[base] = vals.get(base, 0.0) + v
+    lines = []
+    width = 18
+    if reqs:
+        total = sum(reqs.values())
+        parts = " / ".join(f"{t}:{int(v)}"
+                           for t, v in sorted(reqs.items()))
+        lines.append("tenant requests".ljust(width)
+                     + f"{int(total)} ({parts})")
+    if throttled:
+        parts = " / ".join(f"{t}:{int(v)}"
+                           for t, v in sorted(throttled.items()))
+        lines.append("throttled".ljust(width)
+                     + f"{int(sum(throttled.values()))} ({parts})")
+    loads = vals.get("adapter_loads_total", 0.0)
+    evs = vals.get("adapter_evictions_total", 0.0)
+    if loads or evs:
+        lines.append("adapter churn".ljust(width)
+                     + f"{int(loads)} page loads / {int(evs)} "
+                     f"evictions")
+    if "adapter_pages_in_use" in vals:
+        lines.append("arena pages".ljust(width)
+                     + f"{int(vals['adapter_pages_in_use'])} in use "
+                     f"(last sample)")
+    if vals.get("adapter_pushes_total"):
+        lines.append("adapter pushes".ljust(width)
+                     + f"{int(vals['adapter_pushes_total'])} fleet-wide"
+                     f" (no drain)")
+    return lines or None
+
+
 #: recovery-plane series (chaos harness + elastic supervisor +
 #: incremental checkpointing): the direct evidence the preemption plane
 #: detects kills, recovers fast, and that checkpoint cadence is no
@@ -874,6 +947,12 @@ def summarize(path: str, *, wall_s: Optional[float] = None,
         parts.append("")
         parts.append("== fleet plane ==")
         parts.extend(fl)
+
+    tn = tenant_plane_summary(records)
+    if tn:
+        parts.append("")
+        parts.append("== tenant plane ==")
+        parts.extend(tn)
 
     rp = recovery_plane_summary(records)
     if rp:

@@ -341,6 +341,43 @@ def test_bench_fleet_emits_json_contract():
         assert json.load(f) == rec
 
 
+@pytest.mark.slow
+def test_bench_tenants_emits_json_contract():
+    """SATELLITE (ISSUE 20): ``python bench.py --tenants`` must exit 0
+    and write BENCH_tenants.json: mixed-tenant decode throughput vs the
+    base engine (TPOT overhead of the batched-LoRA lane), adapter
+    hot-swap latency under a live request trickle with nothing
+    rejected, and the noisy-neighbor isolation lane where the bulk
+    tenant's slot cap actually throttles."""
+    env = dict(os.environ)
+    env["HETU_TPU_BENCH_PLATFORM"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--tenants"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "tenants", "rank", "base",
+                "mixed", "tpot_overhead", "adapter_swap", "isolation"):
+        assert key in rec, (key, rec)
+    assert rec["base"]["tokens_per_sec"] > 0
+    assert rec["mixed"]["tokens_per_sec"] > 0
+    assert rec["tpot_overhead"] > 0
+    # hot-swap lane: every push landed, and the live trickle kept
+    # flowing — a version push never rejects an in-flight tenant
+    swap = rec["adapter_swap"]
+    assert swap["pushes"] >= 1 and swap["p50_ms"] > 0
+    assert swap["trickle_completed"] == swap["trickle_submitted"]
+    assert swap["trickle_rejected"] == 0
+    # isolation lane: the bulk flood was really throttled by its slot
+    # cap, yet every bulk request still completed (deferred, not shed)
+    iso = rec["isolation"]
+    assert iso["alone_p50_ms"] > 0 and iso["noisy_p50_ms"] > 0
+    assert iso["bulk_completed"] == iso["bulk_offered"]
+    assert iso["bulk_throttled_events"] >= 1
+    with open(os.path.join(_ROOT, "BENCH_tenants.json")) as f:
+        assert json.load(f) == rec
+
+
 def test_graft_entry_fn_runs():
     import jax
     sys.path.insert(0, _ROOT)

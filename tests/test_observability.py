@@ -643,12 +643,13 @@ def test_serving_loop_watchdog_trips_on_stalled_step(telem, tmp_path):
     R = eng._fin_cap
     hang = threading.Event()
 
-    def fake_fn(params, caches, ctl, pf, bt, cow, spec, wq):
+    def fake_fn(params, caches, ctl, pf, bt, cow, spec, wq, lora):
         if hang.is_set():
             time.sleep(1.2)          # the stalled fake step
-        # the 8-operand/7-result contract (ISSUE 17 sampled verify
-        # lane): committed tokens (S, K+1) + per-slot commit counts +
-        # prefill first tokens + pos/last_tok/key carries
+        # the 9-operand/7-result contract (ISSUE 17 sampled verify
+        # lane + ISSUE 20 adapter arena): committed tokens (S, K+1) +
+        # per-slot commit counts + prefill first tokens +
+        # pos/last_tok/key carries
         return (caches, np.zeros((S, 1), np.int32),
                 np.ones(S, np.int32), np.zeros(R, np.int32),
                 ctl["pos"], ctl["last_tok"], ctl["key"])

@@ -1,0 +1,525 @@
+"""Multi-tenant adapter serving plane (ISSUE 20).
+
+Quick tier: host-side units — the refcounted-LRU adapter registry,
+the token-bucket / slot-cap QoS gate, adapter-tagged prefix and spill
+compatibility, and the save/load adapter transport.
+
+Slow tier: engine acceptance — a mixed-tenant batch's greedy tokens
+identical to per-tenant ``merge_lora`` one-shot generation (adapter
+id 0 bitwise base, incl. the int8 KV arena), the one-compile audit
+across adapter load/evict/version churn, hot-swap under live traffic
+with version continuity, and the pinned-arena wait path.
+"""
+
+import numpy as np
+import pytest
+
+from hetu_tpu import telemetry
+from hetu_tpu.serving.tenancy import (
+    AdapterArenaFull, AdapterRegistry, TenantPlane, TenantQoS,
+)
+
+MAX_LEN = 32
+CHUNK = 8
+
+
+def _w(r=2, layers=2, d=8, projs=("q_proj",)):
+    return {p: {"A": np.ones((layers, d, r), np.float32),
+                "B": np.ones((layers, r, d), np.float32)}
+            for p in projs}
+
+
+# -- registry: refcounted LRU over arena pages ------------------------
+
+
+def test_registry_lru_with_refcounts():
+    clock = [0.0]
+    reg = AdapterRegistry(max_adapters=3, r=4,
+                          clock=lambda: clock[0])  # 2 usable pages
+    writes = []
+    reg.on_page_write = lambda page, spec: writes.append(
+        (page, None if spec is None else spec.uid))
+    reg.register("a", "x", _w())
+    reg.register("b", "x", _w())
+    reg.register("c", "x", _w())
+
+    sa = reg.acquire("a", "x")
+    clock[0] = 1.0
+    sb = reg.acquire("b", "x")
+    assert {sa.page, sb.page} == {1, 2}
+    assert reg.pages_in_use == 2 and not reg.can_load()
+    # every page pinned: a third tenant's load must refuse, not thrash
+    with pytest.raises(AdapterArenaFull):
+        reg.ensure_resident("c", "x")
+
+    # release the LRU pin → c evicts a (oldest idle), not b
+    reg.release(sa)
+    clock[0] = 2.0
+    assert reg.can_load()
+    sc = reg.acquire("c", "x")
+    assert sc.page == 1 and sa.page is None
+    assert reg.resident("c", "x") and not reg.resident("a", "x")
+    # the engine saw every page rewrite: a, b, a-evict, c
+    assert writes == [(1, sa.uid), (2, sb.uid), (1, None), (1, sc.uid)]
+    reg.release(sb), reg.release(sc)
+
+
+def test_registry_version_push_fresh_uid_and_stale_drain():
+    reg = AdapterRegistry(max_adapters=4, r=4)
+    v1 = reg.register("t", "x", _w())
+    pinned = reg.acquire("t", "x")
+    assert pinned is v1 and v1.page is not None
+    v2 = reg.register("t", "x", _w())
+    # fresh uid + version: stale KV can never alias the new weights
+    assert v2.uid != v1.uid and v2.version == v1.version + 1
+    assert v1.stale and not v2.stale
+    # the pinned old page survives until its last in-flight ref drops
+    assert v1.page is not None
+    reg.release(v1)
+    assert v1.page is None
+    assert reg.acquire("t", "x") is v2
+
+
+def test_kv_tag_mlp_only_shares_base_prefix():
+    reg = AdapterRegistry(max_adapters=4, r=4)
+    attn = reg.register("t", "attn", _w(projs=("q_proj", "fc_in")))
+    mlp = reg.register("t", "mlp", _w(projs=("fc_in", "gate_proj")))
+    assert reg.kv_tag(None) == 0
+    assert reg.kv_tag(attn) == attn.uid     # attention KV is adapter-own
+    assert reg.kv_tag(mlp) == 0             # MLP-only shares base KV
+    strict = AdapterRegistry(max_adapters=4, r=4,
+                             mlp_shares_base_prefix=False)
+    mlp2 = strict.register("t", "mlp", _w(projs=("fc_in",)))
+    assert strict.kv_tag(mlp2) == mlp2.uid
+
+
+def test_registry_rank_pad_and_scaling_fold():
+    reg = AdapterRegistry(max_adapters=4, r=4)
+    spec = reg.register("t", "x", _w(r=2), scaling=3.0)
+    a, b = spec.weights["q_proj"]["A"], spec.weights["q_proj"]["B"]
+    assert a.shape[-1] == 4 and b.shape[1] == 4   # padded to arena rank
+    np.testing.assert_allclose(b[:, :2], 3.0)     # scaling folded into B
+    np.testing.assert_allclose(a[..., 2:], 0.0)   # pad rows exactly zero
+    np.testing.assert_allclose(b[:, 2:], 0.0)
+    with pytest.raises(ValueError):
+        reg.register("t", "big", _w(r=5))         # rank over the arena
+
+
+# -- QoS: token bucket + slot caps ------------------------------------
+
+
+def test_token_bucket_rate_limit():
+    clock = [0.0]
+    qos = TenantQoS(clock=lambda: clock[0])
+    qos.configure("t", rate=2.0, burst=2)
+    assert qos.check("t") is None
+    qos.on_admit("t"), qos.on_admit("t")          # burst spent
+    assert qos.check("t") == "rate"
+    clock[0] = 0.5                                # refills 1 token
+    assert qos.check("t") is None
+    qos.on_admit("t")
+    assert qos.check("t") == "rate"
+    clock[0] = 10.0                               # refill clamps at burst
+    qos.on_admit("t"), qos.on_admit("t")
+    assert qos.check("t") == "rate"
+    # other tenants (and the anonymous base tenant) are unlimited
+    assert qos.check("other") is None and qos.check(None) is None
+
+
+def test_slot_cap_and_release():
+    qos = TenantQoS()
+    qos.configure("t", max_slots=2)
+    qos.on_admit("t"), qos.on_admit("t")
+    assert qos.active_slots("t") == 2
+    assert qos.check("t") == "slots"
+    qos.on_finish("t")
+    assert qos.check("t") is None
+    qos.on_finish("t"), qos.on_finish("t")        # over-release clamps
+    assert qos.active_slots("t") == 0
+
+
+# -- adapter-tagged KV compatibility ----------------------------------
+
+
+def test_prefix_cache_refuses_cross_adapter_hit():
+    """REGRESSION: a base prefix must never satisfy an adapter request
+    (or vice versa) — attention adapters change what the cached KV
+    means, so the trie filters children by adapter id."""
+    from hetu_tpu.serving.kv_pool import BlockManager
+    from hetu_tpu.serving.prefix_cache import PrefixCache
+
+    mgr = BlockManager(10)
+    cache = PrefixCache(4, mgr)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    b1, b2 = mgr.alloc(), mgr.alloc()
+    cache.insert(toks, [b1, b2], adapter=7)
+    mgr.release(b1), mgr.release(b2)
+
+    assert cache.match(toks, adapter=7) == ([b1, b2], None)
+    # the stale cross-adapter hit is REFUSED, whole-block and tail both
+    assert cache.match(toks) == ([], None)
+    assert cache.match(toks, adapter=8) == ([], None)
+    assert cache.match(toks[:6] + [99], adapter=7) == ([b1], (b2, 2))
+    assert cache.match(toks[:6] + [99]) == ([], None)
+
+    # base spans interleave in the same trie without cross-talk
+    b3, b4 = mgr.alloc(), mgr.alloc()
+    cache.insert(toks, [b3, b4])
+    mgr.release(b3), mgr.release(b4)
+    assert cache.match(toks) == ([b3, b4], None)
+    assert cache.match(toks, adapter=7) == ([b1, b2], None)
+
+    # a version push flushes exactly the dead uid's spans
+    assert cache.flush_adapter(0) == 0            # base never flushes
+    assert cache.flush_adapter(7) == 2
+    assert cache.match(toks, adapter=7) == ([], None)
+    assert cache.match(toks) == ([b3, b4], None)
+    assert mgr.refs[b1] == 0 and mgr.refs[b2] == 0
+
+
+def test_spill_entry_refuses_cross_adapter_resume():
+    import dataclasses
+
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+    from hetu_tpu.serving import KVPool
+    from hetu_tpu.serving.kv_pool import SpillEntry
+
+    model = GPTLMHeadModel(GPTConfig.tiny())
+    pool = KVPool(model, slots=2, max_len=MAX_LEN, block_size=8)
+    data = tuple(np.zeros((c.shape[0], 1) + tuple(c.shape[2:]),
+                          np.asarray(c).dtype) for c in pool.caches)
+    entry = SpillEntry(req_id=0, data=data, n_blocks=1, block_size=8,
+                       pos=4, last_tok=1, tokens=[1], weight_version=0,
+                       adapter=7)
+    assert entry.compatible_with(pool, 0, adapter=7)
+    assert not entry.compatible_with(pool, 0)             # base resume
+    assert not entry.compatible_with(pool, 0, adapter=8)  # reloaded uid
+    base = dataclasses.replace(entry, adapter=0)
+    assert base.compatible_with(pool, 0)
+
+
+def test_adapter_save_load_roundtrip(tmp_path):
+    from hetu_tpu.serving.tenancy import (
+        load_adapter_distributed, save_adapter_distributed,
+    )
+
+    w = {"q_proj": {"A": np.arange(32, dtype=np.float32).reshape(2, 8, 2),
+                    "B": np.ones((2, 2, 8), np.float32)}}
+    path = str(tmp_path / "acme-fr-v3")
+    save_adapter_distributed(path, w, version=3, scaling=1.5)
+    got, version, scaling = load_adapter_distributed(path)
+    assert version == 3 and scaling == 1.5
+    assert sorted(got) == ["q_proj"]
+    np.testing.assert_array_equal(got["q_proj"]["A"], w["q_proj"]["A"])
+    np.testing.assert_array_equal(got["q_proj"]["B"], w["q_proj"]["B"])
+
+
+def test_arena_sizing_is_priced():
+    from hetu_tpu.engine.memory import size_adapter_arena
+    from hetu_tpu.models import GPTConfig
+
+    cfg = GPTConfig.tiny()
+    small = size_adapter_arena(cfg, r=4, max_adapters=4)
+    big = size_adapter_arena(cfg, r=8, max_adapters=8)
+    assert 0 < small < big
+
+
+# -- engine acceptance (slow tier) ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tenant_setup():
+    """Tiny GPT + an attention-targeting LoRA adapter with a REAL
+    (randomized) B so the adapter output differs from base, plus its
+    merged-weight oracle params."""
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+    from hetu_tpu.peft.lora import (
+        LoraConfig, inject_lora, merge_lora, wrap_params_for_lora,
+    )
+    from hetu_tpu.serving.tenancy import extract_adapter, lora_scaling
+
+    cfg = GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    params = model.init(jax.random.key(0), dtype=jnp.float32)
+
+    lmodel = GPTLMHeadModel(cfg)
+    inject_lora(lmodel, LoraConfig(
+        r=4, alpha=8.0, target_patterns=(r"\.(q_proj|v_proj)$",)))
+    lp = wrap_params_for_lora(lmodel, jax.tree.map(jnp.copy, params),
+                              jax.random.key(1))
+
+    def randomize_b(p, key):
+        if isinstance(p, dict):
+            out = {}
+            for k, v in p.items():
+                key, sub = jax.random.split(key)
+                out[k] = 0.02 * jax.random.normal(sub, v.shape, v.dtype) \
+                    if k == "lora_B" else randomize_b(v, sub)
+            return out
+        return p
+
+    lp = randomize_b(lp, jax.random.key(7))
+    weights = extract_adapter(lp, task_id=0)
+    scale = lora_scaling(lmodel)
+    merged = merge_lora(lmodel, lp, task_id=0)
+    return cfg, model, params, weights, scale, merged
+
+
+def _gen(model, params, prompt, max_tokens, **kw):
+    import jax.numpy as jnp
+
+    from hetu_tpu.models import generate
+    out = generate(model, params, jnp.asarray(prompt, jnp.int32)[None],
+                   max_new_tokens=max_tokens, max_len=MAX_LEN, **kw)
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+@pytest.mark.slow
+def test_mixed_tenant_batch_matches_merged_oracle(tenant_setup):
+    """ACCEPTANCE: a mixed-tenant decode batch — base and adapter
+    requests sharing slots — is greedy-token-identical to per-tenant
+    one-shot generation (``merge_lora`` weights for adapter requests,
+    the plain params for base), and the whole churn — adapter load,
+    hot-swap version push, second tenant, evict — replays ONE compiled
+    step."""
+    from hetu_tpu.engine import trace_counts
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params, weights, scale, merged = tenant_setup
+    plane = TenantPlane(max_adapters=4, r=4)
+    eng = ServingEngine(model, params, slots=3, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, tenancy=plane)
+    info = eng.load_adapter("acme", "fr", weights, scaling=scale)
+    assert info["page"] >= 1 and info["version"] == 1
+    traces0 = trace_counts().get("serving_step", 0)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, (L,)).tolist()
+               for L in (5, 7, 4, 9)]
+    sps = [SamplingParams(max_tokens=6),
+           SamplingParams(max_tokens=6, tenant="acme", adapter="fr"),
+           SamplingParams(max_tokens=6, tenant="acme", adapter="fr"),
+           SamplingParams(max_tokens=6)]
+    reqs = [eng.submit(p, s) for p, s in zip(prompts, sps)]
+    eng.run_until_drained()
+    for p, sp, req in zip(prompts, sps, reqs):
+        oracle = merged if sp.adapter else params
+        assert req.tokens == _gen(model, oracle, p, 6), \
+            ("adapter" if sp.adapter else "base", req.tokens)
+
+    # churn: version push + a second tenant + more mixed traffic —
+    # the trace counter must stay at the single initial compile
+    w2 = {k: {"A": np.asarray(v["A"]) * 1.5, "B": np.asarray(v["B"])}
+          for k, v in weights.items()}
+    eng.load_adapter("acme", "fr", w2, scaling=scale)
+    eng.load_adapter("beta", "de", weights, scaling=scale)
+    r_beta = eng.submit(prompts[1], SamplingParams(
+        max_tokens=6, tenant="beta", adapter="de"))
+    r_base = eng.submit(prompts[0], SamplingParams(max_tokens=6))
+    eng.run_until_drained()
+    assert r_beta.tokens == _gen(model, merged, prompts[1], 6)
+    assert r_base.tokens == _gen(model, params, prompts[0], 6)
+    assert trace_counts().get("serving_step", 0) - traces0 == 1, \
+        "adapter churn re-traced the fused step"
+    eng.evict_adapter("beta", "de")
+    assert plane.registry.stats()["adapters"] == 1
+
+
+@pytest.mark.slow
+def test_int8_arena_mixed_tenant_parity(tenant_setup):
+    """The quantized KV arena composes with the adapter lane: adapter
+    requests match one-shot int8-cache generation under merged weights,
+    base requests under the plain params, in one mixed batch."""
+    import jax.numpy as jnp
+
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params, weights, scale, merged = tenant_setup
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, cache_dtype=jnp.int8,
+                        tenancy=TenantPlane(max_adapters=3, r=4))
+    assert eng.pool.quantized
+    eng.load_adapter("acme", "fr", weights, scaling=scale)
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(1, cfg.vocab_size, (L,)).tolist()
+               for L in (5, 11, 3)]
+    sps = [SamplingParams(max_tokens=5, tenant="acme", adapter="fr"),
+           SamplingParams(max_tokens=5),
+           SamplingParams(max_tokens=5, tenant="acme", adapter="fr")]
+    reqs = [eng.submit(p, s) for p, s in zip(prompts, sps)]
+    eng.run_until_drained()
+    for p, sp, req in zip(prompts, sps, reqs):
+        oracle = merged if sp.adapter else params
+        assert req.tokens == _gen(model, oracle, p, 5,
+                                  cache_dtype=jnp.int8)
+
+
+@pytest.mark.slow
+def test_hot_swap_version_continuity_under_live_traffic(tenant_setup):
+    """A version push under live traffic: the in-flight request pinning
+    the old page finishes under the OLD weights, the next request
+    decodes under the new — no drain, no retrace, no torn decode."""
+    from hetu_tpu.engine import trace_counts
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params, weights, scale, merged = tenant_setup
+    plane = TenantPlane(max_adapters=4, r=4)
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, tenancy=plane)
+    eng.load_adapter("acme", "fr", weights, scaling=scale)
+    uid_v1 = plane.registry.get("acme", "fr").uid
+    traces0 = trace_counts().get("serving_step", 0)
+
+    rng = np.random.default_rng(4)
+    p_old = rng.integers(1, cfg.vocab_size, (6,)).tolist()
+    r_old = eng.submit(p_old, SamplingParams(
+        max_tokens=6, tenant="acme", adapter="fr"))
+    while r_old.status == "queued":           # admitted → page pinned
+        assert eng.step()
+    assert r_old.adapter_ref is not None \
+        and r_old.adapter_ref.uid == uid_v1
+
+    # push v2 (zero B = base-equal) while r_old is mid-flight
+    w2 = {k: {"A": np.asarray(v["A"]),
+              "B": np.zeros_like(np.asarray(v["B"]))}
+          for k, v in weights.items()}
+    info = eng.load_adapter("acme", "fr", w2, scaling=scale)
+    assert info["version"] == 2 and info["uid"] != uid_v1
+
+    p_new = rng.integers(1, cfg.vocab_size, (5,)).tolist()
+    r_new = eng.submit(p_new, SamplingParams(
+        max_tokens=6, tenant="acme", adapter="fr"))
+    eng.run_until_drained()
+    # version continuity: old request = v1 weights, new request = v2
+    assert r_old.tokens == _gen(model, merged, p_old, 6)
+    assert r_new.tokens == _gen(model, params, p_new, 6)
+    assert trace_counts().get("serving_step", 0) - traces0 == 1
+    # the stale v1 page drained with its last ref
+    assert plane.registry.stats()["pages_in_use"] == 1
+
+
+@pytest.mark.slow
+def test_arena_full_of_pinned_pages_waits_loudly(tenant_setup):
+    """When every arena page is pinned by in-flight requests, a new
+    tenant's request WAITS at admission (with an ``adapter_wait``
+    flight event) and admits once a page drains — it is never rejected
+    and never thrashes a pinned page."""
+    from hetu_tpu.telemetry.flight import get_flight_recorder
+
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params, weights, scale, merged = tenant_setup
+    plane = TenantPlane(max_adapters=2, r=4)       # ONE adapter page
+    eng = ServingEngine(model, params, slots=2, max_len=MAX_LEN,
+                        prefill_chunk=CHUNK, tenancy=plane)
+    eng.load_adapter("a", "x", weights, scaling=scale)
+    eng.load_adapter("b", "x", weights, scaling=scale)
+    assert plane.registry.resident("a", "x") \
+        or plane.registry.resident("b", "x")
+
+    rng = np.random.default_rng(6)
+    pa = rng.integers(1, cfg.vocab_size, (5,)).tolist()
+    pb = rng.integers(1, cfg.vocab_size, (7,)).tolist()
+    get_flight_recorder().clear()
+    ra = eng.submit(pa, SamplingParams(max_tokens=8, tenant="a",
+                                       adapter="x"))
+    rb = eng.submit(pb, SamplingParams(max_tokens=4, tenant="b",
+                                       adapter="x"))
+    eng.run_until_drained()
+    assert ra.status == "done" and rb.status == "done"
+    assert ra.tokens == _gen(model, merged, pa, 8)
+    assert rb.tokens == _gen(model, merged, pb, 4)
+    waits = [e for e in get_flight_recorder().events()
+             if e["event"] == "adapter_wait"]
+    assert waits and waits[0]["tenant"] == "b"
+
+
+@pytest.mark.slow
+def test_qos_throttle_counters_and_flights(tenant_setup):
+    """The QoS gate throttles a capped tenant (slots and rate), counts
+    it once per episode with the right labels, and still completes
+    every request."""
+    from hetu_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, model, params, weights, scale, merged = tenant_setup
+    telemetry.enable(True)
+    try:
+        plane = TenantPlane(max_adapters=3, r=4)
+        eng = ServingEngine(model, params, slots=3, max_len=MAX_LEN,
+                            prefill_chunk=CHUNK, tenancy=plane)
+        plane.qos.configure("slow", max_slots=1)
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(1, cfg.vocab_size, (4,)).tolist()
+                   for _ in range(3)]
+        reqs = [eng.submit(p, SamplingParams(max_tokens=4,
+                                             tenant="slow"))
+                for p in prompts]
+        eng.run_until_drained()
+        assert all(r.status == "done" for r in reqs)
+        reg = telemetry.get_registry()
+        assert reg.counter("tenant_throttled_total").value(
+            tenant="slow", reason="slots") >= 1
+        assert reg.counter("tenant_requests_total").value(
+            tenant="slow") == 3
+    finally:
+        telemetry.enable(False)
+
+
+@pytest.mark.slow
+def test_router_adapter_affinity_and_fleet_push(tenant_setup):
+    """Fleet plane: the router prefers the replica whose arena holds
+    the request's adapter (reason "adapter"), and
+    ``WeightPublisher.publish_adapter`` pushes a tenant's adapter to
+    every replica without a drain."""
+    from hetu_tpu.serving import (
+        Router, SamplingParams, ServingEngine, WeightPublisher,
+    )
+
+    cfg, model, params, weights, scale, merged = tenant_setup
+    telemetry.enable(True)
+    router = Router(poll_s=0.001)
+    try:
+        engines = {}
+        for name in ("r0", "r1"):
+            engines[name] = ServingEngine(
+                model, params, slots=2, max_len=MAX_LEN,
+                prefill_chunk=CHUNK,
+                tenancy=TenantPlane(max_adapters=3, r=4))
+            router.register(name, engines[name])
+        # load the adapter on ONE replica only: dispatch must stick to
+        # it for the tenant's requests while the fleet is balanced
+        engines["r1"].load_adapter("acme", "fr", weights, scaling=scale)
+
+        rng = np.random.default_rng(5)
+        sp = SamplingParams(max_tokens=4, tenant="acme", adapter="fr")
+        outs = []
+        for _ in range(3):
+            p = rng.integers(1, cfg.vocab_size, (5,)).tolist()
+            r = router.submit(p, sp)
+            assert r.done.wait(120.0)
+            assert r.status == "done", r.error
+            outs.append((p, list(r.tokens)))
+            assert r.replica == "r1", "adapter affinity ignored"
+        for p, toks in outs:
+            assert toks == _gen(model, merged, p, 4)
+        reg = telemetry.get_registry()
+        assert reg.counter("router_dispatch_reason_total").value(
+            reason="adapter") >= 3
+
+        # fleet-wide push: now BOTH replicas hold it, no drain involved
+        pub = WeightPublisher(router)
+        rep = pub.publish_adapter("acme", "fr", weights, scaling=scale)
+        assert [x["replica"] for x in rep["replicas"]] == ["r0", "r1"]
+        assert all("uid" in x for x in rep["replicas"])
+        for eng in engines.values():
+            assert eng.tenancy.registry.resident("acme", "fr")
+        # and the fleet-wide evict drops it everywhere
+        pub.evict_adapter("acme", "fr")
+        for eng in engines.values():
+            assert not eng.tenancy.registry.has("acme", "fr")
+    finally:
+        router.stop()
+        telemetry.enable(False)
